@@ -1,0 +1,11 @@
+"""kpw_tpu — TPU-native streaming Kafka→Parquet writer framework.
+
+Built from scratch (JAX/XLA/Pallas for the encode path, C++ for host codecs)
+with the capability surface of the reference Java library
+``sahabpardaz/kafka-parquet-writer`` (see SURVEY.md): smart-commit Kafka
+consumption with at-least-once delivery, multi-worker parquet writing with
+size/time rotation and atomic tmp→rename publish, and a pluggable
+EncoderBackend (CPU numpy reference vs vmapped TPU kernels).
+"""
+
+__version__ = "0.1.0"

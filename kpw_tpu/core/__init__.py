@@ -1,0 +1,18 @@
+"""parquet-core: from-scratch Parquet format layer (thrift, encodings, pages,
+file writer) — SURVEY.md §7 step 1."""
+
+from .schema import (  # noqa: F401
+    Codec,
+    ColumnDescriptor,
+    ConvertedType,
+    Encoding,
+    Field,
+    PhysicalType,
+    Repetition,
+    Schema,
+    group,
+    leaf,
+    list_of,
+)
+from .writer import ColumnBatch, ParquetFileWriter, WriterProperties, columns_from_arrays  # noqa: F401
+from .pages import ColumnChunkData, CpuChunkEncoder, EncoderOptions  # noqa: F401

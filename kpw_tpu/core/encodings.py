@@ -1,0 +1,304 @@
+"""CPU reference encoders for parquet pages (numpy-vectorized).
+
+This is build-plan step 1 (SURVEY.md §7): the encodings parquet-mr applies
+under the reference's single ``writer.write(record)`` funnel
+(ParquetFile.java:59-62) — PLAIN, RLE/bit-pack hybrid, dictionary,
+DELTA_BINARY_PACKED, DELTA_LENGTH_BYTE_ARRAY — reimplemented from the format
+spec.  These are both the default CPU backend and the correctness oracle for
+the TPU kernels in ``kpw_tpu.ops``.
+"""
+
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+from .schema import PhysicalType
+
+
+def bit_width(max_value: int) -> int:
+    return int(max_value).bit_length()
+
+
+# ---------------------------------------------------------------------------
+# bit-packing (parquet RLE/bit-pack hybrid ordering: value bit j lands at
+# overall bit position i*width + j; bytes are LSB-first)
+# ---------------------------------------------------------------------------
+
+def bitpack(values: np.ndarray, width: int) -> bytes:
+    """Pack ``values`` (< 2**width) into parquet LSB-first bit layout."""
+    if width == 0 or len(values) == 0:
+        return b""
+    v = np.ascontiguousarray(values, dtype=np.uint64)
+    bits = ((v[:, None] >> np.arange(width, dtype=np.uint64)) & 1).astype(np.uint8)
+    flat = bits.reshape(-1)
+    pad = (-flat.size) % 8
+    if pad:
+        flat = np.concatenate([flat, np.zeros(pad, np.uint8)])
+    weights = (1 << np.arange(8, dtype=np.uint16)).astype(np.uint16)
+    out = (flat.reshape(-1, 8) * weights).sum(axis=1).astype(np.uint8)
+    return out.tobytes()
+
+
+def bitunpack(data: bytes, width: int, count: int) -> np.ndarray:
+    """Inverse of :func:`bitpack` (tests / readback)."""
+    if width == 0:
+        return np.zeros(count, np.uint64)
+    raw = np.frombuffer(data, np.uint8)
+    bits = ((raw[:, None] >> np.arange(8, dtype=np.uint8)) & 1).reshape(-1)
+    need = count * width
+    bits = bits[:need].reshape(count, width).astype(np.uint64)
+    return (bits << np.arange(width, dtype=np.uint64)).sum(axis=1)
+
+
+# ---------------------------------------------------------------------------
+# RLE / bit-pack hybrid
+# ---------------------------------------------------------------------------
+
+def _runs(values: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Return (run_values, run_lengths)."""
+    n = len(values)
+    if n == 0:
+        return values, np.zeros(0, np.int64)
+    change = np.nonzero(np.diff(values))[0] + 1
+    starts = np.concatenate([[0], change])
+    lengths = np.diff(np.concatenate([starts, [n]]))
+    return values[starts], lengths
+
+
+def _rle_run(value: int, count: int, width: int) -> bytes:
+    nbytes = (width + 7) // 8
+    from .thrift import varint_bytes
+
+    return varint_bytes(count << 1) + int(value).to_bytes(nbytes, "little")
+
+
+def _bitpack_run(values: np.ndarray, width: int) -> bytes:
+    """values are padded here to a multiple of 8; count = #groups."""
+    from .thrift import varint_bytes
+
+    pad = (-len(values)) % 8
+    if pad:
+        values = np.concatenate([values, np.zeros(pad, values.dtype)])
+    groups = len(values) // 8
+    return varint_bytes((groups << 1) | 1) + bitpack(values, width)
+
+
+def rle_hybrid_encode(values: np.ndarray, width: int) -> bytes:
+    """Parquet RLE/bit-pack hybrid: long runs -> RLE, the rest -> 8-value
+    bit-packed groups (mid-stream bit-pack runs must cover exact multiples of
+    8 values; only the final group may be padded)."""
+    n = len(values)
+    if n == 0:
+        return b""
+    if width == 0:
+        # all values are zero-width (single possible value): one RLE run
+        from .thrift import varint_bytes
+
+        return varint_bytes(n << 1)
+    values = np.ascontiguousarray(values, dtype=np.uint64)
+    run_vals, run_lens = _runs(values)
+    # Fast path: few long runs => pure bit-packing (valid hybrid stream).
+    long_mask = run_lens >= 8
+    if not long_mask.any() or run_lens[long_mask].sum() < max(8, n // 10):
+        return _bitpack_run(values, width)
+
+    out = bytearray()
+    buf: list[np.ndarray] = []
+    buf_len = 0
+
+    def flush_buf() -> None:
+        nonlocal buf, buf_len
+        if buf_len:
+            out.extend(_bitpack_run(np.concatenate(buf), width))
+            buf = []
+            buf_len = 0
+
+    for rv, rl in zip(run_vals.tolist(), run_lens.tolist()):
+        if buf_len % 8:
+            take = min((-buf_len) % 8, rl)
+            buf.append(np.full(take, rv, np.uint64))
+            buf_len += take
+            rl -= take
+        if rl >= 8:
+            flush_buf()
+            out.extend(_rle_run(rv, rl, width))
+            rl = 0
+        if rl:
+            buf.append(np.full(rl, rv, np.uint64))
+            buf_len += rl
+    flush_buf()
+    return bytes(out)
+
+
+def rle_hybrid_decode(data: bytes, width: int, count: int) -> np.ndarray:
+    """Decoder for tests."""
+    out = np.zeros(count, np.uint64)
+    pos = 0
+    idx = 0
+    nbytes = (width + 7) // 8
+    while idx < count:
+        header = 0
+        shift = 0
+        while True:
+            b = data[pos]
+            pos += 1
+            header |= (b & 0x7F) << shift
+            if not b & 0x80:
+                break
+            shift += 7
+        if header & 1:  # bit-packed run
+            groups = header >> 1
+            nvals = groups * 8
+            nb = (nvals * width + 7) // 8
+            vals = bitunpack(data[pos : pos + nb], width, nvals)
+            pos += nb
+            take = min(nvals, count - idx)
+            out[idx : idx + take] = vals[:take]
+            idx += take
+        else:  # RLE run
+            run_len = header >> 1
+            value = int.from_bytes(data[pos : pos + nbytes], "little")
+            pos += nbytes
+            take = min(run_len, count - idx)
+            out[idx : idx + take] = value
+            idx += take
+    return out
+
+
+def rle_levels_v1(levels: np.ndarray, max_level: int) -> bytes:
+    """Definition/repetition levels for data page v1: RLE-hybrid stream with a
+    4-byte little-endian length prefix."""
+    body = rle_hybrid_encode(levels, bit_width(max_level))
+    return struct.pack("<I", len(body)) + body
+
+
+# ---------------------------------------------------------------------------
+# PLAIN encoding per physical type
+# ---------------------------------------------------------------------------
+
+_PLAIN_DTYPES = {
+    PhysicalType.INT32: np.dtype("<i4"),
+    PhysicalType.INT64: np.dtype("<i8"),
+    PhysicalType.FLOAT: np.dtype("<f4"),
+    PhysicalType.DOUBLE: np.dtype("<f8"),
+}
+
+
+def plain_encode(values, physical_type: int) -> bytes:
+    """PLAIN-encode values.  ``values`` is an ndarray for fixed-width types,
+    or a list/array of ``bytes`` for BYTE_ARRAY."""
+    if physical_type == PhysicalType.BOOLEAN:
+        return bitpack(np.asarray(values, np.uint8), 1)
+    if physical_type == PhysicalType.BYTE_ARRAY:
+        return byte_array_plain_encode(values)
+    if physical_type == PhysicalType.FIXED_LEN_BYTE_ARRAY:
+        return b"".join(values)
+    dtype = _PLAIN_DTYPES[physical_type]
+    return np.ascontiguousarray(values, dtype=dtype).tobytes()
+
+
+def byte_array_plain_encode(values) -> bytes:
+    """BYTE_ARRAY PLAIN: 4-byte LE length + raw bytes per value."""
+    if len(values) == 0:
+        return b""
+    return b"".join(struct.pack("<I", len(v)) + v for v in values)
+
+
+# ---------------------------------------------------------------------------
+# Dictionary encoding
+# ---------------------------------------------------------------------------
+
+def dictionary_build(values, physical_type: int):
+    """Return (dictionary_values, indices:np.uint32).  Order = first-occurrence
+    to keep the encoder streaming-friendly and deterministic."""
+    if physical_type == PhysicalType.BYTE_ARRAY or physical_type == PhysicalType.FIXED_LEN_BYTE_ARRAY:
+        table: dict[bytes, int] = {}
+        idx = np.empty(len(values), np.uint32)
+        for i, v in enumerate(values):
+            slot = table.get(v)
+            if slot is None:
+                slot = len(table)
+                table[v] = slot
+            idx[i] = slot
+        return list(table.keys()), idx
+    arr = np.asarray(values)
+    uniq, first_pos, inv = np.unique(arr, return_index=True, return_inverse=True)
+    # reorder to first-occurrence order for determinism across backends
+    order = np.argsort(first_pos, kind="stable")
+    uniq = uniq[order]
+    remap = np.empty_like(order)
+    remap[order] = np.arange(len(order))
+    return uniq, remap[inv].astype(np.uint32)
+
+
+def dictionary_indices_encode(indices: np.ndarray, dict_size: int) -> bytes:
+    """Data-page body for PLAIN_DICTIONARY/RLE_DICTIONARY: 1-byte bit width
+    followed by the RLE-hybrid stream of indices."""
+    width = bit_width(max(dict_size - 1, 0))
+    return bytes([width]) + rle_hybrid_encode(indices, width)
+
+
+# ---------------------------------------------------------------------------
+# DELTA_BINARY_PACKED (ints) — parquet delta encoding
+# ---------------------------------------------------------------------------
+
+_DELTA_BLOCK = 128
+_DELTA_MINIBLOCKS = 4
+_DELTA_MB_SIZE = _DELTA_BLOCK // _DELTA_MINIBLOCKS  # 32
+
+
+def delta_binary_packed_encode(values: np.ndarray) -> bytes:
+    """DELTA_BINARY_PACKED per the spec: header (block size, miniblock count,
+    total count, zigzag first value) then per-block min-delta + per-miniblock
+    bit widths + packed deltas."""
+    from .thrift import varint_bytes, zigzag
+
+    v = np.asarray(values, np.int64)
+    n = len(v)
+    out = bytearray()
+    out += varint_bytes(_DELTA_BLOCK)
+    out += varint_bytes(_DELTA_MINIBLOCKS)
+    out += varint_bytes(n)
+    if n == 0:
+        out += varint_bytes(0)
+        return bytes(out)
+    out += varint_bytes(zigzag(int(v[0])))
+    if n == 1:
+        return bytes(out)
+    deltas = np.diff(v.astype(np.object_))  # object to avoid int64 overflow on diff
+    deltas = np.array([int(d) for d in deltas], dtype=np.object_)
+    pos = 0
+    while pos < len(deltas):
+        block = deltas[pos : pos + _DELTA_BLOCK]
+        pos += _DELTA_BLOCK
+        min_delta = int(min(block))
+        out += varint_bytes(zigzag(min_delta))
+        rel = np.array([int(d) - min_delta for d in block], dtype=np.uint64)
+        widths = []
+        packed_parts = []
+        for mb in range(_DELTA_MINIBLOCKS):
+            seg = rel[mb * _DELTA_MB_SIZE : (mb + 1) * _DELTA_MB_SIZE]
+            if len(seg) == 0:
+                widths.append(0)
+                packed_parts.append(b"")
+                continue
+            w = bit_width(int(seg.max()))
+            widths.append(w)
+            if w:
+                full = np.zeros(_DELTA_MB_SIZE, np.uint64)
+                full[: len(seg)] = seg
+                packed_parts.append(bitpack(full, w))
+            else:
+                packed_parts.append(b"")
+        out += bytes(widths)
+        for p in packed_parts:
+            out += p
+    return bytes(out)
+
+
+def delta_length_byte_array_encode(values) -> bytes:
+    """DELTA_LENGTH_BYTE_ARRAY: delta-packed lengths then concatenated bytes."""
+    lens = np.fromiter((len(v) for v in values), np.int64, count=len(values))
+    return delta_binary_packed_encode(lens) + b"".join(values)

@@ -1,0 +1,254 @@
+"""Thrift-compact serializers for parquet footer/page-header structs.
+
+Field ids follow parquet-format's parquet.thrift.  The reference never sees
+these bytes (parquet-mr owns them); we write them directly so the whole file
+format is under this framework's control.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from .schema import PhysicalType, Repetition  # noqa: F401  (re-export convenience)
+from .thrift import CT_BINARY, CT_I32, CT_I64, CT_STRUCT, CompactWriter
+
+CREATED_BY = "kpw_tpu version 0.1.0 (build tpu-native)"
+
+
+@dataclass
+class Statistics:
+    null_count: int | None = None
+    distinct_count: int | None = None
+    min_value: bytes | None = None
+    max_value: bytes | None = None
+
+    def write(self, w: CompactWriter) -> None:
+        w.struct_begin()
+        if self.null_count is not None:
+            w.field_i64(3, self.null_count)
+        if self.distinct_count is not None:
+            w.field_i64(4, self.distinct_count)
+        if self.max_value is not None:
+            w.field_binary(5, self.max_value)
+        if self.min_value is not None:
+            w.field_binary(6, self.min_value)
+        w.struct_end()
+
+
+@dataclass
+class DataPageHeader:
+    num_values: int
+    encoding: int
+    definition_level_encoding: int
+    repetition_level_encoding: int
+    statistics: Statistics | None = None
+
+    def write(self, w: CompactWriter) -> None:
+        w.struct_begin()
+        w.field_i32(1, self.num_values)
+        w.field_i32(2, self.encoding)
+        w.field_i32(3, self.definition_level_encoding)
+        w.field_i32(4, self.repetition_level_encoding)
+        if self.statistics is not None:
+            w._field_header(5, CT_STRUCT)
+            self.statistics.write(w)
+        w.struct_end()
+
+
+@dataclass
+class DataPageHeaderV2:
+    num_values: int
+    num_nulls: int
+    num_rows: int
+    encoding: int
+    definition_levels_byte_length: int
+    repetition_levels_byte_length: int
+    is_compressed: bool = True
+
+    def write(self, w: CompactWriter) -> None:
+        w.struct_begin()
+        w.field_i32(1, self.num_values)
+        w.field_i32(2, self.num_nulls)
+        w.field_i32(3, self.num_rows)
+        w.field_i32(4, self.encoding)
+        w.field_i32(5, self.definition_levels_byte_length)
+        w.field_i32(6, self.repetition_levels_byte_length)
+        if not self.is_compressed:
+            w.field_bool(7, False)
+        w.struct_end()
+
+
+@dataclass
+class DictionaryPageHeader:
+    num_values: int
+    encoding: int
+
+    def write(self, w: CompactWriter) -> None:
+        w.struct_begin()
+        w.field_i32(1, self.num_values)
+        w.field_i32(2, self.encoding)
+        w.struct_end()
+
+
+def write_page_header(
+    page_type: int,
+    uncompressed_size: int,
+    compressed_size: int,
+    data_header: DataPageHeader | None = None,
+    dict_header: DictionaryPageHeader | None = None,
+    v2_header: DataPageHeaderV2 | None = None,
+    crc: int | None = None,
+) -> bytes:
+    w = CompactWriter()
+    w.struct_begin()
+    w.field_i32(1, page_type)
+    w.field_i32(2, uncompressed_size)
+    w.field_i32(3, compressed_size)
+    if crc is not None:
+        w.field_i32(4, crc)
+    if data_header is not None:
+        w._field_header(5, CT_STRUCT)
+        data_header.write(w)
+    if dict_header is not None:
+        w._field_header(7, CT_STRUCT)
+        dict_header.write(w)
+    if v2_header is not None:
+        w._field_header(8, CT_STRUCT)
+        v2_header.write(w)
+    w.struct_end()
+    return w.getvalue()
+
+
+@dataclass
+class ColumnMetaData:
+    type: int
+    encodings: list[int]
+    path_in_schema: list[str]
+    codec: int
+    num_values: int
+    total_uncompressed_size: int
+    total_compressed_size: int
+    data_page_offset: int
+    dictionary_page_offset: int | None = None
+    statistics: Statistics | None = None
+
+    def write(self, w: CompactWriter) -> None:
+        w.struct_begin()
+        w.field_i32(1, self.type)
+        w.field_list_begin(2, CT_I32, len(self.encodings))
+        for e in self.encodings:
+            w.list_i32(e)
+        w.field_list_begin(3, CT_BINARY, len(self.path_in_schema))
+        for p in self.path_in_schema:
+            w.list_binary(p.encode("utf-8"))
+        w.field_i32(4, self.codec)
+        w.field_i64(5, self.num_values)
+        w.field_i64(6, self.total_uncompressed_size)
+        w.field_i64(7, self.total_compressed_size)
+        w.field_i64(9, self.data_page_offset)
+        if self.dictionary_page_offset is not None:
+            w.field_i64(11, self.dictionary_page_offset)
+        if self.statistics is not None:
+            w._field_header(12, CT_STRUCT)
+            self.statistics.write(w)
+        w.struct_end()
+
+
+@dataclass
+class ColumnChunk:
+    file_offset: int
+    meta_data: ColumnMetaData
+
+    def write(self, w: CompactWriter) -> None:
+        w.struct_begin()
+        w.field_i64(2, self.file_offset)
+        w._field_header(3, CT_STRUCT)
+        self.meta_data.write(w)
+        w.struct_end()
+
+
+@dataclass
+class RowGroup:
+    columns: list[ColumnChunk]
+    total_byte_size: int
+    num_rows: int
+    file_offset: int | None = None
+    total_compressed_size: int | None = None
+    ordinal: int | None = None
+
+    def write(self, w: CompactWriter) -> None:
+        w.struct_begin()
+        w.field_list_begin(1, CT_STRUCT, len(self.columns))
+        for c in self.columns:
+            c.write(w)
+        w.field_i64(2, self.total_byte_size)
+        w.field_i64(3, self.num_rows)
+        if self.file_offset is not None:
+            w.field_i64(5, self.file_offset)
+        if self.total_compressed_size is not None:
+            w.field_i64(6, self.total_compressed_size)
+        if self.ordinal is not None:
+            w.field_i16(7, self.ordinal)
+        w.struct_end()
+
+
+def _write_schema_element(w: CompactWriter, f) -> None:
+    """f: kpw_tpu.core.schema.Field"""
+    w.struct_begin()
+    if f.is_leaf:
+        w.field_i32(1, f.physical_type)
+        if f.type_length is not None:
+            w.field_i32(2, f.type_length)
+    # root has no repetition in common practice unless set
+    if f.repetition is not None:
+        w.field_i32(3, f.repetition)
+    w.field_string(4, f.name)
+    if not f.is_leaf and f.children:
+        w.field_i32(5, len(f.children))
+    if f.converted_type is not None:
+        w.field_i32(6, f.converted_type)
+    if f.field_id is not None:
+        w.field_i32(9, f.field_id)
+    w.struct_end()
+
+
+@dataclass
+class FileMetaData:
+    schema_fields: list  # flattened Fields, root first
+    num_rows: int
+    row_groups: list[RowGroup]
+    key_value_metadata: list[tuple[str, str]] = field(default_factory=list)
+    created_by: str = CREATED_BY
+    version: int = 1
+
+    def serialize(self) -> bytes:
+        w = CompactWriter()
+        w.struct_begin()
+        w.field_i32(1, self.version)
+        w.field_list_begin(2, CT_STRUCT, len(self.schema_fields))
+        for f in self.schema_fields:
+            _write_schema_element(w, f)
+        w.field_i64(3, self.num_rows)
+        w.field_list_begin(4, CT_STRUCT, len(self.row_groups))
+        for rg in self.row_groups:
+            rg.write(w)
+        if self.key_value_metadata:
+            w.field_list_begin(5, CT_STRUCT, len(self.key_value_metadata))
+            for k, v in self.key_value_metadata:
+                w.struct_begin()
+                w.field_string(1, k)
+                if v is not None:
+                    w.field_string(2, v)
+                w.struct_end()
+        w.field_string(6, self.created_by)
+        # column_orders: TypeDefinedOrder for every leaf — readers only trust
+        # min_value/max_value statistics when this is present
+        num_leaves = sum(1 for f in self.schema_fields if f.is_leaf)
+        w.field_list_begin(7, CT_STRUCT, num_leaves)
+        for _ in range(num_leaves):
+            w.struct_begin()
+            w.field_struct_begin(1)  # TypeDefinedOrder (empty struct)
+            w.struct_end()
+            w.struct_end()
+        w.struct_end()
+        return w.getvalue()

@@ -1,0 +1,201 @@
+"""Parquet schema model (physical types, repetition, nesting, rep/def math).
+
+Replaces what the reference gets for free from parquet-mr's ``MessageType`` +
+``ProtoWriteSupport`` (reference ParquetFile.java:97-99): a tree of fields,
+flattened to the footer's ``SchemaElement`` list, with per-leaf max
+repetition/definition levels computed per the Dremel model.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+# Physical types (parquet.thrift Type)
+class PhysicalType:
+    BOOLEAN = 0
+    INT32 = 1
+    INT64 = 2
+    INT96 = 3
+    FLOAT = 4
+    DOUBLE = 5
+    BYTE_ARRAY = 6
+    FIXED_LEN_BYTE_ARRAY = 7
+
+
+class Repetition:
+    REQUIRED = 0
+    OPTIONAL = 1
+    REPEATED = 2
+
+
+# parquet.thrift ConvertedType
+class ConvertedType:
+    UTF8 = 0
+    MAP = 1
+    MAP_KEY_VALUE = 2
+    LIST = 3
+    ENUM = 4
+    DECIMAL = 5
+    DATE = 6
+    TIME_MILLIS = 7
+    TIME_MICROS = 8
+    TIMESTAMP_MILLIS = 9
+    TIMESTAMP_MICROS = 10
+    UINT_8 = 11
+    UINT_16 = 12
+    UINT_32 = 13
+    UINT_64 = 14
+    INT_8 = 15
+    INT_16 = 16
+    INT_32 = 17
+    INT_64 = 18
+    JSON = 19
+    BSON = 20
+    INTERVAL = 21
+
+
+class Encoding:
+    PLAIN = 0
+    PLAIN_DICTIONARY = 2
+    RLE = 3
+    BIT_PACKED = 4
+    DELTA_BINARY_PACKED = 5
+    DELTA_LENGTH_BYTE_ARRAY = 6
+    DELTA_BYTE_ARRAY = 7
+    RLE_DICTIONARY = 8
+
+
+class Codec:
+    UNCOMPRESSED = 0
+    SNAPPY = 1
+    GZIP = 2
+    BROTLI = 4
+    LZ4 = 5
+    ZSTD = 6
+    LZ4_RAW = 7
+
+
+class PageType:
+    DATA_PAGE = 0
+    INDEX_PAGE = 1
+    DICTIONARY_PAGE = 2
+    DATA_PAGE_V2 = 3
+
+
+@dataclass
+class Field:
+    """One node of the schema tree.  Groups have children; leaves a type."""
+
+    name: str
+    repetition: int = Repetition.REQUIRED
+    physical_type: int | None = None  # None => group
+    converted_type: int | None = None
+    type_length: int | None = None  # for FIXED_LEN_BYTE_ARRAY
+    field_id: int | None = None
+    children: list["Field"] = field(default_factory=list)
+
+    @property
+    def is_leaf(self) -> bool:
+        return self.physical_type is not None
+
+
+@dataclass
+class ColumnDescriptor:
+    """A leaf column with its Dremel levels and dotted path."""
+
+    path: tuple[str, ...]
+    leaf: Field
+    max_def: int
+    max_rep: int
+
+    @property
+    def name(self) -> str:
+        return ".".join(self.path)
+
+
+class Schema:
+    """A rooted parquet schema; computes leaf columns and flattens for footers."""
+
+    def __init__(self, fields: list[Field], name: str = "schema") -> None:
+        self.root = Field(name=name, physical_type=None, children=fields)
+        self.columns: list[ColumnDescriptor] = []
+        self._walk(self.root, (), 0, 0)
+
+    def _walk(self, node: Field, path: tuple[str, ...], max_def: int, max_rep: int) -> None:
+        for child in node.children:
+            d, r = max_def, max_rep
+            if child.repetition == Repetition.OPTIONAL:
+                d += 1
+            elif child.repetition == Repetition.REPEATED:
+                d += 1
+                r += 1
+            cpath = path + (child.name,)
+            if child.is_leaf:
+                self.columns.append(ColumnDescriptor(cpath, child, d, r))
+            else:
+                self._walk(child, cpath, d, r)
+
+    def flatten(self) -> list[Field]:
+        """Footer order: root first, then preorder."""
+        out: list[Field] = []
+
+        def rec(node: Field) -> None:
+            out.append(node)
+            for c in node.children:
+                rec(c)
+
+        rec(self.root)
+        return out
+
+    def column(self, dotted: str) -> ColumnDescriptor:
+        for c in self.columns:
+            if c.name == dotted:
+                return c
+        raise KeyError(dotted)
+
+
+# -- convenience constructors ------------------------------------------------
+
+_PHYS_BY_NAME = {
+    "bool": PhysicalType.BOOLEAN,
+    "boolean": PhysicalType.BOOLEAN,
+    "int32": PhysicalType.INT32,
+    "int64": PhysicalType.INT64,
+    "float": PhysicalType.FLOAT,
+    "float32": PhysicalType.FLOAT,
+    "double": PhysicalType.DOUBLE,
+    "float64": PhysicalType.DOUBLE,
+    "bytes": PhysicalType.BYTE_ARRAY,
+    "string": PhysicalType.BYTE_ARRAY,
+}
+
+
+def leaf(name: str, type_name: str, repetition: int = Repetition.REQUIRED,
+         field_id: int | None = None) -> Field:
+    """Build a leaf field from a short type name ('int64', 'string', ...)."""
+    converted = ConvertedType.UTF8 if type_name == "string" else None
+    return Field(
+        name=name,
+        repetition=repetition,
+        physical_type=_PHYS_BY_NAME[type_name],
+        converted_type=converted,
+        field_id=field_id,
+    )
+
+
+def group(name: str, children: list[Field], repetition: int = Repetition.REQUIRED,
+          converted_type: int | None = None) -> Field:
+    return Field(name=name, repetition=repetition, children=children,
+                 converted_type=converted_type)
+
+
+def list_of(name: str, element: Field, repetition: int = Repetition.OPTIONAL) -> Field:
+    """Standard 3-level LIST layout: name (LIST) -> repeated 'list' -> 'element'."""
+    element.name = "element"
+    return Field(
+        name=name,
+        repetition=repetition,
+        converted_type=ConvertedType.LIST,
+        children=[Field(name="list", repetition=Repetition.REPEATED, children=[element])],
+    )

@@ -1,0 +1,34 @@
+"""Native (C++) host library: codecs and byte-assembly hot paths.
+
+The reference's only native code lives in its codec JNI deps (SURVEY.md §2.2
+"Native-code accounting"); correspondingly this package holds the framework's
+C++: a from-scratch Snappy block codec, a libzstd wrapper, and CRC32C.  Built
+lazily with g++ on first use; all callers must tolerate ``lib() is None`` and
+fall back to pure-python/ctypes paths (kpw_tpu.core.compression).
+"""
+
+from __future__ import annotations
+
+_lib = None
+_tried = False
+
+
+def lib():
+    global _lib, _tried
+    if _tried:
+        return _lib
+    _tried = True
+    try:
+        from .build import load
+
+        _lib = load()
+    except Exception as e:
+        import os
+        import warnings
+
+        if os.environ.get("KPW_TPU_NATIVE_REQUIRE"):
+            raise
+        warnings.warn(f"kpw_tpu native codec library unavailable ({e!r}); "
+                      "falling back to ctypes/python codecs")
+        _lib = None
+    return _lib
