@@ -267,15 +267,19 @@ def delta_binary_packed_encode(values: np.ndarray) -> bytes:
     out += varint_bytes(zigzag(int(v[0])))
     if n == 1:
         return bytes(out)
-    deltas = np.diff(v.astype(np.object_))  # object to avoid int64 overflow on diff
-    deltas = np.array([int(d) for d in deltas], dtype=np.object_)
+    # Deltas are defined with int64 wraparound semantics: readers decode the
+    # zigzag min_delta into a wrapping 64-bit long, so we must produce the
+    # same ring arithmetic (numpy int64 subtraction wraps).
+    with np.errstate(over="ignore"):
+        deltas = v[1:] - v[:-1]
     pos = 0
     while pos < len(deltas):
         block = deltas[pos : pos + _DELTA_BLOCK]
         pos += _DELTA_BLOCK
-        min_delta = int(min(block))
+        min_delta = int(block.min())
         out += varint_bytes(zigzag(min_delta))
-        rel = np.array([int(d) - min_delta for d in block], dtype=np.uint64)
+        with np.errstate(over="ignore"):
+            rel = (block - np.int64(min_delta)).view(np.uint64)
         widths = []
         packed_parts = []
         for mb in range(_DELTA_MINIBLOCKS):

@@ -61,26 +61,6 @@ class ColumnChunkData:
             levels += len(self.rep_levels)
         return data + levels // 4
 
-    def concat(self, other: "ColumnChunkData") -> "ColumnChunkData":
-        if isinstance(self.values, np.ndarray):
-            values = np.concatenate([self.values, other.values])
-        else:
-            values = list(self.values) + list(other.values)
-
-        def cat(a, b):
-            if a is None and b is None:
-                return None
-            return np.concatenate([a, b])
-
-        return ColumnChunkData(
-            column=self.column,
-            values=values,
-            def_levels=cat(self.def_levels, other.def_levels),
-            rep_levels=cat(self.rep_levels, other.rep_levels),
-            num_rows=self.num_rows + other.num_rows,
-        )
-
-
 def _min_max_bytes(values, physical_type: int):
     if len(values) == 0:
         return None, None
@@ -176,12 +156,10 @@ class CpuChunkEncoder:
             dict_values, indices = enc.dictionary_build(chunk.values, pt)
             n_uniq = len(dict_values)
             n = len(indices)
-            dict_plain = enc.plain_encode(dict_values, pt)
-            if (
-                n_uniq <= max(1, int(n * opts.max_dictionary_ratio))
-                and len(dict_plain) <= opts.dictionary_page_size_limit
-            ):
-                use_dict = True
+            if n_uniq <= max(1, int(n * opts.max_dictionary_ratio)):
+                dict_plain = enc.plain_encode(dict_values, pt)
+                if len(dict_plain) <= opts.dictionary_page_size_limit:
+                    use_dict = True
 
         blob = bytearray()
         encodings = set()

@@ -96,20 +96,45 @@ class ParquetFileWriter:
         if self._closed:
             raise ValueError("writer closed")
         if self._pending is None:
-            self._pending = list(batch.chunks)
+            self._pending = [[c] for c in batch.chunks]
         else:
             if len(batch.chunks) != len(self._pending):
                 raise ValueError("batch schema mismatch")
-            self._pending = [a.concat(b) for a, b in zip(self._pending, batch.chunks)]
+            for bucket, chunk in zip(self._pending, batch.chunks):
+                bucket.append(chunk)
         self._pending_rows += batch.num_rows
         self._pending_bytes += batch.estimated_bytes()
         if self._pending_bytes >= self.properties.row_group_size:
             self.flush_row_group()
 
+    @staticmethod
+    def _merge_chunks(parts: list[ColumnChunkData]) -> ColumnChunkData:
+        if len(parts) == 1:
+            return parts[0]
+        first = parts[0]
+        if isinstance(first.values, np.ndarray):
+            values = np.concatenate([p.values for p in parts])
+        else:
+            values = [v for p in parts for v in p.values]
+
+        def cat(attr):
+            arrs = [getattr(p, attr) for p in parts]
+            if arrs[0] is None:
+                return None
+            return np.concatenate(arrs)
+
+        return ColumnChunkData(
+            column=first.column,
+            values=values,
+            def_levels=cat("def_levels"),
+            rep_levels=cat("rep_levels"),
+            num_rows=sum(p.num_rows for p in parts),
+        )
+
     def flush_row_group(self) -> None:
         if not self._pending or self._pending_rows == 0:
             return
-        chunks = self._pending
+        chunks = [self._merge_chunks(parts) for parts in self._pending]
         num_rows = self._pending_rows
         self._pending = None
         self._pending_rows = 0

@@ -1,0 +1,158 @@
+"""Lazy g++ build + ctypes bindings for the native codec library.
+
+Builds ``_kpw_native.so`` from ``src/codecs.cc`` on first use (cached next to
+the source; rebuilt when the source mtime changes).  Falls back to a build
+without zstd if libzstd is unlinkable.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+
+_SRC_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_SRC_DIR, "src", "codecs.cc")
+_SO = os.path.join(_SRC_DIR, "_kpw_native.so")
+
+
+def _build() -> str:
+    if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
+        return _SO
+    base = ["g++", "-O3", "-fPIC", "-shared", "-std=c++17", "-o"]
+    # build into a temp file then atomic-rename (parallel test runners)
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=_SRC_DIR)
+    os.close(fd)
+    try:
+        try:
+            subprocess.run(base + [tmp, _SRC, "-lzstd"], check=True,
+                           capture_output=True)
+        except subprocess.CalledProcessError:
+            subprocess.run(base + [tmp, _SRC, "-DKPW_NO_ZSTD"], check=True,
+                           capture_output=True)
+        os.replace(tmp, _SO)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+    return _SO
+
+
+class NativeLib:
+    """bytes-in/bytes-out wrappers over the C ABI."""
+
+    def __init__(self, cdll: ctypes.CDLL) -> None:
+        self._c = cdll
+        c_sz = ctypes.c_size_t
+        c_p = ctypes.c_char_p
+        cdll.kpw_snappy_max_compressed_length.restype = c_sz
+        cdll.kpw_snappy_max_compressed_length.argtypes = [c_sz]
+        cdll.kpw_snappy_compress.restype = ctypes.c_int
+        cdll.kpw_snappy_compress.argtypes = [c_p, c_sz, c_p, ctypes.POINTER(c_sz)]
+        cdll.kpw_snappy_uncompressed_length.restype = ctypes.c_int
+        cdll.kpw_snappy_uncompressed_length.argtypes = [c_p, c_sz, ctypes.POINTER(c_sz)]
+        cdll.kpw_snappy_uncompress.restype = ctypes.c_int
+        cdll.kpw_snappy_uncompress.argtypes = [c_p, c_sz, c_p, c_sz, ctypes.POINTER(c_sz)]
+        self.has_zstd = hasattr(cdll, "kpw_zstd_compress")
+        if self.has_zstd:
+            cdll.kpw_zstd_max_compressed_length.restype = c_sz
+            cdll.kpw_zstd_max_compressed_length.argtypes = [c_sz]
+            cdll.kpw_zstd_compress.restype = ctypes.c_int
+            cdll.kpw_zstd_compress.argtypes = [c_p, c_sz, c_p, c_sz,
+                                               ctypes.POINTER(c_sz), ctypes.c_int]
+            cdll.kpw_zstd_uncompressed_length.restype = ctypes.c_int
+            cdll.kpw_zstd_uncompressed_length.argtypes = [c_p, c_sz, ctypes.POINTER(c_sz)]
+            cdll.kpw_zstd_uncompress.restype = ctypes.c_int
+            cdll.kpw_zstd_uncompress.argtypes = [c_p, c_sz, c_p, c_sz, ctypes.POINTER(c_sz)]
+        cdll.kpw_crc32c.restype = ctypes.c_uint32
+        cdll.kpw_crc32c.argtypes = [c_p, c_sz, ctypes.c_uint32]
+        cdll.kpw_byte_array_plain.restype = None
+        cdll.kpw_byte_array_plain.argtypes = [
+            c_p, ctypes.POINTER(ctypes.c_int64), c_sz, c_p]
+        cdll.kpw_byte_array_gather.restype = None
+        cdll.kpw_byte_array_gather.argtypes = [
+            c_p, ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int32), c_sz, c_p]
+
+    # -- snappy ------------------------------------------------------------
+    def snappy_compress(self, data: bytes) -> bytes:
+        cap = self._c.kpw_snappy_max_compressed_length(len(data))
+        out = ctypes.create_string_buffer(cap)
+        out_len = ctypes.c_size_t(0)
+        rc = self._c.kpw_snappy_compress(data, len(data), out, ctypes.byref(out_len))
+        if rc != 0:
+            raise RuntimeError(f"kpw_snappy_compress rc={rc}")
+        return out.raw[: out_len.value]
+
+    def snappy_decompress(self, data: bytes) -> bytes:
+        size = ctypes.c_size_t(0)
+        rc = self._c.kpw_snappy_uncompressed_length(data, len(data), ctypes.byref(size))
+        if rc != 0:
+            raise RuntimeError("invalid snappy stream")
+        out = ctypes.create_string_buffer(max(size.value, 1))
+        out_len = ctypes.c_size_t(0)
+        rc = self._c.kpw_snappy_uncompress(data, len(data), out, size.value,
+                                           ctypes.byref(out_len))
+        if rc != 0:
+            raise RuntimeError(f"kpw_snappy_uncompress rc={rc}")
+        return out.raw[: out_len.value]
+
+    # -- zstd --------------------------------------------------------------
+    def zstd_compress(self, data: bytes, level: int = 3) -> bytes | None:
+        if not self.has_zstd:
+            return None
+        cap = self._c.kpw_zstd_max_compressed_length(len(data))
+        out = ctypes.create_string_buffer(cap)
+        out_len = ctypes.c_size_t(0)
+        rc = self._c.kpw_zstd_compress(data, len(data), out, cap,
+                                       ctypes.byref(out_len), level)
+        if rc != 0:
+            raise RuntimeError("zstd compress failed")
+        return out.raw[: out_len.value]
+
+    def zstd_decompress(self, data: bytes) -> bytes | None:
+        if not self.has_zstd:
+            return None
+        size = ctypes.c_size_t(0)
+        rc = self._c.kpw_zstd_uncompressed_length(data, len(data), ctypes.byref(size))
+        if rc != 0:
+            raise RuntimeError("zstd: unknown content size")
+        out = ctypes.create_string_buffer(max(size.value, 1))
+        out_len = ctypes.c_size_t(0)
+        rc = self._c.kpw_zstd_uncompress(data, len(data), out, size.value,
+                                         ctypes.byref(out_len))
+        if rc != 0:
+            raise RuntimeError("zstd decompress failed")
+        return out.raw[: out_len.value]
+
+    # -- misc --------------------------------------------------------------
+    def crc32c(self, data: bytes, crc: int = 0) -> int:
+        return self._c.kpw_crc32c(data, len(data), crc)
+
+    def byte_array_plain(self, data: bytes, offsets) -> bytes:
+        import numpy as np
+
+        offs = np.ascontiguousarray(offsets, np.int64)
+        count = len(offs) - 1
+        total = int(offs[-1] - offs[0]) + 4 * count
+        out = ctypes.create_string_buffer(max(total, 1))
+        self._c.kpw_byte_array_plain(
+            data, offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), count, out)
+        return out.raw[:total]
+
+    def byte_array_gather(self, dict_data: bytes, dict_offsets, indices) -> bytes:
+        import numpy as np
+
+        offs = np.ascontiguousarray(dict_offsets, np.int64)
+        idx = np.ascontiguousarray(indices, np.int32)
+        lens = offs[1:] - offs[:-1]
+        total = int(lens[idx].sum()) + 4 * len(idx)
+        out = ctypes.create_string_buffer(max(total, 1))
+        self._c.kpw_byte_array_gather(
+            dict_data, offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), len(idx), out)
+        return out.raw[:total]
+
+
+def load() -> NativeLib:
+    return NativeLib(ctypes.CDLL(_build()))

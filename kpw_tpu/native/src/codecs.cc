@@ -1,0 +1,416 @@
+// kpw_tpu native host library: page codecs + byte-assembly hot paths.
+//
+// The reference system's only native code is the codec layer reached through
+// parquet-mr (snappy-java JNI, zlib, libhadoop CRC — SURVEY.md §2.2
+// "Native-code accounting").  This file is the rebuild's equivalent:
+//   * Snappy block format compressor/decompressor written from scratch
+//     against the public format description (no snappy source used),
+//   * ZSTD via the system libzstd (zstd.h),
+//   * CRC32C (Castagnoli, table-driven), parquet page checksum polynomial,
+//   * BYTE_ARRAY PLAIN assembly (length-prefix interleaving) for the string
+//     hot path.
+//
+// Exposed as a plain C ABI for ctypes.
+
+#include <cstdint>
+#include <cstring>
+#include <cstdlib>
+
+#ifndef KPW_NO_ZSTD
+#include <zstd.h>
+#endif
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// varint32
+// ---------------------------------------------------------------------------
+
+inline size_t varint_encode(uint32_t v, uint8_t* out) {
+  size_t i = 0;
+  while (v >= 0x80) {
+    out[i++] = static_cast<uint8_t>(v) | 0x80;
+    v >>= 7;
+  }
+  out[i++] = static_cast<uint8_t>(v);
+  return i;
+}
+
+inline int varint_decode(const uint8_t* in, size_t n, uint32_t* v) {
+  uint32_t result = 0;
+  int shift = 0;
+  for (size_t i = 0; i < n && i < 5; i++) {
+    result |= static_cast<uint32_t>(in[i] & 0x7F) << shift;
+    if (!(in[i] & 0x80)) {
+      *v = result;
+      return static_cast<int>(i) + 1;
+    }
+    shift += 7;
+  }
+  return -1;
+}
+
+// ---------------------------------------------------------------------------
+// Snappy block format
+// ---------------------------------------------------------------------------
+
+constexpr size_t kBlockSize = 1 << 16;  // compress in 64 KiB fragments
+constexpr int kHashBits = 14;
+constexpr size_t kHashSize = 1 << kHashBits;
+
+inline uint32_t load32(const uint8_t* p) {
+  uint32_t v;
+  std::memcpy(&v, p, 4);
+  return v;
+}
+
+inline uint64_t load64(const uint8_t* p) {
+  uint64_t v;
+  std::memcpy(&v, p, 8);
+  return v;
+}
+
+inline uint32_t hash4(uint32_t v) {
+  return (v * 0x1e35a7bdu) >> (32 - kHashBits);
+}
+
+// Emit a literal run [lit, lit+len)
+inline uint8_t* emit_literal(uint8_t* op, const uint8_t* lit, size_t len) {
+  if (len == 0) return op;
+  size_t n = len - 1;
+  if (n < 60) {
+    *op++ = static_cast<uint8_t>(n << 2);
+  } else if (n < (1u << 8)) {
+    *op++ = 60 << 2;
+    *op++ = static_cast<uint8_t>(n);
+  } else if (n < (1u << 16)) {
+    *op++ = 61 << 2;
+    *op++ = static_cast<uint8_t>(n);
+    *op++ = static_cast<uint8_t>(n >> 8);
+  } else if (n < (1u << 24)) {
+    *op++ = 62 << 2;
+    *op++ = static_cast<uint8_t>(n);
+    *op++ = static_cast<uint8_t>(n >> 8);
+    *op++ = static_cast<uint8_t>(n >> 16);
+  } else {
+    *op++ = 63 << 2;
+    *op++ = static_cast<uint8_t>(n);
+    *op++ = static_cast<uint8_t>(n >> 8);
+    *op++ = static_cast<uint8_t>(n >> 16);
+    *op++ = static_cast<uint8_t>(n >> 24);
+  }
+  std::memcpy(op, lit, len);
+  return op + len;
+}
+
+// Emit one copy element (len <= 64, offset < 65536)
+inline uint8_t* emit_copy_upto64(uint8_t* op, size_t offset, size_t len) {
+  if (len < 12 && offset < 2048) {
+    // copy with 1-byte offset: tag 01
+    *op++ = static_cast<uint8_t>(((offset >> 8) << 5) | ((len - 4) << 2) | 1);
+    *op++ = static_cast<uint8_t>(offset);
+  } else {
+    // copy with 2-byte offset: tag 10
+    *op++ = static_cast<uint8_t>(((len - 1) << 2) | 2);
+    *op++ = static_cast<uint8_t>(offset);
+    *op++ = static_cast<uint8_t>(offset >> 8);
+  }
+  return op;
+}
+
+inline uint8_t* emit_copy(uint8_t* op, size_t offset, size_t len) {
+  // Long matches: emit 64-byte copies, keep remainder >= 4
+  while (len >= 68) {
+    op = emit_copy_upto64(op, offset, 64);
+    len -= 64;
+  }
+  if (len > 64) {
+    op = emit_copy_upto64(op, offset, 60);
+    len -= 60;
+  }
+  return emit_copy_upto64(op, offset, len);
+}
+
+// Compress one fragment (<= 64 KiB); offsets are fragment-relative.
+uint8_t* compress_fragment(const uint8_t* input, size_t n, uint8_t* op,
+                           uint16_t* table) {
+  std::memset(table, 0, kHashSize * sizeof(uint16_t));
+  const uint8_t* ip = input;
+  const uint8_t* ip_end = input + n;
+  const uint8_t* next_emit = input;
+  if (n >= 15) {
+    const uint8_t* ip_limit = input + n - 15;
+    ip++;  // first byte can never be a match target
+    while (ip < ip_limit) {
+      // find a match, skipping ahead faster the longer we go without one
+      uint32_t skip = 32;
+      const uint8_t* next_ip = ip;
+      const uint8_t* candidate;
+      do {
+        ip = next_ip;
+        uint32_t h = hash4(load32(ip));
+        next_ip = ip + (skip++ >> 5);
+        if (next_ip > ip_limit) goto emit_remainder;
+        candidate = input + table[h];
+        table[h] = static_cast<uint16_t>(ip - input);
+      } while (load32(candidate) != load32(ip) || candidate >= ip);
+
+      op = emit_literal(op, next_emit, ip - next_emit);
+
+      // extend the match and emit copies; chain adjacent matches
+      do {
+        const uint8_t* base = ip;
+        size_t matched = 4;
+        ip += 4;
+        candidate += 4;
+        while (ip + 8 <= ip_end && load64(candidate) == load64(ip)) {
+          ip += 8;
+          candidate += 8;
+          matched += 8;
+        }
+        while (ip < ip_end && *candidate == *ip) {
+          ip++;
+          candidate++;
+          matched++;
+        }
+        op = emit_copy(op, base - (candidate - matched), matched);
+        next_emit = ip;
+        if (ip >= ip_limit) goto emit_remainder;
+        // refresh hash entries around the match end
+        uint32_t cur = load32(ip);
+        table[hash4(load32(ip - 1))] = static_cast<uint16_t>(ip - 1 - input);
+        uint32_t h = hash4(cur);
+        candidate = input + table[h];
+        table[h] = static_cast<uint16_t>(ip - input);
+        if (load32(candidate) != cur || candidate >= ip) break;
+      } while (true);
+      ip++;
+    }
+  }
+emit_remainder:
+  if (next_emit < ip_end) {
+    op = emit_literal(op, next_emit, ip_end - next_emit);
+  }
+  return op;
+}
+
+}  // namespace
+
+extern "C" {
+
+size_t kpw_snappy_max_compressed_length(size_t n) {
+  return 32 + n + n / 6;
+}
+
+int kpw_snappy_compress(const uint8_t* in, size_t n, uint8_t* out,
+                        size_t* out_len) {
+  if (n > 0xFFFFFFFFull) return -1;
+  uint8_t* op = out;
+  op += varint_encode(static_cast<uint32_t>(n), op);
+  uint16_t* table =
+      static_cast<uint16_t*>(std::malloc(kHashSize * sizeof(uint16_t)));
+  if (!table) return -2;
+  for (size_t pos = 0; pos < n; pos += kBlockSize) {
+    size_t frag = n - pos < kBlockSize ? n - pos : kBlockSize;
+    op = compress_fragment(in + pos, frag, op, table);
+  }
+  if (n == 0) {
+    // nothing beyond the length varint
+  }
+  std::free(table);
+  *out_len = static_cast<size_t>(op - out);
+  return 0;
+}
+
+int kpw_snappy_uncompressed_length(const uint8_t* in, size_t n,
+                                   size_t* result) {
+  uint32_t v;
+  int used = varint_decode(in, n, &v);
+  if (used < 0) return -1;
+  *result = v;
+  return 0;
+}
+
+int kpw_snappy_uncompress(const uint8_t* in, size_t n, uint8_t* out,
+                          size_t out_cap, size_t* out_len) {
+  uint32_t total;
+  int used = varint_decode(in, n, &total);
+  if (used < 0 || total > out_cap) return -1;
+  const uint8_t* ip = in + used;
+  const uint8_t* ip_end = in + n;
+  uint8_t* op = out;
+  uint8_t* op_end = out + total;
+  while (ip < ip_end && op < op_end) {
+    uint8_t tag = *ip++;
+    uint32_t entry = tag >> 2;
+    switch (tag & 3) {
+      case 0: {  // literal
+        size_t len;
+        if (entry < 60) {
+          len = entry + 1;
+        } else {
+          size_t extra = entry - 59;  // 1..4 bytes
+          if (ip + extra > ip_end) return -2;
+          uint32_t l = 0;
+          for (size_t i = 0; i < extra; i++) l |= static_cast<uint32_t>(ip[i]) << (8 * i);
+          ip += extra;
+          len = static_cast<size_t>(l) + 1;
+        }
+        if (ip + len > ip_end || op + len > op_end) return -3;
+        std::memcpy(op, ip, len);
+        ip += len;
+        op += len;
+        break;
+      }
+      case 1: {  // copy, 1-byte offset
+        if (ip >= ip_end) return -4;
+        size_t len = ((entry >> 0) & 0x7) + 4;
+        size_t offset = ((entry >> 3) << 8) | *ip++;
+        if (offset == 0 || offset > static_cast<size_t>(op - out) ||
+            op + len > op_end)
+          return -5;
+        const uint8_t* src = op - offset;
+        for (size_t i = 0; i < len; i++) op[i] = src[i];
+        op += len;
+        break;
+      }
+      case 2: {  // copy, 2-byte offset
+        if (ip + 2 > ip_end) return -6;
+        size_t len = entry + 1;
+        size_t offset = ip[0] | (static_cast<size_t>(ip[1]) << 8);
+        ip += 2;
+        if (offset == 0 || offset > static_cast<size_t>(op - out) ||
+            op + len > op_end)
+          return -7;
+        const uint8_t* src = op - offset;
+        for (size_t i = 0; i < len; i++) op[i] = src[i];
+        op += len;
+        break;
+      }
+      case 3: {  // copy, 4-byte offset
+        if (ip + 4 > ip_end) return -8;
+        size_t len = entry + 1;
+        size_t offset = ip[0] | (static_cast<size_t>(ip[1]) << 8) |
+                        (static_cast<size_t>(ip[2]) << 16) |
+                        (static_cast<size_t>(ip[3]) << 24);
+        ip += 4;
+        if (offset == 0 || offset > static_cast<size_t>(op - out) ||
+            op + len > op_end)
+          return -9;
+        const uint8_t* src = op - offset;
+        for (size_t i = 0; i < len; i++) op[i] = src[i];
+        op += len;
+        break;
+      }
+    }
+  }
+  if (op != op_end) return -10;
+  *out_len = total;
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// ZSTD via system libzstd
+// ---------------------------------------------------------------------------
+
+#ifndef KPW_NO_ZSTD
+size_t kpw_zstd_max_compressed_length(size_t n) { return ZSTD_compressBound(n); }
+
+int kpw_zstd_compress(const uint8_t* in, size_t n, uint8_t* out,
+                      size_t out_cap, size_t* out_len, int level) {
+  size_t rc = ZSTD_compress(out, out_cap, in, n, level);
+  if (ZSTD_isError(rc)) return -1;
+  *out_len = rc;
+  return 0;
+}
+
+int kpw_zstd_uncompressed_length(const uint8_t* in, size_t n, size_t* result) {
+  unsigned long long sz = ZSTD_getFrameContentSize(in, n);
+  if (sz == ZSTD_CONTENTSIZE_ERROR || sz == ZSTD_CONTENTSIZE_UNKNOWN) return -1;
+  *result = static_cast<size_t>(sz);
+  return 0;
+}
+
+int kpw_zstd_uncompress(const uint8_t* in, size_t n, uint8_t* out,
+                        size_t out_cap, size_t* out_len) {
+  size_t rc = ZSTD_decompress(out, out_cap, in, n);
+  if (ZSTD_isError(rc)) return -1;
+  *out_len = rc;
+  return 0;
+}
+#endif
+
+// ---------------------------------------------------------------------------
+// CRC32C (Castagnoli), bit-reflected, table-driven
+// ---------------------------------------------------------------------------
+
+static uint32_t crc32c_table[8][256];
+static bool crc32c_init_done = false;
+
+static void crc32c_init() {
+  const uint32_t poly = 0x82F63B78u;  // reflected 0x1EDC6F41
+  for (uint32_t i = 0; i < 256; i++) {
+    uint32_t crc = i;
+    for (int j = 0; j < 8; j++)
+      crc = (crc >> 1) ^ ((crc & 1) ? poly : 0);
+    crc32c_table[0][i] = crc;
+  }
+  for (uint32_t i = 0; i < 256; i++)
+    for (int s = 1; s < 8; s++)
+      crc32c_table[s][i] =
+          (crc32c_table[s - 1][i] >> 8) ^ crc32c_table[0][crc32c_table[s - 1][i] & 0xFF];
+  crc32c_init_done = true;
+}
+
+uint32_t kpw_crc32c(const uint8_t* data, size_t n, uint32_t crc) {
+  if (!crc32c_init_done) crc32c_init();
+  crc = ~crc;
+  while (n >= 8) {
+    crc ^= load32(data);
+    uint32_t hi = load32(data + 4);
+    crc = crc32c_table[7][crc & 0xFF] ^ crc32c_table[6][(crc >> 8) & 0xFF] ^
+          crc32c_table[5][(crc >> 16) & 0xFF] ^ crc32c_table[4][crc >> 24] ^
+          crc32c_table[3][hi & 0xFF] ^ crc32c_table[2][(hi >> 8) & 0xFF] ^
+          crc32c_table[1][(hi >> 16) & 0xFF] ^ crc32c_table[0][hi >> 24];
+    data += 8;
+    n -= 8;
+  }
+  while (n--) crc = (crc >> 8) ^ crc32c_table[0][(crc ^ *data++) & 0xFF];
+  return ~crc;
+}
+
+// ---------------------------------------------------------------------------
+// BYTE_ARRAY PLAIN assembly: interleave 4-byte LE lengths with value bytes.
+// data: concatenated values; offsets: count+1 int64 prefix offsets.
+// out must have (offsets[count]-offsets[0]) + 4*count bytes.
+// ---------------------------------------------------------------------------
+
+void kpw_byte_array_plain(const uint8_t* data, const int64_t* offsets,
+                          size_t count, uint8_t* out) {
+  size_t pos = 0;
+  for (size_t i = 0; i < count; i++) {
+    uint32_t len = static_cast<uint32_t>(offsets[i + 1] - offsets[i]);
+    std::memcpy(out + pos, &len, 4);
+    pos += 4;
+    std::memcpy(out + pos, data + offsets[i], len);
+    pos += len;
+  }
+}
+
+// Gather variable-length dictionary entries by index (host-side string
+// dictionary materialization for the TPU path).
+void kpw_byte_array_gather(const uint8_t* dict_data, const int64_t* dict_offsets,
+                           const int32_t* indices, size_t count, uint8_t* out) {
+  size_t pos = 0;
+  for (size_t i = 0; i < count; i++) {
+    int32_t idx = indices[i];
+    int64_t start = dict_offsets[idx];
+    uint32_t len = static_cast<uint32_t>(dict_offsets[idx + 1] - start);
+    std::memcpy(out + pos, &len, 4);
+    pos += 4;
+    std::memcpy(out + pos, dict_data + start, len);
+    pos += len;
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,127 @@
+"""Native C++ codec library tests: correctness vs independent implementations
+(pyarrow/libsnappy decode our snappy; zstandard decodes our zstd)."""
+
+import ctypes
+import os
+
+import numpy as np
+import pytest
+
+from kpw_tpu import native
+from kpw_tpu.core import compression as comp
+
+
+@pytest.fixture(scope="module")
+def lib():
+    os.environ["KPW_TPU_NATIVE_REQUIRE"] = "1"
+    try:
+        out = native.lib()
+    finally:
+        os.environ.pop("KPW_TPU_NATIVE_REQUIRE", None)
+    assert out is not None, "native library must build in this environment"
+    return out
+
+
+def _corpus():
+    rng = np.random.default_rng(0)
+    return [
+        b"",
+        b"a",
+        b"abcabcabcabcabcabcabcabc" * 100,
+        bytes(rng.integers(0, 256, 100_000, dtype=np.uint8)),  # incompressible
+        bytes(rng.integers(0, 4, 100_000, dtype=np.uint8)),  # low entropy
+        b"\x00" * 1_000_000,
+        bytes(rng.integers(0, 256, 200_000, dtype=np.uint8)) * 3,  # cross-64KiB repeats
+        ("the quick brown fox " * 10_000).encode(),
+    ]
+
+
+def test_snappy_self_roundtrip(lib):
+    for data in _corpus():
+        c = lib.snappy_compress(data)
+        assert lib.snappy_decompress(c) == data
+
+
+def test_snappy_cross_validated_by_system_libsnappy(lib):
+    """Our from-scratch compressor's output must be decodable by the system
+    snappy (and vice versa)."""
+    ct = comp._load_snappy_ctypes()
+    if not ct:
+        pytest.skip("system libsnappy unavailable")
+    for data in _corpus():
+        ours = lib.snappy_compress(data)
+        # system decode of our stream
+        out_len = ctypes.c_size_t(0)
+        assert ct.snappy_uncompressed_length(ours, len(ours), ctypes.byref(out_len)) == 0
+        buf = ctypes.create_string_buffer(max(out_len.value, 1))
+        assert ct.snappy_uncompress(ours, len(ours), buf, ctypes.byref(out_len)) == 0
+        assert buf.raw[: out_len.value] == data
+        # our decode of system stream
+        max_len = ct.snappy_max_compressed_length(len(data))
+        cbuf = ctypes.create_string_buffer(max(max_len, 1))
+        clen = ctypes.c_size_t(max_len)
+        assert ct.snappy_compress(data, len(data), cbuf, ctypes.byref(clen)) == 0
+        assert lib.snappy_decompress(cbuf.raw[: clen.value]) == data
+
+
+def test_snappy_compresses(lib):
+    data = b"abab" * 50_000
+    assert len(lib.snappy_compress(data)) < len(data) // 10
+
+
+def test_zstd_cross_validated(lib):
+    if not lib.has_zstd:
+        pytest.skip("built without zstd")
+    import zstandard
+
+    for data in _corpus():
+        ours = lib.zstd_compress(data)
+        assert zstandard.ZstdDecompressor().decompress(ours) == data
+        theirs = zstandard.ZstdCompressor(level=3).compress(data)
+        assert lib.zstd_decompress(theirs) == data
+
+
+def test_crc32c_known_vectors(lib):
+    # RFC 3720 test vector: 32 bytes of zeros -> 0x8A9136AA
+    assert lib.crc32c(b"\x00" * 32) == 0x8A9136AA
+    assert lib.crc32c(b"123456789") == 0xE3069283
+
+
+def test_byte_array_plain_matches_python(lib):
+    from kpw_tpu.core.encodings import byte_array_plain_encode
+
+    values = [b"alpha", b"", b"x" * 300, b"beta"]
+    data = b"".join(values)
+    offsets = np.cumsum([0] + [len(v) for v in values])
+    assert lib.byte_array_plain(data, offsets) == byte_array_plain_encode(values)
+
+
+def test_byte_array_gather(lib):
+    dict_vals = [b"aa", b"bbbb", b"c"]
+    dict_data = b"".join(dict_vals)
+    dict_offsets = np.cumsum([0] + [len(v) for v in dict_vals])
+    idx = np.array([2, 0, 1, 1, 0], np.int32)
+    want = b"".join(
+        len(dict_vals[i]).to_bytes(4, "little") + dict_vals[i] for i in idx
+    )
+    assert lib.byte_array_gather(dict_data, dict_offsets, idx) == want
+
+
+def test_parquet_file_with_native_snappy(lib, tmp_path):
+    """End to end: page compressed by the native lib, read by pyarrow."""
+    import pyarrow.parquet as pq
+
+    from kpw_tpu.core import Codec, ParquetFileWriter, Schema, WriterProperties
+    from kpw_tpu.core import columns_from_arrays, leaf
+
+    schema = Schema([leaf("a", "int64"), leaf("s", "string")])
+    vals = np.arange(50_000)
+    strs = [f"row-{i % 100}".encode() for i in range(50_000)]
+    path = tmp_path / "native.parquet"
+    with open(path, "wb") as f:
+        w = ParquetFileWriter(f, schema, WriterProperties(codec=Codec.SNAPPY))
+        w.write_batch(columns_from_arrays(schema, {"a": vals, "s": strs}))
+        w.close()
+    t = pq.read_table(path)
+    np.testing.assert_array_equal(t["a"].to_numpy(), vals)
+    assert t["s"].to_pylist()[:3] == ["row-0", "row-1", "row-2"]

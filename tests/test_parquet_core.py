@@ -52,12 +52,63 @@ def test_rle_hybrid_roundtrip_runny():
     assert len(data) < len(vals)
 
 
-def test_delta_binary_packed_pyarrow_none():
-    # decoded by our own decoder once written in a full file (below); here just
-    # smoke-check the header layout is parseable lengths-wise
-    vals = np.array([7, 5, 3, 1, 2, 3, 4, 5], np.int64)
+def _delta_decode(blob, count):
+    """Independent-from-encoder DELTA_BINARY_PACKED decoder (spec-driven)."""
+    pos = 0
+
+    def varint():
+        nonlocal pos
+        out = shift = 0
+        while True:
+            b = blob[pos]; pos += 1
+            out |= (b & 0x7F) << shift
+            if not b & 0x80:
+                return out
+            shift += 7
+
+    def unzig(v):
+        return (v >> 1) ^ -(v & 1)
+
+    block = varint(); minis = varint(); total = varint()
+    assert total == count
+    if count == 0:
+        return np.zeros(0, np.int64)
+    first = unzig(varint())
+    out = [np.int64(first)]
+    mb_size = block // minis
+    remaining = count - 1
+    while remaining > 0:
+        min_delta = np.int64(unzig(varint()))
+        widths = list(blob[pos:pos + minis]); pos += minis
+        for w in widths:
+            nvals = min(mb_size, max(remaining, 0))
+            if remaining <= 0:
+                break
+            if w:
+                nb = mb_size * w // 8
+                vals = enc.bitunpack(blob[pos:pos + nb], w, mb_size)
+                pos += nb
+            else:
+                vals = np.zeros(mb_size, np.uint64)
+            with np.errstate(over="ignore"):
+                for v in vals[:nvals]:
+                    out.append(out[-1] + min_delta + np.int64(v.astype(np.int64)))
+            remaining -= nvals
+    return np.array(out[:count], np.int64)
+
+
+@pytest.mark.parametrize("vals", [
+    np.array([7, 5, 3, 1, 2, 3, 4, 5], np.int64),
+    np.array([-(2**63), 2**63 - 1, 0, -1, 2**62], np.int64),  # wraparound deltas
+    np.arange(1000, dtype=np.int64) * 37 - 5000,
+    np.random.default_rng(11).integers(-(2**62), 2**62, 517),
+    np.array([], np.int64),
+    np.array([42], np.int64),
+])
+def test_delta_binary_packed_roundtrip(vals):
     blob = enc.delta_binary_packed_encode(vals)
-    assert isinstance(blob, bytes) and len(blob) > 4
+    got = _delta_decode(blob, len(vals))
+    np.testing.assert_array_equal(got, np.asarray(vals, np.int64))
 
 
 # ---------------------------------------------------------------------------
