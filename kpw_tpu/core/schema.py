@@ -155,6 +155,18 @@ class Schema:
         raise KeyError(dotted)
 
 
+# canonical physical-type -> numpy dtype mapping (shared by all bridges)
+import numpy as _np  # noqa: E402
+
+NUMPY_DTYPES = {
+    PhysicalType.INT32: _np.int32,
+    PhysicalType.INT64: _np.int64,
+    PhysicalType.FLOAT: _np.float32,
+    PhysicalType.DOUBLE: _np.float64,
+    PhysicalType.BOOLEAN: _np.bool_,
+}
+
+
 # -- convenience constructors ------------------------------------------------
 
 _PHYS_BY_NAME = {

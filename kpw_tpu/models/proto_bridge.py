@@ -1,0 +1,222 @@
+"""protobuf Message class -> parquet schema + Dremel columnarizer.
+
+The reference's data model is "any com.google.protobuf.Message subclass"
+(KafkaProtoParquetWriter.java:671-684) shredded by parquet-protobuf's
+ProtoWriteSupport (ParquetFile.java:97-99).  Here the shredding is batched:
+a list of parsed messages becomes one ColumnBatch (per-leaf value arrays +
+repetition/definition levels), which the pluggable EncoderBackend turns into
+pages — the boundary where the TPU path takes over.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from google.protobuf.descriptor import FieldDescriptor as FD
+
+from ..core.schema import (
+    ColumnDescriptor,
+    ConvertedType,
+    Field,
+    PhysicalType,
+    Repetition,
+    Schema,
+)
+from ..core.writer import ColumnBatch
+from ..core.pages import ColumnChunkData
+
+# proto field type -> (physical type, converted type)
+_SCALAR_MAP = {
+    FD.TYPE_INT64: (PhysicalType.INT64, None),
+    FD.TYPE_SINT64: (PhysicalType.INT64, None),
+    FD.TYPE_SFIXED64: (PhysicalType.INT64, None),
+    FD.TYPE_UINT64: (PhysicalType.INT64, ConvertedType.UINT_64),
+    FD.TYPE_FIXED64: (PhysicalType.INT64, ConvertedType.UINT_64),
+    FD.TYPE_INT32: (PhysicalType.INT32, None),
+    FD.TYPE_SINT32: (PhysicalType.INT32, None),
+    FD.TYPE_SFIXED32: (PhysicalType.INT32, None),
+    FD.TYPE_UINT32: (PhysicalType.INT32, ConvertedType.UINT_32),
+    FD.TYPE_FIXED32: (PhysicalType.INT32, ConvertedType.UINT_32),
+    FD.TYPE_BOOL: (PhysicalType.BOOLEAN, None),
+    FD.TYPE_FLOAT: (PhysicalType.FLOAT, None),
+    FD.TYPE_DOUBLE: (PhysicalType.DOUBLE, None),
+    FD.TYPE_STRING: (PhysicalType.BYTE_ARRAY, ConvertedType.UTF8),
+    FD.TYPE_BYTES: (PhysicalType.BYTE_ARRAY, None),
+    FD.TYPE_ENUM: (PhysicalType.BYTE_ARRAY, ConvertedType.ENUM),
+}
+
+from ..core.schema import NUMPY_DTYPES as _NUMPY_DTYPES  # noqa: E402
+
+
+def _is_repeated(fd) -> bool:
+    try:
+        return fd.is_repeated
+    except AttributeError:  # older protobuf runtimes
+        return fd.label == FD.LABEL_REPEATED
+
+
+def _is_required(fd) -> bool:
+    try:
+        return fd.is_required
+    except AttributeError:
+        return fd.label == FD.LABEL_REQUIRED
+
+
+def _repetition_for(fd) -> int:
+    if _is_repeated(fd):
+        return Repetition.REPEATED
+    if _is_required(fd):
+        return Repetition.REQUIRED
+    # proto3 no-presence scalars always carry a value (the default), so they
+    # map to REQUIRED; explicit-presence fields map to OPTIONAL
+    if not fd.has_presence:
+        return Repetition.REQUIRED
+    return Repetition.OPTIONAL
+
+
+def _field_from_descriptor(fd) -> Field:
+    rep = _repetition_for(fd)
+    if fd.type == FD.TYPE_MESSAGE:
+        children = [_field_from_descriptor(c) for c in fd.message_type.fields]
+        return Field(name=fd.name, repetition=rep, children=children,
+                     field_id=fd.number)
+    if fd.type == FD.TYPE_GROUP:
+        raise NotImplementedError("proto1 groups are not supported")
+    phys, conv = _SCALAR_MAP[fd.type]
+    return Field(name=fd.name, repetition=rep, physical_type=phys,
+                 converted_type=conv, field_id=fd.number)
+
+
+def proto_to_schema(msg_class) -> Schema:
+    """Build the parquet schema for a protobuf message class."""
+    desc = msg_class.DESCRIPTOR
+    return Schema([_field_from_descriptor(fd) for fd in desc.fields],
+                  name=desc.name)
+
+
+class _LeafBuffer:
+    __slots__ = ("values", "defs", "reps")
+
+    def __init__(self) -> None:
+        self.values: list = []
+        self.defs: list[int] = []
+        self.reps: list[int] = []
+
+
+class ProtoColumnarizer:
+    """Shreds batches of parsed proto messages into a ColumnBatch.
+
+    Implements the Dremel record-shredding algorithm over the proto object
+    tree; one Python pass per record (the CPU ingest cost the TPU encode path
+    amortizes behind — SURVEY.md §2.4 pipeline parallel analog).
+    """
+
+    def __init__(self, msg_class, schema: Schema | None = None) -> None:
+        self.msg_class = msg_class
+        self.schema = schema or proto_to_schema(msg_class)
+        # plan: walk descriptor parallel to schema columns, precomputing
+        # (leaf order, per-field presence semantics)
+        self._leaf_index: dict[tuple[str, ...], int] = {
+            c.path: i for i, c in enumerate(self.schema.columns)
+        }
+
+    # -- shredding ---------------------------------------------------------
+    def columnarize(self, records) -> ColumnBatch:
+        cols = self.schema.columns
+        buffers = [_LeafBuffer() for _ in cols]
+        # map descriptor walk to leaf indices via path
+        desc = self.msg_class.DESCRIPTOR
+
+        def emit_nulls(fd_path_prefix, sub_fields, r, d) -> None:
+            """Record absence for every leaf under a subtree."""
+            for fd in sub_fields:
+                path = fd_path_prefix + (fd.name,)
+                if fd.type == FD.TYPE_MESSAGE:
+                    emit_nulls(path, fd.message_type.fields, r, d)
+                else:
+                    buf = buffers[self._leaf_index[path]]
+                    buf.defs.append(d)
+                    buf.reps.append(r)
+
+        def visit_fields(msg, fields, path_prefix, r0, d0, rep_depth) -> None:
+            for fd in fields:
+                path = path_prefix + (fd.name,)
+                if _is_repeated(fd):
+                    items = getattr(msg, fd.name)
+                    if len(items) == 0:
+                        if fd.type == FD.TYPE_MESSAGE:
+                            emit_nulls(path, fd.message_type.fields, r0, d0)
+                        else:
+                            buf = buffers[self._leaf_index[path]]
+                            buf.defs.append(d0)
+                            buf.reps.append(r0)
+                        continue
+                    # repetition level of items after the first is the depth
+                    # of *this* repeated field (Dremel), not the leaf's max
+                    item_rep = rep_depth + 1
+                    d1 = d0 + 1
+                    for i, item in enumerate(items):
+                        r = r0 if i == 0 else item_rep
+                        if fd.type == FD.TYPE_MESSAGE:
+                            visit_fields(item, fd.message_type.fields, path,
+                                         r, d1, item_rep)
+                        else:
+                            self._emit_value(buffers[self._leaf_index[path]],
+                                             fd, item, r, d1)
+                elif fd.type == FD.TYPE_MESSAGE:
+                    if msg.HasField(fd.name):
+                        d1 = d0 + (1 if _repetition_for(fd) == Repetition.OPTIONAL else 0)
+                        visit_fields(getattr(msg, fd.name),
+                                     fd.message_type.fields, path, r0, d1,
+                                     rep_depth)
+                    else:
+                        emit_nulls(path, fd.message_type.fields, r0, d0)
+                else:
+                    rep = _repetition_for(fd)
+                    if rep == Repetition.OPTIONAL and not msg.HasField(fd.name):
+                        buf = buffers[self._leaf_index[path]]
+                        buf.defs.append(d0)
+                        buf.reps.append(r0)
+                    else:
+                        d1 = d0 + (1 if rep == Repetition.OPTIONAL else 0)
+                        self._emit_value(buffers[self._leaf_index[path]],
+                                         fd, getattr(msg, fd.name), r0, d1)
+
+        for rec in records:
+            visit_fields(rec, desc.fields, (), 0, 0, 0)
+
+        chunks = []
+        n = len(records)
+        for col, buf in zip(cols, buffers):
+            values = self._finalize_values(col, buf.values)
+            def_levels = (np.asarray(buf.defs, np.int32)
+                          if col.max_def > 0 else None)
+            rep_levels = (np.asarray(buf.reps, np.int32)
+                          if col.max_rep > 0 else None)
+            chunks.append(ColumnChunkData(col, values, def_levels, rep_levels, n))
+        return ColumnBatch(chunks, n)
+
+    # -- helpers -----------------------------------------------------------
+    @staticmethod
+    def _emit_value(buf: _LeafBuffer, fd, value, r: int, d: int) -> None:
+        if fd.type == FD.TYPE_STRING:
+            value = value.encode("utf-8")
+        elif fd.type == FD.TYPE_ENUM:
+            ev = fd.enum_type.values_by_number.get(value)
+            # open enums (proto3): unknown numbers survive parsing; encode a
+            # stable placeholder instead of killing the worker
+            value = (ev.name if ev is not None
+                     else f"UNKNOWN_ENUM_{value}").encode("ascii")
+        elif fd.type in (FD.TYPE_UINT64, FD.TYPE_FIXED64) and value >= 1 << 63:
+            value = value - (1 << 64)  # store as wrapped int64 per UINT_64
+        buf.values.append(value)
+        buf.defs.append(d)
+        buf.reps.append(r)
+
+    @staticmethod
+    def _finalize_values(col: ColumnDescriptor, values: list):
+        pt = col.leaf.physical_type
+        dtype = _NUMPY_DTYPES.get(pt)
+        if dtype is not None:
+            return np.asarray(values, dtype)
+        return values

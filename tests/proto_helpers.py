@@ -1,0 +1,71 @@
+"""Dynamic protobuf message classes for tests (no protoc codegen needed).
+
+Builds message classes at runtime from FileDescriptorProto — the same user
+contract the reference tests exercise with their checked-in generated
+SampleMessage (reference src/test/resources/test-message.proto), but with our
+own schemas.
+"""
+
+from google.protobuf import descriptor_pb2, descriptor_pool, message_factory
+
+_F = descriptor_pb2.FieldDescriptorProto
+
+
+def _field(name, number, ftype, label=_F.LABEL_OPTIONAL, type_name=None):
+    f = _F(name=name, number=number, type=ftype, label=label)
+    if type_name:
+        f.type_name = type_name
+    return f
+
+
+_pool_counter = [0]
+
+
+def build_classes(file_name: str, messages: dict) -> dict:
+    """messages: {MsgName: [FieldDescriptorProto, ...]} -> {MsgName: class}"""
+    _pool_counter[0] += 1
+    pool = descriptor_pool.DescriptorPool()
+    fdp = descriptor_pb2.FileDescriptorProto(
+        name=f"{file_name}_{_pool_counter[0]}.proto",
+        package="kpwtest",
+        syntax="proto2",
+    )
+    for msg_name, fields in messages.items():
+        m = fdp.message_type.add(name=msg_name)
+        m.field.extend(fields)
+    fd = pool.Add(fdp)
+    return {
+        name: message_factory.GetMessageClass(fd.message_types_by_name[name])
+        for name in messages
+    }
+
+
+def sample_message_class():
+    """proto2 message shaped like the reference's test schema: required
+    string + int64, two optional int32s."""
+    return build_classes("sample", {
+        "SampleMessage": [
+            _field("query", 1, _F.TYPE_STRING, _F.LABEL_REQUIRED),
+            _field("timestamp", 2, _F.TYPE_INT64, _F.LABEL_REQUIRED),
+            _field("page_number", 3, _F.TYPE_INT32),
+            _field("result_per_page", 4, _F.TYPE_INT32),
+        ]
+    })["SampleMessage"]
+
+
+def nested_message_classes():
+    """list<struct>-shaped nesting for rep/def level coverage (BASELINE
+    config 5)."""
+    return build_classes("nested", {
+        "Item": [
+            _field("sku", 1, _F.TYPE_STRING, _F.LABEL_REQUIRED),
+            _field("qty", 2, _F.TYPE_INT32),
+            _field("tags", 3, _F.TYPE_STRING, _F.LABEL_REPEATED),
+        ],
+        "Order": [
+            _field("order_id", 1, _F.TYPE_INT64, _F.LABEL_REQUIRED),
+            _field("items", 2, _F.TYPE_MESSAGE, _F.LABEL_REPEATED,
+                   ".kpwtest.Item"),
+            _field("note", 3, _F.TYPE_STRING),
+        ],
+    })["Order"]
