@@ -10,6 +10,7 @@ KafkaProtoParquetWriter.java:473).
 
 from __future__ import annotations
 
+import io
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -78,6 +79,15 @@ class ParquetFileWriter:
 
     # -- low level ---------------------------------------------------------
     def _write(self, data: bytes) -> None:
+        """Positioned write: on retry after a partially-failed earlier write,
+        seek back to the logical position so garbage bytes are overwritten and
+        footer/page offsets stay true (at-least-once: a transient IO failure
+        must never silently drop or shift data)."""
+        if self._pos and hasattr(self.sink, "seek"):
+            try:
+                self.sink.seek(self._pos)
+            except (OSError, io.UnsupportedOperation):
+                pass
         self.sink.write(data)
         self._pos += len(data)
 
@@ -93,6 +103,13 @@ class ParquetFileWriter:
         return self._pos + self._pending_bytes
 
     def write_batch(self, batch: ColumnBatch) -> None:
+        """Append a batch; flushes a row group when the threshold crosses.
+
+        Ownership contract: the batch is owned by the writer as soon as this
+        is called — the append itself cannot fail.  If the internal flush
+        raises (transient IO), the data is safely buffered; retry by calling
+        :meth:`flush_row_group` (or just :meth:`close`), do NOT re-submit the
+        batch."""
         if self._closed:
             raise ValueError("writer closed")
         if self._pending is None:
@@ -132,27 +149,35 @@ class ParquetFileWriter:
         )
 
     def flush_row_group(self) -> None:
+        """Transactional: encode everything, then write, and only then mutate
+        writer state — so a transient IO failure leaves ``_pending`` intact
+        and a retried flush re-encodes and overwrites (no dropped rows, no
+        desynced offsets)."""
         if not self._pending or self._pending_rows == 0:
             return
         chunks = [self._merge_chunks(parts) for parts in self._pending]
         num_rows = self._pending_rows
-        self._pending = None
-        self._pending_rows = 0
-        self._pending_bytes = 0
 
         rg_start = self._pos
         columns: list[ColumnChunk] = []
+        blobs: list[bytes] = []
         total_byte_size = 0
         total_compressed = 0
+        offset = rg_start
         for chunk in chunks:
-            encoded = self.encoder.encode(chunk, self._pos)
-            self._write(encoded.blob)
+            encoded = self.encoder.encode(chunk, offset)
+            blobs.append(encoded.blob)
+            offset += len(encoded.blob)
             columns.append(ColumnChunk(
                 file_offset=encoded.meta.data_page_offset,
                 meta_data=encoded.meta,
             ))
             total_byte_size += encoded.meta.total_uncompressed_size
             total_compressed += encoded.meta.total_compressed_size
+        self._write(b"".join(blobs))  # raises => state untouched, retry safe
+        self._pending = None
+        self._pending_rows = 0
+        self._pending_bytes = 0
         self._row_groups.append(RowGroup(
             columns=columns,
             total_byte_size=total_byte_size,
@@ -174,9 +199,8 @@ class ParquetFileWriter:
             key_value_metadata=list(self.properties.key_value_metadata.items()),
         )
         footer = meta.serialize()
-        self._write(footer)
-        self._write(len(footer).to_bytes(4, "little"))
-        self._write(MAGIC)
+        # one positioned write so a retried close() can't append twice
+        self._write(footer + len(footer).to_bytes(4, "little") + MAGIC)
         self._closed = True
 
 

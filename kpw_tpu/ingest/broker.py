@@ -1,0 +1,127 @@
+"""In-process broker: partitioned append logs + consumer groups + committed
+offsets.
+
+Test-infra analog of the reference's embedded ``KafkaRule`` broker
+(KafkaProtoParquetWriterTest.java:58-59) promoted to a first-class component:
+the framework's default record source in tests and benchmarks, and the
+interface a real Kafka wire client can implement later.  Scale-out data
+parallelism (multiple writer instances sharing a consumer group —
+KafkaProtoParquetWriter.java:72-76) is modeled with range partition
+assignment and rebalance-on-membership-change.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class Record:
+    topic: str
+    partition: int
+    offset: int
+    key: bytes | None
+    value: bytes
+    timestamp: float = 0.0
+
+
+class FakeBroker:
+    """Thread-safe in-memory broker."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._logs: dict[str, list[list[Record]]] = {}
+        self._committed: dict[tuple[str, str, int], int] = {}  # (group, topic, part) -> next offset
+        self._groups: dict[tuple[str, str], list[str]] = {}  # (group, topic) -> member ids
+        self._generation: dict[tuple[str, str], int] = {}
+        self._rr = 0
+
+    # -- topics / produce --------------------------------------------------
+    def create_topic(self, topic: str, partitions: int = 1) -> None:
+        with self._lock:
+            if topic in self._logs:
+                raise ValueError(f"topic exists: {topic}")
+            self._logs[topic] = [[] for _ in range(partitions)]
+
+    def num_partitions(self, topic: str) -> int:
+        with self._lock:
+            return len(self._logs[topic])
+
+    def produce(self, topic: str, value: bytes, key: bytes | None = None,
+                partition: int | None = None) -> tuple[int, int]:
+        with self._lock:
+            if topic not in self._logs:
+                self._logs[topic] = [[]]
+            parts = self._logs[topic]
+            if partition is None:
+                if key is not None:
+                    partition = hash(key) % len(parts)
+                else:
+                    partition = self._rr % len(parts)
+                    self._rr += 1
+            log = parts[partition]
+            rec = Record(topic, partition, len(log), key, value, time.time())
+            log.append(rec)
+            return partition, rec.offset
+
+    # -- fetch -------------------------------------------------------------
+    def fetch(self, topic: str, partition: int, offset: int,
+              max_records: int = 500) -> list[Record]:
+        with self._lock:
+            parts = self._logs.get(topic)
+            if parts is None or partition >= len(parts):
+                return []
+            return parts[partition][offset : offset + max_records]
+
+    def end_offset(self, topic: str, partition: int) -> int:
+        with self._lock:
+            return len(self._logs[topic][partition])
+
+    # -- consumer groups ---------------------------------------------------
+    def join_group(self, group: str, topic: str, member_id: str) -> None:
+        with self._lock:
+            key = (group, topic)
+            members = self._groups.setdefault(key, [])
+            if member_id not in members:
+                members.append(member_id)
+                self._generation[key] = self._generation.get(key, 0) + 1
+
+    def leave_group(self, group: str, topic: str, member_id: str) -> None:
+        with self._lock:
+            key = (group, topic)
+            members = self._groups.get(key, [])
+            if member_id in members:
+                members.remove(member_id)
+                self._generation[key] = self._generation.get(key, 0) + 1
+
+    def generation(self, group: str, topic: str) -> int:
+        with self._lock:
+            return self._generation.get((group, topic), 0)
+
+    def assignment(self, group: str, topic: str, member_id: str) -> list[int]:
+        """Range assignment over the current membership (sorted member ids)."""
+        with self._lock:
+            members = sorted(self._groups.get((group, topic), []))
+            if member_id not in members or topic not in self._logs:
+                return []  # unknown topic: no partitions until first produce
+            n_parts = len(self._logs[topic])
+            idx = members.index(member_id)
+            per = n_parts // len(members)
+            extra = n_parts % len(members)
+            start = idx * per + min(idx, extra)
+            count = per + (1 if idx < extra else 0)
+            return list(range(start, start + count))
+
+    # -- offsets -----------------------------------------------------------
+    def commit(self, group: str, topic: str, partition: int, offset: int) -> None:
+        """offset = next offset to consume (Kafka convention)."""
+        with self._lock:
+            key = (group, topic, partition)
+            if offset > self._committed.get(key, 0):
+                self._committed[key] = offset
+
+    def committed(self, group: str, topic: str, partition: int) -> int:
+        with self._lock:
+            return self._committed.get((group, topic, partition), 0)

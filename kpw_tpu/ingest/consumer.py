@@ -1,0 +1,145 @@
+"""Smart-commit consumer: bounded shared queue + paged offset tracking +
+open-page backpressure.
+
+Interface parity with the external library the reference wires at
+KafkaProtoParquetWriter.java:153-163: ``subscribe(topic)``, ``start()``,
+``poll()`` (non-blocking, many workers concurrently), ``ack(PartitionOffset)``,
+``close()``; auto-commit is never used — the committed offset only advances
+over acked pages (at-least-once anchor, README.MD:6).  A single fetcher
+thread owns broker I/O (the reference's consumer thread), workers share the
+bounded queue (``maxQueuedRecordsInConsumer``, KPW.java:468).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import uuid
+
+from .broker import FakeBroker, Record
+from .offsets import PagedOffsetTracker, PartitionOffset
+
+
+class SmartCommitConsumer:
+    def __init__(
+        self,
+        broker: FakeBroker,
+        group_id: str,
+        page_size: int = 300_000,
+        max_open_pages_per_partition: int = 1,
+        max_queued_records: int = 100_000,
+        fetch_max_records: int = 2000,
+        member_id: str | None = None,
+    ) -> None:
+        self.broker = broker
+        self.group_id = group_id
+        self.member_id = member_id or f"member-{uuid.uuid4().hex[:8]}"
+        self.tracker = PagedOffsetTracker(page_size, max_open_pages_per_partition)
+        self._queue: queue.Queue[Record] = queue.Queue(maxsize=max_queued_records)
+        self._fetch_max = fetch_max_records
+        self._topic: str | None = None
+        self._thread: threading.Thread | None = None
+        self._running = False
+        self._positions: dict[int, int] = {}  # partition -> next fetch offset
+        self._assigned: list[int] = []
+        self._generation = -1
+        self._commit_lock = threading.Lock()
+
+    # -- lifecycle ---------------------------------------------------------
+    def subscribe(self, topic: str) -> None:
+        if self._topic is not None:
+            raise ValueError("already subscribed")
+        self._topic = topic
+
+    def start(self) -> None:
+        if self._topic is None:
+            raise ValueError("subscribe() before start()")
+        if self._thread is not None:
+            raise ValueError("already started")
+        self.broker.join_group(self.group_id, self._topic, self.member_id)
+        self._running = True
+        self._thread = threading.Thread(target=self._fetch_loop,
+                                        name=f"smart-consumer-{self.member_id}",
+                                        daemon=True)
+        self._thread.start()
+
+    def close(self) -> None:
+        self._running = False
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+        if self._topic is not None:
+            self.broker.leave_group(self.group_id, self._topic, self.member_id)
+
+    # -- worker API --------------------------------------------------------
+    def poll(self, timeout: float | None = None) -> Record | None:
+        """Non-blocking by default (reference workers sleep 1 ms on null,
+        KPW.java:260-263)."""
+        try:
+            if timeout is None:
+                return self._queue.get_nowait()
+            return self._queue.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    def ack(self, po: PartitionOffset) -> None:
+        new_commit = self.tracker.ack(po)
+        if new_commit is not None:
+            with self._commit_lock:
+                self.broker.commit(self.group_id, self._topic, po.partition,
+                                   new_commit)
+
+    # -- internals ---------------------------------------------------------
+    def _refresh_assignment(self) -> None:
+        gen = self.broker.generation(self.group_id, self._topic)
+        if gen == self._generation:
+            return
+        self._generation = gen
+        self._assigned = self.broker.assignment(self.group_id, self._topic,
+                                                self.member_id)
+        self._positions = {}
+        for p in self._assigned:
+            base = self.broker.committed(self.group_id, self._topic, p)
+            self._positions[p] = base
+            self.tracker.reset_partition(p, base)
+
+    def _fetch_loop(self) -> None:
+        import logging
+        import time
+
+        try:
+            self._fetch_loop_inner()
+        except Exception:
+            logging.getLogger(__name__).exception(
+                "consumer fetcher thread died; poll() will starve")
+            raise
+
+    def _fetch_loop_inner(self) -> None:
+        import time
+
+        while self._running:
+            self._refresh_assignment()
+            fetched = 0
+            for p in list(self._assigned):
+                if not self._running:
+                    break
+                if self.tracker.is_backpressured(p):
+                    continue  # open-page backpressure (KPW.java:596-611)
+                pos = self._positions.get(p, 0)
+                records = self.broker.fetch(self._topic, p, pos, self._fetch_max)
+                for rec in records:
+                    if self.tracker.is_backpressured(p):
+                        break  # re-check mid-batch: one fetch must not blow the bound
+                    self.tracker.track(p, rec.offset)
+                    while self._running:
+                        try:
+                            self._queue.put(rec, timeout=0.05)
+                            break
+                        except queue.Full:
+                            continue
+                    if not self._running:
+                        break
+                    self._positions[p] = rec.offset + 1
+                    fetched += 1
+            if fetched == 0:
+                time.sleep(0.001)

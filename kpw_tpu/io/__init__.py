@@ -1,0 +1,5 @@
+"""Pluggable filesystems for the writer sink (local FS + in-memory HDFS
+analog), with the atomic tmp→rename publish the correctness protocol needs
+(reference renameAndMoveTempFile, KafkaProtoParquetWriter.java:359-378)."""
+
+from .fs import FileSystem, LocalFileSystem, MemoryFileSystem  # noqa: F401

@@ -1,0 +1,167 @@
+"""Filesystem abstraction: the subset of the Hadoop ``FileSystem`` API the
+reference uses (mkdirs / create / atomic rename / list — KPW.java:359-378,
+test utils HdfsTestUtil.java:79-91), with two implementations:
+
+* :class:`LocalFileSystem` — posix dirs/files; `os.replace` is the atomic
+  publish.
+* :class:`MemoryFileSystem` — in-process page store standing in for HDFS the
+  way MiniDFSCluster does in the reference tests (SURVEY.md §4 rebuild
+  mapping), with the same atomic-rename semantics.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import threading
+
+
+class FileSystem:
+    def mkdirs(self, path: str) -> None:
+        raise NotImplementedError
+
+    def open_write(self, path: str):
+        """Create (overwrite) a file for binary writing."""
+        raise NotImplementedError
+
+    def open_read(self, path: str):
+        raise NotImplementedError
+
+    def rename(self, src: str, dst: str) -> None:
+        """Atomic move; parent of dst must exist."""
+        raise NotImplementedError
+
+    def exists(self, path: str) -> bool:
+        raise NotImplementedError
+
+    def delete(self, path: str) -> None:
+        raise NotImplementedError
+
+    def size(self, path: str) -> int:
+        raise NotImplementedError
+
+    def list_files(self, path: str, extension: str | None = None,
+                   recursive: bool = True) -> list[str]:
+        raise NotImplementedError
+
+
+class LocalFileSystem(FileSystem):
+    def mkdirs(self, path: str) -> None:
+        os.makedirs(path, exist_ok=True)
+
+    def open_write(self, path: str):
+        return open(path, "wb")
+
+    def open_read(self, path: str):
+        return open(path, "rb")
+
+    def rename(self, src: str, dst: str) -> None:
+        os.replace(src, dst)
+
+    def exists(self, path: str) -> bool:
+        return os.path.exists(path)
+
+    def delete(self, path: str) -> None:
+        os.remove(path)
+
+    def size(self, path: str) -> int:
+        return os.path.getsize(path)
+
+    def list_files(self, path: str, extension: str | None = None,
+                   recursive: bool = True) -> list[str]:
+        out = []
+        if not os.path.isdir(path):
+            return out
+        if recursive:
+            for root, _dirs, files in os.walk(path):
+                for f in files:
+                    out.append(os.path.join(root, f))
+        else:
+            out = [os.path.join(path, f) for f in os.listdir(path)
+                   if os.path.isfile(os.path.join(path, f))]
+        if extension is not None:
+            out = [f for f in out if f.endswith(extension)]
+        return sorted(out)
+
+
+class _MemFile(io.BytesIO):
+    """BytesIO that publishes its contents to the store on close."""
+
+    def __init__(self, fs: "MemoryFileSystem", path: str) -> None:
+        super().__init__()
+        self._fs = fs
+        self._path = path
+
+    def close(self) -> None:
+        self._fs._store_put(self._path, self.getvalue())
+        super().close()
+
+
+class MemoryFileSystem(FileSystem):
+    """In-memory FS with directory semantics and atomic rename."""
+
+    def __init__(self) -> None:
+        self._files: dict[str, bytes] = {}
+        self._dirs: set[str] = {"/"}
+        self._lock = threading.RLock()
+
+    @staticmethod
+    def _norm(path: str) -> str:
+        out = os.path.normpath("/" + path.lstrip("/"))
+        return out
+
+    def _store_put(self, path: str, data: bytes) -> None:
+        with self._lock:
+            self._files[self._norm(path)] = data
+
+    def mkdirs(self, path: str) -> None:
+        with self._lock:
+            p = self._norm(path)
+            while p not in self._dirs:
+                self._dirs.add(p)
+                p = os.path.dirname(p)
+
+    def open_write(self, path: str):
+        return _MemFile(self, path)
+
+    def open_read(self, path: str):
+        with self._lock:
+            return io.BytesIO(self._files[self._norm(path)])
+
+    def rename(self, src: str, dst: str) -> None:
+        with self._lock:
+            s, d = self._norm(src), self._norm(dst)
+            if s not in self._files:
+                raise FileNotFoundError(src)
+            if os.path.dirname(d) not in self._dirs:
+                raise FileNotFoundError(f"parent dir missing: {dst}")
+            self._files[d] = self._files.pop(s)
+
+    def exists(self, path: str) -> bool:
+        with self._lock:
+            p = self._norm(path)
+            return p in self._files or p in self._dirs
+
+    def delete(self, path: str) -> None:
+        with self._lock:
+            del self._files[self._norm(path)]
+
+    def size(self, path: str) -> int:
+        with self._lock:
+            return len(self._files[self._norm(path)])
+
+    def list_files(self, path: str, extension: str | None = None,
+                   recursive: bool = True) -> list[str]:
+        with self._lock:
+            prefix = self._norm(path).rstrip("/") + "/"
+            out = []
+            for p in self._files:
+                if not p.startswith(prefix):
+                    continue
+                rest = p[len(prefix):]
+                if not recursive and "/" in rest:
+                    continue
+                if extension is not None and not p.endswith(extension):
+                    continue
+                out.append(p)
+            return sorted(out)

@@ -1,0 +1,7 @@
+"""Writer runtime: Builder config API, orchestrator, worker pool, rotation,
+retry, metrics — the reference's L3-L5 layers rebuilt (SURVEY.md §1)."""
+
+from .builder import Builder  # noqa: F401
+from .metrics import MetricRegistry  # noqa: F401
+from .parquet_file import ParquetFile  # noqa: F401
+from .writer import KafkaProtoParquetWriter  # noqa: F401

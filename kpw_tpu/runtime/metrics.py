@@ -1,0 +1,121 @@
+"""Metrics: written-vs-flushed meters + file size histogram.
+
+Mirrors the reference's Dropwizard registration (KafkaProtoParquetWriter.java:
+111-119,144-151,337-341): ``parquet.writer.written.records|bytes`` mark on
+every accepted record (buffered), ``flushed.*`` only after a file is durably
+published, ``parquet.writer.file.size`` histogram per finalized file.  The
+written≠flushed distinction (buffered vs durable) is load-bearing and kept.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class Meter:
+    """Monotonic counter + exponentially-weighted 1-minute rate."""
+
+    def __init__(self) -> None:
+        self._count = 0
+        self._lock = threading.Lock()
+        self._rate = 0.0
+        self._last = time.monotonic()
+
+    def mark(self, n: int = 1) -> None:
+        with self._lock:
+            now = time.monotonic()
+            dt = now - self._last
+            if dt > 0:
+                inst = n / dt if dt < 60 else 0.0
+                alpha = min(1.0, dt / 60.0)
+                self._rate += alpha * (inst - self._rate)
+                self._last = now
+            self._count += n
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def one_minute_rate(self) -> float:
+        return self._rate
+
+
+class Histogram:
+    def __init__(self, reservoir: int = 1024) -> None:
+        self._values: list[float] = []
+        self._reservoir = reservoir
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def update(self, value: float) -> None:
+        import random
+
+        with self._lock:
+            self._count += 1
+            if len(self._values) < self._reservoir:
+                self._values.append(value)
+            else:
+                i = random.randrange(self._count)
+                if i < self._reservoir:
+                    self._values[i] = value
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            vals = sorted(self._values)
+        if not vals:
+            return {"min": 0, "max": 0, "mean": 0, "p50": 0, "p95": 0}
+
+        def q(p: float) -> float:
+            return vals[min(len(vals) - 1, int(p * len(vals)))]
+
+        return {
+            "min": vals[0],
+            "max": vals[-1],
+            "mean": sum(vals) / len(vals),
+            "p50": q(0.5),
+            "p95": q(0.95),
+        }
+
+
+class MetricRegistry:
+    """Name -> metric; the registry users may pass to the Builder."""
+
+    def __init__(self) -> None:
+        self._metrics: dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def meter(self, name: str) -> Meter:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = Meter()
+                self._metrics[name] = m
+            return m
+
+    def histogram(self, name: str) -> Histogram:
+        with self._lock:
+            h = self._metrics.get(name)
+            if h is None:
+                h = Histogram()
+                self._metrics[name] = h
+            return h
+
+    def get(self, name: str):
+        return self._metrics.get(name)
+
+    def names(self) -> list[str]:
+        return sorted(self._metrics)
+
+
+# metric names (reference KPW.java:111-119)
+WRITTEN_RECORDS_METER = "parquet.writer.written.records"
+FLUSHED_RECORDS_METER = "parquet.writer.flushed.records"
+WRITTEN_BYTES_METER = "parquet.writer.written.bytes"
+FLUSHED_BYTES_METER = "parquet.writer.flushed.bytes"
+FILE_SIZE_HISTOGRAM = "parquet.writer.file.size"

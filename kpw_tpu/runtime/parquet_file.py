@@ -1,0 +1,101 @@
+"""One open parquet output file, record-at-a-time API with batched encode.
+
+API parity with the reference's ``ParquetFile`` wrapper (ParquetFile.java:
+24-123: ctor, write(T), close(), getDataSize(), getCreationDate(),
+getNumWrittenRecords()) — but where the reference funnels each record
+straight into parquet-mr's column writers (PF.java:59-62), this wrapper
+buffers records and shreds/encodes them in columnar *batches*, which is what
+lets the encode hop to vmapped TPU kernels (the north-star EncoderBackend
+boundary)."""
+
+from __future__ import annotations
+
+import time
+
+from ..core.writer import ParquetFileWriter, WriterProperties
+from ..io.fs import FileSystem
+from ..models.proto_bridge import ProtoColumnarizer
+
+
+class ParquetFile:
+    """Not thread-safe; thread-confined to one worker (reference PF.java:20)."""
+
+    def __init__(
+        self,
+        fs: FileSystem,
+        path: str,
+        columnarizer: ProtoColumnarizer,
+        properties: WriterProperties,
+        batch_size: int = 4096,
+        encoder=None,
+    ) -> None:
+        self.path = path
+        self._fs = fs
+        self._sink = fs.open_write(path)
+        self._writer = ParquetFileWriter(self._sink, columnarizer.schema,
+                                         properties, encoder=encoder)
+        self._columnarizer = columnarizer
+        self._batch: list = []
+        self._batch_size = batch_size
+        self._num_records = 0
+        self._est_record_bytes = 64.0  # EWMA of encoded bytes per record
+        self._creation_time = time.time()
+        self._closed = False
+
+    # -- reference API -----------------------------------------------------
+    def write(self, record) -> None:
+        """Buffer one parsed record; encodes when the batch fills.
+
+        NOT retry-safe as a whole (a retry would re-append the record); the
+        worker runtime uses :meth:`append_record` + :meth:`flush_if_full` so
+        only the idempotent flush is retried."""
+        self.append_record(record)
+        self.flush_if_full()
+
+    def append_record(self, record) -> None:
+        """Pure-memory append; cannot fail."""
+        self._batch.append(record)
+        self._num_records += 1
+
+    def flush_if_full(self) -> None:
+        """Idempotent: encodes the pending batch when it crossed the
+        threshold; safe to retry after transient IO failures (records are
+        never re-appended, see ParquetFileWriter.write_batch ownership)."""
+        if len(self._batch) >= self._batch_size:
+            self._flush_batch()
+
+    def close(self) -> None:
+        """Flush pages + footer.  File contents are durable in the sink after
+        this (the rename/publish is the caller's job, as in the reference)."""
+        if self._closed:
+            return
+        self._flush_batch()
+        self._writer.close()
+        self._sink.close()
+        self._closed = True
+
+    def get_data_size(self) -> int:
+        """In-flight size estimate for rotation (reference getDataSize,
+        PF.java:77-79): bytes already written + estimate for buffered rows."""
+        return self._writer.estimated_size() + int(
+            len(self._batch) * self._est_record_bytes)
+
+    def get_creation_time(self) -> float:
+        return self._creation_time
+
+    def get_num_written_records(self) -> int:
+        return self._num_records
+
+    # -- internals ---------------------------------------------------------
+    def _flush_batch(self) -> None:
+        if not self._batch:
+            return
+        batch = self._columnarizer.columnarize(self._batch)
+        n = len(self._batch)
+        self._batch = []
+        before = self._writer.estimated_size()
+        self._writer.write_batch(batch)
+        grew = self._writer.estimated_size() - before
+        if n and grew > 0:
+            per = grew / n
+            self._est_record_bytes += 0.5 * (per - self._est_record_bytes)

@@ -1,0 +1,40 @@
+"""Infinite-retry-on-IO helper.
+
+Reference ``tryUntilSucceeds`` (KafkaProtoParquetWriter.java:410-443): retry
+forever on IOException with a 100 ms sleep, propagate interruption, wrap other
+checked failures.  Python translation of the *semantics*: retry on
+OSError, abort promptly when the owning worker is shutting down.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+logger = logging.getLogger(__name__)
+
+RETRY_SLEEP_SECONDS = 0.1
+
+
+class RetryInterrupted(Exception):
+    """Raised when a stop event fires while retrying."""
+
+
+def try_until_succeeds(fn, stop_event: threading.Event | None = None,
+                       retry_on: tuple = (OSError,),
+                       sleep: float = RETRY_SLEEP_SECONDS):
+    """Call ``fn`` until it returns; retry on ``retry_on`` failures."""
+    while True:
+        try:
+            return fn()
+        except retry_on as e:
+            if stop_event is not None and stop_event.is_set():
+                raise RetryInterrupted() from e
+            logger.warning("IO failure, retrying in %.0f ms: %r",
+                           sleep * 1000, e)
+            if stop_event is not None:
+                if stop_event.wait(sleep):
+                    raise RetryInterrupted() from e
+            else:
+                time.sleep(sleep)

@@ -1,0 +1,161 @@
+"""Ingest-layer unit tests: broker, paged offset tracker, smart consumer."""
+
+import threading
+import time
+
+import pytest
+
+from kpw_tpu.ingest import FakeBroker, PagedOffsetTracker, PartitionOffset, SmartCommitConsumer
+
+
+def test_broker_produce_fetch():
+    b = FakeBroker()
+    b.create_topic("t", 2)
+    for i in range(10):
+        b.produce("t", f"v{i}".encode(), partition=i % 2)
+    assert b.end_offset("t", 0) == 5
+    recs = b.fetch("t", 0, 0, 3)
+    assert [r.value for r in recs] == [b"v0", b"v2", b"v4"]
+    assert [r.offset for r in recs] == [0, 1, 2]
+
+
+def test_broker_range_assignment():
+    b = FakeBroker()
+    b.create_topic("t", 8)
+    b.join_group("g", "t", "a")
+    b.join_group("g", "t", "b")
+    b.join_group("g", "t", "c")
+    parts = [b.assignment("g", "t", m) for m in ("a", "b", "c")]
+    assert sorted(p for ps in parts for p in ps) == list(range(8))
+    assert all(len(p) in (2, 3) for p in parts)
+
+
+def test_tracker_consecutive_commit():
+    t = PagedOffsetTracker(page_size=10, max_open_pages_per_partition=2)
+    for off in range(25):
+        t.track(0, off)
+    # ack out of order: 1,2 first -> no advance (0 missing)
+    assert t.ack(PartitionOffset(0, 1)) is None
+    assert t.ack(PartitionOffset(0, 2)) is None
+    assert t.committed(0) == 0
+    # ack 0 -> frontier jumps to 3
+    assert t.ack(PartitionOffset(0, 0)) == 3
+    # fill first page fully -> commit 10
+    for off in range(3, 10):
+        t.ack(PartitionOffset(0, off))
+    assert t.committed(0) == 10
+    # page 2 fully acked but page 1 has a hole at 10 -> stuck
+    for off in range(11, 25):
+        t.ack(PartitionOffset(0, off))
+    assert t.committed(0) == 10
+    assert t.ack(PartitionOffset(0, 10)) == 25
+
+
+def test_tracker_backpressure():
+    t = PagedOffsetTracker(page_size=10, max_open_pages_per_partition=1)
+    for off in range(10):
+        t.track(0, off)
+    assert not t.is_backpressured(0)
+    t.track(0, 10)  # second page opens
+    assert t.is_backpressured(0)
+    for off in range(10):
+        t.ack(PartitionOffset(0, off))
+    assert t.committed(0) == 10
+    assert not t.is_backpressured(0)  # first page closed
+
+
+def test_tracker_duplicate_acks_and_redelivery():
+    t = PagedOffsetTracker(page_size=5, max_open_pages_per_partition=4)
+    for off in range(5):
+        t.track(0, off)
+    for off in range(5):
+        t.ack(PartitionOffset(0, off))
+    assert t.committed(0) == 5
+    # duplicate/stale acks are no-ops
+    assert t.ack(PartitionOffset(0, 3)) is None
+    assert t.committed(0) == 5
+
+
+def test_consumer_end_to_end_commit():
+    b = FakeBroker()
+    b.create_topic("t", 1)
+    for i in range(100):
+        b.produce("t", f"m{i}".encode())
+    c = SmartCommitConsumer(b, "g", page_size=10,
+                            max_open_pages_per_partition=20)
+    c.subscribe("t")
+    c.start()
+    try:
+        got = []
+        deadline = time.time() + 5
+        while len(got) < 100 and time.time() < deadline:
+            r = c.poll(timeout=0.1)
+            if r is not None:
+                got.append(r)
+        assert len(got) == 100
+        assert [r.value for r in got] == [f"m{i}".encode() for i in range(100)]
+        # nothing committed until acks
+        assert b.committed("g", "t", 0) == 0
+        for r in got:
+            c.ack(PartitionOffset(r.partition, r.offset))
+        deadline = time.time() + 2
+        while b.committed("g", "t", 0) < 100 and time.time() < deadline:
+            time.sleep(0.01)
+        assert b.committed("g", "t", 0) == 100
+    finally:
+        c.close()
+
+
+def test_consumer_resume_from_committed():
+    b = FakeBroker()
+    b.create_topic("t", 1)
+    for i in range(50):
+        b.produce("t", f"m{i}".encode())
+    # first consumer reads 50, acks only first 20
+    c1 = SmartCommitConsumer(b, "g", page_size=10, max_open_pages_per_partition=10)
+    c1.subscribe("t")
+    c1.start()
+    got = []
+    deadline = time.time() + 5
+    while len(got) < 50 and time.time() < deadline:
+        r = c1.poll(timeout=0.1)
+        if r is not None:
+            got.append(r)
+    for r in got[:20]:
+        c1.ack(PartitionOffset(r.partition, r.offset))
+    time.sleep(0.05)
+    c1.close()
+    assert b.committed("g", "t", 0) == 20
+    # second consumer resumes at 20 => records 20..49 redelivered
+    c2 = SmartCommitConsumer(b, "g", page_size=10, max_open_pages_per_partition=10)
+    c2.subscribe("t")
+    c2.start()
+    got2 = []
+    deadline = time.time() + 5
+    while len(got2) < 30 and time.time() < deadline:
+        r = c2.poll(timeout=0.1)
+        if r is not None:
+            got2.append(r)
+    c2.close()
+    assert [r.offset for r in got2] == list(range(20, 50))
+
+
+def test_consumer_backpressure_bounds_delivery():
+    b = FakeBroker()
+    b.create_topic("t", 1)
+    for i in range(1000):
+        b.produce("t", b"x")
+    c = SmartCommitConsumer(b, "g", page_size=10,
+                            max_open_pages_per_partition=1,
+                            max_queued_records=10_000)
+    c.subscribe("t")
+    c.start()
+    try:
+        time.sleep(0.3)  # let the fetcher run without any acks
+        # it must stop delivering once >1 page is open (~20 offsets)
+        delivered = 0
+        while c.poll() is not None:
+            delivered += 1
+        assert delivered <= 30
+    finally:
+        c.close()

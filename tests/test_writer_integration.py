@@ -1,0 +1,297 @@
+"""Black-box integration tests for the writer runtime, mirroring the
+reference's test strategy (SURVEY.md §4): produce N records to an in-process
+broker, run the writer, read finalized files back with an independent reader
+(pyarrow) and assert multiset equality.  The three reference tests
+(testMaxOpenDuration / testMaxFileSize / testDirectoryDateTimePattern,
+KafkaProtoParquetWriterTest.java:105-221) are reproduced, plus
+crash/redelivery, multi-worker, metrics, and poison-pill coverage the
+reference lacks."""
+
+import collections
+import io
+import time
+
+import pyarrow.parquet as pq
+import pytest
+
+from kpw_tpu import Builder, FakeBroker, MemoryFileSystem, MetricRegistry
+
+from proto_helpers import sample_message_class
+
+TOPIC = "logs"
+
+
+def make_writer_builder(broker, fs, cls, **overrides):
+    b = (
+        Builder()
+        .broker(broker)
+        .topic(TOPIC)
+        .proto_class(cls)
+        .target_dir("/out")
+        .filesystem(fs)
+        .instance_name("test")
+        .batch_size(16)
+    )
+    for name, value in overrides.items():
+        getattr(b, name)(value)
+    return b
+
+
+def produce_samples(broker, cls, count, start=0):
+    msgs = []
+    for i in range(start, start + count):
+        m = cls(query=f"query-{i}", timestamp=i)
+        if i % 2 == 0:
+            m.page_number = i % 7
+        broker.produce(TOPIC, m.SerializeToString())
+        msgs.append(m)
+    return msgs
+
+
+def wait_for_files(fs, directory, ext, count, timeout=10.0, recursive=True):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        files = fs.list_files(directory, extension=ext, recursive=recursive)
+        if len(files) >= count:
+            return files
+        time.sleep(0.001)
+    raise AssertionError(
+        f"expected {count} files under {directory}, got "
+        f"{fs.list_files(directory, extension=ext)}")
+
+
+def read_messages(fs, paths):
+    rows = []
+    for p in paths:
+        table = pq.read_table(fs.open_read(p))
+        rows.extend(table.to_pylist())
+    return rows
+
+
+def as_multiset(msgs):
+    return collections.Counter(
+        (m.query, m.timestamp,
+         m.page_number if m.HasField("page_number") else None)
+        for m in msgs
+    )
+
+
+def rows_multiset(rows):
+    return collections.Counter(
+        (r["query"], r["timestamp"], r["page_number"]) for r in rows
+    )
+
+
+def test_max_open_duration():
+    """Reference test 1 (:105-140): small batch, short max-open; exactly one
+    file in the target root with a custom extension; content round-trips."""
+    broker = FakeBroker()
+    broker.create_topic(TOPIC, 1)
+    fs = MemoryFileSystem()
+    cls = sample_message_class()
+    msgs = produce_samples(broker, cls, 100)
+    w = make_writer_builder(
+        broker, fs, cls,
+        max_file_open_duration_seconds=1.0,
+        file_extension=".p",
+    ).build()
+    with w:
+        files = wait_for_files(fs, "/out", ".p", 1, timeout=10)
+        # rotation happens on time; exactly one file expected for 100 records
+        time.sleep(0.3)
+        files = fs.list_files("/out", extension=".p")
+        assert len(files) == 1
+        # no dated subdirectory: file lands directly in /out
+        assert files[0].rsplit("/", 1)[0] == "/out"
+        rows = read_messages(fs, files)
+        assert rows_multiset(rows) == as_multiset(msgs)
+
+
+def test_max_file_size():
+    """Reference test 2 (:142-174): size-based rotation; every finalized file
+    lands just over the threshold (size checked after write — same coarse
+    semantics)."""
+    broker = FakeBroker()
+    broker.create_topic(TOPIC, 1)
+    fs = MemoryFileSystem()
+    cls = sample_message_class()
+    max_size = 100 * 1024
+    w = make_writer_builder(
+        broker, fs, cls,
+        max_file_size=max_size,
+        block_size=10 * 1024,
+        max_file_open_duration_seconds=300.0,
+    ).build()
+    produced = 0
+    with w:
+        while True:
+            produce_samples(broker, cls, 2000, start=produced)
+            produced += 2000
+            files = fs.list_files("/out", extension=".parquet")
+            if len(files) >= 2:
+                break
+            time.sleep(0.02)
+            assert produced < 500_000, "never rotated by size"
+        files = fs.list_files("/out", extension=".parquet")
+        sizes = [fs.size(f) for f in files]
+        for s in sizes:
+            # same tolerance the reference asserts (~0.99x..1.11x); batching
+            # makes overshoot depend on batch granularity, allow 0.9x..1.5x
+            assert max_size * 0.9 < s < max_size * 1.5, sizes
+
+
+def test_directory_date_time_pattern():
+    """Reference test 3 (:180-221): dated subdirectories."""
+    broker = FakeBroker()
+    broker.create_topic(TOPIC, 1)
+    fs = MemoryFileSystem()
+    cls = sample_message_class()
+    msgs = produce_samples(broker, cls, 50)
+    w = make_writer_builder(
+        broker, fs, cls,
+        max_file_open_duration_seconds=0.5,
+        directory_date_time_pattern="%Y/%d",
+    ).build()
+    with w:
+        files = wait_for_files(fs, "/out", ".parquet", 1)
+        expected_dir = f"/out/{time.strftime('%Y/%d')}"
+        assert all(f.startswith(expected_dir + "/") for f in files), files
+        rows = read_messages(fs, files)
+        assert rows_multiset(rows) == as_multiset(msgs)
+
+
+def test_at_least_once_redelivery_after_crash():
+    """Close abandons the open tmp file; unacked offsets are redelivered to a
+    fresh writer with the same group id (SURVEY §3.5/§5 checkpoint-resume)."""
+    broker = FakeBroker()
+    broker.create_topic(TOPIC, 1)
+    fs = MemoryFileSystem()
+    cls = sample_message_class()
+    msgs = produce_samples(broker, cls, 80)
+    # writer 1: long rotation -> never finalizes; close() abandons tmp
+    w1 = make_writer_builder(broker, fs, cls, group_id="g").build()
+    w1.start()
+    deadline = time.time() + 5
+    while w1.total_written_records < 80 and time.time() < deadline:
+        time.sleep(0.01)
+    w1.close()
+    assert w1.total_written_records == 80
+    assert fs.list_files("/out", extension=".parquet") == []
+    assert broker.committed("g", TOPIC, 0) == 0  # nothing acked
+    # writer 2: same group, short rotation -> gets everything again
+    w2 = make_writer_builder(
+        broker, fs, cls, group_id="g",
+        max_file_open_duration_seconds=0.5,
+    ).build()
+    with w2:
+        files = wait_for_files(fs, "/out", ".parquet", 1)
+        time.sleep(0.6)
+        files = fs.list_files("/out", extension=".parquet")
+        rows = read_messages(fs, files)
+        assert rows_multiset(rows) == as_multiset(msgs)
+    deadline = time.time() + 2
+    while broker.committed("g", TOPIC, 0) < 80 and time.time() < deadline:
+        time.sleep(0.01)
+    assert broker.committed("g", TOPIC, 0) == 80
+
+
+def test_multi_worker_threads():
+    """threadCount > 1: workers share the queue, write separate files
+    (KPW.java:40-41,93-94) — uncovered by the reference tests."""
+    broker = FakeBroker()
+    broker.create_topic(TOPIC, 2)
+    fs = MemoryFileSystem()
+    cls = sample_message_class()
+    msgs = produce_samples(broker, cls, 5000)
+    w = make_writer_builder(
+        broker, fs, cls,
+        thread_count=3,
+        max_file_open_duration_seconds=0.5,
+    ).build()
+    with w:
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            files = fs.list_files("/out", extension=".parquet")
+            if files and sum(
+                pq.read_metadata(fs.open_read(f)).num_rows for f in files
+            ) == 5000:
+                break
+            time.sleep(0.05)
+        files = fs.list_files("/out", extension=".parquet")
+        rows = read_messages(fs, files)
+        assert rows_multiset(rows) == as_multiset(msgs)
+        # distinct worker indices appear in file names
+        indices = {f.rsplit("_", 1)[-1].split(".")[0] for f in files}
+        assert len(indices) >= 2
+
+
+def test_metrics_written_vs_flushed():
+    broker = FakeBroker()
+    broker.create_topic(TOPIC, 1)
+    fs = MemoryFileSystem()
+    cls = sample_message_class()
+    produce_samples(broker, cls, 60)
+    reg = MetricRegistry()
+    w = make_writer_builder(
+        broker, fs, cls,
+        metric_registry=reg,
+        max_file_open_duration_seconds=0.5,
+    ).build()
+    with w:
+        wait_for_files(fs, "/out", ".parquet", 1)
+        deadline = time.time() + 3
+        while (reg.get("parquet.writer.flushed.records") is None
+               or reg.get("parquet.writer.flushed.records").count < 60):
+            assert time.time() < deadline
+            time.sleep(0.01)
+    assert reg.get("parquet.writer.written.records").count == 60
+    assert reg.get("parquet.writer.flushed.records").count == 60
+    assert reg.get("parquet.writer.file.size").count >= 1
+    assert reg.get("parquet.writer.written.bytes").count > 0
+
+
+def test_poison_pill_policies():
+    broker = FakeBroker()
+    broker.create_topic(TOPIC, 1)
+    fs = MemoryFileSystem()
+    cls = sample_message_class()
+    produce_samples(broker, cls, 10)
+    broker.produce(TOPIC, b"\xff\xff not a proto \x01")
+    produce_samples(broker, cls, 10, start=10)
+    # 'skip' policy: bad record logged + acked, the 20 good ones survive
+    w = make_writer_builder(
+        broker, fs, cls,
+        on_parse_error="skip",
+        max_file_open_duration_seconds=0.5,
+    ).build()
+    with w:
+        deadline = time.time() + 8
+        total = 0
+        while total < 20 and time.time() < deadline:
+            files = fs.list_files("/out", extension=".parquet")
+            total = sum(pq.read_metadata(fs.open_read(f)).num_rows for f in files)
+            time.sleep(0.05)
+        assert total == 20
+
+
+def test_builder_validation():
+    broker = FakeBroker()
+    cls = sample_message_class()
+    with pytest.raises(ValueError, match="missing required"):
+        Builder().topic("t").build()
+    with pytest.raises(ValueError, match="max_file_size"):
+        (Builder().broker(broker).topic("t").proto_class(cls)
+         .target_dir("/x").max_file_size(1024).build())
+    with pytest.raises(ValueError, match="cover"):
+        (Builder().broker(broker).topic("t").proto_class(cls)
+         .target_dir("/x")
+         .max_expected_throughput_per_second(300_000)
+         .max_file_open_duration_seconds(10)
+         .offset_tracker_page_size(1000)
+         .offset_tracker_max_open_pages_per_partition(2)
+         .build())
+    # auto-derivation: ceil(300k * 900 / 300k) = 900 pages
+    b = (Builder().broker(broker).topic("t").proto_class(cls)
+         .target_dir("/x").filesystem(MemoryFileSystem()))
+    b.build()
+    assert b._offset_tracker_max_open_pages == 900
