@@ -224,10 +224,16 @@ def dictionary_build(values, physical_type: int):
             idx[i] = slot
         return list(table.keys()), idx
     arr = np.asarray(values)
-    uniq, first_pos, inv = np.unique(arr, return_index=True, return_inverse=True)
+    # Uniqueness is defined on the value's *bit pattern* (floats are viewed as
+    # unsigned ints) so -0.0/0.0 and NaN payloads behave identically across the
+    # CPU and TPU backends (the TPU dictionary sort operates on bit keys).
+    key = arr
+    if arr.dtype.kind == "f":
+        key = arr.view(np.uint32 if arr.dtype.itemsize == 4 else np.uint64)
+    _, first_pos, inv = np.unique(key, return_index=True, return_inverse=True)
     # reorder to first-occurrence order for determinism across backends
     order = np.argsort(first_pos, kind="stable")
-    uniq = uniq[order]
+    uniq = arr[first_pos[order]]
     remap = np.empty_like(order)
     remap[order] = np.arange(len(order))
     return uniq, remap[inv].astype(np.uint32)

@@ -4,7 +4,7 @@ This is the boundary the north star swaps for a pluggable backend: the
 reference funnels every record through parquet-mr's ColumnWriter/PageWriter
 (ParquetFile.java:59-62); here a whole column *batch* is encoded at once so
 the encoder can be numpy (this module) or vmapped TPU kernels
-(kpw_tpu.ops.backend.TPUBackend) producing identical bytes.
+(kpw_tpu.ops.backend.TpuChunkEncoder) producing identical bytes.
 """
 
 from __future__ import annotations
@@ -101,10 +101,56 @@ class EncoderOptions:
 
 
 class CpuChunkEncoder:
-    """Numpy reference encoder for one column chunk (whole batch at once)."""
+    """Numpy reference encoder for one column chunk (whole batch at once).
+
+    The four ``_*_body``/``_dictionary_build`` methods are the primitive-op
+    boundary: the TPU backend (kpw_tpu.ops.backend.TpuChunkEncoder) subclasses
+    this and swaps them for device kernels producing byte-identical streams.
+    """
 
     def __init__(self, options: EncoderOptions) -> None:
         self.options = options
+
+    # -- primitive ops (overridden by the TPU backend) ---------------------
+    def _dictionary_build(self, values, pt: int):
+        """Return (dict_values, indices).  ``indices`` may be any object the
+        matching ``_indices_body`` understands (ndarray here; a device handle
+        in the TPU backend)."""
+        return enc.dictionary_build(values, pt)
+
+    def _indices_body(self, indices, va: int, vb: int, dict_size: int) -> bytes:
+        """Data-page value body for slots [va, vb) of a dictionary column."""
+        return enc.dictionary_indices_encode(indices[va:vb], dict_size)
+
+    def _plain_body(self, values, pt: int) -> bytes:
+        return enc.plain_encode(values, pt)
+
+    def _levels_body(self, levels: np.ndarray, max_level: int) -> bytes:
+        return enc.rle_levels_v1(levels, max_level)
+
+    def prepare(self, chunk: ColumnChunkData):
+        """Launch-phase hook for pipelined backends: precompute whatever can
+        be dispatched asynchronously for ``chunk``; the result is handed back
+        to :meth:`encode` as ``pre``.  The CPU encoder has nothing to launch."""
+        return None
+
+    def _finish_prepare(self, pre):
+        """Materialize a :meth:`prepare` handle into (dict_values, indices),
+        or None to fall through to the synchronous ``_dictionary_build``."""
+        return pre
+
+    def encode_many(self, chunks: list[ColumnChunkData], base_offset: int) -> list["EncodedChunk"]:
+        """Encode several chunks laid out back to back.  Launches all device
+        work first (async dispatch), then assembles in order so host assembly
+        of column i overlaps device compute of columns i+1.."""
+        pres = [self.prepare(c) for c in chunks]
+        out = []
+        offset = base_offset
+        for chunk, pre in zip(chunks, pres):
+            e = self.encode(chunk, offset, pre=pre)
+            offset += len(e.blob)
+            out.append(e)
+        return out
 
     # -- helpers -----------------------------------------------------------
     def _dictionary_viable(self, chunk: ColumnChunkData) -> bool:
@@ -142,9 +188,10 @@ class CpuChunkEncoder:
             a = b
         return ranges
 
-    def encode(self, chunk: ColumnChunkData, base_offset: int) -> EncodedChunk:
+    def encode(self, chunk: ColumnChunkData, base_offset: int, pre=None) -> EncodedChunk:
         """Encode a chunk into pages.  ``base_offset`` is the absolute file
-        offset where the blob will be written (for footer offsets)."""
+        offset where the blob will be written (for footer offsets).  ``pre``
+        is the result of :meth:`prepare` when driven via :meth:`encode_many`."""
         col = chunk.column
         pt = col.leaf.physical_type
         opts = self.options
@@ -153,7 +200,8 @@ class CpuChunkEncoder:
         dict_values = None
         indices = None
         if self._dictionary_viable(chunk):
-            dict_values, indices = enc.dictionary_build(chunk.values, pt)
+            built = self._finish_prepare(pre) if pre is not None else None
+            dict_values, indices = built if built is not None else self._dictionary_build(chunk.values, pt)
             n_uniq = len(dict_values)
             n = len(indices)
             if n_uniq <= max(1, int(n * opts.max_dictionary_ratio)):
@@ -203,13 +251,13 @@ class CpuChunkEncoder:
                 va, vb = a, b
             levels_blob = b""
             if col.max_rep > 0:
-                levels_blob += enc.rle_levels_v1(chunk.rep_levels[a:b], col.max_rep)
+                levels_blob += self._levels_body(chunk.rep_levels[a:b], col.max_rep)
             if col.max_def > 0:
-                levels_blob += enc.rle_levels_v1(def_levels[a:b], col.max_def)
+                levels_blob += self._levels_body(def_levels[a:b], col.max_def)
             if use_dict:
-                values_body = enc.dictionary_indices_encode(indices[va:vb], len(dict_values))
+                values_body = self._indices_body(indices, va, vb, len(dict_values))
             else:
-                values_body = enc.plain_encode(chunk.values[va:vb], pt)
+                values_body = self._plain_body(chunk.values[va:vb], pt)
             body = levels_blob + values_body
             comp = compress(body, opts.codec)
             header = write_page_header(

@@ -163,11 +163,16 @@ class ParquetFileWriter:
         blobs: list[bytes] = []
         total_byte_size = 0
         total_compressed = 0
-        offset = rg_start
-        for chunk in chunks:
-            encoded = self.encoder.encode(chunk, offset)
+        if hasattr(self.encoder, "encode_many"):
+            encoded_chunks = self.encoder.encode_many(chunks, rg_start)
+        else:
+            encoded_chunks, offset = [], rg_start
+            for chunk in chunks:
+                e = self.encoder.encode(chunk, offset)
+                offset += len(e.blob)
+                encoded_chunks.append(e)
+        for encoded in encoded_chunks:
             blobs.append(encoded.blob)
-            offset += len(encoded.blob)
             columns.append(ColumnChunk(
                 file_offset=encoded.meta.data_page_offset,
                 meta_data=encoded.meta,

@@ -1,0 +1,19 @@
+"""TPU-native encoder kernels (JAX/XLA/Pallas).
+
+This package is build-plan step 5 (SURVEY.md §7): the hot encode math that
+parquet-mr runs record-at-a-time on the JVM (reference ParquetFile.java:59-62
+-> ColumnWriter/page encoders) re-designed as batched, statically-shaped
+device kernels:
+
+- ``dictionary``: sort-based dictionary build (first-occurrence order) on
+  device — replaces parquet-mr's per-record hash DictionaryValuesWriter.
+- ``packing``: RLE/bit-pack hybrid page bodies — bit extraction + byte
+  assembly as vectorized device ops.
+- ``backend``: ``TpuChunkEncoder``, a drop-in for the CPU reference encoder
+  at the EncoderBackend boundary, byte-identical output.
+
+Everything is shape-static and jit-cached by (padded-size bucket, bit width)
+so XLA compiles a small number of programs regardless of data.
+"""
+
+from .backend import TpuChunkEncoder  # noqa: F401

@@ -1,0 +1,127 @@
+"""TpuChunkEncoder — the TPU EncoderBackend.
+
+Drop-in for the CPU reference encoder at the pluggable boundary described in
+SURVEY.md §1 (the reference funnels every record through
+``ParquetFile.write`` -> parquet-mr ColumnWriter, ParquetFile.java:59-62;
+here a whole column chunk is encoded at once).  Output bytes are identical to
+``CpuChunkEncoder`` — the tests assert file-level byte equality — but the hot
+math runs on device:
+
+- dictionary build: sorted-unique kernel (ops.dictionary), launched for ALL
+  columns of a row group up front (``prepare``/``encode_many``) so device
+  compute overlaps host page assembly — the TPU-native version of the
+  reference's thread-per-file parallelism (KafkaProtoParquetWriter.java:40-41).
+- index pages: device bit-packing + run-stats (ops.packing); the rare
+  long-run pages fall back to the host RLE assembler to keep the stream
+  byte-identical to the oracle.
+
+Strings (BYTE_ARRAY) keep the host hash-map dictionary — variable-length
+bytes don't belong on the MXU/VPU; their dictionary *indices* are still
+integers and could be device-packed, which matters only for very large
+string pages (future work, SURVEY.md §7 hard part f).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core import encodings as enc
+from ..core.pages import ColumnChunkData, CpuChunkEncoder, EncoderOptions
+from ..core.schema import PhysicalType
+from ..core.thrift import varint_bytes
+from .dictionary import DictBuildHandle
+from .packing import pack_page_host, pad_bucket
+
+import jax.numpy as jnp
+
+
+class _DeviceIndices:
+    """Dictionary indices living on device, sliceable per page via
+    lax.dynamic_slice (padded so any (start, bucket) slice is in bounds)."""
+
+    def __init__(self, dev, n: int):
+        self.dev = dev  # (pad_bucket(n),) uint32
+        self.n = n
+        self._padded = {}  # bucket -> device array of len pad_bucket(n)+bucket
+        self._host = None  # lazy host copy for the mixed-RLE fallback
+
+    def padded_for(self, bucket: int):
+        arr = self._padded.get(bucket)
+        if arr is None:
+            arr = jnp.concatenate([self.dev, jnp.zeros(bucket, jnp.uint32)])
+            self._padded[bucket] = arr
+        return arr
+
+    def host(self) -> np.ndarray:
+        if self._host is None:
+            self._host = np.asarray(self.dev)[: self.n]
+        return self._host
+
+    def __len__(self) -> int:
+        return self.n
+
+    def __getitem__(self, sl):  # CPU-path compatibility (unused in hot path)
+        return self.host()[sl]
+
+
+class TpuChunkEncoder(CpuChunkEncoder):
+    """Byte-identical TPU implementation of the chunk encoder."""
+
+    def __init__(self, options: EncoderOptions, min_device_rows: int = 4096) -> None:
+        super().__init__(options)
+        self.min_device_rows = min_device_rows
+
+    # -- eligibility -------------------------------------------------------
+    def _device_eligible(self, values, pt: int) -> bool:
+        return (
+            isinstance(values, np.ndarray)
+            and values.dtype.kind in "iuf"
+            and values.dtype.itemsize in (4, 8)
+            and pt not in (PhysicalType.BOOLEAN, PhysicalType.BYTE_ARRAY,
+                           PhysicalType.FIXED_LEN_BYTE_ARRAY)
+            and len(values) >= self.min_device_rows
+        )
+
+    # -- launch/finish (pipelined via encode_many) -------------------------
+    def prepare(self, chunk: ColumnChunkData):
+        if not self._dictionary_viable(chunk):
+            return None
+        pt = chunk.column.leaf.physical_type
+        if not self._device_eligible(chunk.values, pt):
+            return None
+        return DictBuildHandle(chunk.values)
+
+    def _finish_prepare(self, pre):
+        if pre is None:
+            return None
+        dict_values, indices_dev = pre.result()
+        return dict_values, _DeviceIndices(indices_dev, pre.n)
+
+    # -- primitive overrides ----------------------------------------------
+    def _dictionary_build(self, values, pt: int):
+        if not self._device_eligible(values, pt):
+            return super()._dictionary_build(values, pt)
+        handle = DictBuildHandle(values)
+        dict_values, indices_dev = handle.result()
+        return dict_values, _DeviceIndices(indices_dev, handle.n)
+
+    def _indices_body(self, indices, va: int, vb: int, dict_size: int) -> bytes:
+        if not isinstance(indices, _DeviceIndices):
+            return super()._indices_body(indices, va, vb, dict_size)
+        width = enc.bit_width(max(dict_size - 1, 0))
+        count = vb - va
+        if count == 0:
+            return bytes([width])
+        if width == 0:
+            return bytes([0]) + varint_bytes(count << 1)
+        bucket = pad_bucket(count)
+        packed, long_sum, any_long = pack_page_host(
+            indices.padded_for(bucket), va, count, width, bucket)
+        # Mirror the CPU oracle's RLE-vs-bitpack decision exactly
+        # (core.encodings.rle_hybrid_encode).
+        if not any_long or long_sum < max(8, count // 10):
+            groups = (count + 7) // 8
+            body = varint_bytes((groups << 1) | 1) + packed[: groups * width].tobytes()
+        else:
+            body = enc.rle_hybrid_encode(indices.host()[va:vb], width)
+        return bytes([width]) + body

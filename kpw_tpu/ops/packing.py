@@ -1,0 +1,78 @@
+"""Device bit-packing for parquet's RLE/bit-pack hybrid pages.
+
+The CPU oracle is ``kpw_tpu.core.encodings.bitpack`` (parquet LSB-first bit
+order).  Here the same layout is produced with statically-shaped device ops:
+value bit j of value i lands at overall bit position ``i*width + j``; bytes
+are LSB-first.  Formulated as a (n, width) bit-matrix -> reshape(-1, 8) ->
+dot with byte weights, which XLA fuses into a single elementwise+reduce
+program on the VPU (no MXU needed — this is bandwidth-bound).
+
+Shapes are bucketed to powers of two and jit keys are (bucket, width), so at
+most ~log2(n_max) * 32 programs ever compile.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def pad_bucket(n: int, minimum: int = 256) -> int:
+    """Power-of-two padding bucket (multiple of 8) to bound recompilation."""
+    return 1 << max(int(math.ceil(math.log2(max(n, 1)))), int(math.log2(minimum)))
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def bitpack_device(values: jax.Array, width: int) -> jax.Array:
+    """Pack uint32 ``values`` (length a multiple of 8, already masked so
+    entries beyond the true count are zero) into parquet LSB-first bytes.
+    Returns (len(values) * width // 8,) uint8."""
+    v = values.astype(jnp.uint32)
+    bits = ((v[:, None] >> jnp.arange(width, dtype=jnp.uint32)) & 1).astype(jnp.uint8)
+    flat = bits.reshape(-1, 8)
+    weights = (jnp.uint16(1) << jnp.arange(8, dtype=jnp.uint16)).astype(jnp.uint16)
+    return (flat.astype(jnp.uint16) * weights).sum(axis=1).astype(jnp.uint8)
+
+
+@functools.partial(jax.jit, static_argnums=(3, 4))
+def pack_page(idx_full: jax.Array, start, count, bucket: int, width: int):
+    """Encode one data page's dictionary indices.
+
+    ``idx_full`` is the whole chunk's index array padded so that any
+    ``dynamic_slice`` of size ``bucket`` starting at a valid page offset stays
+    in bounds (see backend._DeviceIndices).  Returns:
+
+    - packed: (bucket * width // 8,) uint8 — parquet bit-packed groups body
+      (the caller slices to ceil(count/8)*width bytes);
+    - long_sum: total length of runs >= 8 within [start, start+count) — the
+      input to the CPU oracle's RLE-vs-bitpack decision
+      (core.encodings.rle_hybrid_encode);
+    - any_long: whether any run >= 8 exists.
+    """
+    page = jax.lax.dynamic_slice(idx_full, (start,), (bucket,))
+    pos = jnp.arange(bucket, dtype=jnp.int32)
+    valid = pos < count
+    v = jnp.where(valid, page, 0).astype(jnp.uint32)
+
+    packed = bitpack_device(v, width)
+
+    # run-length stats (for the hybrid decision, mirrored from the CPU path)
+    newrun = jnp.concatenate([jnp.ones((1,), bool), v[1:] != v[:-1]]) & valid
+    run_id = jnp.cumsum(newrun.astype(jnp.int32)) - 1
+    safe_rid = jnp.where(valid, run_id, bucket)
+    run_lens = jnp.zeros(bucket + 1, jnp.int32).at[safe_rid].add(1, mode="drop")[:bucket]
+    long_mask = run_lens >= 8
+    long_sum = jnp.sum(jnp.where(long_mask, run_lens, 0))
+    return packed, long_sum, jnp.any(long_mask)
+
+
+def pack_page_host(idx_full: jax.Array, start: int, count: int, width: int,
+                   bucket: int) -> tuple[np.ndarray, int, bool]:
+    """Host wrapper: returns (packed bytes ndarray, long_sum, any_long)."""
+    packed, long_sum, any_long = pack_page(
+        idx_full, jnp.int32(start), jnp.int32(count), bucket, width)
+    return np.asarray(packed), int(long_sum), bool(any_long)

@@ -65,12 +65,12 @@ class KafkaProtoParquetWriter:
             return lambda: None  # ParquetFileWriter builds the CPU encoder
         if backend == "tpu":
             try:
-                from ..ops.backend import TPUChunkEncoder
+                from ..ops.backend import TpuChunkEncoder
             except ImportError as e:
                 raise NotImplementedError(
                     "TPU encoder backend unavailable in this build") from e
             opts = self.properties.encoder_options()
-            return lambda: TPUChunkEncoder(opts)
+            return lambda: TpuChunkEncoder(opts)
         if callable(getattr(backend, "encode", None)):
             return lambda: backend
         raise ValueError(f"unknown encoder backend: {backend!r}")

@@ -1,12 +1,24 @@
-"""Test bootstrap: force an 8-device virtual CPU platform *before* jax import
-so multi-chip sharding tests run anywhere (SURVEY.md environment notes)."""
+"""Test bootstrap: force the virtual 8-device CPU platform (SURVEY.md
+environment notes) so sharding tests run anywhere and tests never touch the
+real TPU tunnel.
+
+Subtlety: the environment pre-sets ``JAX_PLATFORMS=axon`` and a
+``sitecustomize`` on PYTHONPATH imports jax at interpreter startup to
+register the axon (real TPU tunnel) PJRT plugin — so mutating ``JAX_PLATFORMS``
+here is too late.  ``jax.config.update`` after import is the reliable switch;
+XLA_FLAGS still works because the CPU backend only initializes on first use.
+"""
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"  # for any subprocesses we spawn
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -295,3 +295,21 @@ def test_builder_validation():
          .target_dir("/x").filesystem(MemoryFileSystem()))
     b.build()
     assert b._offset_tracker_max_open_pages == 900
+
+
+def test_tpu_encoder_backend_via_builder():
+    """Regression: Builder.encoder_backend('tpu') must resolve the real TPU
+    backend (kpw_tpu.ops.backend.TpuChunkEncoder) and round-trip content."""
+    broker = FakeBroker()
+    broker.create_topic(TOPIC, 1)
+    fs = MemoryFileSystem()
+    cls = sample_message_class()
+    msgs = produce_samples(broker, cls, 120)
+    w = make_writer_builder(
+        broker, fs, cls,
+        encoder_backend="tpu",
+        max_file_open_duration_seconds=1.0,
+    ).build()
+    with w:
+        files = wait_for_files(fs, "/out", ".parquet", 1)
+        assert as_multiset(msgs) == rows_multiset(read_messages(fs, files))
