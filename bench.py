@@ -1,0 +1,122 @@
+"""Headline benchmark: 64-column dictionary+RLE parquet encode (BASELINE.md
+config 2 — NYC-taxi-shaped replay, one chip).
+
+Measures end-to-end rows/sec from columnar arrays to finished parquet bytes
+through ``ParquetFileWriter`` with the TPU EncoderBackend, against the
+industry CPU columnar writer (pyarrow's C++ parquet, dictionary on, same
+codec) as the stand-in for parquet-mr (the reference publishes no numbers —
+BASELINE.md; parquet-mr itself is a JVM library not present here, and
+pyarrow is the stronger baseline anyway).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Extra detail goes to stderr.  Run with --cpu to force the virtual CPU
+platform (local smoke); default uses whatever device JAX has (the driver
+runs this on the real TPU chip).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import sys
+import time
+
+import numpy as np
+
+ROWS = 1 << 18  # 262144 rows/batch
+N_COLS = 64
+REPEATS = 3
+
+
+def make_taxi_like(rows: int, seed: int = 0) -> dict[str, np.ndarray]:
+    """64 columns shaped like the NYC-taxi schema: low-cardinality ids/flags,
+    medium-cardinality zones/fares, quantized amounts — all dictionary-viable
+    (the config-2 sweet spot)."""
+    rng = np.random.default_rng(seed)
+    cols: dict[str, np.ndarray] = {}
+    for i in range(N_COLS):
+        kind = i % 4
+        if kind == 0:  # vendor/ratecode/payment-type style: tiny cardinality
+            cols[f"c{i:02d}"] = rng.integers(0, 8, rows).astype(np.int64)
+        elif kind == 1:  # pickup/dropoff zone ids
+            cols[f"c{i:02d}"] = rng.integers(1, 266, rows).astype(np.int32)
+        elif kind == 2:  # quantized fare/tip amounts (cents, heavy repeats)
+            cols[f"c{i:02d}"] = (rng.integers(0, 5000, rows) * 25).astype(np.int64)
+        else:  # trip distance quantized to 0.01 miles
+            cols[f"c{i:02d}"] = (rng.integers(0, 3000, rows) / 100.0).astype(np.float64)
+    return cols
+
+
+def bench_ours(arrays, schema_cols) -> float:
+    from kpw_tpu.core import ParquetFileWriter, Schema, WriterProperties, columns_from_arrays, leaf
+    from kpw_tpu.ops import TpuChunkEncoder
+
+    schema = Schema([leaf(n, t) for n, t in schema_cols])
+    props = WriterProperties()
+
+    def run() -> int:
+        buf = io.BytesIO()
+        w = ParquetFileWriter(buf, schema, props,
+                              encoder=TpuChunkEncoder(props.encoder_options()))
+        w.write_batch(columns_from_arrays(schema, arrays))
+        w.close()
+        return buf.tell()
+
+    size = run()  # warmup: jit compile + transfer paths
+    best = float("inf")
+    for _ in range(REPEATS):
+        t0 = time.perf_counter()
+        run()
+        best = min(best, time.perf_counter() - t0)
+    print(f"[bench] ours: {size} bytes, best {best:.3f}s", file=sys.stderr)
+    return best
+
+
+def bench_pyarrow(arrays) -> float:
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    table = pa.table({k: pa.array(v) for k, v in arrays.items()})
+
+    def run() -> int:
+        buf = io.BytesIO()
+        pq.write_table(table, buf, compression="NONE", use_dictionary=True,
+                       write_statistics=True)
+        return buf.tell()
+
+    size = run()
+    best = float("inf")
+    for _ in range(REPEATS):
+        t0 = time.perf_counter()
+        run()
+        best = min(best, time.perf_counter() - t0)
+    print(f"[bench] pyarrow: {size} bytes, best {best:.3f}s", file=sys.stderr)
+    return best
+
+
+def main() -> None:
+    if "--cpu" in sys.argv:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+
+    print(f"[bench] devices: {jax.devices()}", file=sys.stderr)
+    arrays = make_taxi_like(ROWS)
+    schema_cols = [
+        (name, {"int64": "int64", "int32": "int32", "float64": "double"}[str(v.dtype)])
+        for name, v in arrays.items()
+    ]
+    t_ours = bench_ours(arrays, schema_cols)
+    t_base = bench_pyarrow(arrays)
+    rows_sec = ROWS / t_ours
+    print(json.dumps({
+        "metric": "rows_per_sec_64col_dict_rle",
+        "value": round(rows_sec, 1),
+        "unit": "rows/s",
+        "vs_baseline": round((ROWS / t_ours) / (ROWS / t_base), 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,19 @@
+"""Multi-chip parallelism (build-plan step 6, SURVEY.md §7).
+
+The reference's parallelism is thread-level data parallelism over a shared
+consumer queue plus scale-out via Kafka consumer groups (SURVEY.md §2.4,
+KafkaProtoParquetWriter.java:40-41,72-76).  The TPU-native design is SPMD
+over a ``jax.sharding.Mesh``:
+
+- ``mesh``: device mesh helpers (one ``shard`` axis; partitions -> chips).
+- ``dict_merge``: the north-star collective — when multiple Kafka partitions
+  share a row group, each chip dictionary-encodes its shard locally and the
+  global dictionary is merged with ``all_gather``/``psum`` over ICI
+  (SURVEY.md §5 "Distributed communication backend").
+- ``sharded``: the full sharded encode step (shard_map over rows) used by
+  ``__graft_entry__.dryrun_multichip``.
+"""
+
+from .mesh import make_mesh  # noqa: F401
+from .dict_merge import global_dictionary_encode  # noqa: F401
+from .sharded import sharded_encode_step  # noqa: F401

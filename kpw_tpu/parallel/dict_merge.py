@@ -1,0 +1,161 @@
+"""Global dictionary merge across mesh shards (the north-star collective).
+
+Scenario (BASELINE.md config 4): 16 Kafka partitions land on 8 chips, all
+writing one shared row group.  Each shard dictionary-encodes its rows
+locally, then the shards agree on ONE global dictionary so the row group has
+a single dictionary page.  The reference has no analog — parquet-mr builds
+one hash map per file on one thread (SURVEY.md §2.4 "Collective ops: No").
+
+Algorithm (all static shapes, runs under shard_map over the ``shard`` axis):
+
+  1. per-shard sorted-unique of the local values (capped at ``cap``);
+  2. ``all_gather`` the per-shard unique sets over ICI;
+  3. merge: sort-unique the gathered sets -> the global dictionary in
+     ascending key order (deterministic regardless of shard count);
+  4. per-shard index lookup by the concat-sort-rank trick: sort
+     [dict entries, local values] together; since dict slots ascend in value
+     order, every value's index is (number of dict entries sorted at or
+     before it) - 1 — one lexsort + cumsum, no searchsorted needed (works
+     for 64-bit keys split into (hi, lo) uint32 halves, which plain
+     searchsorted cannot do);
+  5. ``psum`` the per-shard row counts -> global row count for the footer,
+     and an overflow flag if any shard exceeded ``cap``.
+
+Keys are bit-pattern (hi, lo) uint32 pairs as in ops.dictionary, so int64 /
+float64 columns need no device int64 support.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.dictionary import split_keys
+from ..ops.packing import pad_bucket
+
+AXIS = "shard"
+
+
+def _local_unique(hi, lo, valid, cap: int):
+    """Sorted-unique of the valid (hi, lo) keys, padded to ``cap``.
+    Returns (uhi, ulo, uvalid, k) with uniques in ascending key order."""
+    n = lo.shape[0]
+    inv = (~valid).astype(jnp.int32)
+    order = jnp.lexsort((lo, hi, inv))
+    shi, slo, sval = hi[order], lo[order], valid[order]
+    same = jnp.concatenate(
+        [jnp.zeros((1,), bool), (shi[1:] == shi[:-1]) & (slo[1:] == slo[:-1])])
+    is_new = sval & ~same
+    k = jnp.sum(is_new.astype(jnp.int32))
+    # compact the uniques to the front: rank = cumsum(is_new)-1, scatter-drop
+    rank = jnp.where(is_new, jnp.cumsum(is_new.astype(jnp.int32)) - 1, cap)
+    uhi = jnp.zeros(cap + 1, jnp.uint32).at[rank].set(shi, mode="drop")[:cap]
+    ulo = jnp.zeros(cap + 1, jnp.uint32).at[rank].set(slo, mode="drop")[:cap]
+    uvalid = jnp.arange(cap) < k
+    return uhi, ulo, uvalid, k
+
+
+def _rank_against_dict(dhi, dlo, dvalid, vhi, vlo, vvalid):
+    """Index of each (vhi, vlo) key in the ascending dict (dhi, dlo).
+    Values not present map to arbitrary indices (callers guarantee coverage);
+    invalid value slots map to garbage and must be masked by the caller."""
+    G = dhi.shape[0]
+    n = vhi.shape[0]
+    cat_hi = jnp.concatenate([dhi, vhi])
+    cat_lo = jnp.concatenate([dlo, vlo])
+    # dict entries first on ties so the cumsum assigns their slot to the run;
+    # invalid dict pads sort last (their flag=2 exceeds values' flag=1)
+    flag = jnp.concatenate([jnp.where(dvalid, 0, 3),
+                            jnp.where(vvalid, 1, 2).astype(jnp.int32)])
+    order = jnp.lexsort((flag, cat_lo, cat_hi))
+    is_dict = flag[order] == 0
+    slots = jnp.cumsum(is_dict.astype(jnp.int32)) - 1
+    unscrambled = jnp.zeros(G + n, jnp.int32).at[order].set(slots)
+    return unscrambled[G:]
+
+
+def _merge_kernel(hi, lo, count, cap: int):
+    """shard_map body: per-shard local view -> (indices, gdict, gk, rows)."""
+    n = lo.shape[0]
+    valid = jnp.arange(n, dtype=jnp.int32) < count
+    uhi, ulo, uvalid, k = _local_unique(hi, lo, valid, cap)
+    overflow = jax.lax.psum((k > cap).astype(jnp.int32), AXIS)
+
+    ghi = jax.lax.all_gather(uhi, AXIS).reshape(-1)
+    glo = jax.lax.all_gather(ulo, AXIS).reshape(-1)
+    gvalid = jax.lax.all_gather(uvalid, AXIS).reshape(-1)
+    G = ghi.shape[0]
+    mhi, mlo, mvalid, gk = _local_unique(ghi, glo, gvalid, G)
+
+    indices = _rank_against_dict(mhi, mlo, mvalid, hi, lo, valid)
+    rows = jax.lax.psum(count, AXIS)
+    return (indices.astype(jnp.uint32), mhi, mlo, gk, rows, overflow)
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "cap"))
+def _merge_sharded(hi, lo, counts, *, mesh: Mesh, cap: int):
+    sharded = P(AXIS)
+    rep = P()
+    fn = jax.shard_map(
+        lambda h, l, c: _merge_kernel(h, l, c[0], cap),
+        mesh=mesh,
+        in_specs=(sharded, sharded, sharded),
+        out_specs=(sharded, rep, rep, rep, rep, rep),
+        # the merged dict is replicated by construction (computed from
+        # all_gather'd data), but VMA can't see that through lexsort/scatter
+        check_vma=False,
+    )
+    return fn(hi, lo, counts)
+
+
+def global_dictionary_encode(values: np.ndarray, mesh: Mesh, cap: int = 65536):
+    """Encode ``values`` against a mesh-global dictionary.
+
+    Rows are split evenly over the mesh's shards (the partitions->chips
+    assignment); returns (dict_values ascending by bit pattern, indices)
+    as host arrays.  Raises ValueError when a shard's local cardinality
+    exceeds ``cap`` (caller should fall back to plain encoding, the same
+    escape hatch parquet-mr uses for oversized dictionaries)."""
+    n_shards = mesh.devices.size
+    n = len(values)
+    rows_per = max((n + n_shards - 1) // n_shards, 1)  # even split over shards
+    per = pad_bucket(rows_per)  # static per-shard block, padded
+    hi, lo = split_keys(np.ascontiguousarray(values))
+    hi_p = np.zeros(n_shards * per, np.uint32)
+    lo_p = np.zeros(n_shards * per, np.uint32)
+    counts = np.zeros(n_shards, np.int32)
+    for s in range(n_shards):
+        src_a = s * rows_per
+        take = max(0, min(rows_per, n - src_a))
+        if take:
+            dst = slice(s * per, s * per + take)
+            lo_p[dst] = lo[src_a : src_a + take]
+            if hi is not None:
+                hi_p[dst] = hi[src_a : src_a + take]
+        counts[s] = take
+    shard_sharding = NamedSharding(mesh, P(AXIS))
+    hi_d = jax.device_put(hi_p, shard_sharding)
+    lo_d = jax.device_put(lo_p, shard_sharding)
+    cnt_d = jax.device_put(counts, shard_sharding)
+    indices, mhi, mlo, gk, rows, overflow = _merge_sharded(
+        hi_d, lo_d, cnt_d, mesh=mesh, cap=cap)
+    if int(overflow):
+        raise ValueError(f"per-shard dictionary cardinality exceeded cap={cap}")
+    gk = int(gk)
+    assert int(rows) == n
+    mhi_np = np.asarray(mhi)[:gk].astype(np.uint64)
+    mlo_np = np.asarray(mlo)[:gk].astype(np.uint64)
+    arr = np.ascontiguousarray(values)
+    if arr.dtype.itemsize == 4:
+        dict_values = mlo_np.astype(np.uint32).view(arr.dtype)
+    else:
+        dict_values = ((mhi_np << np.uint64(32)) | mlo_np).view(arr.dtype)
+    # shards are contiguous row ranges; reassemble by stripping per-shard pad
+    idx_np = np.asarray(indices)
+    parts = [idx_np[s * per : s * per + int(counts[s])] for s in range(n_shards)]
+    out_idx = np.concatenate(parts) if parts else np.zeros(0, np.uint32)
+    return dict_values, out_idx

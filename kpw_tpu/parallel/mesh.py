@@ -1,0 +1,43 @@
+"""Device-mesh helpers.
+
+One logical axis, ``shard``: Kafka partitions are assigned round-robin to
+mesh shards the way the reference assigns them to worker threads via the
+shared consumer queue (KafkaProtoParquetWriter.java:175-179).  Multi-host
+extends the same axis over DCN — JAX process boundaries play the role of
+the reference's scale-out consumer-group instances (KPW.java:72-76).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(n_devices: int | None = None, axis: str = "shard") -> Mesh:
+    devices = jax.devices()
+    if n_devices is not None:
+        if len(devices) < n_devices:
+            raise ValueError(
+                f"need {n_devices} devices, have {len(devices)} "
+                f"(set --xla_force_host_platform_device_count for CPU dry runs)")
+        devices = devices[:n_devices]
+    return Mesh(np.array(devices), (axis,))
+
+
+def shard_spec(mesh: Mesh) -> NamedSharding:
+    """Rows sharded over the mesh's first axis."""
+    return NamedSharding(mesh, P(mesh.axis_names[0]))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def partition_assignment(n_partitions: int, n_shards: int) -> list[list[int]]:
+    """Round-robin Kafka-partition -> shard assignment (the mesh analog of
+    threads polling a shared queue, KPW.java:93-94)."""
+    out: list[list[int]] = [[] for _ in range(n_shards)]
+    for p in range(n_partitions):
+        out[p % n_shards].append(p)
+    return out

@@ -1,0 +1,97 @@
+"""Multi-chip sharding tests on the virtual 8-device CPU mesh (conftest.py
+forces XLA_FLAGS=--xla_force_host_platform_device_count=8, SURVEY.md env
+notes).  Verifies the north-star collective: global dictionary merge across
+shards (BASELINE.md config 4) and the full sharded encode step."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from kpw_tpu.core import encodings as enc
+from kpw_tpu.parallel import global_dictionary_encode, make_mesh, sharded_encode_step
+from kpw_tpu.parallel.mesh import partition_assignment
+from kpw_tpu.ops.dictionary import split_keys
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    return make_mesh(8)
+
+
+def test_partition_assignment():
+    a = partition_assignment(16, 8)
+    assert [p for shard in a for p in shard] != []
+    assert sorted(p for shard in a for p in shard) == list(range(16))
+    assert all(len(shard) == 2 for shard in a)
+
+
+@pytest.mark.parametrize("dtype", [np.int32, np.int64, np.float64])
+def test_global_dictionary_roundtrip(mesh8, dtype):
+    rng = np.random.default_rng(0)
+    if np.issubdtype(dtype, np.floating):
+        values = rng.choice(rng.normal(size=100), 10000).astype(dtype)
+    else:
+        values = rng.integers(0, 500, 10000).astype(dtype)
+    d, idx = global_dictionary_encode(values, mesh8, cap=2048)
+    # dictionary covers all values, indices reconstruct exactly
+    np.testing.assert_array_equal(d[idx], values)
+    # global dictionary is deterministic: ascending by bit pattern, unique
+    keys = d.view(np.uint32 if d.dtype.itemsize == 4 else np.uint64)
+    assert (np.diff(keys.astype(np.uint64)) > 0).all()
+
+
+def test_global_dictionary_matches_local_set(mesh8):
+    rng = np.random.default_rng(1)
+    values = rng.integers(-300, 300, 5000).astype(np.int64)
+    d, idx = global_dictionary_encode(values, mesh8, cap=2048)
+    assert set(d.tolist()) == set(np.unique(values).tolist())
+    assert len(d) == len(np.unique(values))
+
+
+def test_global_dictionary_overflow_raises(mesh8):
+    values = np.arange(8 * 1024, dtype=np.int64)  # 1024 uniques per shard
+    with pytest.raises(ValueError, match="cap"):
+        global_dictionary_encode(values, mesh8, cap=256)
+
+
+def test_sharded_encode_step(mesh8):
+    """Full SPMD step: 8 shards, 4 columns; packed bytes must equal the CPU
+    bitpack of the global-dictionary indices."""
+    rng = np.random.default_rng(2)
+    C, n_shards, per = 4, 8, 512
+    N = n_shards * per
+    vals = rng.integers(0, 200, (C, N)).astype(np.uint32)
+    counts = np.full(n_shards, per, np.int32)
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    mesh = mesh8
+    row_sharded = NamedSharding(mesh, P(None, "shard"))
+    hi = jax.device_put(jnp.zeros((C, N), jnp.uint32), row_sharded)
+    lo = jax.device_put(vals, row_sharded)
+    cnt = jax.device_put(counts, NamedSharding(mesh, P("shard")))
+
+    packed, mhi, mlo, gk, rows, ovf = sharded_encode_step(
+        hi, lo, cnt, mesh=mesh, cap=1024, width=16)
+    assert int(rows) == N
+    assert int(ovf) == 0
+    packed = np.asarray(packed)
+    for c in range(C):
+        k = int(np.asarray(gk)[c])
+        gdict = np.asarray(mlo)[c][:k]
+        np.testing.assert_array_equal(gdict, np.unique(vals[c]))
+        # indices = position of each value in the ascending dict
+        want_idx = np.searchsorted(gdict, vals[c])
+        want_bytes = enc.bitpack(want_idx.astype(np.uint64), 16)
+        assert packed[c].tobytes() == want_bytes
+
+
+def test_encode_step_single_shapes():
+    from kpw_tpu.parallel.sharded import encode_step_single
+    rng = np.random.default_rng(3)
+    C, N = 4, 512
+    lo = jnp.asarray(rng.integers(0, 50, (C, N)).astype(np.uint32))
+    packed, ulo, k = encode_step_single(lo, jnp.int32(N))
+    assert packed.shape == (C, N * 2)  # 16 bits/value
+    assert (np.asarray(k) == 50).all()
