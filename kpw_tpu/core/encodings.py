@@ -211,32 +211,29 @@ def byte_array_plain_encode(values) -> bytes:
 # ---------------------------------------------------------------------------
 
 def dictionary_build(values, physical_type: int):
-    """Return (dictionary_values, indices:np.uint32).  Order = first-occurrence
-    to keep the encoder streaming-friendly and deterministic."""
+    """Return (dictionary_values, indices:np.uint32).
+
+    Canonical dictionary order = ascending *bit pattern* (floats viewed as
+    unsigned ints, byte strings lexicographic).  parquet readers don't care
+    about dictionary order; ascending order is the cheapest deterministic
+    choice for the TPU sort-based builder (kpw_tpu.ops.dictionary), matches
+    the mesh-global merged dictionaries (kpw_tpu.parallel.dict_merge), and
+    this CPU oracle produces the identical bytes."""
     if physical_type == PhysicalType.BYTE_ARRAY or physical_type == PhysicalType.FIXED_LEN_BYTE_ARRAY:
-        table: dict[bytes, int] = {}
-        idx = np.empty(len(values), np.uint32)
-        for i, v in enumerate(values):
-            slot = table.get(v)
-            if slot is None:
-                slot = len(table)
-                table[v] = slot
-            idx[i] = slot
-        return list(table.keys()), idx
+        table = sorted(set(values))
+        slots = {v: i for i, v in enumerate(table)}
+        idx = np.fromiter((slots[v] for v in values), np.uint32, count=len(values))
+        return table, idx
     arr = np.asarray(values)
-    # Uniqueness is defined on the value's *bit pattern* (floats are viewed as
-    # unsigned ints) so -0.0/0.0 and NaN payloads behave identically across the
-    # CPU and TPU backends (the TPU dictionary sort operates on bit keys).
-    key = arr
-    if arr.dtype.kind == "f":
+    # unsigned bit-pattern keys for 4/8-byte types so the order matches the
+    # device sort exactly (which compares uint32 key halves); narrow types
+    # (never device-eligible) sort by value
+    if arr.dtype.itemsize in (4, 8):
         key = arr.view(np.uint32 if arr.dtype.itemsize == 4 else np.uint64)
-    _, first_pos, inv = np.unique(key, return_index=True, return_inverse=True)
-    # reorder to first-occurrence order for determinism across backends
-    order = np.argsort(first_pos, kind="stable")
-    uniq = arr[first_pos[order]]
-    remap = np.empty_like(order)
-    remap[order] = np.arange(len(order))
-    return uniq, remap[inv].astype(np.uint32)
+        uniq_keys, inv = np.unique(key, return_inverse=True)
+        return uniq_keys.view(arr.dtype), inv.astype(np.uint32)
+    uniq, inv = np.unique(arr, return_inverse=True)
+    return uniq, inv.astype(np.uint32)
 
 
 def dictionary_indices_encode(indices: np.ndarray, dict_size: int) -> bytes:

@@ -5,15 +5,18 @@ SURVEY.md §1 (the reference funnels every record through
 ``ParquetFile.write`` -> parquet-mr ColumnWriter, ParquetFile.java:59-62;
 here a whole column chunk is encoded at once).  Output bytes are identical to
 ``CpuChunkEncoder`` — the tests assert file-level byte equality — but the hot
-math runs on device:
+math runs on device, dispatch-batched per row group:
 
-- dictionary build: sorted-unique kernel (ops.dictionary), launched for ALL
-  columns of a row group up front (``prepare``/``encode_many``) so device
-  compute overlaps host page assembly — the TPU-native version of the
-  reference's thread-per-file parallelism (KafkaProtoParquetWriter.java:40-41).
-- index pages: device bit-packing + run-stats (ops.packing); the rare
-  long-run pages fall back to the host RLE assembler to keep the stream
-  byte-identical to the oracle.
+- phase A (one XLA program per dtype-width group): ALL columns' dictionary
+  builds, stacked (C, N) and vmapped (ops.dictionary.BatchDictBuild);
+- one host sync for the unique counts; dictionary-vs-plain decisions made
+  from the counts alone (fixed-width plain size is k * itemsize);
+- phase B (async): every data page's bit-pack + run-stats launched for all
+  columns before any result is read, so device compute overlaps host page
+  assembly — the TPU-native version of the reference's thread-per-file
+  parallelism (KafkaProtoParquetWriter.java:40-41);
+- the rare long-run pages fall back to the host RLE assembler to keep the
+  stream byte-identical to the oracle.
 
 Strings (BYTE_ARRAY) keep the host hash-map dictionary — variable-length
 bytes don't belong on the MXU/VPU; their dictionary *indices* are still
@@ -29,21 +32,24 @@ from ..core import encodings as enc
 from ..core.pages import ColumnChunkData, CpuChunkEncoder, EncoderOptions
 from ..core.schema import PhysicalType
 from ..core.thrift import varint_bytes
-from .dictionary import DictBuildHandle
-from .packing import pack_page_host, pad_bucket
+from .dictionary import DictBuildHandle, build_dictionaries
+from .packing import pack_page, pack_page_host, pad_bucket
 
+import jax
 import jax.numpy as jnp
 
 
 class _DeviceIndices:
     """Dictionary indices living on device, sliceable per page via
-    lax.dynamic_slice (padded so any (start, bucket) slice is in bounds)."""
+    lax.dynamic_slice (padded so any (start, bucket) slice is in bounds).
+    ``prefetched`` holds page packs launched ahead of assembly."""
 
     def __init__(self, dev, n: int):
         self.dev = dev  # (pad_bucket(n),) uint32
         self.n = n
         self._padded = {}  # bucket -> device array of len pad_bucket(n)+bucket
         self._host = None  # lazy host copy for the mixed-RLE fallback
+        self.prefetched = {}  # (va, vb, width) -> (packed, long_sum, any_long) device
 
     def padded_for(self, bucket: int):
         arr = self._padded.get(bucket)
@@ -82,20 +88,65 @@ class TpuChunkEncoder(CpuChunkEncoder):
             and len(values) >= self.min_device_rows
         )
 
-    # -- launch/finish (pipelined via encode_many) -------------------------
-    def prepare(self, chunk: ColumnChunkData):
-        if not self._dictionary_viable(chunk):
-            return None
-        pt = chunk.column.leaf.physical_type
-        if not self._device_eligible(chunk.values, pt):
-            return None
-        return DictBuildHandle(chunk.values)
+    # -- batched launch (pipelined via encode_many) ------------------------
+    def encode_many(self, chunks: list[ColumnChunkData], base_offset: int):
+        pres = self._prepare_all(chunks)
+        out = []
+        offset = base_offset
+        for chunk, pre in zip(chunks, pres):
+            e = self.encode(chunk, offset, pre=pre)
+            offset += len(e.blob)
+            out.append(e)
+        return out
 
-    def _finish_prepare(self, pre):
-        if pre is None:
-            return None
-        dict_values, indices_dev = pre.result()
-        return dict_values, _DeviceIndices(indices_dev, pre.n)
+    def _prepare_all(self, chunks):
+        """Phase A/B launcher: batched dict builds, then page-pack prefetch."""
+        slots: list = [None] * len(chunks)
+        eligible = [
+            (i, chunk) for i, chunk in enumerate(chunks)
+            if self._dictionary_viable(chunk)
+            and self._device_eligible(chunk.values, chunk.column.leaf.physical_type)
+        ]
+        handles = build_dictionaries([chunk.values for _, chunk in eligible])
+        for (i, chunk), (batch, j) in zip(eligible, handles):
+            k = int(batch.unique_counts()[j])  # syncs once per batch (cached)
+            n = len(chunk.values)
+            itemsize = chunk.values.dtype.itemsize
+            will_use_dict = (
+                k <= max(1, int(n * self.options.max_dictionary_ratio))
+                and k * itemsize <= self.options.dictionary_page_size_limit
+            )
+            dict_values, dev_idx = batch.result(j)
+            di = _DeviceIndices(dev_idx, batch.n)
+            slots[i] = (dict_values, di)
+            if will_use_dict:
+                self._prelaunch_pages(chunk, len(dict_values), di)
+        return slots
+
+    def _prelaunch_pages(self, chunk: ColumnChunkData, dict_size: int,
+                         di: _DeviceIndices) -> None:
+        """Launch every page's pack+run-stats before any readback (async
+        dispatch).  Page geometry mirrors CpuChunkEncoder.encode exactly."""
+        width = enc.bit_width(max(dict_size - 1, 0))
+        if width == 0:
+            return
+        col = chunk.column
+        def_levels = chunk.def_levels
+        if def_levels is not None:
+            present = np.asarray(def_levels) == col.max_def
+            value_offsets = np.concatenate([[0], np.cumsum(present)])
+        for a, b in self._page_slot_ranges(chunk, chunk.estimated_bytes()):
+            if def_levels is not None:
+                va, vb = int(value_offsets[a]), int(value_offsets[b])
+            else:
+                va, vb = a, b
+            count = vb - va
+            if count <= 0:
+                continue
+            bucket = pad_bucket(count)
+            di.prefetched[(va, vb, width)] = pack_page(
+                di.padded_for(bucket), jnp.int32(va), jnp.int32(count),
+                bucket, width)
 
     # -- primitive overrides ----------------------------------------------
     def _dictionary_build(self, values, pt: int):
@@ -114,9 +165,14 @@ class TpuChunkEncoder(CpuChunkEncoder):
             return bytes([width])
         if width == 0:
             return bytes([0]) + varint_bytes(count << 1)
-        bucket = pad_bucket(count)
-        packed, long_sum, any_long = pack_page_host(
-            indices.padded_for(bucket), va, count, width, bucket)
+        pre = indices.prefetched.pop((va, vb, width), None)
+        if pre is not None:
+            packed_d, long_d, any_d = pre
+            packed, long_sum, any_long = np.asarray(packed_d), int(long_d), bool(any_d)
+        else:
+            bucket = pad_bucket(count)
+            packed, long_sum, any_long = pack_page_host(
+                indices.padded_for(bucket), va, count, width, bucket)
         # Mirror the CPU oracle's RLE-vs-bitpack decision exactly
         # (core.encodings.rle_hybrid_encode).
         if not any_long or long_sum < max(8, count // 10):

@@ -1,25 +1,31 @@
-"""Sort-based dictionary build on device (first-occurrence order).
+"""Sort-based dictionary build on device (ascending bit-pattern order).
 
 parquet-mr builds dictionaries with a per-record Java hash map inside
 DictionaryValuesWriter (reference ParquetFile.java:97-99 funnels every record
 through it).  A hash map is the wrong shape for a TPU; the device-native
 formulation is a segmented sort:
 
-  1. lexsort by (validity, key_hi, key_lo, position) — equal values become
-     adjacent, ties keep original order, padding sinks to the end;
-  2. "new unique" flags + prefix sum -> dense unique ids in value order;
-  3. scatter-min of positions per unique id -> first-occurrence position;
-  4. argsort those positions -> the reorder that makes the dictionary match
-     the CPU oracle's first-occurrence order exactly;
-  5. scatter ranks back through the sort permutation -> per-row indices.
+  1. lexsort by (validity, key_hi, key_lo) — equal values become adjacent,
+     padding sinks to the end;
+  2. "new unique" flags + prefix sum -> dense unique ids; since the sort is
+     ascending, the dense id IS the final dictionary index (the canonical
+     dictionary order is ascending bit pattern — see
+     core.encodings.dictionary_build, the byte-identical CPU oracle);
+  3. scatter ids back through the sort permutation -> per-row indices;
+  4. scatter the "new" keys to their id -> the compacted dictionary itself,
+     so the host only ever transfers ~k dictionary entries, not n values.
 
 Keys are the value's *bit pattern* split into (hi, lo) uint32 halves, so no
 64-bit arithmetic is needed on device (TPU int64 is emulated) and float
-uniqueness is bitwise — identical to the CPU oracle
-(core.encodings.dictionary_build).
+uniqueness is bitwise.
 
-Everything is O(n log n) in static shapes; `count` is a traced scalar so one
-compiled program serves every batch in the same padding bucket.
+The build is *column-batched*: all same-width columns of a row group are
+stacked into one (C, N) array and run through a single vmapped program —
+one XLA dispatch and one host sync for a whole 64-column row group instead
+of 64 (the TPU-native answer to the reference encoding columns one at a
+time per record).  Everything is O(n log n) in static shapes; `count` is a
+traced scalar so one compiled program serves every batch in a padding
+bucket.
 """
 
 from __future__ import annotations
@@ -33,17 +39,16 @@ import numpy as np
 from .packing import pad_bucket
 
 
-@functools.partial(jax.jit, static_argnums=(3,))
-def _dict_build(hi: jax.Array, lo: jax.Array, count, wide: bool):
+def _dict_build_one(hi, lo, count, wide: bool):
     n = lo.shape[0]
     pos = jnp.arange(n, dtype=jnp.int32)
     valid = pos < count
     invalid = (~valid).astype(jnp.int32)
     if wide:
-        order = jnp.lexsort((pos, lo, hi, invalid))
+        order = jnp.lexsort((lo, hi, invalid))
         shi = hi[order]
     else:
-        order = jnp.lexsort((pos, lo, invalid))
+        order = jnp.lexsort((lo, invalid))
     slo = lo[order]
     spos = pos[order]
     svalid = valid[order]
@@ -56,14 +61,59 @@ def _dict_build(hi: jax.Array, lo: jax.Array, count, wide: bool):
     uid = jnp.cumsum(is_new.astype(jnp.int32)) - 1
     k = uid[n - 1] + 1  # pads inherit the last uid via cumsum; count==0 -> 0
 
-    safe_uid = jnp.where(svalid, uid, n)
-    first_pos = jnp.full(n + 1, n, jnp.int32).at[safe_uid].min(spos, mode="drop")[:n]
-    occ_order = jnp.argsort(first_pos)  # stable: uniques by first occurrence, pads last
-    rank = jnp.zeros(n, jnp.int32).at[occ_order].set(pos)
-    idx_sorted = rank[jnp.clip(uid, 0, n - 1)]
-    indices = jnp.zeros(n, jnp.uint32).at[spos].set(idx_sorted.astype(jnp.uint32))
-    occ_first = first_pos[occ_order]
-    return occ_first, indices, k
+    # ascending sort => uid is the dictionary index; scatter back to row order
+    indices = jnp.zeros(n, jnp.uint32).at[spos].set(uid.astype(jnp.uint32))
+    # compact the dictionary keys to the front (slot j = unique j)
+    slot = jnp.where(is_new, uid, n)
+    dlo = jnp.zeros(n + 1, jnp.uint32).at[slot].set(slo, mode="drop")[:n]
+    if wide:
+        dhi = jnp.zeros(n + 1, jnp.uint32).at[slot].set(shi, mode="drop")[:n]
+    else:
+        dhi = dlo  # unused placeholder
+    return dhi, dlo, indices, k
+
+
+@functools.partial(jax.jit, static_argnums=(3,))
+def _dict_build_batch(hi, lo, counts, wide: bool):
+    """Vmapped over columns: hi/lo (C, N), counts (C,)."""
+    return jax.vmap(lambda h, l, c: _dict_build_one(h, l, c, wide))(hi, lo, counts)
+
+
+def _dict_build_bins_one(ids, count, R: int):
+    """Sort-free dictionary build for bounded-range non-negative ints:
+    ``ids`` are (value - column_min) offsets < R.  Presence scatter + prefix
+    sum replaces the O(n log n) sort with O(n + R) VPU work — ascending
+    order falls out of the bin layout for free."""
+    n = ids.shape[0]
+    pos = jnp.arange(n, dtype=jnp.int32)
+    valid = pos < count
+    safe_ids = jnp.where(valid, ids, R).astype(jnp.int32)
+    present = jnp.zeros(R + 1, jnp.int32).at[safe_ids].set(1, mode="drop")[:R]
+    kpre = jnp.cumsum(present)
+    indices = (kpre[jnp.clip(safe_ids, 0, R - 1)] - 1).astype(jnp.uint32)
+    k = kpre[R - 1]
+    slot = jnp.where(present > 0, kpre - 1, R)
+    dkey = jnp.zeros(R + 1, jnp.uint32).at[slot].set(
+        jnp.arange(R, dtype=jnp.uint32), mode="drop")[:R]
+    return dkey, indices, k
+
+
+@functools.partial(jax.jit, static_argnums=(2,))
+def _dict_build_bins_batch(ids, counts, R: int):
+    """Vmapped over columns: ids (C, N), counts (C,)."""
+    return jax.vmap(lambda i, c: _dict_build_bins_one(i, c, R))(ids, counts)
+
+
+@functools.partial(jax.jit, static_argnums=(2,))
+def _trim_keys(dhi, dlo, cap: int):
+    """Static-size slice of the compacted dictionary keys for host transfer."""
+    return (jax.lax.dynamic_slice(dhi, (0, 0), (dhi.shape[0], cap)),
+            jax.lax.dynamic_slice(dlo, (0, 0), (dlo.shape[0], cap)))
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def _trim_one(d, cap: int):
+    return jax.lax.dynamic_slice(d, (0, 0), (d.shape[0], cap))
 
 
 def split_keys(arr: np.ndarray) -> tuple[np.ndarray | None, np.ndarray]:
@@ -75,29 +125,160 @@ def split_keys(arr: np.ndarray) -> tuple[np.ndarray | None, np.ndarray]:
     return (u >> np.uint64(32)).astype(np.uint32), (u & np.uint64(0xFFFFFFFF)).astype(np.uint32)
 
 
+def join_keys(hi: np.ndarray, lo: np.ndarray, dtype: np.dtype) -> np.ndarray:
+    """Inverse of :func:`split_keys`: reassemble values from key halves."""
+    if dtype.itemsize == 4:
+        return lo.astype(np.uint32).view(dtype)
+    u = (hi.astype(np.uint64) << np.uint64(32)) | lo.astype(np.uint64)
+    return u.view(dtype)
+
+
+class BatchDictBuild:
+    """One launched dictionary build covering several same-width columns.
+
+    ``columns`` is a list of np arrays with identical length; all are packed
+    into one (C, bucket) device batch and one vmapped program.  ``result(i)``
+    blocks (once, for the whole batch) and returns column i's
+    (dict_values, device_indices_row) in CPU-oracle (ascending) order.
+    """
+
+    def __init__(self, columns: list[np.ndarray], wide: bool):
+        self.dtypes = [c.dtype for c in columns]
+        self.wide = wide
+        C = len(columns)
+        n = len(columns[0])
+        self.n = n
+        bucket = pad_bucket(n)
+        self.bucket = bucket
+        lo_p = np.zeros((C, bucket), np.uint32)
+        hi_p = np.zeros((C, bucket), np.uint32) if wide else lo_p
+        for c, arr in enumerate(columns):
+            hi, lo = split_keys(np.ascontiguousarray(arr))
+            lo_p[c, :n] = lo
+            if wide:
+                hi_p[c, :n] = hi
+        counts = np.full(C, n, np.int32)
+        self.dhi, self.dlo, self.indices, self._k = _dict_build_batch(
+            jnp.asarray(hi_p), jnp.asarray(lo_p), jnp.asarray(counts), wide)
+        self._k_host: np.ndarray | None = None
+        self._keys_host: tuple[np.ndarray, np.ndarray] | None = None
+
+    def unique_counts(self) -> np.ndarray:
+        """Per-column unique counts; first call syncs the batch."""
+        if self._k_host is None:
+            self._k_host = np.asarray(self._k)
+        return self._k_host
+
+    def _key_tables(self) -> tuple[np.ndarray, np.ndarray]:
+        if self._keys_host is None:
+            kmax = int(self.unique_counts().max()) if len(self.dtypes) else 0
+            cap = min(self.bucket, pad_bucket(max(kmax, 1)))
+            dhi, dlo = _trim_keys(self.dhi, self.dlo, cap)
+            self._keys_host = (np.asarray(dhi), np.asarray(dlo))
+        return self._keys_host
+
+    def result(self, i: int) -> tuple[np.ndarray, jax.Array]:
+        k = int(self.unique_counts()[i])
+        dhi, dlo = self._key_tables()
+        dict_values = join_keys(dhi[i, :k], dlo[i, :k], self.dtypes[i])
+        return dict_values, self.indices[i]
+
+
+class BinDictBuild:
+    """Bounded-range batch: sort-free binning build (see _dict_build_bins_one).
+    Only valid for non-negative integer columns whose (max - min) < R — for
+    those, ascending offset order equals ascending bit-pattern order, so the
+    output matches the CPU oracle exactly.  Uploads 4 bytes/row regardless of
+    the column's width (offsets, not values)."""
+
+    def __init__(self, columns: list[np.ndarray], bases: list[int], R: int):
+        self.dtypes = [c.dtype for c in columns]
+        self.bases = bases
+        self.R = R
+        C = len(columns)
+        n = len(columns[0])
+        self.n = n
+        bucket = pad_bucket(n)
+        self.bucket = bucket
+        ids = np.zeros((C, bucket), np.uint32)
+        for c, arr in enumerate(columns):
+            ids[c, :n] = (arr.astype(np.uint64) - np.uint64(bases[c])).astype(np.uint32)
+        counts = np.full(C, n, np.int32)
+        self.dkey, self.indices, self._k = _dict_build_bins_batch(
+            jnp.asarray(ids), jnp.asarray(counts), R)
+        self._k_host: np.ndarray | None = None
+        self._dkey_host: np.ndarray | None = None
+
+    def unique_counts(self) -> np.ndarray:
+        if self._k_host is None:
+            self._k_host = np.asarray(self._k)
+        return self._k_host
+
+    def _key_table(self) -> np.ndarray:
+        if self._dkey_host is None:
+            kmax = int(self.unique_counts().max()) if len(self.dtypes) else 0
+            cap = min(self.R, pad_bucket(max(kmax, 1)))
+            self._dkey_host = np.asarray(_trim_one(self.dkey, cap))
+        return self._dkey_host
+
+    def result(self, i: int) -> tuple[np.ndarray, jax.Array]:
+        k = int(self.unique_counts()[i])
+        offsets = self._key_table()[i, :k].astype(np.uint64)
+        dict_values = (offsets + np.uint64(self.bases[i])).astype(self.dtypes[i])
+        return dict_values, self.indices[i]
+
+
+RANGE_MAX = 1 << 20  # largest bin table the sort-free path will allocate
+
+
+def build_dictionaries(columns: list[np.ndarray]):
+    """Launch dictionary builds for a row group's columns, batching columns
+    that can share one vmapped program.  Returns one handle per column with
+    ``.unique_counts()[j]``/``.result(j)`` semantics as (batch, j) pairs.
+
+    Mode selection per column:
+    - non-negative ints with (max - min) < RANGE_MAX -> binning batch,
+      grouped by bin-table bucket (sort-free, O(n + R));
+    - everything else -> lexsort batch, grouped by key width.
+    """
+    groups: dict = {}
+    metas: list = [None] * len(columns)
+    for i, arr in enumerate(columns):
+        # group key carries the EXACT length: a batch stacks columns into one
+        # (C, N) array, so all members must share N (nullable columns with
+        # different null counts land in different batches)
+        mode = None
+        if arr.dtype.kind in "iu" and len(arr):
+            vmin, vmax = int(arr.min()), int(arr.max())
+            if vmin >= 0 and (vmax - vmin) < RANGE_MAX:
+                R = pad_bucket((vmax - vmin) + 1)
+                mode = ("bins", len(arr), R)
+                metas[i] = vmin
+        if mode is None:
+            mode = ("sort", len(arr), arr.dtype.itemsize == 8)
+        groups.setdefault(mode, []).append(i)
+    handles: list = [None] * len(columns)
+    for mode, idxs in groups.items():
+        cols = [columns[i] for i in idxs]
+        if mode[0] == "bins":
+            batch = BinDictBuild(cols, [metas[i] for i in idxs], mode[2])
+        else:
+            batch = BatchDictBuild(cols, wide=mode[2])
+        for j, i in enumerate(idxs):
+            handles[i] = (batch, j)
+    return handles
+
+
 class DictBuildHandle:
-    """In-flight device dictionary build for one column chunk."""
+    """Single-column convenience wrapper over build_dictionaries."""
 
     def __init__(self, values: np.ndarray):
-        n = len(values)
-        bucket = pad_bucket(n)
-        hi, lo = split_keys(np.ascontiguousarray(values))
-        lo_p = np.zeros(bucket, np.uint32)
-        lo_p[:n] = lo
-        wide = hi is not None
-        if wide:
-            hi_p = np.zeros(bucket, np.uint32)
-            hi_p[:n] = hi
-        else:
-            hi_p = lo_p  # unused operand placeholder
         self.values = values
-        self.n = n
-        self.occ_first, self.indices, self._k = _dict_build(
-            jnp.asarray(hi_p), jnp.asarray(lo_p), jnp.int32(n), wide)
+        self.n = len(values)
+        self._batch, self._j = build_dictionaries([values])[0]
 
     def result(self) -> tuple[np.ndarray, jax.Array]:
         """Block on the unique count and return (dict_values, device indices).
-        dict_values is in first-occurrence order, matching the CPU oracle."""
-        k = int(self._k)
-        occ = np.asarray(self.occ_first)[:k]
-        return self.values[occ], self.indices
+        dict_values is in ascending bit-pattern order, matching the CPU
+        oracle."""
+        return self._batch.result(self._j)

@@ -60,11 +60,11 @@ def test_dict_build_matches_cpu(dtype):
     np.testing.assert_array_equal(got_idx, want_idx.astype(np.uint32))
 
 
-def test_dict_build_first_occurrence_order():
+def test_dict_build_ascending_order():
     values = np.array([7, 3, 7, 9, 3, 1, 9, 7], np.int64)
     d, idx = DictBuildHandle(values).result()
-    np.testing.assert_array_equal(d, [7, 3, 9, 1])
-    np.testing.assert_array_equal(np.asarray(idx)[:8], [0, 1, 0, 2, 1, 3, 2, 0])
+    np.testing.assert_array_equal(d, [1, 3, 7, 9])
+    np.testing.assert_array_equal(np.asarray(idx)[:8], [2, 1, 2, 3, 1, 0, 3, 2])
 
 
 def test_pad_bucket():
@@ -211,3 +211,26 @@ def test_encode_many_pipelined_matches_sequential():
         single.append(e)
     for a, b in zip(many, single):
         assert a.blob == b.blob
+
+
+def test_file_identity_nullable_differing_counts():
+    """Regression: two same-bucket columns with different present-value
+    counts must not share a stacked dictionary batch."""
+    rng = np.random.default_rng(9)
+    n = 6000
+    a_vals = rng.integers(0, 40, n).astype(np.int64)
+    a_valid = rng.random(n) > 0.5   # ~3000 present
+    b_vals = rng.integers(0, 40, n).astype(np.int64)
+    b_valid = rng.random(n) > 0.1   # ~5400 present
+    f_vals = rng.choice(rng.normal(size=16), n)  # sort path, differing count
+    f_valid = rng.random(n) > 0.3
+    schema = Schema([
+        leaf("a", "int64", repetition=Repetition.OPTIONAL),
+        leaf("b", "int64", repetition=Repetition.OPTIONAL),
+        leaf("f", "double", repetition=Repetition.OPTIONAL),
+    ])
+    arrays = {"a": (a_vals, a_valid), "b": (b_vals, b_valid), "f": (f_vals, f_valid)}
+    buf = _identity_case(schema, arrays)
+    table = pq.read_table(buf)
+    got = table["b"].to_numpy(zero_copy_only=False)
+    np.testing.assert_array_equal(got[b_valid].astype(np.int64), b_vals[b_valid])
