@@ -220,6 +220,19 @@ def dictionary_build(values, physical_type: int):
     the mesh-global merged dictionaries (kpw_tpu.parallel.dict_merge), and
     this CPU oracle produces the identical bytes."""
     if physical_type == PhysicalType.BYTE_ARRAY or physical_type == PhysicalType.FIXED_LEN_BYTE_ARRAY:
+        # Vectorized path: numpy 'S' arrays sort bytes lexicographically, same
+        # order as python bytes.  'S' storage strips trailing NULs and is
+        # fixed-width (n x max_len), so gate on both: trailing-NUL data and
+        # length-skewed data (one huge value would blow the allocation up to
+        # n*max_len) take the exact hash-map path.
+        if (
+            len(values)
+            and len(values) * max(map(len, values)) <= 1 << 28  # 256 MiB cap
+            and not any(v[-1:] == b"\x00" for v in values)
+        ):
+            arr = np.array(values, dtype="S")
+            uniq, inv = np.unique(arr, return_inverse=True)
+            return [bytes(u) for u in uniq], inv.astype(np.uint32)
         table = sorted(set(values))
         slots = {v: i for i, v in enumerate(table)}
         idx = np.fromiter((slots[v] for v in values), np.uint32, count=len(values))
@@ -252,13 +265,17 @@ _DELTA_MINIBLOCKS = 4
 _DELTA_MB_SIZE = _DELTA_BLOCK // _DELTA_MINIBLOCKS  # 32
 
 
-def delta_binary_packed_encode(values: np.ndarray) -> bytes:
+def delta_binary_packed_encode(values: np.ndarray, bit_size: int = 64) -> bytes:
     """DELTA_BINARY_PACKED per the spec: header (block size, miniblock count,
     total count, zigzag first value) then per-block min-delta + per-miniblock
-    bit widths + packed deltas."""
+    bit widths + packed deltas.  ``bit_size`` selects the ring arithmetic:
+    INT32 columns use 32-bit wraparound deltas (so widths never exceed 32),
+    INT64 uses 64-bit — matching what readers decode into."""
     from .thrift import varint_bytes, zigzag
 
-    v = np.asarray(values, np.int64)
+    itype = np.int64 if bit_size == 64 else np.int32
+    utype = np.uint64 if bit_size == 64 else np.uint32
+    v = np.asarray(values, itype)
     n = len(v)
     out = bytearray()
     out += varint_bytes(_DELTA_BLOCK)
@@ -270,9 +287,9 @@ def delta_binary_packed_encode(values: np.ndarray) -> bytes:
     out += varint_bytes(zigzag(int(v[0])))
     if n == 1:
         return bytes(out)
-    # Deltas are defined with int64 wraparound semantics: readers decode the
-    # zigzag min_delta into a wrapping 64-bit long, so we must produce the
-    # same ring arithmetic (numpy int64 subtraction wraps).
+    # Ring arithmetic: readers decode the zigzag min_delta into a wrapping
+    # 32/64-bit int, so we must produce the same wraparound (numpy signed
+    # subtraction wraps).
     with np.errstate(over="ignore"):
         deltas = v[1:] - v[:-1]
     pos = 0
@@ -282,7 +299,7 @@ def delta_binary_packed_encode(values: np.ndarray) -> bytes:
         min_delta = int(block.min())
         out += varint_bytes(zigzag(min_delta))
         with np.errstate(over="ignore"):
-            rel = (block - np.int64(min_delta)).view(np.uint64)
+            rel = (block - itype(min_delta)).view(utype)
         widths = []
         packed_parts = []
         for mb in range(_DELTA_MINIBLOCKS):
@@ -306,6 +323,7 @@ def delta_binary_packed_encode(values: np.ndarray) -> bytes:
 
 
 def delta_length_byte_array_encode(values) -> bytes:
-    """DELTA_LENGTH_BYTE_ARRAY: delta-packed lengths then concatenated bytes."""
+    """DELTA_LENGTH_BYTE_ARRAY: delta-packed int32 lengths (per spec) then
+    concatenated bytes."""
     lens = np.fromiter((len(v) for v in values), np.int64, count=len(values))
-    return delta_binary_packed_encode(lens) + b"".join(values)
+    return delta_binary_packed_encode(lens, bit_size=32) + b"".join(values)

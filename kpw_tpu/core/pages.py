@@ -98,6 +98,11 @@ class EncoderOptions:
     dictionary_page_size_limit: int = 1024 * 1024
     max_dictionary_ratio: float = 0.67  # fall back to plain beyond this
     write_statistics: bool = True
+    # Fallback value encoding when the dictionary is rejected/disabled:
+    # False -> PLAIN (parquet-mr v1 behavior); True -> DELTA_BINARY_PACKED
+    # for int columns and DELTA_LENGTH_BYTE_ARRAY for byte arrays
+    # (BASELINE.md config 3: high-cardinality/string-heavy workloads).
+    delta_fallback: bool = False
 
 
 class CpuChunkEncoder:
@@ -124,6 +129,23 @@ class CpuChunkEncoder:
 
     def _plain_body(self, values, pt: int) -> bytes:
         return enc.plain_encode(values, pt)
+
+    def _fallback_encoding(self, pt: int) -> int:
+        """Value encoding for non-dictionary chunks."""
+        if self.options.delta_fallback:
+            if pt in (PhysicalType.INT32, PhysicalType.INT64):
+                return Encoding.DELTA_BINARY_PACKED
+            if pt == PhysicalType.BYTE_ARRAY:
+                return Encoding.DELTA_LENGTH_BYTE_ARRAY
+        return Encoding.PLAIN
+
+    def _values_body(self, values, pt: int, encoding: int) -> bytes:
+        if encoding == Encoding.DELTA_BINARY_PACKED:
+            bit_size = 32 if pt == PhysicalType.INT32 else 64
+            return enc.delta_binary_packed_encode(np.asarray(values), bit_size)
+        if encoding == Encoding.DELTA_LENGTH_BYTE_ARRAY:
+            return enc.delta_length_byte_array_encode(values)
+        return self._plain_body(values, pt)
 
     def _levels_body(self, levels: np.ndarray, max_level: int) -> bytes:
         return enc.rle_levels_v1(levels, max_level)
@@ -234,8 +256,8 @@ class CpuChunkEncoder:
             value_encoding = Encoding.PLAIN_DICTIONARY
             encodings.update([Encoding.PLAIN_DICTIONARY, Encoding.RLE])
         else:
-            value_encoding = Encoding.PLAIN
-            encodings.add(Encoding.PLAIN)
+            value_encoding = self._fallback_encoding(pt)
+            encodings.add(value_encoding)
         if col.max_def > 0 or col.max_rep > 0:
             encodings.add(Encoding.RLE)
 
@@ -257,7 +279,7 @@ class CpuChunkEncoder:
             if use_dict:
                 values_body = self._indices_body(indices, va, vb, len(dict_values))
             else:
-                values_body = self._plain_body(chunk.values[va:vb], pt)
+                values_body = self._values_body(chunk.values[va:vb], pt, value_encoding)
             body = levels_blob + values_body
             comp = compress(body, opts.codec)
             header = write_page_header(

@@ -18,6 +18,7 @@ import numpy as np
 from .metadata import ColumnChunk, FileMetaData, RowGroup
 from .pages import ColumnChunkData, CpuChunkEncoder, EncoderOptions
 from .schema import Schema
+from ..utils.tracing import stage
 
 MAGIC = b"PAR1"
 
@@ -32,6 +33,7 @@ class WriterProperties:
     codec: int = 0
     enable_dictionary: bool = True
     write_statistics: bool = True
+    delta_fallback: bool = False
     key_value_metadata: dict = field(default_factory=dict)
 
     def encoder_options(self) -> EncoderOptions:
@@ -40,6 +42,7 @@ class WriterProperties:
             enable_dictionary=self.enable_dictionary,
             data_page_size=self.data_page_size,
             write_statistics=self.write_statistics,
+            delta_fallback=self.delta_fallback,
         )
 
 
@@ -163,14 +166,15 @@ class ParquetFileWriter:
         blobs: list[bytes] = []
         total_byte_size = 0
         total_compressed = 0
-        if hasattr(self.encoder, "encode_many"):
-            encoded_chunks = self.encoder.encode_many(chunks, rg_start)
-        else:
-            encoded_chunks, offset = [], rg_start
-            for chunk in chunks:
-                e = self.encoder.encode(chunk, offset)
-                offset += len(e.blob)
-                encoded_chunks.append(e)
+        with stage("rowgroup.encode"):
+            if hasattr(self.encoder, "encode_many"):
+                encoded_chunks = self.encoder.encode_many(chunks, rg_start)
+            else:
+                encoded_chunks, offset = [], rg_start
+                for chunk in chunks:
+                    e = self.encoder.encode(chunk, offset)
+                    offset += len(e.blob)
+                    encoded_chunks.append(e)
         for encoded in encoded_chunks:
             blobs.append(encoded.blob)
             columns.append(ColumnChunk(
@@ -179,7 +183,8 @@ class ParquetFileWriter:
             ))
             total_byte_size += encoded.meta.total_uncompressed_size
             total_compressed += encoded.meta.total_compressed_size
-        self._write(b"".join(blobs))  # raises => state untouched, retry safe
+        with stage("rowgroup.io_write"):
+            self._write(b"".join(blobs))  # raises => state untouched, retry safe
         self._pending = None
         self._pending_rows = 0
         self._pending_bytes = 0

@@ -34,6 +34,7 @@ from ..core.schema import PhysicalType
 from ..core.thrift import varint_bytes
 from .dictionary import DictBuildHandle, build_dictionaries
 from .packing import pack_page, pack_page_host, pad_bucket
+from ..utils.tracing import stage
 
 import jax
 import jax.numpy as jnp
@@ -90,13 +91,15 @@ class TpuChunkEncoder(CpuChunkEncoder):
 
     # -- batched launch (pipelined via encode_many) ------------------------
     def encode_many(self, chunks: list[ColumnChunkData], base_offset: int):
-        pres = self._prepare_all(chunks)
-        out = []
-        offset = base_offset
-        for chunk, pre in zip(chunks, pres):
-            e = self.encode(chunk, offset, pre=pre)
-            offset += len(e.blob)
-            out.append(e)
+        with stage("encode.launch"):
+            pres = self._prepare_all(chunks)
+        with stage("encode.assemble"):
+            out = []
+            offset = base_offset
+            for chunk, pre in zip(chunks, pres):
+                e = self.encode(chunk, offset, pre=pre)
+                offset += len(e.blob)
+                out.append(e)
         return out
 
     def _prepare_all(self, chunks):

@@ -43,6 +43,7 @@ class Builder:
         self._page_size = 1024 * 1024  # sane default; NOT the reference quirk
         self._codec = 0  # UNCOMPRESSED (:484)
         self._enable_dictionary = True  # (:489)
+        self._delta_fallback = False  # BASELINE config 3 opt-in
         self._file_date_time_pattern = "%Y%m%d-%H%M%S%f"  # (:486-487 analog)
         self._directory_date_time_pattern: str | None = None
         self._file_extension = ".parquet"  # (:488)
@@ -135,6 +136,12 @@ class Builder:
         self._enable_dictionary = flag
         return self
 
+    def delta_fallback(self, flag: bool) -> "Builder":
+        """Use DELTA_BINARY_PACKED / DELTA_LENGTH_BYTE_ARRAY instead of
+        PLAIN when a column's dictionary is rejected (high cardinality)."""
+        self._delta_fallback = flag
+        return self
+
     # -- naming / placement ------------------------------------------------
     def file_date_time_pattern(self, strftime_pattern: str) -> "Builder":
         self._file_date_time_pattern = strftime_pattern
@@ -222,4 +229,5 @@ class Builder:
             data_page_size=self._page_size,
             codec=self._codec,
             enable_dictionary=self._enable_dictionary,
+            delta_fallback=self._delta_fallback,
         )

@@ -1,0 +1,3 @@
+"""Cross-cutting utilities: tracing/profiling (SURVEY.md §5)."""
+
+from .tracing import StageTimer, get_tracer, set_tracer, stage  # noqa: F401

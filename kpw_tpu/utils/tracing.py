@@ -1,0 +1,87 @@
+"""Per-stage tracing/profiling for the encode pipeline.
+
+The reference has no tracing — only lifecycle logging (SURVEY.md §5,
+KafkaProtoParquetWriter.java:172-197).  The TPU rebuild needs real stage
+attribution because the pipeline is host ingest / device encode / host
+flush: a slowdown can hide in device dispatch, host assembly, or IO.
+
+Two layers, both zero-cost when disabled:
+
+- :class:`StageTimer` — cumulative wall-clock + call counts per stage,
+  queryable programmatically (the metrics analog of the reference's
+  written/flushed meters, KPW.java:144-151, but for time).
+- ``jax.profiler.TraceAnnotation`` — when a JAX profiler trace is being
+  captured, the same ``stage(...)`` spans show up on the TensorBoard/Perfetto
+  timeline against the device activity.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+
+
+class StageTimer:
+    """Thread-safe cumulative timer keyed by stage name."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._total: dict[str, float] = {}
+        self._count: dict[str, int] = {}
+
+    def record(self, name: str, seconds: float) -> None:
+        with self._lock:
+            self._total[name] = self._total.get(name, 0.0) + seconds
+            self._count[name] = self._count.get(name, 0) + 1
+
+    def summary(self) -> dict[str, dict[str, float]]:
+        with self._lock:
+            return {
+                name: {"seconds": self._total[name], "calls": self._count[name]}
+                for name in sorted(self._total)
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._total.clear()
+            self._count.clear()
+
+
+_tracer: StageTimer | None = None
+
+
+def set_tracer(tracer: StageTimer | None) -> None:
+    """Install (or remove) the process-wide stage timer."""
+    global _tracer
+    _tracer = tracer
+
+
+def get_tracer() -> StageTimer | None:
+    return _tracer
+
+
+@contextmanager
+def stage(name: str):
+    """Span a pipeline stage: feeds the installed StageTimer and annotates
+    the JAX profiler timeline.  A true no-op (just a yield) when no tracer is
+    installed, so the hot path pays nothing by default."""
+    tracer = _tracer
+    if tracer is None:
+        yield
+        return
+    annotation = None
+    try:
+        import jax.profiler
+
+        annotation = jax.profiler.TraceAnnotation(name)
+        annotation.__enter__()
+    except Exception:
+        annotation = None
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        if annotation is not None:
+            annotation.__exit__(None, None, None)
+        tracer.record(name, time.perf_counter() - t0)

@@ -303,3 +303,90 @@ def test_transient_io_failure_loses_nothing():
     t = pq.read_table(sink)
     np.testing.assert_array_equal(t["a"].to_numpy(), vals)
     assert t["s"].to_pylist() == [s.decode() for s in strs]
+
+
+def test_delta_fallback_int64():
+    """BASELINE config 3: high-cardinality ints fall back to
+    DELTA_BINARY_PACKED instead of PLAIN; pyarrow decodes it."""
+    rng = np.random.default_rng(40)
+    vals = np.cumsum(rng.integers(-1000, 1000, 30000)).astype(np.int64)
+    schema = Schema([leaf("x", "int64")])
+    buf = io.BytesIO()
+    props = WriterProperties(delta_fallback=True, enable_dictionary=False)
+    w = ParquetFileWriter(buf, schema, props)
+    w.write_batch(columns_from_arrays(schema, {"x": vals}))
+    w.close()
+    buf.seek(0)
+    table = pq.read_table(buf)
+    np.testing.assert_array_equal(table["x"].to_numpy(), vals)
+    buf.seek(0)
+    meta = pq.read_metadata(buf)
+    assert "DELTA_BINARY_PACKED" in meta.row_group(0).column(0).encodings
+    # delta beats plain on smooth data
+    assert meta.row_group(0).column(0).total_compressed_size < 8 * len(vals)
+
+
+def test_delta_length_byte_array_fallback():
+    rng = np.random.default_rng(41)
+    vals = [f"user-{i:08x}-{rng.integers(1e9):09d}".encode() for i in range(8000)]
+    schema = Schema([leaf("s", "string")])
+    buf = io.BytesIO()
+    props = WriterProperties(delta_fallback=True, enable_dictionary=False)
+    w = ParquetFileWriter(buf, schema, props)
+    w.write_batch(columns_from_arrays(schema, {"s": vals}))
+    w.close()
+    buf.seek(0)
+    table = pq.read_table(buf)
+    assert [v.as_py().encode() for v in table["s"]] == vals
+    buf.seek(0)
+    meta = pq.read_metadata(buf)
+    assert "DELTA_LENGTH_BYTE_ARRAY" in meta.row_group(0).column(0).encodings
+
+
+def test_delta_fallback_zstd_roundtrip():
+    """Config 3 full shape: high-cardinality + delta + ZSTD codec."""
+    rng = np.random.default_rng(42)
+    ints = np.cumsum(rng.integers(0, 50, 20000)).astype(np.int64)
+    strs = [f"id-{v:012d}".encode() for v in rng.integers(0, 2**40, 20000)]
+    schema = Schema([leaf("x", "int64"), leaf("s", "string")])
+    buf = io.BytesIO()
+    props = WriterProperties(delta_fallback=True, enable_dictionary=False,
+                             codec=Codec.ZSTD)
+    w = ParquetFileWriter(buf, schema, props)
+    w.write_batch(columns_from_arrays(schema, {"x": ints, "s": strs}))
+    w.close()
+    buf.seek(0)
+    table = pq.read_table(buf)
+    np.testing.assert_array_equal(table["x"].to_numpy(), ints)
+    assert [v.as_py().encode() for v in table["s"]] == strs
+
+
+def test_string_dictionary_trailing_nul():
+    """Binary values with trailing NULs must survive the vectorized string
+    dictionary path (numpy 'S' strips trailing NULs; those take the map path)."""
+    vals = [b"a\x00", b"a", b"b\x00\x00", b"b", b"a\x00"] * 100
+    d, idx = enc.dictionary_build(vals, 6)  # PhysicalType.BYTE_ARRAY
+    assert [d[i] for i in idx] == vals
+    assert sorted(d) == sorted(set(vals))
+
+
+def test_delta_int32_wraparound():
+    """INT32 delta must use 32-bit ring arithmetic (widths <= 32)."""
+    vals = np.array([-2_000_000_000, 2_000_000_000] * 3000, np.int32)
+    schema = Schema([leaf("x", "int32")])
+    buf = io.BytesIO()
+    props = WriterProperties(delta_fallback=True, enable_dictionary=False)
+    w = ParquetFileWriter(buf, schema, props)
+    w.write_batch(columns_from_arrays(schema, {"x": vals}))
+    w.close()
+    buf.seek(0)
+    table = pq.read_table(buf)
+    np.testing.assert_array_equal(table["x"].to_numpy(), vals)
+
+
+def test_string_dictionary_length_skew_fallback():
+    """One huge value among many short ones must not trigger the n*max_len
+    'S' allocation."""
+    vals = [b"short"] * 10000 + [b"x" * 1_000_000]
+    d, idx = enc.dictionary_build(vals, 6)
+    assert [d[i] for i in idx] == vals

@@ -1,0 +1,36 @@
+"""Stage tracing (SURVEY.md §5: the rebuild's tracing/profiling subsystem)."""
+
+import io
+
+import numpy as np
+
+from kpw_tpu.core import ParquetFileWriter, Schema, WriterProperties, columns_from_arrays, leaf
+from kpw_tpu.ops import TpuChunkEncoder
+from kpw_tpu.utils import StageTimer, set_tracer, stage
+
+
+def test_stage_noop_without_tracer():
+    set_tracer(None)
+    with stage("anything"):
+        pass  # must not raise or record
+
+
+def test_stage_timing_pipeline():
+    timer = StageTimer()
+    set_tracer(timer)
+    try:
+        rng = np.random.default_rng(0)
+        schema = Schema([leaf("a", "int64")])
+        props = WriterProperties()
+        buf = io.BytesIO()
+        w = ParquetFileWriter(buf, schema, props,
+                              encoder=TpuChunkEncoder(props.encoder_options(), min_device_rows=1))
+        w.write_batch(columns_from_arrays(
+            schema, {"a": rng.integers(0, 50, 5000).astype(np.int64)}))
+        w.close()
+    finally:
+        set_tracer(None)
+    s = timer.summary()
+    assert {"rowgroup.encode", "rowgroup.io_write",
+            "encode.launch", "encode.assemble"} <= set(s)
+    assert all(v["calls"] >= 1 and v["seconds"] >= 0 for v in s.values())
