@@ -49,15 +49,18 @@ def make_taxi_like(rows: int, seed: int = 0) -> dict[str, np.ndarray]:
 
 def bench_ours(arrays, schema_cols) -> float:
     from kpw_tpu.core import ParquetFileWriter, Schema, WriterProperties, columns_from_arrays, leaf
-    from kpw_tpu.ops import TpuChunkEncoder
+    from kpw_tpu.runtime.select import choose_backend, make_encoder, probe_link
 
     schema = Schema([leaf(n, t) for n, t in schema_cols])
     props = WriterProperties()
+    print(f"[bench] link probe: {probe_link()}", file=sys.stderr)
+    backend = choose_backend()
+    print(f"[bench] backend: {backend}", file=sys.stderr)
 
     def run() -> int:
         buf = io.BytesIO()
         w = ParquetFileWriter(buf, schema, props,
-                              encoder=TpuChunkEncoder(props.encoder_options()))
+                              encoder=make_encoder(props.encoder_options(), backend))
         w.write_batch(columns_from_arrays(schema, arrays))
         w.close()
         return buf.tell()

@@ -150,6 +150,13 @@ class CpuChunkEncoder:
     def _levels_body(self, levels: np.ndarray, max_level: int) -> bytes:
         return enc.rle_levels_v1(levels, max_level)
 
+    def _try_dictionary(self, chunk: ColumnChunkData):
+        """Build (dict_values, indices), or return None when the build can
+        prove ahead of time that the dictionary would be rejected (backends
+        may abort early; the resulting file bytes are identical either way
+        because rejection falls back to the same non-dictionary encoding)."""
+        return self._dictionary_build(chunk.values, chunk.column.leaf.physical_type)
+
     def prepare(self, chunk: ColumnChunkData):
         """Launch-phase hook for pipelined backends: precompute whatever can
         be dispatched asynchronously for ``chunk``; the result is handed back
@@ -223,13 +230,16 @@ class CpuChunkEncoder:
         indices = None
         if self._dictionary_viable(chunk):
             built = self._finish_prepare(pre) if pre is not None else None
-            dict_values, indices = built if built is not None else self._dictionary_build(chunk.values, pt)
-            n_uniq = len(dict_values)
-            n = len(indices)
-            if n_uniq <= max(1, int(n * opts.max_dictionary_ratio)):
-                dict_plain = enc.plain_encode(dict_values, pt)
-                if len(dict_plain) <= opts.dictionary_page_size_limit:
-                    use_dict = True
+            if built is None:
+                built = self._try_dictionary(chunk)
+            if built is not None:
+                dict_values, indices = built
+                n_uniq = len(dict_values)
+                n = len(indices)
+                if n_uniq <= max(1, int(n * opts.max_dictionary_ratio)):
+                    dict_plain = enc.plain_encode(dict_values, pt)
+                    if len(dict_plain) <= opts.dictionary_page_size_limit:
+                        use_dict = True
 
         blob = bytearray()
         encodings = set()
@@ -301,7 +311,10 @@ class CpuChunkEncoder:
 
         stats = None
         if opts.write_statistics:
-            lo, hi = _min_max_bytes(chunk.values, pt)
+            # The dictionary is exactly the set of present values, so its
+            # min/max equals the column's — O(k) instead of O(n).
+            stat_src = dict_values if use_dict else chunk.values
+            lo, hi = _min_max_bytes(stat_src, pt)
             null_count = None
             if chunk.def_levels is not None:
                 null_count = int((chunk.def_levels < col.max_def).sum())

@@ -13,12 +13,14 @@ import subprocess
 import tempfile
 
 _SRC_DIR = os.path.dirname(os.path.abspath(__file__))
-_SRC = os.path.join(_SRC_DIR, "src", "codecs.cc")
+_SRCS = [os.path.join(_SRC_DIR, "src", "codecs.cc"),
+         os.path.join(_SRC_DIR, "src", "encode.cc")]
 _SO = os.path.join(_SRC_DIR, "_kpw_native.so")
 
 
 def _build() -> str:
-    if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
+    if os.path.exists(_SO) and all(
+            os.path.getmtime(_SO) >= os.path.getmtime(s) for s in _SRCS):
         return _SO
     base = ["g++", "-O3", "-fPIC", "-shared", "-std=c++17", "-o"]
     # build into a temp file then atomic-rename (parallel test runners)
@@ -26,10 +28,10 @@ def _build() -> str:
     os.close(fd)
     try:
         try:
-            subprocess.run(base + [tmp, _SRC, "-lzstd"], check=True,
+            subprocess.run(base + [tmp, *_SRCS, "-lzstd"], check=True,
                            capture_output=True)
         except subprocess.CalledProcessError:
-            subprocess.run(base + [tmp, _SRC, "-DKPW_NO_ZSTD"], check=True,
+            subprocess.run(base + [tmp, *_SRCS, "-DKPW_NO_ZSTD"], check=True,
                            capture_output=True)
         os.replace(tmp, _SO)
     finally:
@@ -73,6 +75,19 @@ class NativeLib:
         cdll.kpw_byte_array_gather.argtypes = [
             c_p, ctypes.POINTER(ctypes.c_int64),
             ctypes.POINTER(ctypes.c_int32), c_sz, c_p]
+        c_u32p = ctypes.POINTER(ctypes.c_uint32)
+        c_u64p = ctypes.POINTER(ctypes.c_uint64)
+        cdll.kpw_dict_build_u32.restype = ctypes.c_int
+        cdll.kpw_dict_build_u32.argtypes = [
+            c_u32p, c_sz, c_u32p, c_u32p, ctypes.c_uint32, c_u32p]
+        cdll.kpw_dict_build_u64.restype = ctypes.c_int
+        cdll.kpw_dict_build_u64.argtypes = [
+            c_u64p, c_sz, c_u64p, c_u32p, ctypes.c_uint32, c_u32p]
+        cdll.kpw_rle_hybrid_cap.restype = c_sz
+        cdll.kpw_rle_hybrid_cap.argtypes = [c_sz, ctypes.c_int]
+        cdll.kpw_rle_hybrid_u32.restype = ctypes.c_int
+        cdll.kpw_rle_hybrid_u32.argtypes = [
+            c_u32p, c_sz, ctypes.c_int, c_p, ctypes.POINTER(c_sz)]
 
     # -- snappy ------------------------------------------------------------
     def snappy_compress(self, data: bytes) -> bytes:
@@ -152,6 +167,53 @@ class NativeLib:
             dict_data, offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
             idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), len(idx), out)
         return out.raw[:total]
+
+
+    # -- encoding primitives ----------------------------------------------
+    def dict_build(self, keys, max_k: int | None = None):
+        """Ascending bit-pattern dictionary + uint32 indices for a uint32 or
+        uint64 key array (kpw_tpu.core.encodings.dictionary_build semantics).
+        Returns None when the unique count exceeds ``max_k`` (early abort:
+        the dictionary would be rejected anyway)."""
+        import numpy as np
+
+        arr = np.ascontiguousarray(keys)
+        n = len(arr)
+        cap = n if max_k is None else min(n, max_k)
+        idx = np.empty(n, np.uint32)
+        dict_out = np.empty(cap, arr.dtype)
+        k = ctypes.c_uint32(0)
+        u32p = ctypes.POINTER(ctypes.c_uint32)
+        if arr.dtype.itemsize == 8:
+            u64p = ctypes.POINTER(ctypes.c_uint64)
+            rc = self._c.kpw_dict_build_u64(
+                arr.ctypes.data_as(u64p), n, dict_out.ctypes.data_as(u64p),
+                idx.ctypes.data_as(u32p), cap, ctypes.byref(k))
+        else:
+            rc = self._c.kpw_dict_build_u32(
+                arr.ctypes.data_as(u32p), n, dict_out.ctypes.data_as(u32p),
+                idx.ctypes.data_as(u32p), cap, ctypes.byref(k))
+        if rc == 1:
+            return None
+        if rc != 0:
+            raise RuntimeError(f"kpw_dict_build rc={rc}")
+        return dict_out[: k.value].copy(), idx
+
+    def rle_hybrid(self, values, width: int) -> bytes:
+        """RLE/bit-pack hybrid stream, byte-identical to
+        kpw_tpu.core.encodings.rle_hybrid_encode."""
+        import numpy as np
+
+        v = np.ascontiguousarray(values, np.uint32)
+        cap = self._c.kpw_rle_hybrid_cap(len(v), width)
+        out = ctypes.create_string_buffer(cap)
+        out_len = ctypes.c_size_t(0)
+        rc = self._c.kpw_rle_hybrid_u32(
+            v.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)), len(v), width,
+            out, ctypes.byref(out_len))
+        if rc != 0:
+            raise RuntimeError(f"kpw_rle_hybrid rc={rc}")
+        return out.raw[: out_len.value]
 
 
 def load() -> NativeLib:

@@ -1,0 +1,81 @@
+"""NativeChunkEncoder — C++ host encode path at the pluggable boundary.
+
+Same primitive-op boundary as the TPU backend (kpw_tpu/core/pages.py
+``CpuChunkEncoder``), with dictionary build and RLE/bit-pack moved into the
+native library (src/encode.cc).  Output is byte-identical to the numpy
+oracle; anything the native path doesn't cover (strings, narrow dtypes,
+missing .so) falls through to the superclass.
+
+This is the fast single-host CPU path — the rebuild's counterpart of
+parquet-mr's C++-less JVM encode stack reached from ParquetFile.java:59-62,
+and the backend the auto-selector picks when the accelerator link is too
+slow to pay for offload (kpw_tpu/runtime/writer.py).
+"""
+
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+from ..core import encodings as enc
+from ..core.pages import CpuChunkEncoder, EncoderOptions
+from ..core.schema import PhysicalType
+from . import lib
+
+
+class NativeChunkEncoder(CpuChunkEncoder):
+    """Byte-identical C++ implementation of the chunk encoder primitives."""
+
+    def __init__(self, options: EncoderOptions) -> None:
+        super().__init__(options)
+        self._lib = lib()
+
+    def _native_ok(self, values, pt: int) -> bool:
+        return (
+            self._lib is not None
+            and isinstance(values, np.ndarray)
+            and values.dtype.kind in "iuf"
+            and values.dtype.itemsize in (4, 8)
+            and pt not in (PhysicalType.BOOLEAN, PhysicalType.BYTE_ARRAY,
+                           PhysicalType.FIXED_LEN_BYTE_ARRAY)
+        )
+
+    def _dictionary_build(self, values, pt: int):
+        if not self._native_ok(values, pt):
+            return super()._dictionary_build(values, pt)
+        key = values.view(np.uint32 if values.dtype.itemsize == 4 else np.uint64)
+        d, idx = self._lib.dict_build(key)
+        return d.view(values.dtype), idx
+
+    def _try_dictionary(self, chunk):
+        values = chunk.values
+        pt = chunk.column.leaf.physical_type
+        if not self._native_ok(values, pt):
+            return super()._try_dictionary(chunk)
+        # Largest k that would survive the rejection checks in encode():
+        # the ratio bound and the dictionary-page byte budget.
+        n = len(values)
+        opts = self.options
+        max_k = min(max(1, int(n * opts.max_dictionary_ratio)),
+                    opts.dictionary_page_size_limit // values.dtype.itemsize)
+        key = values.view(np.uint32 if values.dtype.itemsize == 4 else np.uint64)
+        built = self._lib.dict_build(key, max_k=max_k)
+        if built is None:
+            return None  # proven infeasible; encode() falls back to plain/delta
+        d, idx = built
+        return d.view(values.dtype), idx
+
+    def _indices_body(self, indices, va: int, vb: int, dict_size: int) -> bytes:
+        L = self._lib
+        if L is None or not isinstance(indices, np.ndarray):
+            return super()._indices_body(indices, va, vb, dict_size)
+        width = enc.bit_width(max(dict_size - 1, 0))
+        return bytes([width]) + L.rle_hybrid(indices[va:vb], width)
+
+    def _levels_body(self, levels: np.ndarray, max_level: int) -> bytes:
+        L = self._lib
+        if L is None:
+            return super()._levels_body(levels, max_level)
+        body = L.rle_hybrid(np.asarray(levels), enc.bit_width(max_level))
+        return struct.pack("<I", len(body)) + body

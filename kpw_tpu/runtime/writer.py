@@ -63,14 +63,17 @@ class KafkaProtoParquetWriter:
     def _make_encoder_factory(self, backend):
         if backend == "cpu" or backend is None:
             return lambda: None  # ParquetFileWriter builds the CPU encoder
-        if backend == "tpu":
-            try:
-                from ..ops.backend import TpuChunkEncoder
-            except ImportError as e:
-                raise NotImplementedError(
-                    "TPU encoder backend unavailable in this build") from e
+        if backend in ("tpu", "native", "auto"):
+            if backend == "tpu":  # fail fast at construction, not in a worker
+                try:
+                    from ..ops import backend as _ops_backend  # noqa: F401
+                except ImportError as e:
+                    raise NotImplementedError(
+                        "TPU encoder backend unavailable in this build") from e
+            from .select import make_encoder
+
             opts = self.properties.encoder_options()
-            return lambda: TpuChunkEncoder(opts)
+            return lambda: make_encoder(opts, backend)
         if callable(getattr(backend, "encode", None)):
             return lambda: backend
         raise ValueError(f"unknown encoder backend: {backend!r}")

@@ -125,3 +125,157 @@ def test_parquet_file_with_native_snappy(lib, tmp_path):
     t = pq.read_table(path)
     np.testing.assert_array_equal(t["a"].to_numpy(), vals)
     assert t["s"].to_pylist()[:3] == ["row-0", "row-1", "row-2"]
+
+
+# ---------------------------------------------------------------------------
+# native encode primitives (src/encode.cc) vs the numpy oracle
+# ---------------------------------------------------------------------------
+
+def test_native_rle_hybrid_matches_oracle(lib):
+    from kpw_tpu.core import encodings as enc
+
+    rng = np.random.default_rng(1)
+    cases = [
+        (np.zeros(0, np.uint32), 5),                      # empty
+        (np.zeros(100, np.uint32), 0),                    # width 0
+        (rng.integers(0, 2, 1000).astype(np.uint32), 1),  # booleans
+        (rng.integers(0, 300, 10_000).astype(np.uint32), 9),   # no long runs
+        (np.repeat(rng.integers(0, 16, 200), rng.integers(1, 50, 200)).astype(np.uint32), 4),  # run-heavy
+        (np.concatenate([np.full(1000, 7), rng.integers(0, 8, 77)]).astype(np.uint32), 3),  # run then noise tail
+        (rng.integers(0, 1 << 20, 5003).astype(np.uint32), 20),  # wide (>16) width
+        (np.repeat([5, 5, 9], [4, 3, 12]).astype(np.uint32), 4),  # short runs only
+    ]
+    for values, width in cases:
+        got = lib.rle_hybrid(values, width)
+        want = enc.rle_hybrid_encode(values, width)
+        assert got == want, f"width={width} n={len(values)}"
+        if len(values):
+            back = enc.rle_hybrid_decode(got, width, len(values))
+            np.testing.assert_array_equal(back, values.astype(np.uint64))
+
+
+def test_native_dict_build_matches_oracle(lib):
+    from kpw_tpu.core import encodings as enc
+    from kpw_tpu.core.schema import PhysicalType
+
+    rng = np.random.default_rng(2)
+    cols = [
+        (rng.integers(0, 8, 10_000).astype(np.int64), PhysicalType.INT64),
+        (rng.integers(-300, 300, 10_000).astype(np.int32), PhysicalType.INT32),  # negatives: bit-pattern order
+        ((rng.integers(0, 3000, 10_000) / 100.0), PhysicalType.DOUBLE),
+        (rng.integers(0, 1 << 40, 10_000).astype(np.int64), PhysicalType.INT64),  # high-card hash path
+        (rng.integers(0, 100, 10_000).astype(np.float32), PhysicalType.FLOAT),
+        (np.array([1.0, -1.0, 0.0, -0.0, np.nan, 1.0, np.nan]), PhysicalType.DOUBLE),  # nan/-0.0 bit patterns
+    ]
+    for values, pt in cols:
+        key = values.view(np.uint32 if values.dtype.itemsize == 4 else np.uint64)
+        d, idx = lib.dict_build(key)
+        want_d, want_idx = enc.dictionary_build(values, pt)
+        np.testing.assert_array_equal(d.view(values.dtype), want_d)
+        np.testing.assert_array_equal(idx, want_idx)
+
+
+def test_native_dict_build_max_k_abort(lib):
+    rng = np.random.default_rng(3)
+    vals = rng.integers(0, 1 << 40, 10_000).astype(np.uint64)  # ~all unique
+    assert lib.dict_build(vals, max_k=100) is None
+    low = rng.integers(0, 50, 10_000).astype(np.uint64)
+    assert lib.dict_build(low, max_k=100) is not None
+    # bounded-range path also aborts
+    wide = rng.integers(0, 5000, 10_000).astype(np.uint64)
+    assert lib.dict_build(wide, max_k=10) is None
+
+
+def _random_table(rng, rows):
+    return {
+        "lo": rng.integers(0, 10, rows).astype(np.int64),
+        "neg": rng.integers(-1000, 1000, rows).astype(np.int32),
+        "f": (rng.integers(0, 500, rows) / 10.0),
+        "hi": rng.integers(0, 1 << 50, rows).astype(np.int64),  # dict rejected
+        "s": [f"tag-{i % 37}".encode() for i in range(rows)],   # python fallback
+    }
+
+
+def test_native_encoder_byte_identical_to_cpu():
+    """File-level byte equality: NativeChunkEncoder vs the numpy oracle,
+    covering dict, plain fallback (high cardinality), strings, floats."""
+    import io
+
+    from kpw_tpu.core import ParquetFileWriter, Schema, WriterProperties
+    from kpw_tpu.core import columns_from_arrays, leaf
+    from kpw_tpu.core.pages import CpuChunkEncoder
+    from kpw_tpu.native.encoder import NativeChunkEncoder
+
+    rng = np.random.default_rng(4)
+    arrays = _random_table(rng, 20_000)
+    schema = Schema([
+        leaf("lo", "int64"), leaf("neg", "int32"), leaf("f", "double"),
+        leaf("hi", "int64"), leaf("s", "string"),
+    ])
+    props = WriterProperties()
+
+    def run(encoder):
+        buf = io.BytesIO()
+        w = ParquetFileWriter(buf, schema, props, encoder=encoder)
+        w.write_batch(columns_from_arrays(schema, arrays))
+        w.close()
+        return buf.getvalue()
+
+    opts = props.encoder_options()
+    assert run(NativeChunkEncoder(opts)) == run(CpuChunkEncoder(opts))
+
+
+def test_native_encoder_byte_identical_nullable_delta():
+    """Nullable columns (def levels through native _levels_body) and the
+    delta fallback config."""
+    import io
+
+    from kpw_tpu.core import ParquetFileWriter, Schema, WriterProperties
+    from kpw_tpu.core import columns_from_arrays, leaf
+    from kpw_tpu.core.pages import CpuChunkEncoder
+    from kpw_tpu.core.schema import Repetition
+    from kpw_tpu.native.encoder import NativeChunkEncoder
+
+    rng = np.random.default_rng(5)
+    rows = 10_000
+    vals = rng.integers(0, 1 << 45, rows).astype(np.int64)
+    valid = rng.random(rows) >= 0.2
+    schema = Schema([leaf("v", "int64", repetition=Repetition.OPTIONAL)])
+    props = WriterProperties(delta_fallback=True)
+
+    def run(encoder):
+        buf = io.BytesIO()
+        w = ParquetFileWriter(buf, schema, props, encoder=encoder)
+        w.write_batch(columns_from_arrays(schema, {"v": (vals, valid)}))
+        w.close()
+        return buf.getvalue()
+
+    opts = props.encoder_options()
+    assert run(NativeChunkEncoder(opts)) == run(CpuChunkEncoder(opts))
+
+
+def test_backend_selection_cpu_platform():
+    """On the CPU platform the auto selector must pick the native path."""
+    from kpw_tpu.core.pages import EncoderOptions
+    from kpw_tpu.native.encoder import NativeChunkEncoder
+    from kpw_tpu.ops.backend import TpuChunkEncoder
+    from kpw_tpu.runtime import select
+
+    assert select.choose_backend() == "native"
+    opts = EncoderOptions()
+    assert isinstance(select.make_encoder(opts, "auto"), NativeChunkEncoder)
+    assert isinstance(select.make_encoder(opts, "tpu"), TpuChunkEncoder)
+    assert type(select.make_encoder(opts, "cpu")).__name__ == "CpuChunkEncoder"
+
+
+def test_native_dict_build_full_span_keys(lib):
+    """int64 keys 0 and -1 span the whole uint64 space: the bounded-range
+    guard must not wrap (regression: heap overflow/segfault)."""
+    from kpw_tpu.core import encodings as enc
+    from kpw_tpu.core.schema import PhysicalType
+
+    values = np.array([0, -1, 0, -1, 5, -1, 0], np.int64)
+    d, idx = lib.dict_build(values.view(np.uint64))
+    want_d, want_idx = enc.dictionary_build(values, PhysicalType.INT64)
+    np.testing.assert_array_equal(d.view(np.int64), want_d)
+    np.testing.assert_array_equal(idx, want_idx)
