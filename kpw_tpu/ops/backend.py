@@ -33,7 +33,8 @@ from ..core.pages import ColumnChunkData, CpuChunkEncoder, EncoderOptions
 from ..core.schema import PhysicalType
 from ..core.thrift import varint_bytes
 from .dictionary import DictBuildHandle, build_dictionaries
-from .packing import pack_page, pack_page_host, pad_bucket
+from .packing import (gather_index_slices, pack_page, pack_page_host,
+                      pack_pages_multi, pad_bucket)
 from ..utils.tracing import stage
 
 import jax
@@ -71,6 +72,22 @@ class _DeviceIndices:
         return self.host()[sl]
 
 
+class _PageBodies:
+    """Fully-resolved data-page value bodies for one dictionary column,
+    keyed by the page's (va, vb) present-value range.  Stands in for the
+    ``indices`` object in encode(): len() is the present-value count the
+    dictionary ratio check needs."""
+
+    __slots__ = ("n", "bodies")
+
+    def __init__(self, n: int) -> None:
+        self.n = n
+        self.bodies: dict[tuple[int, int], bytes] = {}
+
+    def __len__(self) -> int:
+        return self.n
+
+
 class TpuChunkEncoder(CpuChunkEncoder):
     """Byte-identical TPU implementation of the chunk encoder."""
 
@@ -102,54 +119,155 @@ class TpuChunkEncoder(CpuChunkEncoder):
                 out.append(e)
         return out
 
+    def _page_value_ranges(self, chunk: ColumnChunkData) -> list[tuple[int, int]]:
+        """The (va, vb) present-value range of every data page, mirroring the
+        slot->value mapping in CpuChunkEncoder.encode exactly (page bodies are
+        keyed by these ranges at assembly time)."""
+        col = chunk.column
+        def_levels = chunk.def_levels
+        if def_levels is not None:
+            present = np.asarray(def_levels) == col.max_def
+            value_offsets = np.concatenate([[0], np.cumsum(present)])
+        out = []
+        for a, b in self._page_slot_ranges(chunk, chunk.estimated_bytes()):
+            if def_levels is not None:
+                out.append((int(value_offsets[a]), int(value_offsets[b])))
+            else:
+                out.append((a, b))
+        return out
+
     def _prepare_all(self, chunks):
-        """Phase A/B launcher: batched dict builds, then page-pack prefetch."""
+        """Fused row-group planner, built for high-latency links: every
+        device decision is batched so a whole row group costs a bounded
+        number of round trips —
+
+          1. grouped dictionary builds (build_dictionaries), then ONE
+             readback for every batch's unique counts;
+          2. dictionary accept/reject + page geometry decided on host, page
+             packs launched as one program per (batch, bucket, width) group
+             (pack_pages_multi) and dictionary key tables trimmed on device;
+          3. ONE bulk readback (device_get over the whole pytree) of all
+             packed pages + run stats + key tables;
+          4. the rare long-run pages are fetched in one extra gather and
+             finished with the host RLE assembler for byte-exact streams.
+        """
         slots: list = [None] * len(chunks)
         eligible = [
             (i, chunk) for i, chunk in enumerate(chunks)
             if self._dictionary_viable(chunk)
             and self._device_eligible(chunk.values, chunk.column.leaf.physical_type)
         ]
+        if not eligible:
+            return slots
+        opts = self.options
         handles = build_dictionaries([chunk.values for _, chunk in eligible])
+
+        batches: list = []
+        for batch, _ in handles:
+            if batch not in batches:
+                batches.append(batch)
+        for b, kv in zip(batches, jax.device_get(  # sync 1: all unique counts
+                [b.counts_device() for b in batches])):
+            b._k_host = np.asarray(kv)
+
+        col_plans = []
+        jobs: dict = {}  # (batch_id, bucket, width) -> (batch, [page rows])
+        accepted_kmax: dict = {}
         for (i, chunk), (batch, j) in zip(eligible, handles):
-            k = int(batch.unique_counts()[j])  # syncs once per batch (cached)
+            k = int(batch.unique_counts()[j])
             n = len(chunk.values)
             itemsize = chunk.values.dtype.itemsize
-            will_use_dict = (
-                k <= max(1, int(n * self.options.max_dictionary_ratio))
-                and k * itemsize <= self.options.dictionary_page_size_limit
-            )
-            dict_values, dev_idx = batch.result(j)
-            di = _DeviceIndices(dev_idx, batch.n)
-            slots[i] = (dict_values, di)
-            if will_use_dict:
-                self._prelaunch_pages(chunk, len(dict_values), di)
-        return slots
+            ok_ratio = k <= max(1, int(n * opts.max_dictionary_ratio))
+            will = ok_ratio and k * itemsize <= opts.dictionary_page_size_limit
+            width = enc.bit_width(max(k - 1, 0))
+            pages = self._page_value_ranges(chunk)
+            col_plans.append((i, chunk, batch, j, k, width, will, pages))
+            if will:
+                accepted_kmax[id(batch)] = max(accepted_kmax.get(id(batch), 1), k)
+                if width > 0:
+                    for va, vb in pages:
+                        count = vb - va
+                        if count <= 0:
+                            continue
+                        bucket = pad_bucket(count)
+                        jobs.setdefault((id(batch), bucket, width),
+                                        (batch, []))[1].append((i, j, va, vb, count))
 
-    def _prelaunch_pages(self, chunk: ColumnChunkData, dict_size: int,
-                         di: _DeviceIndices) -> None:
-        """Launch every page's pack+run-stats before any readback (async
-        dispatch).  Page geometry mirrors CpuChunkEncoder.encode exactly."""
-        width = enc.bit_width(max(dict_size - 1, 0))
-        if width == 0:
-            return
-        col = chunk.column
-        def_levels = chunk.def_levels
-        if def_levels is not None:
-            present = np.asarray(def_levels) == col.max_def
-            value_offsets = np.concatenate([[0], np.cumsum(present)])
-        for a, b in self._page_slot_ranges(chunk, chunk.estimated_bytes()):
-            if def_levels is not None:
-                va, vb = int(value_offsets[a]), int(value_offsets[b])
-            else:
-                va, vb = a, b
-            count = vb - va
-            if count <= 0:
-                continue
-            bucket = pad_bucket(count)
-            di.prefetched[(va, vb, width)] = pack_page(
-                di.padded_for(bucket), jnp.int32(va), jnp.int32(count),
+        group_meta = []
+        group_dev = []
+        for (bid, bucket, width), (batch, rows) in jobs.items():
+            packed, long_sum = pack_pages_multi(
+                batch.indices,
+                jnp.asarray(np.array([r[1] for r in rows], np.int32)),
+                jnp.asarray(np.array([r[2] for r in rows], np.int32)),
+                jnp.asarray(np.array([r[4] for r in rows], np.int32)),
                 bucket, width)
+            group_meta.append((rows, width, batch))
+            group_dev.append((packed, long_sum))
+        tables_dev = {
+            id(b): b.key_tables_device(pad_bucket(accepted_kmax[id(b)]))
+            for b in batches if id(b) in accepted_kmax
+        }
+
+        fetched = jax.device_get((group_dev, tables_dev))  # sync 2: bulk
+        groups_host, tables_host = fetched
+
+        bodies_by_slot: dict[int, _PageBodies] = {}
+
+        def bodies_for(i: int, n: int) -> _PageBodies:
+            pb = bodies_by_slot.get(i)
+            if pb is None:
+                pb = bodies_by_slot[i] = _PageBodies(n)
+            return pb
+
+        fallback: dict = {}  # (batch_id) -> (batch, [(i, j, va, vb, count, width)])
+        for (rows, width, batch), (packed_h, long_h) in zip(group_meta, groups_host):
+            for row, (i, j, va, vb, count) in enumerate(rows):
+                long_sum = int(long_h[row])
+                # oracle decision (core.encodings.rle_hybrid_encode): pure
+                # bit-pack unless long-run mass reaches max(8, n // 10)
+                if long_sum < max(8, count // 10):
+                    groups_n = (count + 7) // 8
+                    body = (bytes([width]) + varint_bytes((groups_n << 1) | 1)
+                            + packed_h[row, : groups_n * width].tobytes())
+                    bodies_for(i, len(chunks[i].values)).bodies[(va, vb)] = body
+                else:
+                    fallback.setdefault(id(batch), (batch, []))[1].append(
+                        (i, j, va, vb, count, width))
+
+        if fallback:  # sync 3 (rare): long-run pages need exact host RLE
+            fb_dev = []
+            fb_meta = []
+            for batch, rows in fallback.values():
+                bucket = pad_bucket(max(r[4] for r in rows))
+                fb_dev.append(gather_index_slices(
+                    batch.indices,
+                    jnp.asarray(np.array([r[1] for r in rows], np.int32)),
+                    jnp.asarray(np.array([r[2] for r in rows], np.int32)),
+                    bucket))
+                fb_meta.append(rows)
+            for rows, sl in zip(fb_meta, jax.device_get(fb_dev)):
+                for row, (i, j, va, vb, count, width) in enumerate(rows):
+                    body = bytes([width]) + enc.rle_hybrid_encode(
+                        sl[row, :count], width)
+                    bodies_for(i, len(chunks[i].values)).bodies[(va, vb)] = body
+
+        for i, chunk, batch, j, k, width, will, pages in col_plans:
+            pb = bodies_for(i, len(chunk.values))
+            if will:
+                dict_values = batch.values_from_tables(j, k, tables_host[id(batch)])
+                for va, vb in pages:  # width-0 / empty pages have no device job
+                    count = vb - va
+                    if count == 0:
+                        pb.bodies.setdefault((va, vb), bytes([width]))
+                    elif width == 0:
+                        pb.bodies[(va, vb)] = bytes([0]) + varint_bytes(count << 1)
+            else:
+                # Rejected dictionary: encode() only needs len()/dtype to
+                # re-derive the rejection, so skip the key-table transfer.
+                dict_values = np.zeros(k, chunk.values.dtype)
+            slots[i] = (dict_values, pb)
+        return slots
 
     # -- primitive overrides ----------------------------------------------
     def _dictionary_build(self, values, pt: int):
@@ -160,6 +278,13 @@ class TpuChunkEncoder(CpuChunkEncoder):
         return dict_values, _DeviceIndices(indices_dev, handle.n)
 
     def _indices_body(self, indices, va: int, vb: int, dict_size: int) -> bytes:
+        if isinstance(indices, _PageBodies):
+            body = indices.bodies.get((va, vb))
+            if body is None:
+                raise RuntimeError(
+                    f"page ({va},{vb}) missing from row-group plan — page "
+                    "geometry drifted between planning and assembly")
+            return body
         if not isinstance(indices, _DeviceIndices):
             return super()._indices_body(indices, va, vb, dict_size)
         width = enc.bit_width(max(dict_size - 1, 0))

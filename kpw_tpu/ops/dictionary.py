@@ -183,6 +183,19 @@ class BatchDictBuild:
         dict_values = join_keys(dhi[i, :k], dlo[i, :k], self.dtypes[i])
         return dict_values, self.indices[i]
 
+    # -- sync-free accessors for the fused row-group planner ---------------
+    def counts_device(self) -> jax.Array:
+        return self._k
+
+    def key_tables_device(self, cap: int):
+        """Trimmed key tables as *device* arrays (no host sync); the planner
+        folds them into one bulk readback."""
+        return _trim_keys(self.dhi, self.dlo, min(cap, self.bucket))
+
+    def values_from_tables(self, i: int, k: int, tables) -> np.ndarray:
+        dhi, dlo = tables
+        return join_keys(dhi[i, :k], dlo[i, :k], self.dtypes[i])
+
 
 class BinDictBuild:
     """Bounded-range batch: sort-free binning build (see _dict_build_bins_one).
@@ -226,6 +239,17 @@ class BinDictBuild:
         offsets = self._key_table()[i, :k].astype(np.uint64)
         dict_values = (offsets + np.uint64(self.bases[i])).astype(self.dtypes[i])
         return dict_values, self.indices[i]
+
+    # -- sync-free accessors for the fused row-group planner ---------------
+    def counts_device(self) -> jax.Array:
+        return self._k
+
+    def key_tables_device(self, cap: int):
+        return _trim_one(self.dkey, min(cap, self.R))
+
+    def values_from_tables(self, i: int, k: int, tables) -> np.ndarray:
+        offsets = tables[i, :k].astype(np.uint64)
+        return (offsets + np.uint64(self.bases[i])).astype(self.dtypes[i])
 
 
 RANGE_MAX = 1 << 20  # largest bin table the sort-free path will allocate

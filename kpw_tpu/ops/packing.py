@@ -76,3 +76,46 @@ def pack_page_host(idx_full: jax.Array, start: int, count: int, width: int,
     packed, long_sum, any_long = pack_page(
         idx_full, jnp.int32(start), jnp.int32(count), bucket, width)
     return np.asarray(packed), int(long_sum), bool(any_long)
+
+
+@functools.partial(jax.jit, static_argnums=(4, 5))
+def pack_pages_multi(idx_all: jax.Array, col_ids: jax.Array, starts: jax.Array,
+                     counts: jax.Array, bucket: int, width: int):
+    """Pack many pages — possibly from different columns of one (C, N) index
+    batch — in a single program (one dispatch for the whole group instead of
+    one per page; essential when dispatch latency is high).
+
+    Returns (packed (P, bucket*width//8) uint8, long_sum (P,) int32) where
+    long_sum is the total length of runs >= 8 in each page (the input to the
+    oracle's RLE-vs-bitpack decision; a page has a long run iff long_sum > 0).
+    """
+    padded = jnp.pad(idx_all, ((0, 0), (0, bucket)))
+
+    def one(cid, start, count):
+        page = jax.lax.dynamic_slice(padded, (cid, start), (1, bucket))[0]
+        pos = jnp.arange(bucket, dtype=jnp.int32)
+        valid = pos < count
+        v = jnp.where(valid, page, 0).astype(jnp.uint32)
+        packed = bitpack_device(v, width)
+        newrun = jnp.concatenate([jnp.ones((1,), bool), v[1:] != v[:-1]]) & valid
+        run_id = jnp.cumsum(newrun.astype(jnp.int32)) - 1
+        safe_rid = jnp.where(valid, run_id, bucket)
+        run_lens = jnp.zeros(bucket + 1, jnp.int32).at[safe_rid].add(1, mode="drop")[:bucket]
+        long_sum = jnp.sum(jnp.where(run_lens >= 8, run_lens, 0))
+        return packed, long_sum
+
+    return jax.vmap(one)(col_ids, starts, counts)
+
+
+@functools.partial(jax.jit, static_argnums=(3,))
+def gather_index_slices(idx_all: jax.Array, col_ids: jax.Array,
+                        starts: jax.Array, bucket: int) -> jax.Array:
+    """Fetch index windows [start, start+bucket) for several (column, start)
+    pairs in one program — used to pull only the rare long-run pages to the
+    host for the exact mixed RLE stream."""
+    padded = jnp.pad(idx_all, ((0, 0), (0, bucket)))
+
+    def one(cid, start):
+        return jax.lax.dynamic_slice(padded, (cid, start), (1, bucket))[0]
+
+    return jax.vmap(one)(col_ids, starts)
