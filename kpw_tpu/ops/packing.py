@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import functools
 import math
+import os
 
 import jax
 import jax.numpy as jnp
@@ -78,7 +79,62 @@ def pack_page_host(idx_full: jax.Array, start: int, count: int, width: int,
     return np.asarray(packed), int(long_sum), bool(any_long)
 
 
+def _slice_mask_stats(idx_all, col_ids, starts, counts, bucket):
+    """vmap over pages: slice each page window, zero-mask past its count, and
+    compute the long-run mass for the RLE-vs-bitpack decision.  Returns
+    (v (P, bucket) uint32, long_sum (P,) int32)."""
+    padded = jnp.pad(idx_all, ((0, 0), (0, bucket)))
+
+    def one(cid, start, count):
+        page = jax.lax.dynamic_slice(padded, (cid, start), (1, bucket))[0]
+        pos = jnp.arange(bucket, dtype=jnp.int32)
+        valid = pos < count
+        v = jnp.where(valid, page, 0).astype(jnp.uint32)
+        newrun = jnp.concatenate([jnp.ones((1,), bool), v[1:] != v[:-1]]) & valid
+        run_id = jnp.cumsum(newrun.astype(jnp.int32)) - 1
+        safe_rid = jnp.where(valid, run_id, bucket)
+        run_lens = jnp.zeros(bucket + 1, jnp.int32).at[safe_rid].add(1, mode="drop")[:bucket]
+        long_sum = jnp.sum(jnp.where(run_lens >= 8, run_lens, 0))
+        return v, long_sum
+
+    return jax.vmap(one)(col_ids, starts, counts)
+
+
 @functools.partial(jax.jit, static_argnums=(4, 5))
+def _pack_pages_multi_xla(idx_all, col_ids, starts, counts, bucket: int, width: int):
+    v, long_sum = _slice_mask_stats(idx_all, col_ids, starts, counts, bucket)
+    return jax.vmap(lambda p: bitpack_device(p, width))(v), long_sum
+
+
+@functools.partial(jax.jit, static_argnums=(4, 5, 6))
+def _pack_pages_multi_pallas(idx_all, col_ids, starts, counts, bucket: int,
+                             width: int, interpret: bool):
+    from .pallas_bitpack import bitpack_pages_core
+
+    v, long_sum = _slice_mask_stats(idx_all, col_ids, starts, counts, bucket)
+    return bitpack_pages_core(v, width, interpret), long_sum
+
+
+# Below this many total values the pallas launch is dispatch-dominated and
+# the fused-XLA program wins (measured on v5e: crossover ~1M values).
+_PALLAS_MIN_VALUES = 1 << 20
+
+
+def use_pallas(n_values: int) -> tuple[bool, bool]:
+    """(use, interpret) for the bit-pack dispatch.  KPW_PALLAS=0 disables,
+    =1 forces, =interpret forces the interpreter (CPU CI); default: real
+    Mosaic kernels on TPU for large batches only."""
+    mode = os.environ.get("KPW_PALLAS", "auto")
+    if mode == "0":
+        return False, False
+    if mode == "interpret":
+        return True, True
+    if mode == "1":
+        return True, False
+    return (jax.default_backend() == "tpu"
+            and n_values >= _PALLAS_MIN_VALUES), False
+
+
 def pack_pages_multi(idx_all: jax.Array, col_ids: jax.Array, starts: jax.Array,
                      counts: jax.Array, bucket: int, width: int):
     """Pack many pages — possibly from different columns of one (C, N) index
@@ -88,23 +144,16 @@ def pack_pages_multi(idx_all: jax.Array, col_ids: jax.Array, starts: jax.Array,
     Returns (packed (P, bucket*width//8) uint8, long_sum (P,) int32) where
     long_sum is the total length of runs >= 8 in each page (the input to the
     oracle's RLE-vs-bitpack decision; a page has a long run iff long_sum > 0).
+
+    On TPU with enough work the bit-pack runs as a pallas kernel
+    (pallas_bitpack.py: VMEM-resident bit expand + MXU byte fold); otherwise
+    the fused-XLA formulation.  Both are byte-identical to the CPU oracle.
     """
-    padded = jnp.pad(idx_all, ((0, 0), (0, bucket)))
-
-    def one(cid, start, count):
-        page = jax.lax.dynamic_slice(padded, (cid, start), (1, bucket))[0]
-        pos = jnp.arange(bucket, dtype=jnp.int32)
-        valid = pos < count
-        v = jnp.where(valid, page, 0).astype(jnp.uint32)
-        packed = bitpack_device(v, width)
-        newrun = jnp.concatenate([jnp.ones((1,), bool), v[1:] != v[:-1]]) & valid
-        run_id = jnp.cumsum(newrun.astype(jnp.int32)) - 1
-        safe_rid = jnp.where(valid, run_id, bucket)
-        run_lens = jnp.zeros(bucket + 1, jnp.int32).at[safe_rid].add(1, mode="drop")[:bucket]
-        long_sum = jnp.sum(jnp.where(run_lens >= 8, run_lens, 0))
-        return packed, long_sum
-
-    return jax.vmap(one)(col_ids, starts, counts)
+    pal, interp = use_pallas(len(col_ids) * bucket)
+    if pal:
+        return _pack_pages_multi_pallas(
+            idx_all, col_ids, starts, counts, bucket, width, interp)
+    return _pack_pages_multi_xla(idx_all, col_ids, starts, counts, bucket, width)
 
 
 @functools.partial(jax.jit, static_argnums=(3,))

@@ -1,0 +1,99 @@
+"""Pallas TPU kernel for parquet LSB-first bit-packing.
+
+The XLA formulation in ``ops.packing`` materialises a (n, width) bit matrix
+in HBM between the shift/mask step and the byte-fold reduce.  This kernel
+keeps the whole pipeline in VMEM: each grid step loads a tile of dictionary
+indices, expands bits on the VPU, and folds them into output bytes with one
+small constant matmul on the MXU — one HBM read of the indices and one HBM
+write of the packed bytes, nothing in between.
+
+Layout.  A page of ``n`` values at bit ``width`` w packs value i's bit j at
+overall bit position ``i*w + j`` (LSB-first bytes) —
+``core.encodings.bitpack`` is the byte-exact oracle.  Group 8 consecutive
+values: group g emits exactly w bytes (8 values x w bits), so a page
+reshaped to (G, 8) (G = bucket/8) maps to (G, w) output bytes with no
+cross-group carries.  Transposed to put G on the TPU lane dimension:
+
+  v8t   (8, G)  uint32   v8t[i, g] = value 8g+i
+  bits  (8w, G)          bits[i*w+j, g] = (v8t[i, g] >> j) & 1
+  bytes (w, G)  = Wt @ bits   where Wt[m, p] = 2^(p%8) if p//8 == m else 0
+
+The matmul is exact in float32 (partial sums <= 255).  The grid is
+(pages, lane-tiles); lane tiles bound VMEM to ~1 MiB regardless of bucket.
+
+Used by ``ops.packing.pack_pages_multi`` when running on a real TPU
+(KPW_PALLAS=1 forces it, KPW_PALLAS=0 disables, KPW_PALLAS=interpret runs
+the interpreter on any backend — how the CPU CI exercises this file).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# Lane-dimension tile: 8 * 32 * LANE_TILE * 4 B of bit planes ~= 1 MiB at
+# width 32 — comfortably inside VMEM with double buffering.
+LANE_TILE = 1024
+
+
+def _fold_matrix(width: int) -> jnp.ndarray:
+    """(width, 8*width) f32: Wt[m, p] = 2^(p%8) iff byte p//8 == m."""
+    p = jax.lax.broadcasted_iota(jnp.int32, (width, 8 * width), 1)
+    m = jax.lax.broadcasted_iota(jnp.int32, (width, 8 * width), 0)
+    weight = (jnp.int32(1) << (p % 8)).astype(jnp.float32)
+    return jnp.where(p // 8 == m, weight, 0.0)
+
+
+def _bitpack_kernel(v_ref, out_ref, *, width: int):
+    """v_ref (1, 8, Gt) uint32 -> out_ref (1, width, Gt) f32 (byte values)."""
+    v = v_ref[0]  # (8, Gt)
+    gt = v.shape[1]
+    shifts = jax.lax.broadcasted_iota(jnp.uint32, (8, width, 1), 1)
+    bits = ((v[:, None, :] >> shifts) & jnp.uint32(1))  # (8, width, Gt)
+    # Mosaic has no uint32->f32 cast; bits are 0/1 so int32 is lossless.
+    bits_flat = bits.reshape(8 * width, gt).astype(jnp.int32).astype(jnp.float32)
+    out_ref[0] = jnp.dot(_fold_matrix(width), bits_flat,
+                         preferred_element_type=jnp.float32)
+
+
+def bitpack_pages_core(pages: jax.Array, width: int,
+                       interpret: bool = False) -> jax.Array:
+    """Traceable core (callable inside an enclosing jit): (P, bucket) uint32,
+    entries beyond each page's count already masked to zero -> (P,
+    bucket*width//8) uint8, byte-equal to ``core.encodings.bitpack`` per
+    page.  bucket must be a multiple of 8 (ops.packing.pad_bucket guarantees
+    a power of two >= 256)."""
+    P, bucket = pages.shape
+    if bucket % 8:
+        raise ValueError(f"bucket must be a multiple of 8, got {bucket}")
+    G = bucket // 8
+    # Lane tile must divide G exactly or trailing groups would never be
+    # computed; gcd keeps full tiles for the power-of-two buckets pad_bucket
+    # produces and stays correct for any multiple of 8.
+    gt = math.gcd(G, LANE_TILE)
+    v8t = pages.reshape(P, G, 8).transpose(0, 2, 1)  # (P, 8, G)
+
+    bytes_f = pl.pallas_call(
+        functools.partial(_bitpack_kernel, width=width),
+        out_shape=jax.ShapeDtypeStruct((P, width, G), jnp.float32),
+        grid=(P, G // gt),
+        in_specs=[pl.BlockSpec((1, 8, gt), lambda p, g: (p, 0, g),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((1, width, gt), lambda p, g: (p, 0, g),
+                               memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(v8t)
+
+    # (P, w, G) byte planes -> (P, G, w) -> row-major byte stream per page.
+    return bytes_f.astype(jnp.uint8).transpose(0, 2, 1).reshape(P, G * width)
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2))
+def bitpack_pages_pallas(pages: jax.Array, width: int,
+                         interpret: bool = False) -> jax.Array:
+    return bitpack_pages_core(pages, width, interpret)

@@ -1,0 +1,121 @@
+"""Pallas bit-pack kernel: byte-identity vs the CPU oracle (interpret mode —
+the compiled Mosaic path runs the identical trace on a real chip) and the
+dispatch policy in ops.packing.pack_pages_multi.
+"""
+
+import io
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from kpw_tpu.core import ParquetFileWriter, Schema, WriterProperties, columns_from_arrays, leaf
+from kpw_tpu.core import encodings as enc
+from kpw_tpu.core.pages import CpuChunkEncoder
+from kpw_tpu.ops import TpuChunkEncoder
+from kpw_tpu.ops.packing import pack_pages_multi, use_pallas
+
+
+@pytest.mark.parametrize("width", [1, 2, 3, 5, 7, 8, 11, 13, 16, 20, 24, 31, 32])
+def test_bitpack_pallas_byte_identity(width):
+    from kpw_tpu.ops.pallas_bitpack import bitpack_pages_pallas
+
+    rng = np.random.default_rng(width)
+    P, bucket = 3, 512
+    hi = min(width, 31)
+    pages = rng.integers(0, 1 << hi, (P, bucket)).astype(np.uint32)
+    if width == 32:
+        pages |= np.uint32(0x8000_0000)
+    out = np.asarray(bitpack_pages_pallas(jnp.asarray(pages), width, True))
+    for p in range(P):
+        ref = np.frombuffer(enc.bitpack(pages[p], width), np.uint8)
+        np.testing.assert_array_equal(out[p], ref)
+
+
+def test_bitpack_pallas_lane_tiling():
+    """bucket large enough that the kernel grid tiles the lane dimension."""
+    from kpw_tpu.ops.pallas_bitpack import LANE_TILE, bitpack_pages_pallas
+
+    rng = np.random.default_rng(0)
+    bucket = LANE_TILE * 8 * 2  # G = 2 * LANE_TILE -> 2 lane tiles
+    pages = rng.integers(0, 1 << 9, (2, bucket)).astype(np.uint32)
+    out = np.asarray(bitpack_pages_pallas(jnp.asarray(pages), 9, True))
+    for p in range(2):
+        ref = np.frombuffer(enc.bitpack(pages[p], 9), np.uint8)
+        np.testing.assert_array_equal(out[p], ref)
+
+
+def test_bitpack_pallas_non_power_of_two_bucket():
+    """G = bucket/8 not a multiple of LANE_TILE: the gcd tile choice must
+    still cover every group (regression: trailing groups silently dropped)."""
+    from kpw_tpu.ops.pallas_bitpack import LANE_TILE, bitpack_pages_pallas
+
+    rng = np.random.default_rng(1)
+    bucket = 8 * (LANE_TILE + LANE_TILE // 2)  # G = 1.5 * LANE_TILE
+    pages = rng.integers(0, 1 << 4, (2, bucket)).astype(np.uint32)
+    out = np.asarray(bitpack_pages_pallas(jnp.asarray(pages), 4, True))
+    for p in range(2):
+        ref = np.frombuffer(enc.bitpack(pages[p], 4), np.uint8)
+        np.testing.assert_array_equal(out[p], ref)
+
+
+def test_pack_pages_multi_pallas_route(monkeypatch):
+    """Forcing KPW_PALLAS=interpret routes pack_pages_multi through the
+    kernel; output must equal the XLA route bit-for-bit."""
+    monkeypatch.delenv("KPW_PALLAS", raising=False)
+    rng = np.random.default_rng(5)
+    C, N, width = 3, 4096, 6
+    idx = jnp.asarray(rng.integers(0, 1 << width, (C, N)).astype(np.uint32))
+    cols = jnp.asarray(np.array([0, 1, 2, 1], np.int32))
+    starts = jnp.asarray(np.array([0, 512, 1024, 0], np.int32))
+    counts = jnp.asarray(np.array([500, 512, 300, 4096], np.int32))
+    ref_packed, ref_long = pack_pages_multi(idx, cols, starts, counts, 4096, width)
+
+    monkeypatch.setenv("KPW_PALLAS", "interpret")
+    got_packed, got_long = pack_pages_multi(idx, cols, starts, counts, 4096, width)
+    np.testing.assert_array_equal(np.asarray(got_packed), np.asarray(ref_packed))
+    np.testing.assert_array_equal(np.asarray(got_long), np.asarray(ref_long))
+
+
+def test_use_pallas_policy(monkeypatch):
+    monkeypatch.setenv("KPW_PALLAS", "0")
+    assert use_pallas(1 << 30) == (False, False)
+    monkeypatch.setenv("KPW_PALLAS", "1")
+    assert use_pallas(1) == (True, False)
+    monkeypatch.setenv("KPW_PALLAS", "interpret")
+    assert use_pallas(1) == (True, True)
+    monkeypatch.delenv("KPW_PALLAS")
+    # auto mode: mosaic only on tpu, and only for large batches
+    import jax
+    on_tpu = jax.default_backend() == "tpu"
+    assert use_pallas(1 << 30) == (on_tpu, False)
+    assert use_pallas(8) == (False, False)
+
+
+def test_file_identity_via_pallas_route(monkeypatch):
+    """Full-file byte identity CPU oracle vs TPU backend with the pallas
+    bit-pack forced on (interpret mode)."""
+    rng = np.random.default_rng(6)
+    schema = Schema([leaf("a", "int64"), leaf("b", "int32")])
+    arrays = {
+        "a": rng.integers(0, 300, size=8192).astype(np.int64),
+        "b": rng.integers(-4, 4, size=8192).astype(np.int32),
+    }
+
+    def write(encoder_cls):
+        props = WriterProperties()
+        encoder = encoder_cls(props.encoder_options())
+        if encoder_cls is TpuChunkEncoder:
+            encoder.min_device_rows = 1
+        buf = io.BytesIO()
+        w = ParquetFileWriter(buf, schema, props, encoder=encoder)
+        w.write_batch(columns_from_arrays(schema, arrays))
+        w.close()
+        return buf.getvalue()
+
+    monkeypatch.setenv("KPW_PALLAS", "0")
+    cpu = write(CpuChunkEncoder)
+    monkeypatch.setenv("KPW_PALLAS", "interpret")
+    tpu = write(TpuChunkEncoder)
+    assert cpu == tpu
