@@ -103,7 +103,16 @@ def rle_hybrid_encode(values: np.ndarray, width: int) -> bytes:
     long_mask = run_lens >= 8
     if not long_mask.any() or run_lens[long_mask].sum() < max(8, n // 10):
         return _bitpack_run(values, width)
+    return rle_hybrid_from_runs(run_vals, run_lens, width)
 
+
+def rle_hybrid_from_runs(run_vals: np.ndarray, run_lens: np.ndarray,
+                         width: int) -> bytes:
+    """The mixed RLE/bit-pack assembly of :func:`rle_hybrid_encode`, driven
+    from precomputed runs — O(runs) host work, so a device run-scan (TPU
+    level encoding, ops.levels) can hand off only the compact run list.
+    Byte-identical to the slow path of ``rle_hybrid_encode`` by construction
+    (that function delegates here)."""
     out = bytearray()
     buf: list[np.ndarray] = []
     buf_len = 0

@@ -150,6 +150,17 @@ class CpuChunkEncoder:
     def _levels_body(self, levels: np.ndarray, max_level: int) -> bytes:
         return enc.rle_levels_v1(levels, max_level)
 
+    def _levels_page_blob(self, chunk: "ColumnChunkData", a: int, b: int) -> bytes:
+        """rep + def level streams for slots [a, b) — the per-page boundary
+        the TPU backend overrides with planned device-encoded bodies."""
+        col = chunk.column
+        blob = b""
+        if col.max_rep > 0:
+            blob += self._levels_body(chunk.rep_levels[a:b], col.max_rep)
+        if col.max_def > 0:
+            blob += self._levels_body(chunk.def_levels[a:b], col.max_def)
+        return blob
+
     def _try_dictionary(self, chunk: ColumnChunkData):
         """Build (dict_values, indices), or return None when the build can
         prove ahead of time that the dictionary would be rejected (backends
@@ -217,6 +228,12 @@ class CpuChunkEncoder:
             a = b
         return ranges
 
+    def _slot_ranges(self, chunk: ColumnChunkData) -> list[tuple[int, int]]:
+        """Page slot ranges for ``chunk`` — the single entry point so a
+        backend can memoize the O(num_slots) record-start scan across the
+        planner/encode passes that all need the same geometry."""
+        return self._page_slot_ranges(chunk, chunk.estimated_bytes())
+
     def encode(self, chunk: ColumnChunkData, base_offset: int, pre=None) -> EncodedChunk:
         """Encode a chunk into pages.  ``base_offset`` is the absolute file
         offset where the blob will be written (for footer offsets).  ``pre``
@@ -276,16 +293,12 @@ class CpuChunkEncoder:
         if def_levels is not None:
             present = np.asarray(def_levels) == col.max_def
             value_offsets = np.concatenate([[0], np.cumsum(present)])
-        for a, b in self._page_slot_ranges(chunk, chunk.estimated_bytes()):
+        for a, b in self._slot_ranges(chunk):
             if def_levels is not None:
                 va, vb = int(value_offsets[a]), int(value_offsets[b])
             else:
                 va, vb = a, b
-            levels_blob = b""
-            if col.max_rep > 0:
-                levels_blob += self._levels_body(chunk.rep_levels[a:b], col.max_rep)
-            if col.max_def > 0:
-                levels_blob += self._levels_body(def_levels[a:b], col.max_def)
+            levels_blob = self._levels_page_blob(chunk, a, b)
             if use_dict:
                 values_body = self._indices_body(indices, va, vb, len(dict_values))
             else:

@@ -26,6 +26,8 @@ string pages (future work, SURVEY.md §7 hard part f).
 
 from __future__ import annotations
 
+import struct
+
 import numpy as np
 
 from ..core import encodings as enc
@@ -33,8 +35,9 @@ from ..core.pages import ColumnChunkData, CpuChunkEncoder, EncoderOptions
 from ..core.schema import PhysicalType
 from ..core.thrift import varint_bytes
 from .dictionary import DictBuildHandle, build_dictionaries
+from .levels import level_runs_multi, level_stats_multi
 from .packing import (gather_index_slices, pack_page, pack_page_host,
-                      pack_pages_multi, pad_bucket)
+                      pack_pages_multi, pack_pages_only, pad_bucket)
 from ..utils.tracing import stage
 
 import jax
@@ -88,6 +91,137 @@ class _PageBodies:
         return self.n
 
 
+class _LevelPlanner:
+    """Device encoding of every rep/def level stream in a row group
+    (BASELINE.md config 5), folded into the planner's two round trips:
+
+    phase A (joins sync 1): run stats for all level pages — long-run mass
+    (the oracle's bit-pack-vs-mixed decision, core.encodings
+    .rle_hybrid_encode) and run counts (sizing the phase-B gather);
+    phase B (joins sync 2): high-entropy pages reuse the value path's
+    bit-pack program (pack_pages_multi — pallas-backed on TPU); run-heavy
+    pages get their compact run list extracted on device and replayed
+    through rle_hybrid_from_runs on host, byte-identical by construction.
+    """
+
+    def __init__(self, encoder: "TpuChunkEncoder", chunks) -> None:
+        streams = []  # (chunk_idx, kind, levels ndarray, width)
+        self._pages = []  # (stream_row, chunk_idx, kind, a, b, width)
+        for i, chunk in enumerate(chunks):
+            col = chunk.column
+            if chunk.num_slots < encoder.min_device_rows:
+                continue
+            kinds = []
+            if col.max_rep > 0:
+                kinds.append(("rep", np.asarray(chunk.rep_levels),
+                              enc.bit_width(col.max_rep)))
+            if col.max_def > 0:
+                kinds.append(("def", np.asarray(chunk.def_levels),
+                              enc.bit_width(col.max_def)))
+            if not kinds:
+                continue
+            ranges = encoder._slot_ranges(chunk)
+            for kind, levels, width in kinds:
+                row = len(streams)
+                streams.append(levels)
+                for a, b in ranges:
+                    if b > a:
+                        self._pages.append((row, i, kind, a, b, width))
+        self.empty = not self._pages
+        self.plans: dict[int, dict] = {}
+        self._chunks = chunks
+        self._stat_groups = []  # (pages, device (long_sum, n_runs))
+        self._b_groups = []  # (mode, pages-with-meta, device arrays)
+        if self.empty:
+            return
+        # levels are 1-3 bit values: stack as uint8 (kernels widen on device)
+        # to quarter the host->device transfer.
+        maxn = max(len(s) for s in streams)
+        stacked = np.zeros((len(streams), maxn), np.uint8)
+        for r, s in enumerate(streams):
+            stacked[r, : len(s)] = s
+        self._dev = jnp.asarray(stacked)
+        # phase A: stats jobs grouped by window bucket
+        by_bucket: dict[int, list] = {}
+        for page in self._pages:
+            _, _, _, a, b, _ = page
+            by_bucket.setdefault(pad_bucket(b - a), []).append(page)
+        for bucket, rows in by_bucket.items():
+            stats = level_stats_multi(
+                self._dev,
+                jnp.asarray(np.array([p[0] for p in rows], np.int32)),
+                jnp.asarray(np.array([p[3] for p in rows], np.int32)),
+                jnp.asarray(np.array([p[4] - p[3] for p in rows], np.int32)),
+                bucket)
+            self._stat_groups.append((rows, stats))
+
+    def stats_device(self):
+        return [g[1] for g in self._stat_groups]
+
+    def launch_phase_b(self, stats_host) -> None:
+        """Classify pages from phase-A stats and launch phase-B programs."""
+        fast: dict[tuple[int, int], list] = {}  # (bucket, width) -> pages
+        slow: dict[int, list] = {}  # bucket -> (page, n_runs)
+        for (rows, _), (long_h, runs_h) in zip(self._stat_groups, stats_host):
+            for r, page in enumerate(rows):
+                _, _, _, a, b, width = page
+                count = b - a
+                if int(long_h[r]) < max(8, count // 10):
+                    fast.setdefault((pad_bucket(count), width), []).append(page)
+                else:
+                    slow.setdefault(pad_bucket(count), []).append(
+                        (page, int(runs_h[r])))
+        for (bucket, width), pages in fast.items():
+            packed = pack_pages_only(  # stats already known from phase A
+                self._dev,
+                jnp.asarray(np.array([p[0] for p in pages], np.int32)),
+                jnp.asarray(np.array([p[3] for p in pages], np.int32)),
+                jnp.asarray(np.array([p[4] - p[3] for p in pages], np.int32)),
+                bucket, width)
+            self._b_groups.append(("fast", pages, packed))
+        for bucket, entries in slow.items():
+            run_bucket = pad_bucket(max(n for _, n in entries))
+            runs = level_runs_multi(
+                self._dev,
+                jnp.asarray(np.array([p[0] for p, _ in entries], np.int32)),
+                jnp.asarray(np.array([p[3] for p, _ in entries], np.int32)),
+                jnp.asarray(np.array([p[4] - p[3] for p, _ in entries], np.int32)),
+                bucket, run_bucket)
+            self._b_groups.append(("slow", entries, runs))
+
+    def phase_b_device(self):
+        return [g[2] for g in self._b_groups]
+
+    def assemble(self, fetched) -> None:
+        """Build the per-page level payloads (v1: 4-byte LE length prefix)
+        and fold rep+def into per-(chunk, page) blobs."""
+        parts: dict[tuple[int, int, int], dict] = {}  # (i, a, b) -> kind -> bytes
+        for (mode, items, _), host in zip(self._b_groups, fetched):
+            if mode == "fast":
+                packed_h = host
+                for r, (row, i, kind, a, b, width) in enumerate(items):
+                    count = b - a
+                    groups = (count + 7) // 8
+                    payload = (varint_bytes((groups << 1) | 1)
+                               + packed_h[r, : groups * width].tobytes())
+                    parts.setdefault((i, a, b), {})[kind] = payload
+            else:
+                vals_h, lens_h = host
+                for r, ((row, i, kind, a, b, width), n_runs) in enumerate(items):
+                    payload = enc.rle_hybrid_from_runs(
+                        vals_h[r, :n_runs].astype(np.uint64),
+                        lens_h[r, :n_runs], width)
+                    parts.setdefault((i, a, b), {})[kind] = payload
+        for (i, a, b), kinds in parts.items():
+            col = self._chunks[i].column
+            blob = b""
+            for kind, max_level in (("rep", col.max_rep), ("def", col.max_def)):
+                if max_level > 0:
+                    payload = kinds[kind]
+                    blob += struct.pack("<I", len(payload)) + payload
+            self.plans.setdefault(id(self._chunks[i]), {})[(a, b)] = blob
+
+
 class TpuChunkEncoder(CpuChunkEncoder):
     """Byte-identical TPU implementation of the chunk encoder."""
 
@@ -113,11 +247,29 @@ class TpuChunkEncoder(CpuChunkEncoder):
         with stage("encode.assemble"):
             out = []
             offset = base_offset
-            for chunk, pre in zip(chunks, pres):
-                e = self.encode(chunk, offset, pre=pre)
-                offset += len(e.blob)
-                out.append(e)
+            try:
+                for chunk, pre in zip(chunks, pres):
+                    e = self.encode(chunk, offset, pre=pre)
+                    offset += len(e.blob)
+                    out.append(e)
+            finally:
+                # keyed by id(chunk) — must not outlive the chunk objects
+                self._level_plans = {}
+                self._ranges_cache = {}
         return out
+
+    def _slot_ranges(self, chunk: ColumnChunkData) -> list[tuple[int, int]]:
+        cache = getattr(self, "_ranges_cache", None)
+        if cache is None:
+            cache = self._ranges_cache = {}
+        hit = cache.get(id(chunk))
+        if hit is not None and hit[0] is chunk:  # guard against id() reuse
+            return hit[1]
+        if len(cache) > 1024:  # direct encode() callers never clear
+            cache.clear()
+        ranges = super()._slot_ranges(chunk)
+        cache[id(chunk)] = (chunk, ranges)
+        return ranges
 
     def _page_value_ranges(self, chunk: ColumnChunkData) -> list[tuple[int, int]]:
         """The (va, vb) present-value range of every data page, mirroring the
@@ -129,7 +281,7 @@ class TpuChunkEncoder(CpuChunkEncoder):
             present = np.asarray(def_levels) == col.max_def
             value_offsets = np.concatenate([[0], np.cumsum(present)])
         out = []
-        for a, b in self._page_slot_ranges(chunk, chunk.estimated_bytes()):
+        for a, b in self._slot_ranges(chunk):
             if def_levels is not None:
                 out.append((int(value_offsets[a]), int(value_offsets[b])))
             else:
@@ -152,23 +304,28 @@ class TpuChunkEncoder(CpuChunkEncoder):
              finished with the host RLE assembler for byte-exact streams.
         """
         slots: list = [None] * len(chunks)
+        lvl = _LevelPlanner(self, chunks)  # phase A launched here
         eligible = [
             (i, chunk) for i, chunk in enumerate(chunks)
             if self._dictionary_viable(chunk)
             and self._device_eligible(chunk.values, chunk.column.leaf.physical_type)
         ]
-        if not eligible:
+        if not eligible and lvl.empty:
             return slots
         opts = self.options
-        handles = build_dictionaries([chunk.values for _, chunk in eligible])
+        handles = (build_dictionaries([chunk.values for _, chunk in eligible])
+                   if eligible else [])
 
         batches: list = []
         for batch, _ in handles:
             if batch not in batches:
                 batches.append(batch)
-        for b, kv in zip(batches, jax.device_get(  # sync 1: all unique counts
-                [b.counts_device() for b in batches])):
+        counts_host, lvl_stats_host = jax.device_get(  # sync 1: counts + level stats
+            ([b.counts_device() for b in batches], lvl.stats_device()))
+        for b, kv in zip(batches, counts_host):
             b._k_host = np.asarray(kv)
+        if not lvl.empty:
+            lvl.launch_phase_b(lvl_stats_host)
 
         col_plans = []
         jobs: dict = {}  # (batch_id, bucket, width) -> (batch, [page rows])
@@ -209,8 +366,12 @@ class TpuChunkEncoder(CpuChunkEncoder):
             for b in batches if id(b) in accepted_kmax
         }
 
-        fetched = jax.device_get((group_dev, tables_dev))  # sync 2: bulk
-        groups_host, tables_host = fetched
+        fetched = jax.device_get(  # sync 2: bulk
+            (group_dev, tables_dev, lvl.phase_b_device() if not lvl.empty else []))
+        groups_host, tables_host, lvl_host = fetched
+        if not lvl.empty:
+            lvl.assemble(lvl_host)
+            self._level_plans = lvl.plans
 
         bodies_by_slot: dict[int, _PageBodies] = {}
 
@@ -270,6 +431,14 @@ class TpuChunkEncoder(CpuChunkEncoder):
         return slots
 
     # -- primitive overrides ----------------------------------------------
+    def _levels_page_blob(self, chunk, a: int, b: int) -> bytes:
+        plan = getattr(self, "_level_plans", None)
+        if plan:
+            body = plan.get(id(chunk), {}).get((a, b))
+            if body is not None:
+                return body
+        return super()._levels_page_blob(chunk, a, b)
+
     def _dictionary_build(self, values, pt: int):
         if not self._device_eligible(values, pt):
             return super()._dictionary_build(values, pt)

@@ -1,0 +1,68 @@
+"""Device encoding of repetition/definition level streams (BASELINE.md
+config 5: nested list<struct> rep/def-level RLE on TPU).
+
+Level streams are tiny-width integers (bit_width(max_level), usually 1-3
+bits) with two regimes:
+
+- high-entropy streams take the oracle's pure bit-pack fast path
+  (core.encodings.rle_hybrid_encode) — served by the same device bit-pack
+  program as dictionary indices (ops.packing.pack_pages_multi, pallas-backed
+  on TPU);
+- run-dominated streams (the common case: def levels are mostly max_def)
+  take the mixed RLE path.  There the O(n) work is the run *scan*; the
+  assembly is O(runs).  So the scan runs on device (cumsum + scatter,
+  vmapped over pages) and only the compact run list is transferred, which
+  the host replays through core.encodings.rle_hybrid_from_runs for a
+  byte-identical stream.
+
+Both programs window into one stacked (K, maxN) array of every level stream
+in the row group, so the whole group costs two round trips regardless of
+column count — same planner shape as the value path (ops.backend).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .packing import window_run_scan
+
+
+@functools.partial(jax.jit, static_argnums=(4,))
+def level_stats_multi(levels_all: jax.Array, stream_ids: jax.Array,
+                      starts: jax.Array, counts: jax.Array, bucket: int):
+    """Per page window: (long_sum, n_runs) — ``long_sum`` is the total length
+    of runs >= 8 (the oracle's bit-pack-vs-mixed decision input) and
+    ``n_runs`` the run count (sizes the phase-B run gather)."""
+    padded = jnp.pad(levels_all, ((0, 0), (0, bucket)))
+
+    def one(sid, start, count):
+        _, valid, run_id, run_lens = window_run_scan(
+            padded, sid, start, count, bucket, bucket)
+        long_sum = jnp.sum(jnp.where(run_lens >= 8, run_lens, 0))
+        n_runs = jnp.max(jnp.where(valid, run_id, -1)) + 1
+        return long_sum, n_runs
+
+    return jax.vmap(one)(stream_ids, starts, counts)
+
+
+@functools.partial(jax.jit, static_argnums=(4, 5))
+def level_runs_multi(levels_all: jax.Array, stream_ids: jax.Array,
+                     starts: jax.Array, counts: jax.Array, bucket: int,
+                     run_bucket: int):
+    """Extract each page window's run list: (run_vals (P, run_bucket) uint32,
+    run_lens (P, run_bucket) int32).  ``run_bucket`` must be >= the page's
+    n_runs from :func:`level_stats_multi`; excess slots are zero."""
+    padded = jnp.pad(levels_all, ((0, 0), (0, bucket)))
+
+    def one(sid, start, count):
+        v, valid, run_id, run_lens = window_run_scan(
+            padded, sid, start, count, bucket, run_bucket)
+        safe_rid = jnp.where(valid, run_id, run_bucket)
+        run_vals = jnp.zeros(run_bucket + 1, jnp.uint32).at[safe_rid].set(
+            v, mode="drop")[:run_bucket]
+        return run_vals, run_lens
+
+    return jax.vmap(one)(stream_ids, starts, counts)

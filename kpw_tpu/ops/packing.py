@@ -79,6 +79,29 @@ def pack_page_host(idx_full: jax.Array, start: int, count: int, width: int,
     return np.asarray(packed), int(long_sum), bool(any_long)
 
 
+def window_run_scan(padded, row, start, count, bucket: int, scatter_bucket: int):
+    """The one run-scan used by every device window program (value pages in
+    this module, level streams in ops.levels) — a single definition so the
+    run semantics can never drift between paths that must stay byte-identical
+    to the CPU oracle (core.encodings._runs).
+
+    Slices window [start, start+bucket) of ``padded[row]``, zero-masks past
+    ``count``, labels runs.  Returns (v uint32 (bucket,), valid bool
+    (bucket,), run_id int32 (bucket,), run_lens int32 (scatter_bucket,)).
+    ``scatter_bucket`` bounds the run-length scatter (>= the caller's known
+    run count, or just ``bucket``)."""
+    page = jax.lax.dynamic_slice(padded, (row, start), (1, bucket))[0]
+    pos = jnp.arange(bucket, dtype=jnp.int32)
+    valid = pos < count
+    v = jnp.where(valid, page, 0).astype(jnp.uint32)
+    newrun = jnp.concatenate([jnp.ones((1,), bool), v[1:] != v[:-1]]) & valid
+    run_id = jnp.cumsum(newrun.astype(jnp.int32)) - 1
+    safe_rid = jnp.where(valid, run_id, scatter_bucket)
+    run_lens = jnp.zeros(scatter_bucket + 1, jnp.int32).at[safe_rid].add(
+        1, mode="drop")[:scatter_bucket]
+    return v, valid, run_id, run_lens
+
+
 def _slice_mask_stats(idx_all, col_ids, starts, counts, bucket):
     """vmap over pages: slice each page window, zero-mask past its count, and
     compute the long-run mass for the RLE-vs-bitpack decision.  Returns
@@ -86,16 +109,22 @@ def _slice_mask_stats(idx_all, col_ids, starts, counts, bucket):
     padded = jnp.pad(idx_all, ((0, 0), (0, bucket)))
 
     def one(cid, start, count):
-        page = jax.lax.dynamic_slice(padded, (cid, start), (1, bucket))[0]
-        pos = jnp.arange(bucket, dtype=jnp.int32)
-        valid = pos < count
-        v = jnp.where(valid, page, 0).astype(jnp.uint32)
-        newrun = jnp.concatenate([jnp.ones((1,), bool), v[1:] != v[:-1]]) & valid
-        run_id = jnp.cumsum(newrun.astype(jnp.int32)) - 1
-        safe_rid = jnp.where(valid, run_id, bucket)
-        run_lens = jnp.zeros(bucket + 1, jnp.int32).at[safe_rid].add(1, mode="drop")[:bucket]
+        v, _, _, run_lens = window_run_scan(padded, cid, start, count, bucket, bucket)
         long_sum = jnp.sum(jnp.where(run_lens >= 8, run_lens, 0))
         return v, long_sum
+
+    return jax.vmap(one)(col_ids, starts, counts)
+
+
+def _slice_mask(idx_all, col_ids, starts, counts, bucket):
+    """Like :func:`_slice_mask_stats` without the run scan — for callers that
+    already know the page's stats (the level planner's phase B)."""
+    padded = jnp.pad(idx_all, ((0, 0), (0, bucket)))
+
+    def one(cid, start, count):
+        page = jax.lax.dynamic_slice(padded, (cid, start), (1, bucket))[0]
+        pos = jnp.arange(bucket, dtype=jnp.int32)
+        return jnp.where(pos < count, page, 0).astype(jnp.uint32)
 
     return jax.vmap(one)(col_ids, starts, counts)
 
@@ -133,6 +162,33 @@ def use_pallas(n_values: int) -> tuple[bool, bool]:
         return True, False
     return (jax.default_backend() == "tpu"
             and n_values >= _PALLAS_MIN_VALUES), False
+
+
+@functools.partial(jax.jit, static_argnums=(4, 5))
+def _pack_only_xla(idx_all, col_ids, starts, counts, bucket: int, width: int):
+    v = _slice_mask(idx_all, col_ids, starts, counts, bucket)
+    return jax.vmap(lambda p: bitpack_device(p, width))(v)
+
+
+@functools.partial(jax.jit, static_argnums=(4, 5, 6))
+def _pack_only_pallas(idx_all, col_ids, starts, counts, bucket: int,
+                      width: int, interpret: bool):
+    from .pallas_bitpack import bitpack_pages_core
+
+    v = _slice_mask(idx_all, col_ids, starts, counts, bucket)
+    return bitpack_pages_core(v, width, interpret)
+
+
+def pack_pages_only(idx_all: jax.Array, col_ids: jax.Array, starts: jax.Array,
+                    counts: jax.Array, bucket: int, width: int) -> jax.Array:
+    """:func:`pack_pages_multi` without the run-stats pass — for pages whose
+    RLE-vs-bitpack decision is already known.  Returns packed
+    (P, bucket*width//8) uint8."""
+    pal, interp = use_pallas(len(col_ids) * bucket)
+    if pal:
+        return _pack_only_pallas(idx_all, col_ids, starts, counts, bucket,
+                                 width, interp)
+    return _pack_only_xla(idx_all, col_ids, starts, counts, bucket, width)
 
 
 def pack_pages_multi(idx_all: jax.Array, col_ids: jax.Array, starts: jax.Array,

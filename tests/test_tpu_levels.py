@@ -1,0 +1,185 @@
+"""Device rep/def level encoding (BASELINE.md config 5): byte-identity of
+nested and optional columns through the TPU backend vs the CPU oracle, plus
+pyarrow round-trip of nested content.
+"""
+
+import io
+
+import numpy as np
+import pyarrow.parquet as pq
+import pytest
+
+from kpw_tpu.core import (
+    ParquetFileWriter,
+    Repetition,
+    Schema,
+    WriterProperties,
+    columns_from_arrays,
+    group,
+    leaf,
+    list_of,
+)
+from kpw_tpu.core.pages import ColumnChunkData, CpuChunkEncoder
+from kpw_tpu.models import proto_to_schema
+from kpw_tpu.ops import TpuChunkEncoder
+from kpw_tpu.ops.levels import level_runs_multi, level_stats_multi
+from kpw_tpu.core import encodings as enc
+
+import jax.numpy as jnp
+
+from proto_helpers import nested_message_classes
+
+
+# ---------------------------------------------------------------------------
+# kernel unit tests
+# ---------------------------------------------------------------------------
+
+def _stats_oracle(levels):
+    vals, lens = enc._runs(np.asarray(levels, np.uint64))
+    long_sum = int(lens[lens >= 8].sum())
+    return long_sum, len(lens)
+
+
+@pytest.mark.parametrize("pattern", ["runny", "random", "alternating"])
+def test_level_stats_matches_runs_oracle(pattern):
+    rng = np.random.default_rng(0)
+    n = 1000
+    if pattern == "runny":
+        lv = np.repeat(rng.integers(0, 3, 50), 20)[:n]
+    elif pattern == "random":
+        lv = rng.integers(0, 4, n)
+    else:
+        lv = np.tile([0, 1], n // 2)
+    stacked = jnp.asarray(lv[None, :].astype(np.uint32))
+    bucket = 1024
+    long_d, runs_d = level_stats_multi(
+        stacked, jnp.asarray([0], jnp.int32), jnp.asarray([0], jnp.int32),
+        jnp.asarray([n], jnp.int32), bucket)
+    long_ref, runs_ref = _stats_oracle(lv)
+    assert int(long_d[0]) == long_ref
+    assert int(runs_d[0]) == runs_ref
+
+    vals_d, lens_d = level_runs_multi(
+        stacked, jnp.asarray([0], jnp.int32), jnp.asarray([0], jnp.int32),
+        jnp.asarray([n], jnp.int32), bucket, 1024)
+    ref_vals, ref_lens = enc._runs(np.asarray(lv, np.uint64))
+    k = runs_ref
+    np.testing.assert_array_equal(np.asarray(vals_d[0])[:k], ref_vals.astype(np.uint32))
+    np.testing.assert_array_equal(np.asarray(lens_d[0])[:k], ref_lens)
+
+
+def test_rle_hybrid_from_runs_matches_slow_path():
+    rng = np.random.default_rng(1)
+    # run-dominated stream -> oracle takes the mixed path
+    lv = np.repeat(rng.integers(0, 2, 80), rng.integers(1, 40, 80))
+    width = 1
+    ref = enc.rle_hybrid_encode(lv, width)
+    vals, lens = enc._runs(np.asarray(lv, np.uint64))
+    assert enc.rle_hybrid_from_runs(vals, lens, width) == ref
+
+
+# ---------------------------------------------------------------------------
+# file-level byte identity through the planner
+# ---------------------------------------------------------------------------
+
+def _write_with(encoder_cls, schema, batch, **props):
+    properties = WriterProperties(**props)
+    encoder = encoder_cls(properties.encoder_options())
+    if encoder_cls is TpuChunkEncoder:
+        encoder.min_device_rows = 1
+    buf = io.BytesIO()
+    w = ParquetFileWriter(buf, schema, properties, encoder=encoder)
+    w.write_batch(batch)
+    w.close()
+    buf.seek(0)
+    return buf
+
+
+def _identity(schema, batch, **props):
+    cpu = _write_with(CpuChunkEncoder, schema, batch, **props)
+    tpu = _write_with(TpuChunkEncoder, schema, batch, **props)
+    assert cpu.getvalue() == tpu.getvalue()
+    return tpu
+
+
+def test_optional_runny_def_levels_identity():
+    """Mostly-present optional column: def levels are one long run -> the
+    device run-scan + host replay path."""
+    rng = np.random.default_rng(2)
+    n = 20000
+    valid = np.ones(n, bool)
+    valid[5000:5003] = False
+    schema = Schema([leaf("x", "int64", Repetition.OPTIONAL)])
+    vals = rng.integers(0, 50, n).astype(np.int64)
+    batch = columns_from_arrays(schema, {"x": (vals, valid)})
+    buf = _identity(schema, batch)
+    got = pq.read_table(buf)["x"].to_pylist()
+    assert got.count(None) == 3
+
+
+def test_optional_random_def_levels_identity():
+    """High-entropy def levels -> the device bit-pack (fast) path."""
+    rng = np.random.default_rng(3)
+    n = 20000
+    valid = rng.integers(0, 2, n).astype(bool)
+    schema = Schema([leaf("x", "int64", Repetition.OPTIONAL)])
+    vals = rng.integers(0, 50, n).astype(np.int64)
+    batch = columns_from_arrays(schema, {"x": (vals, valid)})
+    buf = _identity(schema, batch)
+    table = pq.read_table(buf)
+    assert sum(v is None for v in table["x"].to_pylist()) == int((~valid).sum())
+
+
+def test_nested_list_struct_identity_and_roundtrip():
+    """BASELINE config 5: list<struct> rep/def levels through the TPU path,
+    multiset-compared via an independent reader."""
+    Order = nested_message_classes()
+    rng = np.random.default_rng(4)
+    msgs = []
+    for i in range(4000):
+        o = Order()
+        o.order_id = int(rng.integers(0, 1 << 40))
+        for _ in range(int(rng.integers(0, 4))):
+            it = o.items.add()
+            it.sku = f"sku{int(rng.integers(0, 30))}"
+            it.qty = int(rng.integers(1, 9))
+            for _ in range(int(rng.integers(0, 3))):
+                it.tags.append(f"t{int(rng.integers(0, 5))}")
+        msgs.append(o)
+
+    from kpw_tpu.models import ProtoColumnarizer
+
+    schema = proto_to_schema(Order)
+    batch = ProtoColumnarizer(Order, schema).columnarize(msgs)
+    buf = _identity(schema, batch, data_page_size=32 * 1024)
+
+    table = pq.read_table(buf)
+    got_qty = [[it["qty"] for it in (row or [])] for row in table["items"].to_pylist()]
+    want_qty = [[it.qty for it in o.items] for o in msgs]
+    assert got_qty == want_qty
+
+
+def test_level_plan_cleared_between_row_groups():
+    """Two write_batch calls (two row groups): plans keyed by id(chunk) must
+    not leak across groups."""
+    rng = np.random.default_rng(5)
+    schema = Schema([leaf("x", "int64", Repetition.OPTIONAL)])
+
+    def batch():
+        n = 6000
+        valid = np.ones(n, bool)
+        valid[::7] = False
+        vals = rng.integers(0, 20, n).astype(np.int64)
+        return columns_from_arrays(schema, {"x": (vals, valid)})
+
+    properties = WriterProperties()
+    encoder = TpuChunkEncoder(properties.encoder_options())
+    encoder.min_device_rows = 1
+    buf = io.BytesIO()
+    w = ParquetFileWriter(buf, schema, properties, encoder=encoder)
+    w.write_batch(batch())
+    assert not getattr(encoder, "_level_plans", {})
+    w.write_batch(batch())
+    w.close()
+    buf.seek(0)
+    assert pq.read_table(buf).num_rows == 12000
