@@ -1,23 +1,36 @@
-"""Headline benchmark: 64-column dictionary+RLE parquet encode (BASELINE.md
-config 2 — NYC-taxi-shaped replay, one chip).
+"""Benchmark suite: the five BASELINE.md configs.
 
-Measures end-to-end rows/sec from columnar arrays to finished parquet bytes
-through ``ParquetFileWriter`` with the TPU EncoderBackend, against the
-industry CPU columnar writer (pyarrow's C++ parquet, dictionary on, same
-codec) as the stand-in for parquet-mr (the reference publishes no numbers —
-BASELINE.md; parquet-mr itself is a JVM library not present here, and
-pyarrow is the stronger baseline anyway).
+Default (no args) = the headline: config 2, 64-column dictionary+RLE parquet
+encode (NYC-taxi-shaped replay, one chip), printed as ONE JSON line
+{"metric", "value", "unit", "vs_baseline"} — what the driver records.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-Extra detail goes to stderr.  Run with --cpu to force the virtual CPU
-platform (local smoke); default uses whatever device JAX has (the driver
-runs this on the real TPU chip).
+  --config N   run one config (1-5)
+  --all        run every config, one JSON line each (headline last)
+  --cpu        force the virtual CPU platform (local smoke)
+
+Baseline for every config is pyarrow's C++ parquet writer with matched
+settings (codec, dictionary, encodings) — the stand-in for parquet-mr (the
+reference publishes no numbers, BASELINE.md; parquet-mr is a JVM library not
+present here, and pyarrow is the stronger baseline anyway).  vs_baseline =
+our rows/sec over pyarrow's.  Extra detail goes to stderr.
+
+Configs (BASELINE.json `configs`):
+  1. flat Avro-style 8 int64 + 4 string columns, Snappy
+  2. NYC-taxi 64 columns, dictionary+RLE, uncompressed (headline)
+  3. high-cardinality string-heavy: ZSTD + DELTA_BINARY_PACKED /
+     DELTA_LENGTH_BYTE_ARRAY
+  4. 16 partitions -> 8-shard mesh, shared row group with collective
+     dictionary merge (runs on a virtual CPU mesh when only one real chip
+     is visible — the sharding path itself is what's measured)
+  5. nested list<struct>: repetition/definition-level RLE on device
 """
 
 from __future__ import annotations
 
 import io
 import json
+import os
+import subprocess
 import sys
 import time
 
@@ -27,6 +40,98 @@ ROWS = 1 << 18  # 262144 rows/batch
 N_COLS = 64
 REPEATS = 3
 
+
+def _best(run, repeats: int = REPEATS, warmed: bool = False) -> float:
+    """Best-of-N wall time; pass warmed=True when the caller already ran the
+    workload once (jit compile + transfer paths)."""
+    if not warmed:
+        run()
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        run()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def _bench_writer(schema, arrays, props, label: str) -> tuple[float, int]:
+    """Time our ParquetFileWriter with the auto-selected backend."""
+    from kpw_tpu.core import ParquetFileWriter, columns_from_arrays
+    from kpw_tpu.runtime.select import choose_backend, make_encoder
+
+    backend = choose_backend()
+    print(f"[bench:{label}] backend: {backend}", file=sys.stderr)
+
+    def run() -> int:
+        buf = io.BytesIO()
+        w = ParquetFileWriter(buf, schema, props,
+                              encoder=make_encoder(props.encoder_options(), backend))
+        w.write_batch(columns_from_arrays(schema, arrays))
+        w.close()
+        return buf.tell()
+
+    size = run()  # doubles as the warmup
+    best = _best(run, warmed=True)
+    print(f"[bench:{label}] ours: {size} bytes, best {best:.3f}s", file=sys.stderr)
+    return best, size
+
+
+def _bench_pyarrow(table, label: str, **write_kwargs) -> tuple[float, int]:
+    import pyarrow.parquet as pq
+
+    def run() -> int:
+        buf = io.BytesIO()
+        pq.write_table(table, buf, **write_kwargs)
+        return buf.tell()
+
+    size = run()  # doubles as the warmup
+    best = _best(run, warmed=True)
+    print(f"[bench:{label}] pyarrow: {size} bytes, best {best:.3f}s", file=sys.stderr)
+    return best, size
+
+
+def _result(metric: str, rows: int, t_ours: float, t_base: float) -> dict:
+    return {
+        "metric": metric,
+        "value": round(rows / t_ours, 1),
+        "unit": "rows/s",
+        "vs_baseline": round(t_base / t_ours, 3),
+    }
+
+
+# ---------------------------------------------------------------------------
+# config 1: flat Avro-style, Snappy
+# ---------------------------------------------------------------------------
+
+def bench_config1() -> dict:
+    import pyarrow as pa
+
+    from kpw_tpu.core import Codec, Schema, WriterProperties, leaf
+
+    rng = np.random.default_rng(1)
+    rows = ROWS
+    arrays: dict = {}
+    for i in range(8):
+        arrays[f"i{i}"] = rng.integers(0, 10 ** (i + 1), rows).astype(np.int64)
+    pool = [f"cat_{j:03d}".encode() for j in range(100)]
+    for i in range(4):
+        arrays[f"s{i}"] = [pool[k] for k in rng.integers(0, 100, rows)]
+
+    schema = Schema([leaf(f"i{i}", "int64") for i in range(8)]
+                    + [leaf(f"s{i}", "string") for i in range(4)])
+    props = WriterProperties(codec=Codec.SNAPPY)
+    t_ours, _ = _bench_writer(schema, arrays, props, "cfg1")
+
+    table = pa.table({k: pa.array([v.decode() for v in vs]) if isinstance(vs, list)
+                      else pa.array(vs) for k, vs in arrays.items()})
+    t_base, _ = _bench_pyarrow(table, "cfg1", compression="snappy",
+                               use_dictionary=True, write_statistics=True)
+    return _result("rows_per_sec_flat_avro_snappy", rows, t_ours, t_base)
+
+
+# ---------------------------------------------------------------------------
+# config 2 (headline): 64-col taxi, dictionary+RLE
+# ---------------------------------------------------------------------------
 
 def make_taxi_like(rows: int, seed: int = 0) -> dict[str, np.ndarray]:
     """64 columns shaped like the NYC-taxi schema: low-cardinality ids/flags,
@@ -47,54 +152,183 @@ def make_taxi_like(rows: int, seed: int = 0) -> dict[str, np.ndarray]:
     return cols
 
 
-def bench_ours(arrays, schema_cols) -> float:
-    from kpw_tpu.core import ParquetFileWriter, Schema, WriterProperties, columns_from_arrays, leaf
-    from kpw_tpu.runtime.select import choose_backend, make_encoder, probe_link
+def bench_config2() -> dict:
+    import pyarrow as pa
 
-    schema = Schema([leaf(n, t) for n, t in schema_cols])
+    from kpw_tpu.core import Schema, WriterProperties, leaf
+    from kpw_tpu.runtime.select import probe_link
+
+    print(f"[bench:cfg2] link probe: {probe_link()}", file=sys.stderr)
+    arrays = make_taxi_like(ROWS)
+    type_map = {"int64": "int64", "int32": "int32", "float64": "double"}
+    schema = Schema([leaf(n, type_map[str(v.dtype)]) for n, v in arrays.items()])
+    t_ours, _ = _bench_writer(schema, arrays, WriterProperties(), "cfg2")
+
+    table = pa.table({k: pa.array(v) for k, v in arrays.items()})
+    t_base, _ = _bench_pyarrow(table, "cfg2", compression="NONE",
+                               use_dictionary=True, write_statistics=True)
+    return _result("rows_per_sec_64col_dict_rle", ROWS, t_ours, t_base)
+
+
+# ---------------------------------------------------------------------------
+# config 3: high-cardinality string-heavy, ZSTD + delta encodings
+# ---------------------------------------------------------------------------
+
+def bench_config3() -> dict:
+    import pyarrow as pa
+
+    from kpw_tpu.core import Codec, Schema, WriterProperties, leaf
+
+    rng = np.random.default_rng(3)
+    rows = 1 << 17
+    arrays: dict = {}
+    base = 1_700_000_000_000
+    for i in range(4):  # timestamp-like: large, near-sorted -> delta shines
+        arrays[f"ts{i}"] = (base + np.cumsum(rng.integers(0, 50, rows))
+                            + rng.integers(0, 5, rows)).astype(np.int64)
+    for i in range(4):  # uuid-ish unique strings
+        arrays[f"u{i}"] = [f"{v:032x}".encode()
+                           for v in rng.integers(0, 1 << 62, rows)]
+
+    schema = Schema([leaf(f"ts{i}", "int64") for i in range(4)]
+                    + [leaf(f"u{i}", "string") for i in range(4)])
+    props = WriterProperties(codec=Codec.ZSTD, enable_dictionary=False,
+                             delta_fallback=True)
+    t_ours, _ = _bench_writer(schema, arrays, props, "cfg3")
+
+    table = pa.table({k: pa.array([v.decode() for v in vs]) if isinstance(vs, list)
+                      else pa.array(vs) for k, vs in arrays.items()})
+    enc_map = {f"ts{i}": "DELTA_BINARY_PACKED" for i in range(4)}
+    enc_map.update({f"u{i}": "DELTA_LENGTH_BYTE_ARRAY" for i in range(4)})
+    t_base, _ = _bench_pyarrow(table, "cfg3", compression="zstd",
+                               use_dictionary=False, column_encoding=enc_map,
+                               write_statistics=True)
+    return _result("rows_per_sec_high_card_zstd_delta", rows, t_ours, t_base)
+
+
+# ---------------------------------------------------------------------------
+# config 4: 16 partitions -> 8-shard mesh, collective dictionary merge
+# ---------------------------------------------------------------------------
+
+def bench_config4() -> dict:
+    import jax
+
+    if len(jax.devices()) < 2:
+        # One real chip: measure the sharding path on a virtual CPU mesh in a
+        # subprocess (the driver separately dry-runs multi-chip via
+        # __graft_entry__.dryrun_multichip).
+        print("[bench:cfg4] <2 devices; re-running on virtual 8-CPU mesh",
+              file=sys.stderr)
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                            + " --xla_force_host_platform_device_count=8").strip()
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--config", "4", "--cpu"],
+            env=env, capture_output=True, text=True)
+        sys.stderr.write(out.stderr)
+        if out.returncode != 0:
+            raise RuntimeError(f"cfg4 subprocess failed (rc={out.returncode}); "
+                               "stderr above")
+        return json.loads(out.stdout.strip().splitlines()[-1])
+
+    import jax.numpy as jnp
+    import pyarrow as pa
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from kpw_tpu.parallel import make_mesh, sharded_encode_step
+
+    n_shards = min(8, len(jax.devices()))
+    mesh = make_mesh(n_shards)
+    rng = np.random.default_rng(4)
+    C = 16  # 16 Kafka partitions' worth of columns in one shared row group
+    per = 1 << 15
+    N = n_shards * per
+    vals = rng.integers(0, 1000, (C, N)).astype(np.uint32)
+    counts = np.full(n_shards, per, np.int32)
+
+    row_sharded = NamedSharding(mesh, P(None, "shard"))
+    hi = jax.device_put(jnp.zeros((C, N), jnp.uint32), row_sharded)
+    lo = jax.device_put(jnp.asarray(vals), row_sharded)
+    cnt = jax.device_put(jnp.asarray(counts), NamedSharding(mesh, P("shard")))
+
+    def run():
+        packed, *_ = sharded_encode_step(hi, lo, cnt, mesh=mesh, cap=2048,
+                                         width=16)
+        jax.block_until_ready(packed)
+
+    t_ours = _best(run)
+    print(f"[bench:cfg4] mesh={n_shards} shards, {C}x{N} vals, "
+          f"best {t_ours:.3f}s", file=sys.stderr)
+
+    table = pa.table({f"c{c}": pa.array(vals[c]) for c in range(C)})
+    t_base, _ = _bench_pyarrow(table, "cfg4", compression="NONE",
+                               use_dictionary=True, write_statistics=False)
+    return _result("rows_per_sec_sharded_dict_merge", N, t_ours, t_base)
+
+
+# ---------------------------------------------------------------------------
+# config 5: nested list<struct>, rep/def-level RLE on device
+# ---------------------------------------------------------------------------
+
+def bench_config5() -> dict:
+    import pyarrow as pa
+
+    from kpw_tpu.core import ParquetFileWriter, WriterProperties
+    from kpw_tpu.models import ProtoColumnarizer, proto_to_schema
+    from kpw_tpu.runtime.select import choose_backend, make_encoder
+
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                    "tests"))
+    from proto_helpers import nested_message_classes
+
+    Order = nested_message_classes()
+    rng = np.random.default_rng(5)
+    rows = 1 << 15
+    msgs = []
+    for i in range(rows):
+        o = Order()
+        o.order_id = int(rng.integers(0, 1 << 40))
+        for _ in range(int(rng.integers(0, 4))):
+            it = o.items.add()
+            it.sku = f"sku{int(rng.integers(0, 64))}"
+            it.qty = int(rng.integers(1, 100))
+        msgs.append(o)
+
+    schema = proto_to_schema(Order)
+    batch = ProtoColumnarizer(Order, schema).columnarize(msgs)  # prebuilt:
+    # the timed section is the encode path, matching the flat configs which
+    # also start from columnar data.
     props = WriterProperties()
-    print(f"[bench] link probe: {probe_link()}", file=sys.stderr)
     backend = choose_backend()
-    print(f"[bench] backend: {backend}", file=sys.stderr)
+    print(f"[bench:cfg5] backend: {backend}", file=sys.stderr)
 
     def run() -> int:
         buf = io.BytesIO()
         w = ParquetFileWriter(buf, schema, props,
                               encoder=make_encoder(props.encoder_options(), backend))
-        w.write_batch(columns_from_arrays(schema, arrays))
+        w.write_batch(batch)
         w.close()
         return buf.tell()
 
-    size = run()  # warmup: jit compile + transfer paths
-    best = float("inf")
-    for _ in range(REPEATS):
-        t0 = time.perf_counter()
-        run()
-        best = min(best, time.perf_counter() - t0)
-    print(f"[bench] ours: {size} bytes, best {best:.3f}s", file=sys.stderr)
-    return best
+    size = run()  # doubles as the warmup
+    t_ours = _best(run, warmed=True)
+    print(f"[bench:cfg5] ours: {size} bytes, best {t_ours:.3f}s", file=sys.stderr)
+
+    items = [[{"sku": it.sku, "qty": it.qty, "tags": list(it.tags)}
+              for it in o.items] for o in msgs]
+    table = pa.table({
+        "order_id": pa.array([o.order_id for o in msgs], pa.int64()),
+        "items": pa.array(items),
+        "note": pa.array([o.note for o in msgs]),
+    })
+    t_base, _ = _bench_pyarrow(table, "cfg5", compression="NONE",
+                               use_dictionary=True, write_statistics=True)
+    return _result("rows_per_sec_nested_list_struct", rows, t_ours, t_base)
 
 
-def bench_pyarrow(arrays) -> float:
-    import pyarrow as pa
-    import pyarrow.parquet as pq
-
-    table = pa.table({k: pa.array(v) for k, v in arrays.items()})
-
-    def run() -> int:
-        buf = io.BytesIO()
-        pq.write_table(table, buf, compression="NONE", use_dictionary=True,
-                       write_statistics=True)
-        return buf.tell()
-
-    size = run()
-    best = float("inf")
-    for _ in range(REPEATS):
-        t0 = time.perf_counter()
-        run()
-        best = min(best, time.perf_counter() - t0)
-    print(f"[bench] pyarrow: {size} bytes, best {best:.3f}s", file=sys.stderr)
-    return best
+CONFIGS = {1: bench_config1, 2: bench_config2, 3: bench_config3,
+           4: bench_config4, 5: bench_config5}
 
 
 def main() -> None:
@@ -105,20 +339,16 @@ def main() -> None:
     import jax
 
     print(f"[bench] devices: {jax.devices()}", file=sys.stderr)
-    arrays = make_taxi_like(ROWS)
-    schema_cols = [
-        (name, {"int64": "int64", "int32": "int32", "float64": "double"}[str(v.dtype)])
-        for name, v in arrays.items()
-    ]
-    t_ours = bench_ours(arrays, schema_cols)
-    t_base = bench_pyarrow(arrays)
-    rows_sec = ROWS / t_ours
-    print(json.dumps({
-        "metric": "rows_per_sec_64col_dict_rle",
-        "value": round(rows_sec, 1),
-        "unit": "rows/s",
-        "vs_baseline": round((ROWS / t_ours) / (ROWS / t_base), 3),
-    }))
+
+    if "--all" in sys.argv:
+        for n in (1, 3, 4, 5, 2):  # headline (2) last
+            print(json.dumps(CONFIGS[n]()), flush=True)
+        return
+    if "--config" in sys.argv:
+        n = int(sys.argv[sys.argv.index("--config") + 1])
+        print(json.dumps(CONFIGS[n]()))
+        return
+    print(json.dumps(bench_config2()))
 
 
 if __name__ == "__main__":

@@ -213,13 +213,16 @@ class _LevelPlanner:
                         lens_h[r, :n_runs], width)
                     parts.setdefault((i, a, b), {})[kind] = payload
         for (i, a, b), kinds in parts.items():
-            col = self._chunks[i].column
+            chunk = self._chunks[i]
+            col = chunk.column
             blob = b""
             for kind, max_level in (("rep", col.max_rep), ("def", col.max_def)):
                 if max_level > 0:
                     payload = kinds[kind]
                     blob += struct.pack("<I", len(payload)) + payload
-            self.plans.setdefault(id(self._chunks[i]), {})[(a, b)] = blob
+            # entries carry the chunk itself so a consumer can identity-check
+            # against id() reuse (plans may survive an aborted _prepare_all)
+            self.plans.setdefault(id(chunk), (chunk, {}))[1][(a, b)] = blob
 
 
 class TpuChunkEncoder(CpuChunkEncoder):
@@ -432,11 +435,13 @@ class TpuChunkEncoder(CpuChunkEncoder):
 
     # -- primitive overrides ----------------------------------------------
     def _levels_page_blob(self, chunk, a: int, b: int) -> bytes:
-        plan = getattr(self, "_level_plans", None)
-        if plan:
-            body = plan.get(id(chunk), {}).get((a, b))
-            if body is not None:
-                return body
+        plans = getattr(self, "_level_plans", None)
+        if plans:
+            hit = plans.get(id(chunk))
+            if hit is not None and hit[0] is chunk:  # guard against id() reuse
+                body = hit[1].get((a, b))
+                if body is not None:
+                    return body
         return super()._levels_page_blob(chunk, a, b)
 
     def _dictionary_build(self, values, pt: int):
