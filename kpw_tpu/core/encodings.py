@@ -229,6 +229,8 @@ def dictionary_build(values, physical_type: int):
     the mesh-global merged dictionaries (kpw_tpu.parallel.dict_merge), and
     this CPU oracle produces the identical bytes."""
     if physical_type == PhysicalType.BYTE_ARRAY or physical_type == PhysicalType.FIXED_LEN_BYTE_ARRAY:
+        if not isinstance(values, list):
+            values = list(values)  # ByteColumn etc.: the oracle works on lists
         # Vectorized path: numpy 'S' arrays sort bytes lexicographically, same
         # order as python bytes.  'S' storage strips trailing NULs and is
         # fixed-width (n x max_len), so gate on both: trailing-NUL data and

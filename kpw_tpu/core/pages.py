@@ -14,6 +14,7 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from . import encodings as enc
+from .bytecol import ByteColumn
 from .compression import compress
 from .metadata import (
     ColumnChunk,
@@ -48,18 +49,27 @@ class ColumnChunkData:
             return len(self.def_levels)
         return len(self.values)
 
+    _est_bytes: int | None = field(default=None, repr=False, compare=False)
+
     def estimated_bytes(self) -> int:
-        v = self.values
-        if isinstance(v, np.ndarray):
-            data = v.nbytes
-        else:
-            data = sum(len(x) + 4 for x in v)
-        levels = 0
-        if self.def_levels is not None:
-            levels += len(self.def_levels)
-        if self.rep_levels is not None:
-            levels += len(self.rep_levels)
-        return data + levels // 4
+        # Memoized: the byte-list scan is O(n) and every consumer (batch
+        # sizing, page geometry, the TPU planner) asks repeatedly.  Chunk
+        # data is immutable once handed to the writer.
+        if self._est_bytes is None:
+            v = self.values
+            if isinstance(v, np.ndarray):
+                data = v.nbytes
+            elif isinstance(v, ByteColumn):
+                data = v.payload_bytes() + 4 * len(v)
+            else:
+                data = sum(len(x) + 4 for x in v)
+            levels = 0
+            if self.def_levels is not None:
+                levels += len(self.def_levels)
+            if self.rep_levels is not None:
+                levels += len(self.rep_levels)
+            self._est_bytes = data + levels // 4
+        return self._est_bytes
 
 def _min_max_bytes(values, physical_type: int):
     if len(values) == 0:
@@ -149,6 +159,11 @@ class CpuChunkEncoder:
 
     def _levels_body(self, levels: np.ndarray, max_level: int) -> bytes:
         return enc.rle_levels_v1(levels, max_level)
+
+    def _stats_min_max(self, values, pt: int):
+        """Column statistics min/max — overridable so backends can avoid
+        iterating packed byte columns in Python."""
+        return _min_max_bytes(values, pt)
 
     def _levels_page_blob(self, chunk: "ColumnChunkData", a: int, b: int) -> bytes:
         """rep + def level streams for slots [a, b) — the per-page boundary
@@ -327,7 +342,7 @@ class CpuChunkEncoder:
             # The dictionary is exactly the set of present values, so its
             # min/max equals the column's — O(k) instead of O(n).
             stat_src = dict_values if use_dict else chunk.values
-            lo, hi = _min_max_bytes(stat_src, pt)
+            lo, hi = self._stats_min_max(stat_src, pt)
             null_count = None
             if chunk.def_levels is not None:
                 null_count = int((chunk.def_levels < col.max_def).sum())

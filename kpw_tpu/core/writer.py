@@ -15,9 +15,10 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from .bytecol import ByteColumn
 from .metadata import ColumnChunk, FileMetaData, RowGroup
 from .pages import ColumnChunkData, CpuChunkEncoder, EncoderOptions
-from .schema import Schema
+from .schema import PhysicalType, Schema
 from ..utils.tracing import stage
 
 MAGIC = b"PAR1"
@@ -134,6 +135,15 @@ class ParquetFileWriter:
         first = parts[0]
         if isinstance(first.values, np.ndarray):
             values = np.concatenate([p.values for p in parts])
+        elif all(isinstance(p.values, ByteColumn) for p in parts):
+            datas = [p.values.payload() for p in parts]
+            offsets = [np.zeros(1, np.int64)]
+            base = 0
+            for p in parts:
+                o = p.values.offsets
+                offsets.append(o[1:] - o[0] + base)
+                base += p.values.payload_bytes()
+            values = ByteColumn(b"".join(datas), np.concatenate(offsets))
         else:
             values = [v for p in parts for v in p.values]
 
@@ -236,6 +246,9 @@ def columns_from_arrays(schema: Schema, arrays: dict[str, object]) -> ColumnBatc
             n = len(values)
             if col.max_def > 0:
                 def_levels = np.full(n, col.max_def, np.int32)
+        if isinstance(values, list) and col.leaf.physical_type in (
+                PhysicalType.BYTE_ARRAY, PhysicalType.FIXED_LEN_BYTE_ARRAY):
+            values = ByteColumn.from_list(values)
         if num_rows is None:
             num_rows = n
         elif num_rows != n:

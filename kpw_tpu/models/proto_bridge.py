@@ -22,6 +22,7 @@ from ..core.schema import (
     Repetition,
     Schema,
 )
+from ..core.bytecol import ByteColumn
 from ..core.writer import ColumnBatch
 from ..core.pages import ColumnChunkData
 
@@ -219,4 +220,6 @@ class ProtoColumnarizer:
         dtype = _NUMPY_DTYPES.get(pt)
         if dtype is not None:
             return np.asarray(values, dtype)
+        if pt in (PhysicalType.BYTE_ARRAY, PhysicalType.FIXED_LEN_BYTE_ARRAY):
+            return ByteColumn.from_list(values)
         return values

@@ -83,6 +83,21 @@ class NativeLib:
         cdll.kpw_dict_build_u64.restype = ctypes.c_int
         cdll.kpw_dict_build_u64.argtypes = [
             c_u64p, c_sz, c_u64p, c_u32p, ctypes.c_uint32, c_u32p]
+        c_i64p = ctypes.POINTER(ctypes.c_int64)
+        c_i32p = ctypes.POINTER(ctypes.c_int32)
+        cdll.kpw_delta_bp_cap.restype = c_sz
+        cdll.kpw_delta_bp_cap.argtypes = [c_sz]
+        cdll.kpw_delta_bp32.restype = ctypes.c_int
+        cdll.kpw_delta_bp32.argtypes = [c_i32p, c_sz, c_p, ctypes.POINTER(c_sz)]
+        cdll.kpw_delta_bp64.restype = ctypes.c_int
+        cdll.kpw_delta_bp64.argtypes = [c_i64p, c_sz, c_p, ctypes.POINTER(c_sz)]
+        cdll.kpw_dict_build_bytes.restype = ctypes.c_int
+        cdll.kpw_dict_build_bytes.argtypes = [
+            c_p, c_i64p, c_sz, c_i64p, c_u32p, ctypes.c_uint32, c_u32p]
+        cdll.kpw_bytes_min_max.restype = None
+        cdll.kpw_bytes_min_max.argtypes = [c_p, c_i64p, c_sz,
+                                           ctypes.POINTER(c_sz),
+                                           ctypes.POINTER(c_sz)]
         cdll.kpw_rle_hybrid_cap.restype = c_sz
         cdll.kpw_rle_hybrid_cap.argtypes = [c_sz, ctypes.c_int]
         cdll.kpw_rle_hybrid_u32.restype = ctypes.c_int
@@ -198,6 +213,66 @@ class NativeLib:
         if rc != 0:
             raise RuntimeError(f"kpw_dict_build rc={rc}")
         return dict_out[: k.value].copy(), idx
+
+    def dict_build_bytes(self, data: bytes, offsets, max_k: int | None = None):
+        """Byte-array dictionary over a concatenated buffer + int64 offsets
+        (n+1 entries).  Returns (uniq_pos int64 (k,) — index of each unique
+        value's first occurrence, in ascending lexicographic order — and
+        idx uint32 (n,)), or None when uniques exceed ``max_k``."""
+        import numpy as np
+
+        offs = np.ascontiguousarray(offsets, np.int64)
+        n = len(offs) - 1
+        cap = n if max_k is None else min(n, max_k)
+        uniq_pos = np.empty(max(cap, 1), np.int64)
+        idx = np.empty(max(n, 1), np.uint32)
+        k = ctypes.c_uint32(0)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        u32p = ctypes.POINTER(ctypes.c_uint32)
+        rc = self._c.kpw_dict_build_bytes(
+            data, offs.ctypes.data_as(i64p), n,
+            uniq_pos.ctypes.data_as(i64p), idx.ctypes.data_as(u32p),
+            cap, ctypes.byref(k))
+        if rc == 1:
+            return None
+        if rc != 0:
+            raise RuntimeError(f"kpw_dict_build_bytes rc={rc}")
+        return uniq_pos[: k.value].copy(), idx[:n]
+
+    def bytes_min_max(self, data: bytes, offsets) -> tuple[int, int]:
+        """(min_idx, max_idx) of the lexicographically smallest/largest
+        value; offsets must have >= 2 entries (n >= 1)."""
+        import numpy as np
+
+        offs = np.ascontiguousarray(offsets, np.int64)
+        mn = ctypes.c_size_t(0)
+        mx = ctypes.c_size_t(0)
+        self._c.kpw_bytes_min_max(
+            data, offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            len(offs) - 1, ctypes.byref(mn), ctypes.byref(mx))
+        return mn.value, mx.value
+
+    def delta_binary_packed(self, values, bit_size: int = 64) -> bytes:
+        """DELTA_BINARY_PACKED stream, byte-identical to
+        kpw_tpu.core.encodings.delta_binary_packed_encode."""
+        import numpy as np
+
+        itype = np.int64 if bit_size == 64 else np.int32
+        v = np.ascontiguousarray(values, itype)
+        cap = self._c.kpw_delta_bp_cap(len(v))
+        out = ctypes.create_string_buffer(cap)
+        out_len = ctypes.c_size_t(0)
+        if bit_size == 64:
+            rc = self._c.kpw_delta_bp64(
+                v.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), len(v),
+                out, ctypes.byref(out_len))
+        else:
+            rc = self._c.kpw_delta_bp32(
+                v.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), len(v),
+                out, ctypes.byref(out_len))
+        if rc != 0:
+            raise RuntimeError(f"kpw_delta_bp rc={rc}")
+        return out.raw[: out_len.value]
 
     def rle_hybrid(self, values, width: int) -> bytes:
         """RLE/bit-pack hybrid stream, byte-identical to

@@ -19,6 +19,7 @@ import struct
 import numpy as np
 
 from ..core import encodings as enc
+from ..core.bytecol import ByteColumn
 from ..core.pages import CpuChunkEncoder, EncoderOptions
 from ..core.schema import PhysicalType
 from . import lib
@@ -41,7 +42,31 @@ class NativeChunkEncoder(CpuChunkEncoder):
                            PhysicalType.FIXED_LEN_BYTE_ARRAY)
         )
 
+    def _bytes_native_ok(self, values, pt: int) -> bool:
+        return (self._lib is not None
+                and pt in (PhysicalType.BYTE_ARRAY,
+                           PhysicalType.FIXED_LEN_BYTE_ARRAY)
+                and isinstance(values, (list, ByteColumn)))
+
+    @staticmethod
+    def _bytes_parts(values) -> tuple[bytes, np.ndarray]:
+        if not isinstance(values, ByteColumn):
+            values = ByteColumn.from_list(values)
+        return values.data, values.offsets  # zero-copy, absolute offsets
+
+    def _bytes_dictionary(self, values, max_k: int | None):
+        data, offsets = self._bytes_parts(values)
+        built = self._lib.dict_build_bytes(data, offsets, max_k)
+        if built is None:
+            return None
+        uniq_pos, idx = built
+        if isinstance(values, ByteColumn):
+            return values.take(uniq_pos), idx
+        return [values[p] for p in uniq_pos], idx
+
     def _dictionary_build(self, values, pt: int):
+        if self._bytes_native_ok(values, pt):
+            return self._bytes_dictionary(values, None)
         if not self._native_ok(values, pt):
             return super()._dictionary_build(values, pt)
         key = values.view(np.uint32 if values.dtype.itemsize == 4 else np.uint64)
@@ -51,6 +76,11 @@ class NativeChunkEncoder(CpuChunkEncoder):
     def _try_dictionary(self, chunk):
         values = chunk.values
         pt = chunk.column.leaf.physical_type
+        if self._bytes_native_ok(values, pt):
+            # Early abort at the ratio bound (the byte-budget check needs the
+            # built dictionary, so encode() still applies it afterwards).
+            max_k = max(1, int(len(values) * self.options.max_dictionary_ratio))
+            return self._bytes_dictionary(values, max_k)
         if not self._native_ok(values, pt):
             return super()._try_dictionary(chunk)
         # Largest k that would survive the rejection checks in encode():
@@ -65,6 +95,34 @@ class NativeChunkEncoder(CpuChunkEncoder):
             return None  # proven infeasible; encode() falls back to plain/delta
         d, idx = built
         return d.view(values.dtype), idx
+
+    def _values_body(self, values, pt: int, encoding: int) -> bytes:
+        from ..core.schema import Encoding
+
+        L = self._lib
+        if L is not None and encoding == Encoding.DELTA_BINARY_PACKED:
+            bit_size = 32 if pt == PhysicalType.INT32 else 64
+            return L.delta_binary_packed(np.asarray(values), bit_size)
+        if L is not None and encoding == Encoding.DELTA_LENGTH_BYTE_ARRAY:
+            if isinstance(values, ByteColumn):
+                return (L.delta_binary_packed(values.lens(), 32)
+                        + values.payload())
+            lens = np.fromiter(map(len, values), np.int64, count=len(values))
+            return L.delta_binary_packed(lens, 32) + b"".join(values)
+        return super()._values_body(values, pt, encoding)
+
+    def _stats_min_max(self, values, pt: int):
+        if (self._lib is not None and isinstance(values, ByteColumn)
+                and len(values)):
+            mn, mx = self._lib.bytes_min_max(values.data, values.offsets)
+            return values[mn], values[mx]
+        return super()._stats_min_max(values, pt)
+
+    def _plain_body(self, values, pt: int) -> bytes:
+        if (self._lib is not None and isinstance(values, ByteColumn)
+                and pt == PhysicalType.BYTE_ARRAY):
+            return self._lib.byte_array_plain(values.data, values.offsets)
+        return super()._plain_body(values, pt)
 
     def _indices_body(self, indices, va: int, vb: int, dict_size: int) -> bytes:
         L = self._lib

@@ -168,6 +168,166 @@ int dict_build(const K* vals, size_t n, K* dict_out, uint32_t* idx_out,
   return 0;
 }
 
+// 64-bit-wide variant of bitpack_stream for delta miniblocks (widths up to
+// 64); acc holds at most 7+64 bits.
+inline uint8_t* bitpack_stream64(const uint64_t* v, size_t n, int width,
+                                 uint8_t* op) {
+  unsigned __int128 acc = 0;
+  int nbits = 0;
+  for (size_t i = 0; i < n; ++i) {
+    acc |= static_cast<unsigned __int128>(v[i]) << nbits;
+    nbits += width;
+    while (nbits >= 8) {
+      *op++ = static_cast<uint8_t>(acc);
+      acc >>= 8;
+      nbits -= 8;
+    }
+  }
+  if (nbits) *op++ = static_cast<uint8_t>(acc);
+  return op;
+}
+
+inline uint64_t zigzag64(int64_t x) {
+  return (static_cast<uint64_t>(x) << 1) ^ static_cast<uint64_t>(x >> 63);
+}
+
+// DELTA_BINARY_PACKED (core.encodings.delta_binary_packed_encode oracle):
+// block 128, 4 miniblocks of 32; ring arithmetic in the value width (I).
+template <typename I, typename U>
+int delta_bp(const I* v, size_t n, uint8_t* out, size_t* out_len) {
+  constexpr int kBlock = 128, kMini = 4, kMB = 32;
+  uint8_t* op = out;
+  op += varint(kBlock, op);
+  op += varint(kMini, op);
+  op += varint(n, op);
+  if (n == 0) {
+    op += varint(0, op);
+    *out_len = static_cast<size_t>(op - out);
+    return 0;
+  }
+  op += varint(zigzag64(static_cast<int64_t>(v[0])), op);
+  if (n == 1) {
+    *out_len = static_cast<size_t>(op - out);
+    return 0;
+  }
+  const size_t nd = n - 1;
+  std::vector<I> deltas(nd);
+  for (size_t i = 0; i < nd; ++i)
+    deltas[i] = static_cast<I>(static_cast<U>(v[i + 1]) - static_cast<U>(v[i]));
+  uint64_t rel[kBlock];
+  for (size_t pos = 0; pos < nd; pos += kBlock) {
+    const size_t m = std::min(static_cast<size_t>(kBlock), nd - pos);
+    I min_delta = deltas[pos];
+    for (size_t i = 1; i < m; ++i)
+      if (deltas[pos + i] < min_delta) min_delta = deltas[pos + i];
+    op += varint(zigzag64(static_cast<int64_t>(min_delta)), op);
+    for (size_t i = 0; i < m; ++i)
+      rel[i] = static_cast<U>(static_cast<U>(deltas[pos + i]) -
+                              static_cast<U>(min_delta));
+    for (size_t i = m; i < kBlock; ++i) rel[i] = 0;
+    uint8_t* widths = op;
+    op += kMini;
+    for (int mb = 0; mb < kMini; ++mb) {
+      const size_t a = static_cast<size_t>(mb) * kMB;
+      if (a >= m) {  // miniblock entirely past the data: width 0, no bytes
+        widths[mb] = 0;
+        continue;
+      }
+      uint64_t mx = 0;
+      for (size_t i = a; i < a + kMB; ++i)
+        if (rel[i] > mx) mx = rel[i];
+      const int w = mx ? 64 - __builtin_clzll(mx) : 0;
+      widths[mb] = static_cast<uint8_t>(w);
+      if (w) op = bitpack_stream64(rel + a, kMB, w, op);
+    }
+  }
+  *out_len = static_cast<size_t>(op - out);
+  return 0;
+}
+
+// Byte-array (string) dictionary: open-addressing over (offset, len) views
+// into the caller's concatenated buffer, then a lexicographic sort of the
+// unique set — the same order as python bytes comparison (memcmp on the
+// common prefix, shorter-is-smaller tie-break), so output matches the
+// numpy/python oracle (core.encodings.dictionary_build) byte for byte.
+struct BytesView {
+  const uint8_t* p;
+  int64_t len;
+};
+
+inline bool view_eq(const BytesView& a, const BytesView& b) {
+  return a.len == b.len && std::memcmp(a.p, b.p, static_cast<size_t>(a.len)) == 0;
+}
+
+inline bool view_lt(const BytesView& a, const BytesView& b) {
+  const size_t m = static_cast<size_t>(a.len < b.len ? a.len : b.len);
+  const int c = std::memcmp(a.p, b.p, m);
+  if (c) return c < 0;
+  return a.len < b.len;
+}
+
+inline uint64_t hash_bytes(const uint8_t* p, int64_t len) {
+  uint64_t h = 0xCBF29CE484222325ull;
+  for (int64_t i = 0; i < len; ++i) h = (h ^ p[i]) * 0x100000001B3ull;
+  return mix(h);
+}
+
+int dict_build_bytes(const uint8_t* data, const int64_t* offsets, size_t n,
+                     int64_t* uniq_pos_out, uint32_t* idx_out, uint32_t max_k,
+                     uint32_t* k_out) {
+  size_t cap = 1024;
+  std::vector<uint32_t> ids(cap, UINT32_MAX);
+  std::vector<BytesView> uniq;
+  std::vector<int64_t> first_pos;
+  uniq.reserve(1024);
+  first_pos.reserve(1024);
+  size_t mask = cap - 1;
+  auto grow = [&]() {
+    cap <<= 1;
+    mask = cap - 1;
+    ids.assign(cap, UINT32_MAX);
+    for (uint32_t id = 0; id < uniq.size(); ++id) {
+      size_t s = static_cast<size_t>(hash_bytes(uniq[id].p, uniq[id].len)) & mask;
+      while (ids[s] != UINT32_MAX) s = (s + 1) & mask;
+      ids[s] = id;
+    }
+  };
+  for (size_t i = 0; i < n; ++i) {
+    const BytesView v{data + offsets[i], offsets[i + 1] - offsets[i]};
+    size_t s = static_cast<size_t>(hash_bytes(v.p, v.len)) & mask;
+    for (;;) {
+      const uint32_t id = ids[s];
+      if (id == UINT32_MAX) {
+        ids[s] = static_cast<uint32_t>(uniq.size());
+        idx_out[i] = static_cast<uint32_t>(uniq.size());
+        uniq.push_back(v);
+        first_pos.push_back(static_cast<int64_t>(i));
+        if (uniq.size() > max_k) return 1;  // dictionary infeasible
+        if (2 * uniq.size() >= cap) grow();
+        break;
+      }
+      if (view_eq(uniq[id], v)) {
+        idx_out[i] = id;
+        break;
+      }
+      s = (s + 1) & mask;
+    }
+  }
+  const size_t k = uniq.size();
+  std::vector<uint32_t> order(k);
+  for (uint32_t x = 0; x < k; ++x) order[x] = x;
+  std::sort(order.begin(), order.end(),
+            [&](uint32_t a, uint32_t b) { return view_lt(uniq[a], uniq[b]); });
+  std::vector<uint32_t> rank(k);
+  for (uint32_t r = 0; r < k; ++r) {
+    rank[order[r]] = r;
+    uniq_pos_out[r] = first_pos[order[r]];
+  }
+  for (size_t i = 0; i < n; ++i) idx_out[i] = rank[idx_out[i]];
+  *k_out = static_cast<uint32_t>(k);
+  return 0;
+}
+
 }  // namespace
 
 extern "C" {
@@ -180,6 +340,43 @@ int kpw_dict_build_u32(const uint32_t* vals, size_t n, uint32_t* dict_out,
 int kpw_dict_build_u64(const uint64_t* vals, size_t n, uint64_t* dict_out,
                        uint32_t* idx_out, uint32_t max_k, uint32_t* k_out) {
   return dict_build(vals, n, dict_out, idx_out, max_k, k_out);
+}
+
+// Output bound: 4 header varints (<=10 B each) + per 128-delta block one
+// min-delta varint (<=10 B) + 4 width bytes + 4 miniblocks of 32 values at
+// <=64 bits (256 B each).
+size_t kpw_delta_bp_cap(size_t n) {
+  return 64 + ((n + 127) / 128) * (14 + 4 * 256);
+}
+
+int kpw_delta_bp32(const int32_t* v, size_t n, uint8_t* out, size_t* out_len) {
+  return delta_bp<int32_t, uint32_t>(v, n, out, out_len);
+}
+
+int kpw_delta_bp64(const int64_t* v, size_t n, uint8_t* out, size_t* out_len) {
+  return delta_bp<int64_t, uint64_t>(v, n, out, out_len);
+}
+
+// Lexicographic min/max of a byte-array column (column statistics) — one
+// memcmp pass instead of two python iterations.
+void kpw_bytes_min_max(const uint8_t* data, const int64_t* offsets, size_t n,
+                       size_t* min_idx, size_t* max_idx) {
+  size_t mn = 0, mx = 0;
+  for (size_t i = 1; i < n; ++i) {
+    const BytesView v{data + offsets[i], offsets[i + 1] - offsets[i]};
+    const BytesView m{data + offsets[mn], offsets[mn + 1] - offsets[mn]};
+    const BytesView M{data + offsets[mx], offsets[mx + 1] - offsets[mx]};
+    if (view_lt(v, m)) mn = i;
+    if (view_lt(M, v)) mx = i;
+  }
+  *min_idx = mn;
+  *max_idx = mx;
+}
+
+int kpw_dict_build_bytes(const uint8_t* data, const int64_t* offsets, size_t n,
+                         int64_t* uniq_pos_out, uint32_t* idx_out,
+                         uint32_t max_k, uint32_t* k_out) {
+  return dict_build_bytes(data, offsets, n, uniq_pos_out, idx_out, max_k, k_out);
 }
 
 // Worst-case output bound for the hybrid stream: each 8-value group costs at

@@ -31,7 +31,8 @@ import struct
 import numpy as np
 
 from ..core import encodings as enc
-from ..core.pages import ColumnChunkData, CpuChunkEncoder, EncoderOptions
+from ..core.pages import ColumnChunkData, EncoderOptions
+from ..native.encoder import NativeChunkEncoder
 from ..core.schema import PhysicalType
 from ..core.thrift import varint_bytes
 from .dictionary import DictBuildHandle, build_dictionaries
@@ -225,8 +226,13 @@ class _LevelPlanner:
             self.plans.setdefault(id(chunk), (chunk, {}))[1][(a, b)] = blob
 
 
-class TpuChunkEncoder(CpuChunkEncoder):
-    """Byte-identical TPU implementation of the chunk encoder."""
+class TpuChunkEncoder(NativeChunkEncoder):
+    """Byte-identical TPU implementation of the chunk encoder.
+
+    Host-side work that stays off the device (string dictionaries, delta
+    fallbacks, small chunks below min_device_rows) rides the native C++
+    primitives via the superclass; everything is byte-identical to the CPU
+    oracle either way."""
 
     def __init__(self, options: EncoderOptions, min_device_rows: int = 4096) -> None:
         super().__init__(options)

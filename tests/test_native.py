@@ -279,3 +279,132 @@ def test_native_dict_build_full_span_keys(lib):
     want_d, want_idx = enc.dictionary_build(values, PhysicalType.INT64)
     np.testing.assert_array_equal(d.view(np.int64), want_d)
     np.testing.assert_array_equal(idx, want_idx)
+
+
+def test_native_dict_build_bytes_matches_oracle(lib):
+    from kpw_tpu.core import encodings as enc
+    from kpw_tpu.core.schema import PhysicalType
+
+    rng = np.random.default_rng(7)
+    cases = [
+        [f"cat_{i:03d}".encode() for i in rng.integers(0, 100, 5000)],
+        [b"", b"a", b"", b"ab", b"a", b"b" * 300, b""],  # empties + long
+        [b"x\x00", b"x", b"x\x00\x00"],  # trailing NULs (oracle hash path)
+        [bytes([b]) for b in rng.integers(0, 256, 4000)],  # all byte values
+    ]
+    for values in cases:
+        data = b"".join(values)
+        offsets = np.zeros(len(values) + 1, np.int64)
+        np.cumsum([len(v) for v in values], out=offsets[1:])
+        uniq_pos, idx = lib.dict_build_bytes(data, offsets)
+        got_table = [values[p] for p in uniq_pos]
+        want_table, want_idx = enc.dictionary_build(values, PhysicalType.BYTE_ARRAY)
+        assert got_table == list(want_table)
+        np.testing.assert_array_equal(idx, want_idx)
+
+
+def test_native_dict_build_bytes_max_k_abort(lib):
+    values = [f"u{i}".encode() for i in range(1000)]  # all unique
+    data = b"".join(values)
+    offsets = np.zeros(len(values) + 1, np.int64)
+    np.cumsum([len(v) for v in values], out=offsets[1:])
+    assert lib.dict_build_bytes(data, offsets, max_k=50) is None
+
+
+def test_native_encoder_string_dictionary_identity():
+    """String-heavy table: native byte-array dictionary vs the oracle at
+    file level, including a high-cardinality column (rejected dict)."""
+    import io
+
+    from kpw_tpu.core import ParquetFileWriter, Schema, WriterProperties
+    from kpw_tpu.core import columns_from_arrays, leaf
+    from kpw_tpu.core.pages import CpuChunkEncoder
+    from kpw_tpu.native.encoder import NativeChunkEncoder
+
+    rng = np.random.default_rng(8)
+    rows = 15_000
+    arrays = {
+        "s_lo": [f"cat_{k:02d}".encode() for k in rng.integers(0, 60, rows)],
+        "s_hi": [f"{v:028x}".encode() for v in rng.integers(0, 1 << 62, rows)],
+        "s_nul": [(b"v\x00" if k else b"v") for k in rng.integers(0, 2, rows)],
+    }
+    schema = Schema([leaf("s_lo", "string"), leaf("s_hi", "string"),
+                     leaf("s_nul", "string")])
+    props = WriterProperties()
+
+    def run(encoder):
+        buf = io.BytesIO()
+        w = ParquetFileWriter(buf, schema, props, encoder=encoder)
+        w.write_batch(columns_from_arrays(schema, arrays))
+        w.close()
+        return buf.getvalue()
+
+    opts = props.encoder_options()
+    assert run(NativeChunkEncoder(opts)) == run(CpuChunkEncoder(opts))
+
+
+def test_native_delta_binary_packed_matches_oracle(lib):
+    from kpw_tpu.core import encodings as enc
+
+    rng = np.random.default_rng(9)
+    cases64 = [
+        np.array([], np.int64),
+        np.array([42], np.int64),
+        rng.integers(-(1 << 62), 1 << 62, 1000).astype(np.int64),  # wide deltas
+        (1_700_000_000_000 + np.cumsum(rng.integers(0, 50, 777))).astype(np.int64),
+        np.full(300, -5, np.int64),  # zero deltas
+        np.array([0, (1 << 63) - 1, -(1 << 63), 17], np.int64),  # wraparound
+    ]
+    for v in cases64:
+        assert lib.delta_binary_packed(v, 64) == enc.delta_binary_packed_encode(v, 64)
+    cases32 = [
+        rng.integers(-(1 << 30), 1 << 30, 1000).astype(np.int32),
+        np.array([0, (1 << 31) - 1, -(1 << 31)], np.int32),
+        np.arange(129, dtype=np.int32),  # exactly one block + 1
+    ]
+    for v in cases32:
+        assert lib.delta_binary_packed(v, 32) == enc.delta_binary_packed_encode(v, 32)
+
+
+def test_native_encoder_delta_identity():
+    """delta_fallback config: native DELTA_BINARY_PACKED and
+    DELTA_LENGTH_BYTE_ARRAY vs the oracle at file level."""
+    import io
+
+    from kpw_tpu.core import Codec, ParquetFileWriter, Schema, WriterProperties
+    from kpw_tpu.core import columns_from_arrays, leaf
+    from kpw_tpu.core.pages import CpuChunkEncoder
+    from kpw_tpu.native.encoder import NativeChunkEncoder
+
+    rng = np.random.default_rng(10)
+    rows = 12_000
+    arrays = {
+        "ts": (1_700_000_000 + np.cumsum(rng.integers(0, 9, rows))).astype(np.int64),
+        "i32": rng.integers(-(1 << 29), 1 << 29, rows).astype(np.int32),
+        "u": [f"{v:024x}".encode() for v in rng.integers(0, 1 << 60, rows)],
+    }
+    schema = Schema([leaf("ts", "int64"), leaf("i32", "int32"), leaf("u", "string")])
+    props = WriterProperties(codec=Codec.ZSTD, enable_dictionary=False,
+                             delta_fallback=True)
+
+    def run(encoder):
+        buf = io.BytesIO()
+        w = ParquetFileWriter(buf, schema, props, encoder=encoder)
+        w.write_batch(columns_from_arrays(schema, arrays))
+        w.close()
+        return buf.getvalue()
+
+    opts = props.encoder_options()
+    assert run(NativeChunkEncoder(opts)) == run(CpuChunkEncoder(opts))
+
+
+def test_native_bytes_min_max(lib):
+    from kpw_tpu.core.bytecol import ByteColumn
+
+    rng = np.random.default_rng(11)
+    values = [f"{v:08x}".encode() for v in rng.integers(0, 1 << 30, 3000)]
+    values += [b"", b"\xff" * 40]
+    col = ByteColumn.from_list(values)
+    mn, mx = lib.bytes_min_max(col.data, col.offsets)
+    assert col[mn] == min(values)
+    assert col[mx] == max(values)

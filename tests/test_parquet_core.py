@@ -390,3 +390,33 @@ def test_string_dictionary_length_skew_fallback():
     vals = [b"short"] * 10000 + [b"x" * 1_000_000]
     d, idx = enc.dictionary_build(vals, 6)
     assert [d[i] for i in idx] == vals
+
+
+def test_byte_column_list_compat():
+    from kpw_tpu.core.bytecol import ByteColumn
+
+    values = [b"alpha", b"", b"b" * 100, b"gamma"]
+    col = ByteColumn.from_list(values)
+    assert len(col) == 4
+    assert list(col) == values
+    assert col[2] == values[2]
+    window = col[1:3]
+    assert list(window) == values[1:3]
+    assert window.payload_bytes() == 100
+    assert window.take([1, 0]) == [values[2], values[1]]
+    np.testing.assert_array_equal(col.lens(), [5, 0, 100, 5])
+
+
+def test_byte_column_end_to_end_statistics():
+    """String stats (min/max) must survive the packed representation."""
+    import pyarrow.parquet as pq
+
+    schema = Schema([leaf("s", "string")])
+    vals = [b"m", b"a", b"z", b"q"]
+    buf = io.BytesIO()
+    w = ParquetFileWriter(buf, schema, WriterProperties())
+    w.write_batch(columns_from_arrays(schema, {"s": vals}))
+    w.close()
+    buf.seek(0)
+    col = pq.read_metadata(buf).row_group(0).column(0)
+    assert col.statistics.min == "a" and col.statistics.max == "z"
