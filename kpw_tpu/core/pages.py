@@ -113,6 +113,10 @@ class EncoderOptions:
     # for int columns and DELTA_LENGTH_BYTE_ARRAY for byte arrays
     # (BASELINE.md config 3: high-cardinality/string-heavy workloads).
     delta_fallback: bool = False
+    # Column-parallel encode threads in the native backend (0 = one per
+    # core).  The BASELINE target is per *host*, and the native primitives
+    # release the GIL, so columns encode in parallel; 1 disables.
+    encoder_threads: int = 0
 
 
 class CpuChunkEncoder:

@@ -35,6 +35,7 @@ class WriterProperties:
     enable_dictionary: bool = True
     write_statistics: bool = True
     delta_fallback: bool = False
+    encoder_threads: int = 0
     key_value_metadata: dict = field(default_factory=dict)
 
     def encoder_options(self) -> EncoderOptions:
@@ -44,6 +45,7 @@ class WriterProperties:
             data_page_size=self.data_page_size,
             write_statistics=self.write_statistics,
             delta_fallback=self.delta_fallback,
+            encoder_threads=self.encoder_threads,
         )
 
 

@@ -14,7 +14,9 @@ slow to pay for offload (kpw_tpu/runtime/writer.py).
 
 from __future__ import annotations
 
+import os
 import struct
+import threading
 
 import numpy as np
 
@@ -24,6 +26,25 @@ from ..core.pages import CpuChunkEncoder, EncoderOptions
 from ..core.schema import PhysicalType
 from . import lib
 
+_POOL = None
+_POOL_LOCK = threading.Lock()
+
+
+def _shared_pool():
+    """One process-wide encode pool: encoders are constructed per rotated
+    file by the streaming writer, so a per-encoder pool would leak threads
+    on every rotation.  Sized to the core count; callers gate on their own
+    encoder_threads before using it."""
+    global _POOL
+    with _POOL_LOCK:
+        if _POOL is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            _POOL = ThreadPoolExecutor(
+                max_workers=max(2, os.cpu_count() or 1),
+                thread_name_prefix="kpw-encode")
+        return _POOL
+
 
 class NativeChunkEncoder(CpuChunkEncoder):
     """Byte-identical C++ implementation of the chunk encoder primitives."""
@@ -31,6 +52,28 @@ class NativeChunkEncoder(CpuChunkEncoder):
     def __init__(self, options: EncoderOptions) -> None:
         super().__init__(options)
         self._lib = lib()
+
+    def encode_many(self, chunks, base_offset: int):
+        """Column-parallel encode: the hot primitives (dictionary build,
+        RLE/bit-pack, delta, codecs) are GIL-releasing native calls, so
+        columns encode concurrently — the intra-file counterpart of the
+        reference's thread-per-file data parallelism
+        (KafkaProtoParquetWriter.java:40-41).  Each chunk encodes at offset
+        0 (page bytes never embed offsets), then footer offsets shift by
+        the running base — byte-identical to the sequential path."""
+        workers = self.options.encoder_threads or (os.cpu_count() or 1)
+        workers = min(workers, len(chunks))
+        if self._lib is None or workers <= 1:
+            return super().encode_many(chunks, base_offset)
+        encoded = list(_shared_pool().map(lambda c: self.encode(c, 0), chunks))
+        offset = base_offset
+        for e in encoded:
+            m = e.meta
+            if m.dictionary_page_offset is not None:
+                m.dictionary_page_offset += offset
+            m.data_page_offset += offset
+            offset += len(e.blob)
+        return encoded
 
     def _native_ok(self, values, pt: int) -> bool:
         return (

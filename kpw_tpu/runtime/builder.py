@@ -44,6 +44,7 @@ class Builder:
         self._codec = 0  # UNCOMPRESSED (:484)
         self._enable_dictionary = True  # (:489)
         self._delta_fallback = False  # BASELINE config 3 opt-in
+        self._encoder_threads = 0  # native column-parallel encode (0 = auto)
         self._file_date_time_pattern = "%Y%m%d-%H%M%S%f"  # (:486-487 analog)
         self._directory_date_time_pattern: str | None = None
         self._file_extension = ".parquet"  # (:488)
@@ -142,6 +143,15 @@ class Builder:
         self._delta_fallback = flag
         return self
 
+    def encoder_threads(self, n: int) -> "Builder":
+        """Column-parallel encode threads in the native backend per worker
+        (0 = one per core, 1 = sequential).  Orthogonal to thread_count,
+        which parallelizes across files like the reference."""
+        if n < 0:
+            raise ValueError("encoder_threads must be >= 0")
+        self._encoder_threads = n
+        return self
+
     # -- naming / placement ------------------------------------------------
     def file_date_time_pattern(self, strftime_pattern: str) -> "Builder":
         self._file_date_time_pattern = strftime_pattern
@@ -230,4 +240,5 @@ class Builder:
             codec=self._codec,
             enable_dictionary=self._enable_dictionary,
             delta_fallback=self._delta_fallback,
+            encoder_threads=self._encoder_threads,
         )

@@ -408,3 +408,43 @@ def test_native_bytes_min_max(lib):
     mn, mx = lib.bytes_min_max(col.data, col.offsets)
     assert col[mn] == min(values)
     assert col[mx] == max(values)
+
+
+def test_native_encoder_threaded_identity():
+    """encoder_threads > 1 must produce byte-identical files (offsets are
+    shifted after parallel encode), across multiple row groups."""
+    import io
+
+    from kpw_tpu.core import ParquetFileWriter, Schema, WriterProperties
+    from kpw_tpu.core import columns_from_arrays, leaf
+    from kpw_tpu.native.encoder import NativeChunkEncoder
+
+    rng = np.random.default_rng(12)
+    rows = 9000
+    arrays = {
+        "a": rng.integers(0, 50, rows).astype(np.int64),
+        "b": rng.integers(0, 1 << 45, rows).astype(np.int64),
+        "s": [f"v{k}".encode() for k in rng.integers(0, 80, rows)],
+        "d": (rng.integers(0, 900, rows) / 7.0),
+    }
+    schema = Schema([leaf("a", "int64"), leaf("b", "int64"),
+                     leaf("s", "string"), leaf("d", "double")])
+
+    def run(threads):
+        props = WriterProperties(encoder_threads=threads,
+                                 row_group_size=120_000)
+        buf = io.BytesIO()
+        w = ParquetFileWriter(buf, schema, props,
+                              encoder=NativeChunkEncoder(props.encoder_options()))
+        for _ in range(3):  # several batches -> multiple row groups
+            w.write_batch(columns_from_arrays(schema, arrays))
+        w.close()
+        return buf.getvalue()
+
+    seq = run(1)
+    par = run(4)
+    assert seq == par
+    import pyarrow.parquet as pq
+
+    md = pq.read_metadata(io.BytesIO(par))
+    assert md.num_rows == rows * 3 and md.num_row_groups >= 2
