@@ -1,0 +1,201 @@
+"""Real-Kafka broker client behind the same surface as the in-process
+``FakeBroker`` (SURVEY.md §7 step 3: "Real-broker client optional behind the
+same interface").
+
+``SmartCommitConsumer`` consumes the broker through seven methods —
+``join_group / leave_group / generation / assignment / committed / fetch /
+commit`` — so pointing the writer at a real cluster is just
+
+    broker = KafkaBrokerClient(bootstrap_servers="host:9092")
+    Builder().broker(broker)...
+
+The adapter maps that surface onto ``kafka-python`` (the same wire client
+family the reference uses from the JVM, KafkaProtoParquetWriter.java:30-32):
+
+- group membership and rebalancing ride Kafka's own consumer-group protocol
+  via one subscribed ``KafkaConsumer`` per (group, member), with auto-commit
+  forced off exactly like the reference forcing ``enable.auto.commit=false``
+  (KPW.java:156);
+- ``KafkaConsumer`` is not thread-safe, so every touch of a member's
+  consumer happens under that member's lock — the writer's fetcher thread
+  (fetch) and worker threads (commit on ack) serialize here;
+- ``fetch``/``commit`` route to the member that *owns* the partition (the
+  assignment can be split across several members of the same client);
+- the group join needs poll() calls to make progress, so ``generation()`` —
+  which the smart consumer's fetch loop calls every iteration — drives a
+  short poll on any member that still has no assignment.
+
+``kafka-python`` is an optional dependency — constructing the client without
+it raises ImportError with install guidance; nothing here is imported at
+package import time.  Not covered by in-repo tests (no broker in the test
+image); the FakeBroker-backed integration suite drives the identical
+consumer surface (tests/test_ingest.py, test_writer_integration.py).
+"""
+
+from __future__ import annotations
+
+import threading
+
+from .broker import Record
+
+
+class _Member:
+    __slots__ = ("consumer", "lock", "generation")
+
+    def __init__(self, consumer) -> None:
+        self.consumer = consumer
+        self.lock = threading.Lock()
+        self.generation = 0
+
+
+class KafkaBrokerClient:
+    """FakeBroker-compatible consumer surface over a real Kafka cluster."""
+
+    def __init__(self, bootstrap_servers: str | list[str],
+                 client_config: dict | None = None,
+                 poll_timeout_ms: int = 100) -> None:
+        try:
+            import kafka  # noqa: F401
+        except ImportError as e:  # pragma: no cover - exercised without dep
+            raise ImportError(
+                "KafkaBrokerClient needs the 'kafka-python' package "
+                "(pip install kafka-python); for broker-less operation use "
+                "kpw_tpu.ingest.FakeBroker") from e
+        self._bootstrap = bootstrap_servers
+        self._config = dict(client_config or {})
+        self._poll_timeout_ms = poll_timeout_ms
+        self._reg_lock = threading.Lock()  # guards the member registry only
+        self._members: dict[tuple[str, str], _Member] = {}
+
+    # -- group membership --------------------------------------------------
+    def join_group(self, group: str, topic: str, member_id: str) -> None:
+        from kafka import ConsumerRebalanceListener, KafkaConsumer
+
+        key = (group, member_id)
+        with self._reg_lock:
+            if key in self._members:
+                return
+            cfg = dict(self._config)
+            # Smart-commit invariant: the broker-side offset only moves via
+            # our explicit commit() after durable publish (KPW.java:156).
+            cfg.update(enable_auto_commit=False, group_id=group,
+                       auto_offset_reset="earliest",
+                       key_deserializer=None, value_deserializer=None)
+            consumer = KafkaConsumer(bootstrap_servers=self._bootstrap, **cfg)
+            member = _Member(consumer)
+
+            class _Listener(ConsumerRebalanceListener):
+                def on_partitions_revoked(self, revoked):
+                    pass
+
+                def on_partitions_assigned(self, assigned):
+                    member.generation += 1  # fires inside member's poll()
+
+            consumer.subscribe([topic], listener=_Listener())
+            self._members[key] = member
+
+    def leave_group(self, group: str, topic: str, member_id: str) -> None:
+        with self._reg_lock:
+            member = self._members.pop((group, member_id), None)
+        if member is not None:
+            with member.lock:
+                member.consumer.close()
+
+    def _group_members(self, group: str) -> list[_Member]:
+        with self._reg_lock:
+            return [m for (g, _), m in self._members.items() if g == group]
+
+    def generation(self, group: str, topic: str) -> int:
+        """Sum of rebalance counts — changes whenever any member's
+        assignment changes.  Also pumps the group protocol: a member that
+        has no assignment yet only completes its join inside poll(), and the
+        smart consumer calls generation() every fetch-loop iteration."""
+        total = 0
+        for member in self._group_members(group):
+            with member.lock:
+                if not member.consumer.assignment():
+                    member.consumer.poll(timeout_ms=self._poll_timeout_ms,
+                                         max_records=1, update_offsets=False)
+                total += member.generation
+        return total
+
+    def assignment(self, group: str, topic: str, member_id: str) -> list[int]:
+        with self._reg_lock:
+            member = self._members.get((group, member_id))
+        if member is None:
+            return []
+        with member.lock:
+            return sorted(tp.partition for tp in member.consumer.assignment()
+                          if tp.topic == topic)
+
+    def _owner(self, group: str, topic: str, partition: int) -> _Member | None:
+        from kafka import TopicPartition
+
+        tp = TopicPartition(topic, partition)
+        for member in self._group_members(group):
+            with member.lock:
+                if tp in member.consumer.assignment():
+                    return member
+        return None
+
+    # -- offsets -----------------------------------------------------------
+    def committed(self, group: str, topic: str, partition: int) -> int:
+        from kafka import TopicPartition
+        from kafka.structs import OffsetAndMetadata
+
+        members = self._group_members(group)
+        if not members:
+            return 0
+        member = self._owner(group, topic, partition) or members[0]
+        with member.lock:
+            got = member.consumer.committed(TopicPartition(topic, partition))
+        if isinstance(got, OffsetAndMetadata):
+            got = got.offset
+        return int(got or 0)
+
+    def commit(self, group: str, topic: str, partition: int, offset: int) -> None:
+        from kafka import TopicPartition
+        from kafka.structs import OffsetAndMetadata
+
+        member = self._owner(group, topic, partition)
+        if member is None:
+            members = self._group_members(group)
+            if not members:
+                raise RuntimeError(f"no consumer joined for group {group}")
+            member = members[0]
+        with member.lock:
+            member.consumer.commit({TopicPartition(topic, partition):
+                                    OffsetAndMetadata(offset, None, -1)})
+
+    # -- records -----------------------------------------------------------
+    def fetch(self, topic: str, partition: int, offset: int,
+              max_records: int) -> list[Record]:
+        from kafka import TopicPartition
+
+        # group is not part of the FakeBroker fetch signature; all members
+        # of this client share the data path, so route by ownership across
+        # every registered member.
+        with self._reg_lock:
+            members = list(self._members.values())
+        tp = TopicPartition(topic, partition)
+        for member in members:
+            with member.lock:
+                consumer = member.consumer
+                if tp not in consumer.assignment():
+                    continue
+                if consumer.position(tp) != offset:
+                    consumer.seek(tp, offset)
+                others = [p for p in consumer.assignment() if p != tp]
+                if others:
+                    consumer.pause(*others)
+                try:
+                    batch = consumer.poll(timeout_ms=self._poll_timeout_ms,
+                                          max_records=max_records)
+                finally:
+                    if others:
+                        consumer.resume(*others)
+                return [Record(topic=topic, partition=partition,
+                               offset=r.offset, key=r.key, value=r.value,
+                               timestamp=r.timestamp / 1000.0)
+                        for r in batch.get(tp, [])]
+        return []

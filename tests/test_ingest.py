@@ -159,3 +159,32 @@ def test_consumer_backpressure_bounds_delivery():
         assert delivered <= 30
     finally:
         c.close()
+
+
+def test_kafka_client_gated_import():
+    """The real-broker adapter imports without kafka-python but refuses to
+    construct, pointing at the FakeBroker alternative."""
+    from kpw_tpu.ingest import KafkaBrokerClient
+
+    try:
+        import kafka  # noqa: F401
+    except ImportError:
+        with pytest.raises(ImportError, match="kafka-python"):
+            KafkaBrokerClient("localhost:9092")
+    else:  # pragma: no cover - image has no kafka-python
+        pass
+
+
+def test_kafka_client_surface_matches_fake_broker():
+    """The adapter must expose the exact consumer-facing surface of
+    FakeBroker that SmartCommitConsumer uses."""
+    import inspect
+
+    from kpw_tpu.ingest import FakeBroker
+    from kpw_tpu.ingest.kafka_client import KafkaBrokerClient
+
+    for name in ("join_group", "leave_group", "generation", "assignment",
+                 "committed", "commit", "fetch"):
+        fake = inspect.signature(getattr(FakeBroker, name))
+        real = inspect.signature(getattr(KafkaBrokerClient, name))
+        assert list(fake.parameters) == list(real.parameters), name
