@@ -3,3 +3,4 @@ analog), with the atomic tmp→rename publish the correctness protocol needs
 (reference renameAndMoveTempFile, KafkaProtoParquetWriter.java:359-378)."""
 
 from .fs import FileSystem, LocalFileSystem, MemoryFileSystem  # noqa: F401
+from .hdfs import HdfsFileSystem  # noqa: F401  (needs libhdfs at construction)

@@ -188,3 +188,19 @@ def test_kafka_client_surface_matches_fake_broker():
         fake = inspect.signature(getattr(FakeBroker, name))
         real = inspect.signature(getattr(KafkaBrokerClient, name))
         assert list(fake.parameters) == list(real.parameters), name
+
+
+def test_hdfs_adapter_surface():
+    """HdfsFileSystem implements the full FileSystem surface and gates its
+    connection errors with actionable guidance (no cluster in the image)."""
+    import inspect
+
+    from kpw_tpu.io.fs import FileSystem
+    from kpw_tpu.io.hdfs import HdfsFileSystem
+
+    for name, member in inspect.getmembers(FileSystem, inspect.isfunction):
+        if name.startswith("_"):
+            continue
+        assert getattr(HdfsFileSystem, name) is not member, f"{name} not overridden"
+    with pytest.raises((RuntimeError, ImportError)):
+        HdfsFileSystem(host="localhost", port=1)
