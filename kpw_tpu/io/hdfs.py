@@ -36,6 +36,10 @@ class HdfsFileSystem(FileSystem):
         extra = dict(kwargs)
         if replication is not None:
             extra["replication"] = replication
+        from pyarrow.fs import FileSelector, FileType
+
+        self._FileType = FileType
+        self._FileSelector = FileSelector
         try:
             self._fs = HadoopFileSystem(host, port, user=user, **extra)
         except Exception as e:  # libhdfs/CLASSPATH missing
@@ -57,35 +61,32 @@ class HdfsFileSystem(FileSystem):
         self._fs.move(src, dst)  # HDFS NameNode rename: atomic
 
     def exists(self, path: str) -> bool:
-        from pyarrow.fs import FileType
-
-        return self._fs.get_file_info(path).type != FileType.NotFound
+        return self._fs.get_file_info(path).type != self._FileType.NotFound
 
     def delete(self, path: str) -> None:
+        # Parity with Local/Memory FS: delete() is a *file* operation —
+        # raise on a directory (never recursively wipe published output)
+        # and on a missing path.
         info = self._fs.get_file_info(path)
-        from pyarrow.fs import FileType
-
-        if info.type == FileType.Directory:
-            self._fs.delete_dir(path)
-        elif info.type != FileType.NotFound:
-            self._fs.delete_file(path)
+        if info.type == self._FileType.NotFound:
+            raise FileNotFoundError(path)
+        if info.type == self._FileType.Directory:
+            raise IsADirectoryError(path)
+        self._fs.delete_file(path)
 
     def size(self, path: str) -> int:
-        from pyarrow.fs import FileType
-
         info = self._fs.get_file_info(path)
-        if info.type == FileType.NotFound:  # match Local/Memory FS: raise,
-            raise FileNotFoundError(path)   # never report a lost file as 0 B
+        if info.type == self._FileType.NotFound:  # match Local/Memory FS:
+            raise FileNotFoundError(path)  # never report a lost file as 0 B
         return int(info.size or 0)
 
     def list_files(self, path: str, extension: str | None = None,
                    recursive: bool = True) -> list[str]:
-        from pyarrow.fs import FileSelector, FileType
-
-        sel = FileSelector(path, recursive=recursive, allow_not_found=True)
+        sel = self._FileSelector(path, recursive=recursive,
+                                 allow_not_found=True)
         out = []
         for info in self._fs.get_file_info(sel):
-            if info.type != FileType.File:
+            if info.type != self._FileType.File:
                 continue
             if extension is None or info.path.endswith(extension):
                 out.append(posixpath.join("/", info.path)
