@@ -68,3 +68,13 @@ class ByteColumn:
         o = self.offsets
         d = self.data
         return [d[o[p]: o[p + 1]] for p in positions]
+
+
+def lens_and_payload(values) -> tuple[np.ndarray, bytes]:
+    """(int64 lengths, concatenated bytes) for a ByteColumn or list[bytes] —
+    the one definition of this extraction (consumed by the native and device
+    DELTA_LENGTH_BYTE_ARRAY paths)."""
+    if isinstance(values, ByteColumn):
+        return values.lens().astype(np.int64), values.payload()
+    lens = np.fromiter(map(len, values), np.int64, count=len(values))
+    return lens, b"".join(values)

@@ -147,11 +147,10 @@ class NativeChunkEncoder(CpuChunkEncoder):
             bit_size = 32 if pt == PhysicalType.INT32 else 64
             return L.delta_binary_packed(np.asarray(values), bit_size)
         if L is not None and encoding == Encoding.DELTA_LENGTH_BYTE_ARRAY:
-            if isinstance(values, ByteColumn):
-                return (L.delta_binary_packed(values.lens(), 32)
-                        + values.payload())
-            lens = np.fromiter(map(len, values), np.int64, count=len(values))
-            return L.delta_binary_packed(lens, 32) + b"".join(values)
+            from ..core.bytecol import lens_and_payload
+
+            lens, payload = lens_and_payload(values)
+            return L.delta_binary_packed(lens, 32) + payload
         return super()._values_body(values, pt, encoding)
 
     def _stats_min_max(self, values, pt: int):

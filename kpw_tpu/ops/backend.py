@@ -440,6 +440,31 @@ class TpuChunkEncoder(NativeChunkEncoder):
         return slots
 
     # -- primitive overrides ----------------------------------------------
+    def _values_body(self, values, pt: int, encoding: int) -> bytes:
+        """Delta fallbacks ride the device kernels (SURVEY §7 step 5:
+        per-column delta & delta-length-byte-array) for large chunks; small
+        ones and everything else fall through to the native host path.
+
+        Dispatch note: unlike the dictionary path, delta pages encode as one
+        device round trip per page (the assemble loop calls this per page) —
+        acceptable where this backend is auto-selected (fast link), and the
+        obvious next step if delta-heavy workloads dominate is folding these
+        into the _prepare_all batch like the level planner."""
+        from ..core.schema import Encoding
+
+        if len(values) >= self.min_device_rows:
+            if (encoding == Encoding.DELTA_BINARY_PACKED
+                    and isinstance(values, np.ndarray)):
+                from .delta import delta_binary_packed_device
+
+                bit_size = 32 if pt == PhysicalType.INT32 else 64
+                return delta_binary_packed_device(values, bit_size)
+            if encoding == Encoding.DELTA_LENGTH_BYTE_ARRAY:
+                from .delta import delta_length_byte_array_device
+
+                return delta_length_byte_array_device(values)
+        return super()._values_body(values, pt, encoding)
+
     def _levels_page_blob(self, chunk, a: int, b: int) -> bytes:
         plans = getattr(self, "_level_plans", None)
         if plans:

@@ -1,0 +1,219 @@
+"""Device DELTA_BINARY_PACKED (SURVEY.md §7 step 5: "delta &
+delta-length-byte-array" as per-column device kernels; BASELINE.md config 3).
+
+The parquet delta format (core.encodings.delta_binary_packed_encode is the
+byte oracle): blocks of 128 deltas, 4 miniblocks of 32, per-block zigzag
+min-delta, per-miniblock bit widths, miniblocks packed LSB-first at their
+own width.  The data-parallel work — ring-arithmetic deltas, signed block
+minima, relative deltas, per-miniblock widths, and the bit-packing itself —
+runs on device with static shapes:
+
+- 64-bit ring arithmetic without device int64: values travel as (hi, lo)
+  uint32 pairs; subtract-with-borrow and signed comparison via a sign-bit
+  flip (the same key-splitting convention as ops.dictionary);
+- widths are data-dependent per miniblock, so each miniblock's pack runs
+  under a ``lax.switch`` over the 65 possible widths — every branch is a
+  statically-shaped LSB-first bit-pack writing into a fixed 256-byte slot
+  (worst case: 32 values x 64 bits);
+- the host assembles the stream in O(blocks): header varints, zigzag
+  min-deltas, width bytes, and memcpy slices of the packed buffer.
+
+Byte-identity with the numpy oracle is asserted by tests for int32 and
+int64 across sign/wraparound edge cases.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.thrift import varint_bytes, zigzag
+
+_BLOCK = 128
+_MINI = 4
+_MB = 32  # values per miniblock
+
+
+def _sub64(ahi, alo, bhi, blo):
+    """(a - b) mod 2^64 on (hi, lo) uint32 pairs."""
+    lo = alo - blo
+    borrow = (alo < blo).astype(jnp.uint32)
+    hi = ahi - bhi - borrow
+    return hi, lo
+
+
+def _signed_less(ahi, alo, bhi, blo):
+    """a < b as signed 64-bit: flip the sign bit of hi for unsigned order."""
+    f = jnp.uint32(0x8000_0000)
+    ah, bh = ahi ^ f, bhi ^ f
+    return (ah < bh) | ((ah == bh) & (alo < blo))
+
+
+def _bit_width64(hi, lo):
+    """bit_width of the unsigned 64-bit value (hi, lo): 0 for 0."""
+    def bw32(x):
+        # 32 - clz(x) via float trick is inexact; use comparison ladder
+        w = jnp.zeros(x.shape, jnp.int32)
+        for b in range(32):
+            w = jnp.where(x >= (jnp.uint32(1) << b), b + 1, w)
+        return w
+
+    return jnp.where(hi > 0, 32 + bw32(hi), bw32(lo))
+
+
+def _pack_mb_at_width(hi, lo, width: int) -> jnp.ndarray:
+    """LSB-first pack of 32 (hi, lo) values at static ``width`` into a
+    fixed (256,) uint8 slot (4*width bytes meaningful, rest zero)."""
+    if width == 0:
+        return jnp.zeros(_MB * 8, jnp.uint8)
+    # bits matrix (32, width): bit j of value i
+    j = jnp.arange(width, dtype=jnp.uint32)
+    lo_bits = (lo[:, None] >> jnp.minimum(j, 31)) & jnp.where(j < 32, 1, 0).astype(jnp.uint32)
+    hi_bits = jnp.where(j[None, :] >= 32,
+                        (hi[:, None] >> jnp.maximum(j - 32, 0).astype(jnp.uint32)) & 1,
+                        0).astype(jnp.uint32)
+    bits = jnp.where(j[None, :] < 32, lo_bits, hi_bits)  # (32, width)
+    flat = bits.reshape(-1)  # position p = i*width + j
+    nbytes = _MB * width // 8
+    byte_idx = jnp.arange(nbytes * 8, dtype=jnp.int32)
+    folded = (flat[byte_idx] << (byte_idx % 8).astype(jnp.uint32))
+    bytes_ = jnp.sum(folded.reshape(nbytes, 8), axis=1).astype(jnp.uint8)
+    return jnp.zeros(_MB * 8, jnp.uint8).at[:nbytes].set(bytes_)
+
+
+@functools.partial(jax.jit, static_argnums=(3,))
+def delta_blocks_device(vhi: jax.Array, vlo: jax.Array, n: jax.Array,
+                        bit_size: int):
+    """Device phase of DELTA_BINARY_PACKED for ``n`` values provided as
+    (hi, lo) uint32 pairs padded to 1 + blocks*128 entries (blocks from the
+    array shape — callers bucket the padding so jit keys stay bounded; ``n``
+    is traced).
+
+    ``bit_size`` selects the ring: 64 works on (hi, lo) pairs, 32 on the lo
+    plane alone (hi fixed at zero) — one kernel body for both.
+
+    Returns (min_hi, min_lo) per block (signed min-deltas), widths
+    (blocks, 4) int32, and packed (blocks, 4, 256) uint8 miniblock slots
+    (each meaningful up to 4*width bytes; padding blocks are width 0).
+    """
+    ring64 = bit_size == 64
+    blocks = (vhi.shape[0] - 1) // _BLOCK
+    nd = n - 1
+    if ring64:
+        dhi, dlo = _sub64(vhi[1:], vlo[1:], vhi[:-1], vlo[:-1])  # ring deltas
+    else:
+        dlo = vlo[1:] - vlo[:-1]  # uint32 ring
+        dhi = jnp.zeros_like(dlo)
+    total = blocks * _BLOCK
+    pos = jnp.arange(total, dtype=jnp.int32)
+    valid = pos < nd
+    dhi = dhi.reshape(blocks, _BLOCK)
+    dlo = dlo.reshape(blocks, _BLOCK)
+    vmask = valid.reshape(blocks, _BLOCK)
+
+    def signed_less(ahi, alo, bhi, blo):
+        if ring64:
+            return _signed_less(ahi, alo, bhi, blo)
+        f = jnp.uint32(0x8000_0000)
+        return (alo ^ f) < (blo ^ f)
+
+    def per_block(bhi, blo, bvalid):
+        # signed min over the valid deltas (pad slots excluded by masking
+        # to the first valid delta of the block — block always has >= 1)
+        def mincmp(carry, x):
+            chi, clo = carry
+            xhi, xlo, xv = x
+            take = xv & signed_less(xhi, xlo, chi, clo)
+            return (jnp.where(take, xhi, chi), jnp.where(take, xlo, clo)), None
+
+        (mhi, mlo), _ = jax.lax.scan(
+            mincmp, (bhi[0], blo[0]),
+            (bhi, blo, bvalid))
+        if ring64:
+            rhi, rlo = _sub64(bhi, blo, jnp.broadcast_to(mhi, bhi.shape),
+                              jnp.broadcast_to(mlo, blo.shape))
+        else:
+            rhi, rlo = jnp.zeros_like(bhi), blo - mlo
+        # pad (invalid) slots pack as zero, like the oracle's zero padding
+        rhi = jnp.where(bvalid, rhi, 0)
+        rlo = jnp.where(bvalid, rlo, 0)
+        rhi_m = rhi.reshape(_MINI, _MB)
+        rlo_m = rlo.reshape(_MINI, _MB)
+        mb_valid = bvalid.reshape(_MINI, _MB)
+
+        def per_mb(mhi_v, mlo_v, mv):
+            any_valid = jnp.any(mv)
+            w = jnp.max(jnp.where(mv, _bit_width64(mhi_v, mlo_v), 0))
+            w = jnp.where(any_valid, w, 0)
+            packed = jax.lax.switch(
+                w, [functools.partial(_pack_mb_at_width, width=int(ww))
+                    for ww in range(bit_size + 1)], mhi_v, mlo_v)
+            return w, packed
+
+        ws, packs = jax.vmap(per_mb)(rhi_m, rlo_m, mb_valid)
+        return mhi, mlo, ws, packs
+
+    return jax.vmap(per_block)(dhi, dlo, vmask)
+
+
+def _split64(values: np.ndarray):
+    a = np.ascontiguousarray(values)
+    if a.dtype.itemsize == 8:
+        u = a.view(np.uint64)
+        return (u >> np.uint64(32)).astype(np.uint32), u.astype(np.uint32)
+    u = a.view(np.uint32)
+    return np.zeros_like(u), u
+
+
+def delta_binary_packed_device(values: np.ndarray, bit_size: int = 64) -> bytes:
+    """Full DELTA_BINARY_PACKED via the device kernel + O(blocks) host
+    assembly.  Byte-identical to core.encodings.delta_binary_packed_encode."""
+    itype = np.int64 if bit_size == 64 else np.int32
+    v = np.ascontiguousarray(values, itype)
+    n = len(v)
+    out = bytearray()
+    out += varint_bytes(_BLOCK)
+    out += varint_bytes(_MINI)
+    out += varint_bytes(n)
+    if n == 0:
+        out += varint_bytes(0)
+        return bytes(out)
+    out += varint_bytes(zigzag(int(v[0])))
+    if n == 1:
+        return bytes(out)
+
+    nd = n - 1
+    blocks = (nd + _BLOCK - 1) // _BLOCK
+    # pad the block count to a power of two so jit specializes on a bounded
+    # set of shapes (invalid blocks mask to width-0 miniblocks)
+    pad_blocks = 1 << max(0, (blocks - 1).bit_length())
+    padded = np.zeros(1 + pad_blocks * _BLOCK, itype)
+    padded[:n] = v
+    hi, lo = _split64(padded)
+    mh, ml, widths, packed = jax.device_get(  # one bulk readback
+        delta_blocks_device(jnp.asarray(hi), jnp.asarray(lo), jnp.int32(n),
+                            bit_size))
+
+    for b in range(blocks):
+        md = int(ml[b]) if bit_size == 32 else (int(mh[b]) << 32) | int(ml[b])
+        if md >= 1 << (bit_size - 1):
+            md -= 1 << bit_size
+        out += varint_bytes(zigzag(md))
+        out += bytes(int(w) for w in widths[b])
+        for m in range(_MINI):
+            w = int(widths[b][m])
+            if w:
+                out += packed[b, m, : 4 * w].tobytes()
+    return bytes(out)
+
+
+def delta_length_byte_array_device(values) -> bytes:
+    """DELTA_LENGTH_BYTE_ARRAY with the length vector delta-packed on
+    device; the byte payload is a straight host concat."""
+    from ..core.bytecol import lens_and_payload
+
+    lens, payload = lens_and_payload(values)
+    return delta_binary_packed_device(lens, 32) + payload
