@@ -24,6 +24,12 @@ class FileSystem:
         """Create (overwrite) a file for binary writing."""
         raise NotImplementedError
 
+    def open_append(self, path: str):
+        """Open for binary appending (creating if missing) — existing
+        contents are never truncated, so a failed append can lose at most
+        the new tail (the dead-letter durability requirement)."""
+        raise NotImplementedError
+
     def open_read(self, path: str):
         raise NotImplementedError
 
@@ -51,6 +57,9 @@ class LocalFileSystem(FileSystem):
 
     def open_write(self, path: str):
         return open(path, "wb")
+
+    def open_append(self, path: str):
+        return open(path, "ab")
 
     def open_read(self, path: str):
         return open(path, "rb")
@@ -85,12 +94,19 @@ class LocalFileSystem(FileSystem):
 
 
 class _MemFile(io.BytesIO):
-    """BytesIO that publishes its contents to the store on close."""
+    """BytesIO that publishes its contents to the store on close.  In
+    append mode the buffer is seeded with the existing contents and the
+    whole value republishes atomically under the store lock."""
 
-    def __init__(self, fs: "MemoryFileSystem", path: str) -> None:
+    def __init__(self, fs: "MemoryFileSystem", path: str,
+                 append: bool = False) -> None:
         super().__init__()
         self._fs = fs
         self._path = path
+        if append:
+            existing = fs._store_get(path)
+            if existing:
+                self.write(existing)
 
     def close(self) -> None:
         self._fs._store_put(self._path, self.getvalue())
@@ -114,6 +130,10 @@ class MemoryFileSystem(FileSystem):
         with self._lock:
             self._files[self._norm(path)] = data
 
+    def _store_get(self, path: str) -> bytes:
+        with self._lock:
+            return self._files.get(self._norm(path), b"")
+
     def mkdirs(self, path: str) -> None:
         with self._lock:
             p = self._norm(path)
@@ -123,6 +143,9 @@ class MemoryFileSystem(FileSystem):
 
     def open_write(self, path: str):
         return _MemFile(self, path)
+
+    def open_append(self, path: str):
+        return _MemFile(self, path, append=True)
 
     def open_read(self, path: str):
         with self._lock:

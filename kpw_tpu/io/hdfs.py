@@ -54,6 +54,9 @@ class HdfsFileSystem(FileSystem):
     def open_write(self, path: str):
         return self._fs.open_output_stream(path)
 
+    def open_append(self, path: str):
+        return self._fs.open_append_stream(path)
+
     def open_read(self, path: str):
         return self._fs.open_input_stream(path)
 

@@ -185,9 +185,12 @@ class Builder:
 
     def on_parse_error(self, policy: str) -> "Builder":
         """'raise' (reference parity: poison pill kills the worker,
-        KPW.java:271-275) or 'skip' (log + ack)."""
-        if policy not in ("raise", "skip"):
-            raise ValueError("on_parse_error must be 'raise' or 'skip'")
+        KPW.java:271-275), 'skip' (log + ack), or 'dead_letter' (raw payload
+        appended to targetDir/deadletter/{instance}_{worker}.bin, then
+        ack)."""
+        if policy not in ("raise", "skip", "dead_letter"):
+            raise ValueError(
+                "on_parse_error must be 'raise', 'skip' or 'dead_letter'")
         self._on_parse_error = policy
         return self
 

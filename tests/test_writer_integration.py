@@ -366,3 +366,37 @@ def test_two_instances_scale_out():
     # both instances actually produced output (partitions were split)
     names = {p.rsplit("/", 1)[-1] for p in files}
     assert any("alpha" in n for n in names) and any("beta" in n for n in names)
+
+
+def test_dead_letter_policy():
+    """'dead_letter': the raw payload lands in a deadletter file before the
+    offset is acked; the stream continues."""
+    import struct
+
+    broker = FakeBroker()
+    broker.create_topic(TOPIC, 1)
+    fs = MemoryFileSystem()
+    cls = sample_message_class()
+    produce_samples(broker, cls, 8)
+    poison = b"\xff\xfe poison \x01"
+    broker.produce(TOPIC, poison)
+    produce_samples(broker, cls, 8, start=8)
+    w = make_writer_builder(
+        broker, fs, cls,
+        on_parse_error="dead_letter",
+        max_file_open_duration_seconds=0.5,
+    ).build()
+    with w:
+        deadline = time.time() + 8
+        total = 0
+        while total < 16 and time.time() < deadline:
+            files = fs.list_files("/out", extension=".parquet")
+            total = sum(pq.read_metadata(fs.open_read(f)).num_rows for f in files)
+            time.sleep(0.05)
+        assert total == 16
+    dl = fs.list_files("/out/deadletter", extension=".bin")
+    assert len(dl) == 1
+    with fs.open_read(dl[0]) as f:
+        blob = f.read()
+    part, off, ln = struct.unpack("<iqI", blob[:16])
+    assert blob[16:16 + ln] == poison and ln == len(poison)
