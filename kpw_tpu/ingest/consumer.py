@@ -12,9 +12,9 @@ bounded queue (``maxQueuedRecordsInConsumer``, KPW.java:468).
 
 from __future__ import annotations
 
-import queue
 import threading
 import uuid
+from collections import deque
 
 from .broker import FakeBroker, Record
 from .offsets import PagedOffsetTracker, PartitionOffset
@@ -35,7 +35,15 @@ class SmartCommitConsumer:
         self.group_id = group_id
         self.member_id = member_id or f"member-{uuid.uuid4().hex[:8]}"
         self.tracker = PagedOffsetTracker(page_size, max_open_pages_per_partition)
-        self._queue: queue.Queue[Record] = queue.Queue(maxsize=max_queued_records)
+        # Batch-native bounded buffer: a deque of record *batches* under one
+        # condition, so the fetcher pays one lock round per fetch and
+        # workers one per poll_many — the per-record queue.Queue handoff was
+        # the throughput ceiling (~2 us/record each side).  The bound is on
+        # record count; one in-flight fetch batch may overshoot it.
+        self._buf: "deque[list[Record]]" = deque()
+        self._buf_count = 0
+        self._buf_max = max_queued_records
+        self._buf_cond = threading.Condition()
         self._fetch_max = fetch_max_records
         self._topic: str | None = None
         self._thread: threading.Thread | None = None
@@ -74,13 +82,50 @@ class SmartCommitConsumer:
     # -- worker API --------------------------------------------------------
     def poll(self, timeout: float | None = None) -> Record | None:
         """Non-blocking by default (reference workers sleep 1 ms on null,
-        KPW.java:260-263)."""
-        try:
-            if timeout is None:
-                return self._queue.get_nowait()
-            return self._queue.get(timeout=timeout)
-        except queue.Empty:
-            return None
+        KPW.java:260-263).  With a timeout, waits under the buffer condition
+        (wait_for: no check-then-wait race, no spurious early None)."""
+        with self._buf_cond:
+            if timeout is not None and not self._buf:
+                self._buf_cond.wait_for(lambda: bool(self._buf), timeout)
+            got = self._drain_locked(1)
+        return got[0] if got else None
+
+    def poll_many(self, max_records: int) -> list[Record]:
+        """Drain up to ``max_records`` without blocking — one lock round for
+        the whole batch (the batch counterpart of :meth:`poll`)."""
+        with self._buf_cond:
+            return self._drain_locked(max_records)
+
+    def _drain_locked(self, max_records: int) -> list[Record]:
+        out: list[Record] = []
+        while self._buf and len(out) < max_records:
+            head = self._buf[0]
+            take = max_records - len(out)
+            if take >= len(head):
+                out.extend(head)
+                self._buf.popleft()
+                self._buf_count -= len(head)
+            else:
+                out.extend(head[:take])
+                self._buf[0] = head[take:]
+                self._buf_count -= take
+        if out:
+            self._buf_cond.notify_all()
+        return out
+
+    def _put_batch(self, records: list[Record]) -> bool:
+        """Fetcher side: enqueue one tracked batch, blocking while the
+        record-count bound is reached.  Returns False when shut down before
+        space opened (caller must not advance its fetch position)."""
+        with self._buf_cond:
+            while self._buf_count >= self._buf_max:
+                if not self._running:
+                    return False
+                self._buf_cond.wait(0.05)
+            self._buf.append(records)
+            self._buf_count += len(records)
+            self._buf_cond.notify_all()
+        return True
 
     def ack(self, po: PartitionOffset) -> None:
         new_commit = self.tracker.ack(po)
@@ -127,19 +172,17 @@ class SmartCommitConsumer:
                     continue  # open-page backpressure (KPW.java:596-611)
                 pos = self._positions.get(p, 0)
                 records = self.broker.fetch(self._topic, p, pos, self._fetch_max)
+                accepted = []
                 for rec in records:
                     if self.tracker.is_backpressured(p):
                         break  # re-check mid-batch: one fetch must not blow the bound
                     self.tracker.track(p, rec.offset)
-                    while self._running:
-                        try:
-                            self._queue.put(rec, timeout=0.05)
-                            break
-                        except queue.Full:
-                            continue
-                    if not self._running:
-                        break
-                    self._positions[p] = rec.offset + 1
-                    fetched += 1
+                    accepted.append(rec)
+                if not accepted:
+                    continue
+                if not self._put_batch(accepted):
+                    break  # shutting down: position not advanced, redelivered
+                self._positions[p] = accepted[-1].offset + 1
+                fetched += len(accepted)
             if fetched == 0:
                 time.sleep(0.001)

@@ -122,7 +122,63 @@ class ProtoColumnarizer:
         }
 
     # -- shredding ---------------------------------------------------------
+    def _flat_plan(self):
+        """Per-column (field descriptor, optional?, converter) when the
+        message is flat (top-level scalar leaves only) — the common case
+        (reference test schema, BASELINE flat configs), worth a tight loop
+        instead of the generic Dremel visitor (~2.5x shredding throughput)."""
+        desc = self.msg_class.DESCRIPTOR
+        if any(_is_repeated(fd) or fd.type == FD.TYPE_MESSAGE
+               for fd in desc.fields):
+            return None
+        plan = []
+        for col in self.schema.columns:
+            fd = desc.fields_by_name[col.path[0]]
+            if fd.type == FD.TYPE_STRING:
+                conv = lambda v: v.encode("utf-8")
+            elif fd.type == FD.TYPE_ENUM:
+                values_by_number = fd.enum_type.values_by_number
+
+                def conv(v, _vb=values_by_number):
+                    ev = _vb.get(v)
+                    return (ev.name if ev is not None
+                            else f"UNKNOWN_ENUM_{v}").encode("ascii")
+            elif fd.type in (FD.TYPE_UINT64, FD.TYPE_FIXED64):
+                conv = lambda v: v - (1 << 64) if v >= 1 << 63 else v
+            else:
+                conv = None
+            plan.append((fd, _repetition_for(fd) == Repetition.OPTIONAL, conv))
+        return plan
+
+    def _columnarize_flat(self, records, plan) -> ColumnBatch:
+        n = len(records)
+        chunks = []
+        for col, (fd, optional, conv) in zip(self.schema.columns, plan):
+            name = fd.name
+            if optional:
+                defs = np.empty(n, np.int32)
+                values = []
+                for i, m in enumerate(records):
+                    if m.HasField(name):
+                        defs[i] = 1
+                        values.append(getattr(m, name))
+                    else:
+                        defs[i] = 0
+            else:
+                defs = None
+                values = [getattr(m, name) for m in records]
+            if conv is not None:
+                values = [conv(v) for v in values]
+            chunks.append(ColumnChunkData(
+                col, self._finalize_values(col, values), defs, None, n))
+        return ColumnBatch(chunks, n)
+
     def columnarize(self, records) -> ColumnBatch:
+        plan = getattr(self, "_flat", False)
+        if plan is False:
+            plan = self._flat = self._flat_plan()
+        if plan is not None:
+            return self._columnarize_flat(records, plan)
         cols = self.schema.columns
         buffers = [_LeafBuffer() for _ in cols]
         # map descriptor walk to leaf indices via path

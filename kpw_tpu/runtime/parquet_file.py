@@ -57,6 +57,11 @@ class ParquetFile:
         self._batch.append(record)
         self._num_records += 1
 
+    def append_records(self, records: list) -> None:
+        """Bulk pure-memory append; cannot fail."""
+        self._batch.extend(records)
+        self._num_records += len(records)
+
     def flush_if_full(self) -> None:
         """Idempotent: encodes the pending batch when it crossed the
         threshold; safe to retry after transient IO failures (records are

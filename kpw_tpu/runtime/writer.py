@@ -156,51 +156,65 @@ class _Worker:
     def _run(self) -> None:
         b = self.p._b
         try:
+            # same overshoot cap as _open_file: one appended batch must stay
+            # well under max_file_size or size rotation loses its ~1% bound
+            est_record = 64
+            size_cap = max(64, int(b._max_file_size / 16 / est_record))
+            poll_batch = min(max(64, b._batch_size), size_cap)
             while not self._stop.is_set():
                 if (self.current_file is not None
                         and self._is_file_timed_out()):
                     self._finalize_current_file()
-                rec = self.p.consumer.poll()
-                if rec is None:
+                recs = self.p.consumer.poll_many(poll_batch)
+                if not recs:
                     time.sleep(0.001)
                     continue
-                try:
-                    msg = b._parser(rec.value)
-                except Exception:
-                    if b._on_parse_error == "dead_letter":
-                        logger.exception(
-                            "Dead-lettering unparseable record %s/%s",
-                            rec.partition, rec.offset)
-                        # durability first, like the main path: the raw
-                        # payload lands in the dead-letter file before ack
-                        try_until_succeeds(
-                            lambda: self._dead_letter(rec),
-                            stop_event=self._stop)
-                        self.p.consumer.ack(
-                            PartitionOffset(rec.partition, rec.offset))
-                        continue
-                    if b._on_parse_error == "skip":
-                        logger.exception("Skipping unparseable record %s/%s",
-                                         rec.partition, rec.offset)
-                        # a skipped record has no durability dependency: ack now
-                        self.p.consumer.ack(
-                            PartitionOffset(rec.partition, rec.offset))
-                        continue
-                    logger.exception(
-                        "Can not parse record; worker %d dies (reference "
-                        "poison-pill parity, KPW.java:271-275)", self.index)
-                    raise
+                parsed = []  # (record, message) — parsed in bulk so the
+                # per-record loop overhead amortizes (design capacity is
+                # 300k rec/s/instance, KPW.java:463)
+                nbytes = 0
+                for rec in recs:
+                    try:
+                        parsed.append((rec, b._parser(rec.value)))
+                        nbytes += len(rec.value)
+                    except Exception:
+                        if b._on_parse_error == "dead_letter":
+                            logger.exception(
+                                "Dead-lettering unparseable record %s/%s",
+                                rec.partition, rec.offset)
+                            # durability first, like the main path: the raw
+                            # payload lands in the dead-letter file before ack
+                            try_until_succeeds(
+                                lambda: self._dead_letter(rec),
+                                stop_event=self._stop)
+                            self.p.consumer.ack(
+                                PartitionOffset(rec.partition, rec.offset))
+                        elif b._on_parse_error == "skip":
+                            logger.exception(
+                                "Skipping unparseable record %s/%s",
+                                rec.partition, rec.offset)
+                            # no durability dependency: ack now
+                            self.p.consumer.ack(
+                                PartitionOffset(rec.partition, rec.offset))
+                        else:
+                            logger.exception(
+                                "Can not parse record; worker %d dies "
+                                "(reference poison-pill parity, "
+                                "KPW.java:271-275)", self.index)
+                            raise
+                if not parsed:
+                    continue
                 if self.current_file is None:
                     self._open_file()
                 # append is pure memory; only the (idempotent) flush retries
-                self.current_file.append_record(msg)
+                self.current_file.append_records([m for _, m in parsed])
                 try_until_succeeds(self.current_file.flush_if_full,
                                    stop_event=self._stop)
-                self._written_offsets.append(
-                    PartitionOffset(rec.partition, rec.offset))
-                self.p._written_records.mark()
-                self.p._written_bytes.mark(len(rec.value))
-                self._file_records += 1
+                self._written_offsets.extend(
+                    PartitionOffset(r.partition, r.offset) for r, _ in parsed)
+                self.p._written_records.mark(len(parsed))
+                self.p._written_bytes.mark(nbytes)
+                self._file_records += len(parsed)
                 if self._is_file_full():
                     self._finalize_current_file()
         except RetryInterrupted:
