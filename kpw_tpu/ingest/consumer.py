@@ -41,6 +41,7 @@ class SmartCommitConsumer:
         # the throughput ceiling (~2 us/record each side).  The bound is on
         # record count; one in-flight fetch batch may overshoot it.
         self._buf: "deque[list[Record]]" = deque()
+        self._head_pos = 0  # consumed prefix of _buf[0]
         self._buf_count = 0
         self._buf_max = max_queued_records
         self._buf_cond = threading.Condition()
@@ -100,14 +101,18 @@ class SmartCommitConsumer:
         out: list[Record] = []
         while self._buf and len(out) < max_records:
             head = self._buf[0]
+            avail = len(head) - self._head_pos
             take = max_records - len(out)
-            if take >= len(head):
-                out.extend(head)
+            if take >= avail:
+                out.extend(head[self._head_pos:] if self._head_pos else head)
                 self._buf.popleft()
-                self._buf_count -= len(head)
+                self._head_pos = 0
+                self._buf_count -= avail
             else:
-                out.extend(head[:take])
-                self._buf[0] = head[take:]
+                # partial drain: advance an index into the head batch (O(1)
+                # per-record consumption for poll() users; no reslicing)
+                out.extend(head[self._head_pos: self._head_pos + take])
+                self._head_pos += take
                 self._buf_count -= take
         if out:
             self._buf_cond.notify_all()

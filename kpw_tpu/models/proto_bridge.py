@@ -145,6 +145,8 @@ class ProtoColumnarizer:
                             else f"UNKNOWN_ENUM_{v}").encode("ascii")
             elif fd.type in (FD.TYPE_UINT64, FD.TYPE_FIXED64):
                 conv = lambda v: v - (1 << 64) if v >= 1 << 63 else v
+            elif fd.type in (FD.TYPE_UINT32, FD.TYPE_FIXED32):
+                conv = lambda v: v - (1 << 32) if v >= 1 << 31 else v
             else:
                 conv = None
             plan.append((fd, _repetition_for(fd) == Repetition.OPTIONAL, conv))
@@ -266,6 +268,8 @@ class ProtoColumnarizer:
                      else f"UNKNOWN_ENUM_{value}").encode("ascii")
         elif fd.type in (FD.TYPE_UINT64, FD.TYPE_FIXED64) and value >= 1 << 63:
             value = value - (1 << 64)  # store as wrapped int64 per UINT_64
+        elif fd.type in (FD.TYPE_UINT32, FD.TYPE_FIXED32) and value >= 1 << 31:
+            value = value - (1 << 32)  # store as wrapped int32 per UINT_32
         buf.values.append(value)
         buf.defs.append(d)
         buf.reps.append(r)

@@ -28,6 +28,15 @@ from .retry import RetryInterrupted, try_until_succeeds
 logger = logging.getLogger(__name__)
 
 
+def _rotation_batch_cap(max_file_size: int, est_record_bytes: int = 64) -> int:
+    """Rotation granularity: get_data_size() only moves per flushed batch,
+    so both the poll batch and the encode batch are capped at ~1/16 of the
+    size threshold (keeps the reference's ~1% overshoot bound at small
+    maxFileSize without giving up vectorized encode at the 1 GiB default).
+    One definition, used by the worker loop and the file opener."""
+    return max(64, int(max_file_size / 16 / est_record_bytes))
+
+
 class KafkaProtoParquetWriter:
     """Streaming writer: Kafka topic -> rotated parquet files.  Construct via
     ``kpw_tpu.Builder``; lifecycle = ``start()`` / ``close()`` (Closeable
@@ -156,11 +165,10 @@ class _Worker:
     def _run(self) -> None:
         b = self.p._b
         try:
-            # same overshoot cap as _open_file: one appended batch must stay
-            # well under max_file_size or size rotation loses its ~1% bound
-            est_record = 64
-            size_cap = max(64, int(b._max_file_size / 16 / est_record))
-            poll_batch = min(max(64, b._batch_size), size_cap)
+            # one appended batch must stay well under max_file_size or size
+            # rotation loses its ~1% bound (same cap as the flush batch)
+            poll_batch = min(max(64, b._batch_size),
+                             _rotation_batch_cap(b._max_file_size))
             while not self._stop.is_set():
                 if (self.current_file is not None
                         and self._is_file_timed_out()):
@@ -253,14 +261,8 @@ class _Worker:
                 f"{self.p._b._instance_name}_{self.index}_{rand}.tmp")
 
     def _open_file(self) -> None:
-        # Rotation granularity: get_data_size() only moves per flushed batch,
-        # so cap the batch so one batch is <= ~1/16 of the size threshold
-        # (keeps the reference's ~1% overshoot bound at small maxFileSize
-        # without giving up vectorized encode at the 1 GiB default).
-        batch = self.p._b._batch_size
-        est_record = 64
-        cap = max(64, int(self.p._b._max_file_size / 16 / est_record))
-        batch = min(batch, cap)
+        batch = min(self.p._b._batch_size,
+                    _rotation_batch_cap(self.p._b._max_file_size))
 
         def make() -> ParquetFile:
             self.p.fs.mkdirs(f"{self.p.target_dir}/tmp")
