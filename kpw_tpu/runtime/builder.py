@@ -54,6 +54,7 @@ class Builder:
         self._backend = "cpu"
         self._batch_size = 4096
         self._on_parse_error = "raise"  # parity: poison pill kills the worker
+        self._clean_abandoned_tmp = False  # opt-in tmp GC at start()
 
     # -- required ----------------------------------------------------------
     def broker(self, broker) -> "Builder":
@@ -181,6 +182,13 @@ class Builder:
 
     def batch_size(self, n: int) -> "Builder":
         self._batch_size = n
+        return self
+
+    def clean_abandoned_tmp(self, flag: bool) -> "Builder":
+        """Delete this instance's stale .tmp files at start() (crash
+        leftovers the reference never GCs, SURVEY.md §3.5).  Off by default:
+        only safe when at most one live writer uses this instance name."""
+        self._clean_abandoned_tmp = flag
         return self
 
     def on_parse_error(self, policy: str) -> "Builder":

@@ -94,11 +94,40 @@ class KafkaProtoParquetWriter:
             raise ValueError("already started")
         self._started = True
         logger.info("Starting tpu parquet writer '%s'", self._b._instance_name)
+        if self._b._clean_abandoned_tmp:
+            self._gc_abandoned_tmp()
         self.consumer.start()
         for i in range(self._b._thread_count):
             w = _Worker(self, i)
             self._workers.append(w)
             w.start()
+
+    def _gc_abandoned_tmp(self) -> None:
+        """Remove .tmp files left by a previous run of THIS instance name
+        (the reference never GCs these — SURVEY.md §3.5; opt-in because a
+        second live writer sharing the instance name would lose its open
+        file).  Scoped to the ``{instance}_`` prefix so other instances
+        writing to the same target directory are untouched."""
+        import re
+
+        tmp_dir = f"{self.target_dir}/tmp"
+        # strict tmp-name shape '{instance}_{worker}_{rand}.tmp' — a bare
+        # prefix test would also match instance names that extend ours
+        # (e.g. 'ingest' deleting live 'ingest_backup_0_*.tmp')
+        pat = re.compile(
+            re.escape(self._b._instance_name) + r"_\d+_\d+\.tmp$")
+        try:
+            stale = [p for p in self.fs.list_files(tmp_dir, extension=".tmp",
+                                                   recursive=False)
+                     if pat.fullmatch(p.rsplit("/", 1)[-1])]
+        except FileNotFoundError:
+            return
+        for p in stale:
+            try:
+                self.fs.delete(p)
+                logger.info("Removed abandoned tmp file %s", p)
+            except OSError:
+                logger.warning("Could not remove abandoned tmp file %s", p)
 
     def close(self) -> None:
         if self._closed:

@@ -400,3 +400,38 @@ def test_dead_letter_policy():
         blob = f.read()
     part, off, ln = struct.unpack("<iqI", blob[:16])
     assert blob[16:16 + ln] == poison and ln == len(poison)
+
+
+def test_clean_abandoned_tmp():
+    """Crash leftovers: a first writer's abandoned .tmp is GC'd by a second
+    writer with clean_abandoned_tmp(True) and the same instance name; other
+    instances' tmp files survive."""
+    broker = FakeBroker()
+    broker.create_topic(TOPIC, 1)
+    fs = MemoryFileSystem()
+    cls = sample_message_class()
+    msgs = produce_samples(broker, cls, 40)
+    # plant crash leftovers: two stale tmps of instance 'test' (the name
+    # make_writer_builder uses) and one from an unrelated instance that the
+    # prefix-scoped GC must not touch
+    fs.mkdirs("/out/tmp")
+    stale = ["/out/tmp/test_0_111.tmp", "/out/tmp/test_1_222.tmp"]
+    # prefix-collision guard: an instance whose name extends ours must survive
+    for p in stale + ["/out/tmp/otherinst_0_123.tmp",
+                      "/out/tmp/test_backup_0_9.tmp"]:
+        with fs.open_write(p) as f:
+            f.write(b"leftover")
+
+    w2 = make_writer_builder(
+        broker, fs, cls, group_id="g",
+        clean_abandoned_tmp=True,
+        max_file_open_duration_seconds=0.5,
+    ).build()
+    with w2:
+        wait_for_files(fs, "/out", ".parquet", 1)
+        remaining = fs.list_files("/out/tmp", extension=".tmp", recursive=False)
+        assert "/out/tmp/otherinst_0_123.tmp" in remaining
+        assert "/out/tmp/test_backup_0_9.tmp" in remaining
+        assert not any(r in remaining for r in stale)
+        rows = read_messages(fs, fs.list_files("/out", extension=".parquet"))
+    assert rows_multiset(rows) == as_multiset(msgs)
