@@ -169,6 +169,13 @@ class CpuChunkEncoder:
         iterating packed byte columns in Python."""
         return _min_max_bytes(values, pt)
 
+    def _values_page_body(self, chunk: "ColumnChunkData", va: int, vb: int,
+                          pt: int, encoding: int) -> bytes:
+        """Non-dictionary value body for present-value range [va, vb) — the
+        per-page boundary a backend can override with pre-planned bodies
+        (the TPU delta planner)."""
+        return self._values_body(chunk.values[va:vb], pt, encoding)
+
     def _levels_page_blob(self, chunk: "ColumnChunkData", a: int, b: int) -> bytes:
         """rep + def level streams for slots [a, b) — the per-page boundary
         the TPU backend overrides with planned device-encoded bodies."""
@@ -321,7 +328,8 @@ class CpuChunkEncoder:
             if use_dict:
                 values_body = self._indices_body(indices, va, vb, len(dict_values))
             else:
-                values_body = self._values_body(chunk.values[va:vb], pt, value_encoding)
+                values_body = self._values_page_body(chunk, va, vb, pt,
+                                                     value_encoding)
             body = levels_blob + values_body
             comp = compress(body, opts.codec)
             header = write_page_header(

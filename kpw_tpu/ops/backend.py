@@ -35,6 +35,8 @@ from ..core.pages import ColumnChunkData, EncoderOptions
 from ..native.encoder import NativeChunkEncoder
 from ..core.schema import PhysicalType
 from ..core.thrift import varint_bytes
+from ..core.bytecol import ByteColumn
+from .delta import assemble_delta_page, delta_pages_multi
 from .dictionary import DictBuildHandle, build_dictionaries
 from .levels import level_runs_multi, level_stats_multi
 from .packing import (gather_index_slices, pack_page, pack_page_host,
@@ -226,6 +228,109 @@ class _LevelPlanner:
             self.plans.setdefault(id(chunk), (chunk, {}))[1][(a, b)] = blob
 
 
+class _DeltaPlanner:
+    """Batched device delta encoding for the row group's non-dictionary
+    pages (BASELINE config 3), folded into the planner's phase B: one
+    ``delta_pages_multi`` launch per (bucket, bit_size) group instead of
+    one dispatch per page.
+
+    Covers chunks whose encoding is statically known to be a delta
+    fallback (dictionary disabled or not viable): int32/int64 columns pack
+    their values; byte-array columns pack their *length* vector (the
+    DELTA_LENGTH payload is a host concat of the packed string window)."""
+
+    def __init__(self, encoder: "TpuChunkEncoder", chunks) -> None:
+        from ..core.schema import Encoding
+
+        self.plans: dict[int, tuple] = {}  # id(chunk) -> (chunk, {(va,vb): bytes})
+        self._jobs = []  # (row, chunk, bit_size, pages)
+        streams: list[np.ndarray] = []  # per-job int64/int32-ring lo streams
+        opts = encoder.options
+        if not opts.delta_fallback:
+            self.empty = True
+            return
+        for i, chunk in enumerate(chunks):
+            if encoder._dictionary_viable(chunk):
+                continue  # dictionary path (or rejected later: per-page route)
+            pt = chunk.column.leaf.physical_type
+            enc_kind = encoder._fallback_encoding(pt)
+            values = chunk.values
+            if len(values) < encoder.min_device_rows:
+                continue
+            if enc_kind == Encoding.DELTA_BINARY_PACKED and isinstance(
+                    values, np.ndarray):
+                bit_size = 32 if pt == PhysicalType.INT32 else 64
+                # normalize to the column's ring dtype exactly like the
+                # oracle (np.ascontiguousarray(values, itype)) — an int32
+                # array in an INT64 column must sign-extend into the hi
+                # plane, not leave it zero
+                stream = np.ascontiguousarray(
+                    values, np.int32 if bit_size == 32 else np.int64)
+            elif enc_kind == Encoding.DELTA_LENGTH_BYTE_ARRAY and isinstance(
+                    values, ByteColumn):
+                # lengths ride the 32-bit ring per the spec
+                stream = np.ascontiguousarray(values.lens(), np.int32)
+                bit_size = 32
+            else:
+                continue
+            pages = [(va, vb) for va, vb in encoder._page_value_ranges(chunk)
+                     if vb - va >= 2]
+            if not pages:
+                continue
+            row = len(streams)
+            streams.append(stream)
+            self._jobs.append((row, chunk, bit_size, pages))
+        self.empty = not self._jobs
+        self._groups = []
+        self._streams = streams
+        if self.empty:
+            return
+        maxn = max(len(s) for s in streams)
+        hi_all = np.zeros((len(streams), maxn), np.uint32)
+        lo_all = np.zeros((len(streams), maxn), np.uint32)
+        for r, s in enumerate(streams):
+            if s.dtype.itemsize == 8:
+                u = np.ascontiguousarray(s).view(np.uint64)
+                hi_all[r, : len(s)] = (u >> np.uint64(32)).astype(np.uint32)
+                lo_all[r, : len(s)] = u.astype(np.uint32)
+            else:
+                lo_all[r, : len(s)] = np.ascontiguousarray(s).view(np.uint32)
+        hi_d = jnp.asarray(hi_all)
+        lo_d = jnp.asarray(lo_all)
+        # group pages by (bucket, bit_size) and launch one program each
+        by_key: dict[tuple[int, int], list] = {}
+        for row, chunk, bit_size, pages in self._jobs:
+            for va, vb in pages:
+                by_key.setdefault((pad_bucket(vb - va), bit_size), []).append(
+                    (row, chunk, va, vb))
+        for (bucket, bit_size), items in by_key.items():
+            dev = delta_pages_multi(
+                hi_d, lo_d,
+                jnp.asarray(np.array([row for row, _, _, _ in items], np.int32)),
+                jnp.asarray(np.array([va for _, _, va, _ in items], np.int32)),
+                jnp.asarray(np.array([vb - va for _, _, va, vb in items],
+                                     np.int32)),
+                bucket, bit_size)
+            self._groups.append((items, bit_size, dev))
+
+    def device_outputs(self):
+        return [g[2] for g in self._groups]
+
+    def assemble(self, fetched) -> None:
+        from ..core.schema import Encoding
+
+        for (items, bit_size, _), host in zip(self._groups, fetched):
+            mh, ml, widths, packed = host
+            for r, (row, chunk, va, vb) in enumerate(items):
+                count = vb - va
+                first = int(self._streams[row][va])  # ring dtype already
+                body = assemble_delta_page(first, count, mh[r], ml[r],
+                                           widths[r], packed[r], bit_size)
+                if isinstance(chunk.values, ByteColumn):
+                    body += chunk.values[va:vb].payload()
+                self.plans.setdefault(id(chunk), (chunk, {}))[1][(va, vb)] = body
+
+
 class TpuChunkEncoder(NativeChunkEncoder):
     """Byte-identical TPU implementation of the chunk encoder.
 
@@ -264,6 +369,7 @@ class TpuChunkEncoder(NativeChunkEncoder):
             finally:
                 # keyed by id(chunk) — must not outlive the chunk objects
                 self._level_plans = {}
+                self._delta_plans = {}
                 self._ranges_cache = {}
         return out
 
@@ -314,12 +420,13 @@ class TpuChunkEncoder(NativeChunkEncoder):
         """
         slots: list = [None] * len(chunks)
         lvl = _LevelPlanner(self, chunks)  # phase A launched here
+        dlt = _DeltaPlanner(self, chunks)  # delta pages launched here
         eligible = [
             (i, chunk) for i, chunk in enumerate(chunks)
             if self._dictionary_viable(chunk)
             and self._device_eligible(chunk.values, chunk.column.leaf.physical_type)
         ]
-        if not eligible and lvl.empty:
+        if not eligible and lvl.empty and dlt.empty:
             return slots
         opts = self.options
         handles = (build_dictionaries([chunk.values for _, chunk in eligible])
@@ -376,11 +483,16 @@ class TpuChunkEncoder(NativeChunkEncoder):
         }
 
         fetched = jax.device_get(  # sync 2: bulk
-            (group_dev, tables_dev, lvl.phase_b_device() if not lvl.empty else []))
-        groups_host, tables_host, lvl_host = fetched
+            (group_dev, tables_dev,
+             lvl.phase_b_device() if not lvl.empty else [],
+             dlt.device_outputs() if not dlt.empty else []))
+        groups_host, tables_host, lvl_host, dlt_host = fetched
         if not lvl.empty:
             lvl.assemble(lvl_host)
             self._level_plans = lvl.plans
+        if not dlt.empty:
+            dlt.assemble(dlt_host)
+            self._delta_plans = dlt.plans
 
         bodies_by_slot: dict[int, _PageBodies] = {}
 
@@ -445,11 +557,11 @@ class TpuChunkEncoder(NativeChunkEncoder):
         per-column delta & delta-length-byte-array) for large chunks; small
         ones and everything else fall through to the native host path.
 
-        Dispatch note: unlike the dictionary path, delta pages encode as one
-        device round trip per page (the assemble loop calls this per page) —
-        acceptable where this backend is auto-selected (fast link), and the
-        obvious next step if delta-heavy workloads dominate is folding these
-        into the _prepare_all batch like the level planner."""
+        This is the *fallback* route: pages of statically-known delta chunks
+        are batched by _DeltaPlanner into one dispatch per (bucket, ring)
+        group and served from the plan via _values_page_body; only small
+        chunks and dictionary-*rejected* columns (unknowable at plan time)
+        land here, paying one round trip per page."""
         from ..core.schema import Encoding
 
         if len(values) >= self.min_device_rows:
@@ -464,6 +576,17 @@ class TpuChunkEncoder(NativeChunkEncoder):
 
                 return delta_length_byte_array_device(values)
         return super()._values_body(values, pt, encoding)
+
+    def _values_page_body(self, chunk, va: int, vb: int, pt: int,
+                          encoding: int) -> bytes:
+        plans = getattr(self, "_delta_plans", None)
+        if plans:
+            hit = plans.get(id(chunk))
+            if hit is not None and hit[0] is chunk:  # guard against id() reuse
+                body = hit[1].get((va, vb))
+                if body is not None:
+                    return body
+        return super()._values_page_body(chunk, va, vb, pt, encoding)
 
     def _levels_page_blob(self, chunk, a: int, b: int) -> bytes:
         plans = getattr(self, "_level_plans", None)

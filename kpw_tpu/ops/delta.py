@@ -84,13 +84,11 @@ def _pack_mb_at_width(hi, lo, width: int) -> jnp.ndarray:
     return jnp.zeros(_MB * 8, jnp.uint8).at[:nbytes].set(bytes_)
 
 
-@functools.partial(jax.jit, static_argnums=(3,))
-def delta_blocks_device(vhi: jax.Array, vlo: jax.Array, n: jax.Array,
-                        bit_size: int):
-    """Device phase of DELTA_BINARY_PACKED for ``n`` values provided as
-    (hi, lo) uint32 pairs padded to 1 + blocks*128 entries (blocks from the
-    array shape — callers bucket the padding so jit keys stay bounded; ``n``
-    is traced).
+def _delta_window(vhi: jax.Array, vlo: jax.Array, n: jax.Array,
+                  bit_size: int):
+    """Traceable core: DELTA_BINARY_PACKED device phase for one window of
+    ``n`` values provided as (hi, lo) uint32 pairs padded to 1 + blocks*128
+    entries.
 
     ``bit_size`` selects the ring: 64 works on (hi, lo) pairs, 32 on the lo
     plane alone (hi fixed at zero) — one kernel body for both.
@@ -159,6 +157,67 @@ def delta_blocks_device(vhi: jax.Array, vlo: jax.Array, n: jax.Array,
     return jax.vmap(per_block)(dhi, dlo, vmask)
 
 
+@functools.partial(jax.jit, static_argnums=(3,))
+def delta_blocks_device(vhi: jax.Array, vlo: jax.Array, n: jax.Array,
+                        bit_size: int):
+    """One full stream (see :func:`_delta_window`); jit keys bounded by the
+    caller's power-of-two block padding."""
+    return _delta_window(vhi, vlo, n, bit_size)
+
+
+@functools.partial(jax.jit, static_argnums=(5, 6))
+def delta_pages_multi(hi_all: jax.Array, lo_all: jax.Array,
+                      stream_ids: jax.Array, starts: jax.Array,
+                      counts: jax.Array, bucket: int, bit_size: int):
+    """Batched per-page delta encode over windows of stacked value streams —
+    the TPU backend's planner launches ONE of these per (bucket, bit_size)
+    group so a whole row group's delta pages cost one dispatch
+    (ops.backend._DeltaPlanner), mirroring pack_pages_multi.
+
+    ``hi_all``/``lo_all`` are (K, maxN) uint32 planes; each page encodes the
+    window [start, start + bucket] of its stream (bucket a multiple of 128,
+    ops.packing.pad_bucket guarantees it), masked to ``count`` values.
+    Returns per-page stacked :func:`_delta_window` outputs.
+    """
+    padded_hi = jnp.pad(hi_all, ((0, 0), (0, bucket + 1)))
+    padded_lo = jnp.pad(lo_all, ((0, 0), (0, bucket + 1)))
+
+    def one(sid, start, count):
+        whi = jax.lax.dynamic_slice(padded_hi, (sid, start), (1, bucket + 1))[0]
+        wlo = jax.lax.dynamic_slice(padded_lo, (sid, start), (1, bucket + 1))[0]
+        return _delta_window(whi, wlo, count, bit_size)
+
+    return jax.vmap(one)(stream_ids, starts, counts)
+
+
+def assemble_delta_page(first_value: int, count: int, mh, ml, widths, packed,
+                        bit_size: int) -> bytes:
+    """Host assembly of one page's DELTA_BINARY_PACKED stream from the
+    device outputs (O(blocks)); byte-identical to the oracle."""
+    out = bytearray()
+    out += varint_bytes(_BLOCK)
+    out += varint_bytes(_MINI)
+    out += varint_bytes(count)
+    if count == 0:
+        out += varint_bytes(0)
+        return bytes(out)
+    out += varint_bytes(zigzag(int(first_value)))
+    if count == 1:
+        return bytes(out)
+    blocks = (count - 1 + _BLOCK - 1) // _BLOCK
+    for b in range(blocks):
+        md = int(ml[b]) if bit_size == 32 else (int(mh[b]) << 32) | int(ml[b])
+        if md >= 1 << (bit_size - 1):
+            md -= 1 << bit_size
+        out += varint_bytes(zigzag(md))
+        out += bytes(int(w) for w in widths[b])
+        for m in range(_MINI):
+            w = int(widths[b][m])
+            if w:
+                out += packed[b, m, : 4 * w].tobytes()
+    return bytes(out)
+
+
 def _split64(values: np.ndarray):
     a = np.ascontiguousarray(values)
     if a.dtype.itemsize == 8:
@@ -174,19 +233,10 @@ def delta_binary_packed_device(values: np.ndarray, bit_size: int = 64) -> bytes:
     itype = np.int64 if bit_size == 64 else np.int32
     v = np.ascontiguousarray(values, itype)
     n = len(v)
-    out = bytearray()
-    out += varint_bytes(_BLOCK)
-    out += varint_bytes(_MINI)
-    out += varint_bytes(n)
-    if n == 0:
-        out += varint_bytes(0)
-        return bytes(out)
-    out += varint_bytes(zigzag(int(v[0])))
-    if n == 1:
-        return bytes(out)
-
-    nd = n - 1
-    blocks = (nd + _BLOCK - 1) // _BLOCK
+    if n <= 1:
+        return assemble_delta_page(int(v[0]) if n else 0, n,
+                                   None, None, None, None, bit_size)
+    blocks = (n - 1 + _BLOCK - 1) // _BLOCK
     # pad the block count to a power of two so jit specializes on a bounded
     # set of shapes (invalid blocks mask to width-0 miniblocks)
     pad_blocks = 1 << max(0, (blocks - 1).bit_length())
@@ -196,18 +246,7 @@ def delta_binary_packed_device(values: np.ndarray, bit_size: int = 64) -> bytes:
     mh, ml, widths, packed = jax.device_get(  # one bulk readback
         delta_blocks_device(jnp.asarray(hi), jnp.asarray(lo), jnp.int32(n),
                             bit_size))
-
-    for b in range(blocks):
-        md = int(ml[b]) if bit_size == 32 else (int(mh[b]) << 32) | int(ml[b])
-        if md >= 1 << (bit_size - 1):
-            md -= 1 << bit_size
-        out += varint_bytes(zigzag(md))
-        out += bytes(int(w) for w in widths[b])
-        for m in range(_MINI):
-            w = int(widths[b][m])
-            if w:
-                out += packed[b, m, : 4 * w].tobytes()
-    return bytes(out)
+    return assemble_delta_page(int(v[0]), n, mh, ml, widths, packed, bit_size)
 
 
 def delta_length_byte_array_device(values) -> bytes:

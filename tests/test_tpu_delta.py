@@ -72,8 +72,10 @@ def test_file_identity_delta_fallback_tpu_backend():
         "u": [f"{v:020x}".encode() for v in rng.integers(0, 1 << 60, rows)],
     }
     schema = Schema([leaf("ts", "int64"), leaf("i32", "int32"), leaf("u", "string")])
+    # small pages force several delta pages per chunk, exercising the
+    # batched _DeltaPlanner (one device launch per bucket group)
     props = WriterProperties(codec=Codec.ZSTD, enable_dictionary=False,
-                             delta_fallback=True)
+                             delta_fallback=True, data_page_size=16 * 1024)
 
     def run(encoder_cls):
         encoder = encoder_cls(props.encoder_options())
@@ -92,3 +94,28 @@ def test_file_identity_delta_fallback_tpu_backend():
     np.testing.assert_array_equal(t["ts"].to_numpy(), arrays["ts"])
     np.testing.assert_array_equal(t["i32"].to_numpy(), arrays["i32"])
     assert [v.encode() for v in t["u"].to_pylist()] == arrays["u"]
+
+
+def test_planner_dtype_mismatch_identity():
+    """Regression: an int32 ndarray in an INT64 column must sign-extend into
+    the ring (the oracle casts); the planner must match byte-for-byte."""
+    rng = np.random.default_rng(9)
+    vals = rng.integers(-(1 << 20), 1 << 20, 6000).astype(np.int32)
+    schema = Schema([leaf("ts", "int64")])
+    props = WriterProperties(enable_dictionary=False, delta_fallback=True,
+                             data_page_size=8 * 1024)
+
+    def run(cls):
+        e = cls(props.encoder_options())
+        if cls is TpuChunkEncoder:
+            e.min_device_rows = 1
+        buf = io.BytesIO()
+        w = ParquetFileWriter(buf, schema, props, encoder=e)
+        w.write_batch(columns_from_arrays(schema, {"ts": vals}))
+        w.close()
+        return buf.getvalue()
+
+    cpu = run(CpuChunkEncoder)
+    assert run(TpuChunkEncoder) == cpu
+    t = pq.read_table(io.BytesIO(cpu))
+    np.testing.assert_array_equal(t["ts"].to_numpy(), vals.astype(np.int64))
