@@ -18,10 +18,11 @@ math runs on device, dispatch-batched per row group:
 - the rare long-run pages fall back to the host RLE assembler to keep the
   stream byte-identical to the oracle.
 
-Strings (BYTE_ARRAY) keep the host hash-map dictionary — variable-length
-bytes don't belong on the MXU/VPU; their dictionary *indices* are still
-integers and could be device-packed, which matters only for very large
-string pages (future work, SURVEY.md §7 hard part f).
+Strings (BYTE_ARRAY) build their dictionary on host (native C++ hash —
+variable-length bytes don't belong on the MXU/VPU), but their dictionary
+*indices* are integers like any other dictionary column and ride the same
+batched device bit-pack phase (_StringDictPlanner, SURVEY.md §7 hard
+part f).
 """
 
 from __future__ import annotations
@@ -228,6 +229,112 @@ class _LevelPlanner:
             self.plans.setdefault(id(chunk), (chunk, {}))[1][(a, b)] = blob
 
 
+def _hybrid_body(packed_row, long_sum: int, count: int, width: int,
+                 idx_fallback) -> bytes:
+    """One definition of the planner's data-page body assembly: device
+    bit-pack bytes when the oracle's RLE-vs-bitpack decision
+    (core.encodings.rle_hybrid_encode: long-run mass < max(8, n//10)) says
+    pure bit-pack, else the exact mixed host RLE over ``idx_fallback()``."""
+    if long_sum < max(8, count // 10):
+        groups_n = (count + 7) // 8
+        return (bytes([width]) + varint_bytes((groups_n << 1) | 1)
+                + packed_row[: groups_n * width].tobytes())
+    return bytes([width]) + enc.rle_hybrid_encode(idx_fallback(), width)
+
+
+class _StringDictPlanner:
+    """Byte-array dictionary columns in the row-group batch (SURVEY.md §7
+    hard part f): the dictionary itself builds on host (native C++ hash —
+    variable-length bytes don't belong on the VPU), but the *indices* are
+    integers like any other dictionary column, so their page packing joins
+    the planner's batched device phase (pack_pages_multi — pallas-backed on
+    TPU) instead of encoding page by page on host."""
+
+    def __init__(self, encoder: "TpuChunkEncoder", chunks) -> None:
+        self._items = []  # (i, chunk, dict_values, idx, width, pages)
+        self._rejected = []  # (i, dict_values, idx): budget-rejected builds
+        self._groups = []
+        opts = encoder.options
+        self.empty = True
+        if encoder._lib is None or not opts.enable_dictionary:
+            return
+        for i, chunk in enumerate(chunks):
+            pt = chunk.column.leaf.physical_type
+            values = chunk.values
+            if (not encoder._dictionary_viable(chunk)
+                    or not encoder._bytes_native_ok(values, pt)
+                    or len(values) < encoder.min_device_rows):
+                continue
+            n = len(values)
+            max_k = max(1, int(n * opts.max_dictionary_ratio))
+            built = encoder._bytes_dictionary(values, max_k)
+            if built is None:
+                continue  # ratio abort: encode() re-derives cheaply
+            dict_values, idx = built
+            k = len(dict_values)
+            plain_len = sum(map(len, dict_values))
+            if pt == PhysicalType.BYTE_ARRAY:
+                plain_len += 4 * k  # FLBA PLAIN has no length prefixes
+            if plain_len > opts.dictionary_page_size_limit:
+                # byte-budget rejection: hand the built dict through the
+                # slot so encode() re-derives the rejection without a
+                # second O(n) build
+                self._rejected.append((i, dict_values, idx))
+                continue
+            width = enc.bit_width(max(k - 1, 0))
+            pages = encoder._page_value_ranges(chunk)
+            self._items.append((i, chunk, dict_values, idx, width, pages))
+        self.empty = not self._items and not self._rejected
+        if not self._items:
+            return
+        maxn = max(len(idx) for _, _, _, idx, _, _ in self._items)
+        stacked = np.zeros((len(self._items), maxn), np.uint32)
+        for r, (_, _, _, idx, _, _) in enumerate(self._items):
+            stacked[r, : len(idx)] = idx
+        dev = jnp.asarray(stacked)
+        by_key: dict[tuple[int, int], list] = {}
+        for r, (i, chunk, _, _, width, pages) in enumerate(self._items):
+            if width == 0:
+                continue  # single-value dicts have no packed body
+            for va, vb in pages:
+                if vb - va > 0:
+                    by_key.setdefault((pad_bucket(vb - va), width), []).append(
+                        (r, va, vb))
+        for (bucket, width), rows in by_key.items():
+            packed, long_sum = pack_pages_multi(
+                dev,
+                jnp.asarray(np.array([r for r, _, _ in rows], np.int32)),
+                jnp.asarray(np.array([va for _, va, _ in rows], np.int32)),
+                jnp.asarray(np.array([vb - va for _, va, vb in rows], np.int32)),
+                bucket, width)
+            self._groups.append((rows, width, (packed, long_sum)))
+
+    def device_outputs(self):
+        return [g[2] for g in self._groups]
+
+    def fill_slots(self, fetched, slots) -> None:
+        """Assemble page bodies (device bit-pack or host RLE for long-run
+        pages — the index array is already host-resident) and install
+        (dict_values, _PageBodies) into the planner slots."""
+        for i, dict_values, idx in self._rejected:
+            slots[i] = (dict_values, idx)  # encode() re-derives the rejection
+        bodies: dict[int, _PageBodies] = {}
+        for r, (i, chunk, dict_values, idx, width, pages) in enumerate(self._items):
+            pb = bodies[r] = _PageBodies(len(idx))
+            for va, vb in pages:  # width-0 / empty pages have no device job
+                if vb - va == 0:
+                    pb.bodies[(va, vb)] = bytes([width])
+                elif width == 0:
+                    pb.bodies[(va, vb)] = (bytes([0])
+                                           + varint_bytes((vb - va) << 1))
+            slots[i] = (dict_values, pb)
+        for (rows, width, _), (packed_h, long_h) in zip(self._groups, fetched):
+            for row, (r, va, vb) in enumerate(rows):
+                bodies[r].bodies[(va, vb)] = _hybrid_body(
+                    packed_h[row], int(long_h[row]), vb - va, width,
+                    lambda r=r, va=va, vb=vb: self._items[r][3][va:vb])
+
+
 class _DeltaPlanner:
     """Batched device delta encoding for the row group's non-dictionary
     pages (BASELINE config 3), folded into the planner's phase B: one
@@ -421,12 +528,13 @@ class TpuChunkEncoder(NativeChunkEncoder):
         slots: list = [None] * len(chunks)
         lvl = _LevelPlanner(self, chunks)  # phase A launched here
         dlt = _DeltaPlanner(self, chunks)  # delta pages launched here
+        sdp = _StringDictPlanner(self, chunks)  # string index packs launched
         eligible = [
             (i, chunk) for i, chunk in enumerate(chunks)
             if self._dictionary_viable(chunk)
             and self._device_eligible(chunk.values, chunk.column.leaf.physical_type)
         ]
-        if not eligible and lvl.empty and dlt.empty:
+        if not eligible and lvl.empty and dlt.empty and sdp.empty:
             return slots
         opts = self.options
         handles = (build_dictionaries([chunk.values for _, chunk in eligible])
@@ -485,14 +593,17 @@ class TpuChunkEncoder(NativeChunkEncoder):
         fetched = jax.device_get(  # sync 2: bulk
             (group_dev, tables_dev,
              lvl.phase_b_device() if not lvl.empty else [],
-             dlt.device_outputs() if not dlt.empty else []))
-        groups_host, tables_host, lvl_host, dlt_host = fetched
+             dlt.device_outputs() if not dlt.empty else [],
+             sdp.device_outputs() if not sdp.empty else []))
+        groups_host, tables_host, lvl_host, dlt_host, sdp_host = fetched
         if not lvl.empty:
             lvl.assemble(lvl_host)
             self._level_plans = lvl.plans
         if not dlt.empty:
             dlt.assemble(dlt_host)
             self._delta_plans = dlt.plans
+        if not sdp.empty:
+            sdp.fill_slots(sdp_host, slots)
 
         bodies_by_slot: dict[int, _PageBodies] = {}
 

@@ -234,3 +234,40 @@ def test_file_identity_nullable_differing_counts():
     table = pq.read_table(buf)
     got = table["b"].to_numpy(zero_copy_only=False)
     np.testing.assert_array_equal(got[b_valid].astype(np.int64), b_vals[b_valid])
+
+
+def test_file_identity_string_dictionary_planner():
+    """String dictionary columns through the batched planner (host dict,
+    device-packed indices): byte identity + readback, multiple pages."""
+    rng = np.random.default_rng(21)
+    n = 30000
+    arrays = {
+        "s": [f"cat_{k:03d}".encode() for k in rng.integers(0, 150, n)],
+        "t": [b"x", b"y"] * (n // 2),  # tiny cardinality, width 1
+        "hi": [f"{v:026x}".encode() for v in rng.integers(0, 1 << 60, n)],  # rejected
+        "a": rng.integers(0, 500, n).astype(np.int64),  # numeric path alongside
+    }
+    schema = Schema([leaf("s", "string"), leaf("t", "string"),
+                     leaf("hi", "string"), leaf("a", "int64")])
+    buf = _identity_case(schema, arrays, data_page_size=16 * 1024)
+    table = pq.read_table(buf)
+    assert table["s"].to_pylist() == [v.decode() for v in arrays["s"]]
+    assert table["hi"].to_pylist() == [v.decode() for v in arrays["hi"]]
+    meta = pq.read_metadata(buf)
+    assert "PLAIN_DICTIONARY" in str(meta.row_group(0).column(0).encodings)
+
+
+def test_string_dictionary_budget_rejection_passthrough():
+    """Dictionary viable by ratio but over the page byte budget: the planner
+    hands the built dict through the slot, encode() re-derives the rejection,
+    and the column falls back to PLAIN — byte-identical to the oracle."""
+    rng = np.random.default_rng(22)
+    n = 24000
+    # ~12k uniques x ~120 B ≈ 1.4 MiB dictionary: ratio passes (0.5 < 0.67),
+    # byte budget (1 MiB) fails
+    pool = [f"{v:0118x}".encode() for v in rng.integers(0, 1 << 62, n // 2)]
+    arrays = {"s": [pool[k] for k in rng.integers(0, len(pool), n)]}
+    schema = Schema([leaf("s", "string")])
+    buf = _identity_case(schema, arrays, data_page_size=64 * 1024)
+    meta = pq.read_metadata(buf)
+    assert "PLAIN_DICTIONARY" not in str(meta.row_group(0).column(0).encodings)
