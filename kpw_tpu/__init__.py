@@ -11,5 +11,5 @@ EncoderBackend (CPU numpy reference vs vmapped TPU kernels).
 __version__ = "0.1.0"
 
 from .runtime import Builder, KafkaProtoParquetWriter, MetricRegistry  # noqa: E402,F401
-from .ingest import FakeBroker, PartitionOffset, SmartCommitConsumer  # noqa: E402,F401
-from .io import LocalFileSystem, MemoryFileSystem  # noqa: E402,F401
+from .ingest import FakeBroker, KafkaBrokerClient, PartitionOffset, SmartCommitConsumer  # noqa: E402,F401
+from .io import HdfsFileSystem, LocalFileSystem, MemoryFileSystem  # noqa: E402,F401

@@ -229,6 +229,17 @@ class _LevelPlanner:
             self.plans.setdefault(id(chunk), (chunk, {}))[1][(a, b)] = blob
 
 
+def _trivial_body(width: int, count: int) -> bytes | None:
+    """Data-page index body for the no-device-job cases — empty page (just
+    the width byte) and width-0 single-value dictionary (one RLE run header,
+    no value bytes).  One definition for every planner/assembly site."""
+    if count == 0:
+        return bytes([width])
+    if width == 0:
+        return bytes([0]) + varint_bytes(count << 1)
+    return None
+
+
 def _hybrid_body(packed_row, long_sum: int, count: int, width: int,
                  idx_fallback) -> bytes:
     """One definition of the planner's data-page body assembly: device
@@ -322,11 +333,9 @@ class _StringDictPlanner:
         for r, (i, chunk, dict_values, idx, width, pages) in enumerate(self._items):
             pb = bodies[r] = _PageBodies(len(idx))
             for va, vb in pages:  # width-0 / empty pages have no device job
-                if vb - va == 0:
-                    pb.bodies[(va, vb)] = bytes([width])
-                elif width == 0:
-                    pb.bodies[(va, vb)] = (bytes([0])
-                                           + varint_bytes((vb - va) << 1))
+                body = _trivial_body(width, vb - va)
+                if body is not None:
+                    pb.bodies[(va, vb)] = body
             slots[i] = (dict_values, pb)
         for (rows, width, _), (packed_h, long_h) in zip(self._groups, fetched):
             for row, (r, va, vb) in enumerate(rows):
@@ -528,17 +537,19 @@ class TpuChunkEncoder(NativeChunkEncoder):
         slots: list = [None] * len(chunks)
         lvl = _LevelPlanner(self, chunks)  # phase A launched here
         dlt = _DeltaPlanner(self, chunks)  # delta pages launched here
-        sdp = _StringDictPlanner(self, chunks)  # string index packs launched
         eligible = [
             (i, chunk) for i, chunk in enumerate(chunks)
             if self._dictionary_viable(chunk)
             and self._device_eligible(chunk.values, chunk.column.leaf.physical_type)
         ]
-        if not eligible and lvl.empty and dlt.empty and sdp.empty:
-            return slots
         opts = self.options
         handles = (build_dictionaries([chunk.values for _, chunk in eligible])
                    if eligible else [])
+        # after the numeric launches so the host string hashing overlaps
+        # the device dictionary builds
+        sdp = _StringDictPlanner(self, chunks)
+        if not eligible and lvl.empty and dlt.empty and sdp.empty:
+            return slots
 
         batches: list = []
         for batch, _ in handles:
@@ -650,11 +661,9 @@ class TpuChunkEncoder(NativeChunkEncoder):
             if will:
                 dict_values = batch.values_from_tables(j, k, tables_host[id(batch)])
                 for va, vb in pages:  # width-0 / empty pages have no device job
-                    count = vb - va
-                    if count == 0:
-                        pb.bodies.setdefault((va, vb), bytes([width]))
-                    elif width == 0:
-                        pb.bodies[(va, vb)] = bytes([0]) + varint_bytes(count << 1)
+                    body = _trivial_body(width, vb - va)
+                    if body is not None:
+                        pb.bodies.setdefault((va, vb), body)
             else:
                 # Rejected dictionary: encode() only needs len()/dtype to
                 # re-derive the rejection, so skip the key-table transfer.
@@ -728,10 +737,9 @@ class TpuChunkEncoder(NativeChunkEncoder):
             return super()._indices_body(indices, va, vb, dict_size)
         width = enc.bit_width(max(dict_size - 1, 0))
         count = vb - va
-        if count == 0:
-            return bytes([width])
-        if width == 0:
-            return bytes([0]) + varint_bytes(count << 1)
+        trivial = _trivial_body(width, count)
+        if trivial is not None:
+            return trivial
         pre = indices.prefetched.pop((va, vb, width), None)
         if pre is not None:
             packed_d, long_d, any_d = pre

@@ -271,3 +271,19 @@ def test_string_dictionary_budget_rejection_passthrough():
     buf = _identity_case(schema, arrays, data_page_size=64 * 1024)
     meta = pq.read_metadata(buf)
     assert "PLAIN_DICTIONARY" not in str(meta.row_group(0).column(0).encodings)
+
+
+def test_string_dictionary_planner_nullable():
+    """OPTIONAL string column through _StringDictPlanner: page (va, vb)
+    value ranges diverge from slot ranges exactly when def levels carry
+    nulls — byte identity locks the mapping in."""
+    rng = np.random.default_rng(23)
+    n = 20000
+    valid = rng.integers(0, 4, n) > 0  # ~25% nulls
+    vals = [f"cat_{k:02d}".encode() for k in rng.integers(0, 40, n)]
+    schema = Schema([leaf("s", "string", Repetition.OPTIONAL)])
+    arrays = {"s": (vals, valid)}
+    buf = _identity_case(schema, arrays, data_page_size=8 * 1024)
+    got = pq.read_table(buf)["s"].to_pylist()
+    want = [v.decode() if ok else None for v, ok in zip(vals, valid)]
+    assert got == want
