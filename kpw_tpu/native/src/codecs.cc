@@ -318,7 +318,17 @@ size_t kpw_zstd_max_compressed_length(size_t n) { return ZSTD_compressBound(n); 
 
 int kpw_zstd_compress(const uint8_t* in, size_t n, uint8_t* out,
                       size_t out_cap, size_t* out_len, int level) {
-  size_t rc = ZSTD_compress(out, out_cap, in, n, level);
+  // context reuse across pages (thread-local: pages compress from the
+  // column-parallel pool) — ZSTD_compress allocates a fresh cctx per call.
+  // RAII holder so exiting threads free their context.
+  struct CtxHolder {
+    ZSTD_CCtx* ctx = ZSTD_createCCtx();
+    ~CtxHolder() { ZSTD_freeCCtx(ctx); }
+  };
+  static thread_local CtxHolder holder;
+  size_t rc = holder.ctx != nullptr
+                  ? ZSTD_compressCCtx(holder.ctx, out, out_cap, in, n, level)
+                  : ZSTD_compress(out, out_cap, in, n, level);
   if (ZSTD_isError(rc)) return -1;
   *out_len = rc;
   return 0;
