@@ -230,6 +230,14 @@ def bench_config4() -> dict:
     import jax
 
     if len(jax.devices()) < 2:
+        if os.environ.get("KPW_BENCH_CFG4_CHILD"):
+            # We ARE the re-exec'd child and still see <2 devices: the
+            # XLA_FLAGS device-count request was ignored (e.g. conflicting
+            # pre-set flags).  Raise instead of forking unboundedly.
+            raise RuntimeError(
+                "cfg4 child still sees <2 devices; "
+                "--xla_force_host_platform_device_count was not honored "
+                f"(XLA_FLAGS={os.environ.get('XLA_FLAGS')!r})")
         # One real chip: measure the sharding path on a virtual CPU mesh in a
         # subprocess (the driver separately dry-runs multi-chip via
         # __graft_entry__.dryrun_multichip).
@@ -237,6 +245,7 @@ def bench_config4() -> dict:
               file=sys.stderr)
         env = dict(os.environ)
         env["JAX_PLATFORMS"] = "cpu"
+        env["KPW_BENCH_CFG4_CHILD"] = "1"
         env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
                             + " --xla_force_host_platform_device_count=8").strip()
         out = subprocess.run(

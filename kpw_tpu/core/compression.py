@@ -119,16 +119,20 @@ def zstd_decompress(data: bytes) -> bytes:
     return zstandard.ZstdDecompressor().decompress(data)
 
 
-def compress(data: bytes, codec: int) -> bytes:
+def compress(data: bytes, codec: int, level: int | None = None) -> bytes:
+    """``level`` applies to level-capable codecs (zstd default 3, gzip
+    default 6 — parquet-mr's codec configuration surface, exposed via
+    Builder.compression_level); snappy has no level knob."""
     if codec == Codec.UNCOMPRESSED:
         return data
     if codec == Codec.SNAPPY:
         return snappy_compress(data)
     if codec == Codec.GZIP:
-        co = zlib.compressobj(6, zlib.DEFLATED, 16 + 15)
+        co = zlib.compressobj(6 if level is None else level,
+                              zlib.DEFLATED, 16 + 15)
         return co.compress(data) + co.flush()
     if codec == Codec.ZSTD:
-        return zstd_compress(data)
+        return zstd_compress(data, 3 if level is None else level)
     raise ValueError(f"unsupported codec {codec}")
 
 

@@ -103,6 +103,9 @@ class EncodedChunk:
 @dataclass
 class EncoderOptions:
     codec: int = Codec.UNCOMPRESSED
+    # None = codec default (zstd 3, gzip 6); parquet-mr exposes the same
+    # knob through its codec configuration (SURVEY.md §5 config surface)
+    compression_level: int | None = None
     enable_dictionary: bool = True
     data_page_size: int = 1024 * 1024
     dictionary_page_size_limit: int = 1024 * 1024
@@ -294,7 +297,7 @@ class CpuChunkEncoder:
 
         if use_dict:
             body = dict_plain
-            comp = compress(body, opts.codec)
+            comp = compress(body, opts.codec, opts.compression_level)
             header = write_page_header(
                 PageType.DICTIONARY_PAGE,
                 len(body),
@@ -331,7 +334,7 @@ class CpuChunkEncoder:
                 values_body = self._values_page_body(chunk, va, vb, pt,
                                                      value_encoding)
             body = levels_blob + values_body
-            comp = compress(body, opts.codec)
+            comp = compress(body, opts.codec, opts.compression_level)
             header = write_page_header(
                 PageType.DATA_PAGE,
                 len(body),

@@ -32,6 +32,7 @@ class WriterProperties:
     row_group_size: int = 128 * 1024 * 1024
     data_page_size: int = 1024 * 1024
     codec: int = 0
+    compression_level: int | None = None
     enable_dictionary: bool = True
     write_statistics: bool = True
     delta_fallback: bool = False
@@ -41,6 +42,7 @@ class WriterProperties:
     def encoder_options(self) -> EncoderOptions:
         return EncoderOptions(
             codec=self.codec,
+            compression_level=self.compression_level,
             enable_dictionary=self.enable_dictionary,
             data_page_size=self.data_page_size,
             write_statistics=self.write_statistics,

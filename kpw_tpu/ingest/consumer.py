@@ -38,8 +38,9 @@ class SmartCommitConsumer:
         # Batch-native bounded buffer: a deque of record *batches* under one
         # condition, so the fetcher pays one lock round per fetch and
         # workers one per poll_many — the per-record queue.Queue handoff was
-        # the throughput ceiling (~2 us/record each side).  The bound is on
-        # record count; one in-flight fetch batch may overshoot it.
+        # the throughput ceiling (~2 us/record each side).  The record-count
+        # bound is hard (reference BlockingQueue capacity semantics):
+        # oversized batches are admitted in slices, see _put_batch.
         self._buf: "deque[list[Record]]" = deque()
         self._head_pos = 0  # consumed prefix of _buf[0]
         self._buf_count = 0
@@ -120,16 +121,27 @@ class SmartCommitConsumer:
 
     def _put_batch(self, records: list[Record]) -> bool:
         """Fetcher side: enqueue one tracked batch, blocking while the
-        record-count bound is reached.  Returns False when shut down before
-        space opened (caller must not advance its fetch position)."""
+        record-count bound is reached.  The bound is HARD (the reference's
+        maxQueuedRecordsInConsumer is a BlockingQueue capacity): an
+        oversized batch is admitted in slices as space opens, never
+        overshooting ``max_queued_records``.  Returns False when shut down
+        before everything was admitted (caller must not advance its fetch
+        position; already-admitted slices may be redelivered — at-least-once
+        allows the duplicates)."""
+        pos = 0
         with self._buf_cond:
-            while self._buf_count >= self._buf_max:
-                if not self._running:
-                    return False
-                self._buf_cond.wait(0.05)
-            self._buf.append(records)
-            self._buf_count += len(records)
-            self._buf_cond.notify_all()
+            while pos < len(records):
+                space = self._buf_max - self._buf_count
+                if space <= 0:
+                    if not self._running:
+                        return False
+                    self._buf_cond.wait(0.05)
+                    continue
+                part = records[pos: pos + space] if (pos or space < len(records) - pos) else records
+                self._buf.append(part)
+                self._buf_count += len(part)
+                pos += len(part)
+                self._buf_cond.notify_all()
         return True
 
     def ack(self, po: PartitionOffset) -> None:

@@ -167,7 +167,10 @@ class MemoryFileSystem(FileSystem):
 
     def delete(self, path: str) -> None:
         with self._lock:
-            del self._files[self._norm(path)]
+            p = self._norm(path)
+            if p not in self._files:
+                raise FileNotFoundError(path)  # match LocalFileSystem
+            del self._files[p]
 
     def size(self, path: str) -> int:
         with self._lock:

@@ -3,8 +3,12 @@
 // The reference system's only native code is the codec layer reached through
 // parquet-mr (snappy-java JNI, zlib, libhadoop CRC — SURVEY.md §2.2
 // "Native-code accounting").  This file is the rebuild's equivalent:
-//   * Snappy block format compressor/decompressor written from scratch
-//     against the public format description (no snappy source used),
+//   * Snappy block-format compressor/decompressor.  The wire format follows
+//     the public format description; the compressor's internal heuristics
+//     (the 0x1e35a7bd hash multiplier, the skip>>5 match-skipping schedule,
+//     the emit_literal/emit_copy decomposition) follow the algorithm of
+//     upstream google/snappy (BSD-licensed) — credit where due; output is
+//     cross-validated against libsnappy in tests/test_native.py,
 //   * ZSTD via the system libzstd (zstd.h),
 //   * CRC32C (Castagnoli, table-driven), parquet page checksum polynomial,
 //   * BYTE_ARRAY PLAIN assembly (length-prefix interleaving) for the string

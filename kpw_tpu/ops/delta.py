@@ -71,9 +71,13 @@ def _pack_mb_at_width(hi, lo, width: int) -> jnp.ndarray:
         return jnp.zeros(_MB * 8, jnp.uint8)
     # bits matrix (32, width): bit j of value i
     j = jnp.arange(width, dtype=jnp.uint32)
+    # clamp BEFORE subtracting: j is uint32, so (j - 32) wraps for j < 32 and
+    # shifts >= bit width are undefined in XLA — the outer where masks the
+    # lanes but the shift amount itself must stay < 32 on every backend
+    j_hi = jnp.where(j >= 32, j - 32, 0).astype(jnp.uint32)
     lo_bits = (lo[:, None] >> jnp.minimum(j, 31)) & jnp.where(j < 32, 1, 0).astype(jnp.uint32)
     hi_bits = jnp.where(j[None, :] >= 32,
-                        (hi[:, None] >> jnp.maximum(j - 32, 0).astype(jnp.uint32)) & 1,
+                        (hi[:, None] >> j_hi) & 1,
                         0).astype(jnp.uint32)
     bits = jnp.where(j[None, :] < 32, lo_bits, hi_bits)  # (32, width)
     flat = bits.reshape(-1)  # position p = i*width + j

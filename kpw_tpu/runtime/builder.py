@@ -42,6 +42,9 @@ class Builder:
         self._block_size = 128 * 1024 * 1024  # (:473)
         self._page_size = 1024 * 1024  # sane default; NOT the reference quirk
         self._codec = 0  # UNCOMPRESSED (:484)
+        self._compression_level: int | None = None  # codec default
+        self._consumer_config: dict | None = None  # KPW.java:627-631 analog
+        self._filesystem_config: dict | None = None  # KPW.java:662-666 analog
         self._enable_dictionary = True  # (:489)
         self._delta_fallback = False  # BASELINE config 3 opt-in
         self._encoder_threads = 0  # native column-parallel encode (0 = auto)
@@ -134,6 +137,16 @@ class Builder:
         self._codec = codec_from_name(codec)
         return self
 
+    def compression_level(self, level: int | None) -> "Builder":
+        """Codec compression level for level-capable codecs (zstd -22..22,
+        default 3; gzip 0-9, default 6).  None = codec default.  Setting a
+        level with snappy/uncompressed is rejected at build() (those codecs
+        have no level knob; a silently-ignored setting would mask a config
+        mistake) — parity with parquet-mr's codec-level configuration
+        surface."""
+        self._compression_level = level  # validated against the codec in build()
+        return self
+
     def enable_dictionary(self, flag: bool) -> "Builder":
         self._enable_dictionary = flag
         return self
@@ -164,6 +177,28 @@ class Builder:
 
     def file_extension(self, ext: str) -> "Builder":
         self._file_extension = ext
+        return self
+
+    # -- pass-through config maps (KPW.java:627-631, :662-666) --------------
+    def consumer_config(self, config: dict) -> "Builder":
+        """Raw Kafka consumer config map, pass-through parity with the
+        reference's ``consumerConfig`` (KafkaProtoParquetWriter.java:627-631).
+        When no ``broker()`` is supplied, ``build()`` constructs a real
+        ``KafkaBrokerClient`` from it — ``bootstrap.servers`` (or
+        ``bootstrap_servers``) is then required; every other key is handed to
+        the kafka-python consumer verbatim (dotted Kafka names are translated
+        to kafka-python's underscore kwargs)."""
+        self._consumer_config = dict(config)
+        return self
+
+    def filesystem_config(self, config: dict) -> "Builder":
+        """Raw filesystem config map, pass-through parity with the
+        reference's ``hadoopConf`` (KafkaProtoParquetWriter.java:662-666).
+        When no ``filesystem()`` is supplied, ``build()`` resolves the sink
+        from ``fs.defaultFS`` exactly like the reference (KPW.java:137-141):
+        ``hdfs://host:port`` -> HdfsFileSystem (remaining keys passed as
+        libhdfs extra_conf), ``file://`` or absent -> LocalFileSystem."""
+        self._filesystem_config = dict(config)
         return self
 
     # -- plumbing ----------------------------------------------------------
@@ -203,7 +238,53 @@ class Builder:
         return self
 
     # -- build -------------------------------------------------------------
+    def _broker_from_consumer_config(self):
+        """Construct a real KafkaBrokerClient from the pass-through map
+        (the reference builds its consumer from consumerConfig the same way,
+        KPW.java:153-163)."""
+        cfg = {k.replace(".", "_"): v for k, v in self._consumer_config.items()}
+        servers = cfg.pop("bootstrap_servers", None)
+        if servers is None:
+            raise ValueError(
+                "consumer_config needs 'bootstrap.servers' when no broker() "
+                "is supplied")
+        # group.id in the map names the consumer group (KPW.java:158 only
+        # defaults it when absent) — route it to the writer's group id, which
+        # is what join_group hands the Kafka client; a conflicting explicit
+        # group_id() is a config error, not a silent override
+        cfg_group = cfg.pop("group_id", None)
+        if cfg_group is not None:
+            if self._group_id is not None and self._group_id != cfg_group:
+                raise ValueError(
+                    f"conflicting consumer groups: group_id({self._group_id!r})"
+                    f" vs consumer_config group.id {cfg_group!r}")
+            self._group_id = cfg_group
+        from ..ingest.kafka_client import KafkaBrokerClient
+
+        return KafkaBrokerClient(servers, client_config=cfg)
+
+    def _filesystem_from_config(self):
+        """Resolve the sink from fs.defaultFS (KPW.java:137-141 parity)."""
+        cfg = dict(self._filesystem_config)
+        default_fs = cfg.pop("fs.defaultFS", cfg.pop("fs_defaultFS", ""))
+        if default_fs.startswith("hdfs://"):
+            from urllib.parse import urlparse
+
+            from ..io.hdfs import HdfsFileSystem
+
+            u = urlparse(default_fs)
+            return HdfsFileSystem(host=u.hostname or "default",
+                                  port=u.port or 8020,
+                                  extra_conf=cfg or None)
+        if default_fs and not default_fs.startswith("file://"):
+            raise ValueError(f"unsupported fs.defaultFS scheme: {default_fs}")
+        return LocalFileSystem()
+
     def build(self):
+        if self._broker is None and self._consumer_config is not None:
+            self._broker = self._broker_from_consumer_config()
+        if self._filesystem is None and self._filesystem_config is not None:
+            self._filesystem = self._filesystem_from_config()
         # required fields (reference :729-733)
         missing = [name for name, v in [
             ("broker", self._broker),
@@ -213,6 +294,19 @@ class Builder:
         ] if v is None]
         if missing:
             raise ValueError(f"missing required builder fields: {missing}")
+        if self._compression_level is not None:
+            from ..core.schema import Codec
+
+            lo, hi = {Codec.GZIP: (0, 9), Codec.ZSTD: (-22, 22)}.get(
+                self._codec, (None, None))
+            if lo is None:
+                raise ValueError(
+                    "compression_level is only meaningful for gzip/zstd "
+                    f"(codec={self._codec})")
+            if not lo <= self._compression_level <= hi:
+                raise ValueError(
+                    f"compression_level {self._compression_level} outside "
+                    f"[{lo}, {hi}] for this codec")
         if self._max_file_size < MIN_MAX_FILE_SIZE:
             raise ValueError(
                 f"max_file_size must be >= {MIN_MAX_FILE_SIZE} bytes "
@@ -249,6 +343,7 @@ class Builder:
             row_group_size=self._block_size,
             data_page_size=self._page_size,
             codec=self._codec,
+            compression_level=self._compression_level,
             enable_dictionary=self._enable_dictionary,
             delta_fallback=self._delta_fallback,
             encoder_threads=self._encoder_threads,

@@ -239,3 +239,34 @@ def test_filesystem_append_semantics():
             f.write(b"two")
         with lfs.open_read(p) as f:
             assert f.read() == b"onetwo"
+
+
+def test_consumer_queue_bound_is_hard():
+    """max_queued_records is a hard bound (reference BlockingQueue
+    capacity): even when one fetch batch exceeds it, the in-queue record
+    count never overshoots; draining lets the rest through."""
+    from kpw_tpu.ingest.consumer import SmartCommitConsumer
+
+    broker = FakeBroker()
+    broker.create_topic("t", 1)
+    for i in range(500):
+        broker.produce("t", f"v{i}".encode())
+    c = SmartCommitConsumer(broker, "g", max_queued_records=64,
+                            fetch_max_records=500)
+    c.subscribe("t")
+    c.start()
+    try:
+        deadline = time.time() + 5
+        while c._buf_count < 64 and time.time() < deadline:
+            time.sleep(0.001)
+        # hard bound: never more than 64 queued
+        for _ in range(50):
+            assert c._buf_count <= 64
+            time.sleep(0.001)
+        got = []
+        while len(got) < 500 and time.time() < deadline:
+            got.extend(c.poll_many(32))
+            assert c._buf_count <= 64
+        assert [r.value for r in got] == [f"v{i}".encode() for i in range(500)]
+    finally:
+        c.close()

@@ -435,3 +435,83 @@ def test_clean_abandoned_tmp():
         assert not any(r in remaining for r in stale)
         rows = read_messages(fs, fs.list_files("/out", extension=".parquet"))
     assert rows_multiset(rows) == as_multiset(msgs)
+
+
+def test_builder_config_map_passthroughs():
+    """Pass-through config maps (KPW.java:627-631 consumerConfig, :662-666
+    hadoopConf): consumer_config builds a real KafkaBrokerClient when no
+    broker is given; filesystem_config resolves fs.defaultFS like the
+    reference (file:// -> local; unknown scheme rejected)."""
+    cls = sample_message_class()
+
+    # consumer_config without bootstrap.servers: loud failure
+    with pytest.raises(ValueError, match="bootstrap.servers"):
+        (Builder().topic("t").proto_class(cls).target_dir("/x")
+         .filesystem(MemoryFileSystem())
+         .consumer_config({"fetch.max.bytes": 1 << 20}).build())
+
+    # filesystem_config with file:// resolves to LocalFileSystem
+    from kpw_tpu.io.fs import LocalFileSystem
+    b = (Builder().broker(FakeBroker()).topic("t").proto_class(cls)
+         .target_dir("/tmp/kpw-test-passthrough")
+         .filesystem_config({"fs.defaultFS": "file:///"}))
+    w = b.build()
+    assert isinstance(b._filesystem, LocalFileSystem)
+
+    # unsupported scheme rejected
+    with pytest.raises(ValueError, match="unsupported fs.defaultFS"):
+        (Builder().broker(FakeBroker()).topic("t").proto_class(cls)
+         .target_dir("/x")
+         .filesystem_config({"fs.defaultFS": "s3://bucket"}).build())
+
+    # group.id in the map routes to the writer's consumer group
+    b = (Builder().broker(FakeBroker()).topic("t").proto_class(cls)
+         .target_dir("/x").filesystem(MemoryFileSystem()))
+    b._consumer_config = {"bootstrap.servers": "h:9092", "group.id": "cg"}
+    try:
+        b._broker_from_consumer_config()
+    except ImportError:
+        pass  # kafka-python absent in image; group routing happens first
+    assert b._group_id == "cg"
+    with pytest.raises(ValueError, match="conflicting consumer groups"):
+        b2 = (Builder().group_id("other"))
+        b2._consumer_config = {"bootstrap.servers": "h:9092",
+                               "group.id": "cg"}
+        b2._broker_from_consumer_config()
+
+    del w
+
+
+def test_builder_compression_level():
+    """compression_level plumbs through to the page codec (zstd here): a
+    higher level must produce a smaller-or-equal file, and validation
+    rejects out-of-range / codec-less levels."""
+    broker = FakeBroker()
+    broker.create_topic(TOPIC, 1)
+    cls = sample_message_class()
+    produce_samples(broker, cls, 400)
+
+    def one_file_size(level):
+        fs = MemoryFileSystem()
+        w = make_writer_builder(
+            broker, fs, cls,
+            compression=("zstd"),
+            compression_level=level,
+            group_id=f"lvl-{level}",
+            max_file_open_duration_seconds=0.6,
+        ).build()
+        with w:
+            files = wait_for_files(fs, "/out", ".parquet", 1)
+            return fs.size(files[0])
+
+    s_fast, s_slow = one_file_size(1), one_file_size(19)
+    assert s_slow <= s_fast
+
+    with pytest.raises(ValueError, match="compression_level"):
+        (Builder().broker(broker).topic("t").proto_class(cls)
+         .target_dir("/x").filesystem(MemoryFileSystem())
+         .compression("zstd").compression_level(99).build())
+    with pytest.raises(ValueError, match="only meaningful"):
+        (Builder().broker(broker).topic("t").proto_class(cls)
+         .target_dir("/x").filesystem(MemoryFileSystem())
+         .compression_level(3).build())
