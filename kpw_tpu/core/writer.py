@@ -110,14 +110,10 @@ class ParquetFileWriter:
         getDataSize() (ParquetFile.java:77-79); this is the equivalent."""
         return self._pos + self._pending_bytes
 
-    def write_batch(self, batch: ColumnBatch) -> None:
-        """Append a batch; flushes a row group when the threshold crosses.
-
-        Ownership contract: the batch is owned by the writer as soon as this
-        is called — the append itself cannot fail.  If the internal flush
-        raises (transient IO), the data is safely buffered; retry by calling
-        :meth:`flush_row_group` (or just :meth:`close`), do NOT re-submit the
-        batch."""
+    def append_batch(self, batch: ColumnBatch) -> None:
+        """Pure-memory append: buffers the batch, never touches the sink
+        (cannot raise transient IO).  Pair with :meth:`maybe_flush_row_group`
+        — the seam the streaming worker retries independently."""
         if self._closed:
             raise ValueError("writer closed")
         if self._pending is None:
@@ -129,8 +125,23 @@ class ParquetFileWriter:
                 bucket.append(chunk)
         self._pending_rows += batch.num_rows
         self._pending_bytes += batch.estimated_bytes()
+
+    def maybe_flush_row_group(self) -> None:
+        """Flush iff the pending bytes crossed row_group_size (idempotent,
+        retry-safe)."""
         if self._pending_bytes >= self.properties.row_group_size:
             self.flush_row_group()
+
+    def write_batch(self, batch: ColumnBatch) -> None:
+        """Append a batch; flushes a row group when the threshold crosses.
+
+        Ownership contract: the batch is owned by the writer as soon as this
+        is called — the append itself cannot fail.  If the internal flush
+        raises (transient IO), the data is safely buffered; retry by calling
+        :meth:`flush_row_group` (or just :meth:`close`), do NOT re-submit the
+        batch."""
+        self.append_batch(batch)
+        self.maybe_flush_row_group()
 
     @staticmethod
     def _merge_chunks(parts: list[ColumnChunkData]) -> ColumnChunkData:

@@ -151,7 +151,45 @@ class SmartCommitConsumer:
                 self.broker.commit(self.group_id, self._topic, po.partition,
                                    new_commit)
 
+    def ack_run(self, partition: int, start: int, count: int) -> None:
+        """Batch ack of a contiguous offset run — one tracker round and at
+        most one broker commit for a whole published batch (the worker acks
+        whole files' worth of offsets at publish time)."""
+        if count <= 0:
+            return
+        new_commit = self.tracker.ack_run(partition, start, count)
+        if new_commit is not None:
+            with self._commit_lock:
+                self.broker.commit(self.group_id, self._topic, partition,
+                                   new_commit)
+
     # -- internals ---------------------------------------------------------
+    def _track_batch(self, partition: int, records: list[Record]) -> list[Record]:
+        """Track a fetch batch in contiguous runs, chunked at offset-tracker
+        page boundaries with a backpressure re-check per chunk (granularity:
+        the open-page bound may be exceeded by at most the one page that
+        trips it, mirroring the per-record loop this replaces at page
+        resolution instead of record resolution)."""
+        tr = self.tracker
+        page = tr.page_size
+        accepted_until = 0  # index into records
+        i = 0
+        n = len(records)
+        while i < n:
+            if tr.is_backpressured(partition):
+                break
+            # contiguous run starting at i, clipped at the next page boundary
+            start = records[i].offset
+            page_end_off = (start // page + 1) * page
+            j = i + 1
+            while (j < n and records[j].offset == records[j - 1].offset + 1
+                   and records[j].offset < page_end_off):
+                j += 1
+            tr.track_run(partition, start, records[j - 1].offset - start + 1)
+            accepted_until = j
+            i = j
+        return records[:accepted_until] if accepted_until < n else records
+
     def _refresh_assignment(self) -> None:
         gen = self.broker.generation(self.group_id, self._topic)
         if gen == self._generation:
@@ -189,12 +227,7 @@ class SmartCommitConsumer:
                     continue  # open-page backpressure (KPW.java:596-611)
                 pos = self._positions.get(p, 0)
                 records = self.broker.fetch(self._topic, p, pos, self._fetch_max)
-                accepted = []
-                for rec in records:
-                    if self.tracker.is_backpressured(p):
-                        break  # re-check mid-batch: one fetch must not blow the bound
-                    self.tracker.track(p, rec.offset)
-                    accepted.append(rec)
+                accepted = self._track_batch(p, records)
                 if not accepted:
                     continue
                 if not self._put_batch(accepted):

@@ -58,6 +58,21 @@ class _PartitionTracker:
         if offset >= self.delivered:
             self.delivered = offset + 1
 
+    def track_run(self, start: int, count: int) -> None:
+        """Track a contiguous run [start, start+count) in O(pages touched)
+        instead of O(count) — fetch batches arrive as runs, and per-offset
+        tracking was the streaming fetcher's hottest line."""
+        end = start + count
+        off = start
+        while off < end:
+            page = self._page_for(off)
+            page_end = min(end, page.start + self.page_size)
+            if page_end > page.delivered_end:
+                page.delivered_end = page_end
+            off = page_end
+        if end > self.delivered:
+            self.delivered = end
+
     def ack(self, offset: int) -> None:
         if offset < self.committed:
             return  # duplicate delivery from a previous generation
@@ -66,6 +81,22 @@ class _PartitionTracker:
         if not page.acked[slot]:
             page.acked[slot] = True
             page.acked_count += 1
+
+    def ack_run(self, start: int, count: int) -> None:
+        """Ack a contiguous run [start, start+count): numpy slice per page
+        touched (the worker publishes whole poll batches at once)."""
+        end = start + count
+        off = max(start, self.committed)  # skip pre-commit duplicates
+        while off < end:
+            page = self._page_for(off)
+            page_end = min(end, page.start + self.page_size)
+            a, b = off - page.start, page_end - page.start
+            seg = page.acked[a:b]
+            newly = (b - a) - int(seg.sum())
+            if newly:
+                seg[:] = True
+                page.acked_count += newly
+            off = page_end
 
     def advance(self) -> int | None:
         """Advance the committed frontier across fully-acked pages (and a
@@ -80,12 +111,11 @@ class _PartitionTracker:
             delivered_in_page = page.delivered_end - page.start
             if delivered_in_page <= 0:
                 break
-            acked_through = 0
-            flat = page.acked
-            # count consecutive acked from committed position
+            # consecutive acked run from the committed position (vectorized:
+            # argmin finds the first un-acked flag)
             pos = self.committed - page.start
-            while pos < delivered_in_page and flat[pos]:
-                pos += 1
+            sub = page.acked[pos:delivered_in_page]
+            pos += len(sub) if sub.all() else int(np.argmin(sub))
             new_commit = page.start + pos
             if new_commit == self.committed:
                 break
@@ -128,12 +158,24 @@ class PagedOffsetTracker:
         with self._lock:
             self._part(partition).track(offset)
 
+    def track_run(self, partition: int, start: int, count: int) -> None:
+        with self._lock:
+            self._part(partition).track_run(start, count)
+
     def ack(self, po: PartitionOffset) -> int | None:
         """Record an ack; returns a new commit offset for the partition if
         the frontier advanced."""
         with self._lock:
             t = self._part(po.partition)
             t.ack(po.offset)
+            return t.advance()
+
+    def ack_run(self, partition: int, start: int, count: int) -> int | None:
+        """Batch ack of a contiguous offset run; returns a new commit offset
+        for the partition if the frontier advanced."""
+        with self._lock:
+            t = self._part(partition)
+            t.ack_run(start, count)
             return t.advance()
 
     def committed(self, partition: int) -> int:

@@ -95,6 +95,74 @@ def proto_to_schema(msg_class) -> Schema:
                   name=desc.name)
 
 
+def _file_syntax(file_desc) -> str:
+    """'proto2' | 'proto3' (upb FileDescriptor hides .syntax; recover it
+    from the serialized FileDescriptorProto).  Drives UTF-8 validation
+    parity: proto3 parsers reject invalid UTF-8 in strings, proto2 parsers
+    pass the raw bytes through."""
+    syntax = getattr(file_desc, "syntax", None)
+    if syntax:
+        return syntax
+    try:
+        from google.protobuf import descriptor_pb2
+
+        fdp = descriptor_pb2.FileDescriptorProto()
+        file_desc.CopyToProto(fdp)
+        return fdp.syntax or "proto2"
+    except Exception:
+        return "proto2"
+
+
+class WireShredError(Exception):
+    """The native wire-format shredder could not prove a record clean; the
+    caller must re-parse the batch in Python (exact per-record semantics,
+    including the poison-pill policies)."""
+
+    def __init__(self, record_index: int) -> None:
+        super().__init__(f"wire shred failed at record {record_index}")
+        self.record_index = record_index
+
+
+# field kinds — mirrored in kpw_tpu/native/src/shred.cc enum Kind
+_K_VARINT64, _K_VARINT32, _K_SINT64, _K_SINT32 = 0, 1, 2, 3
+_K_FIXED64, _K_FIXED32, _K_BOOL, _K_SPAN, _K_SPAN_UTF8 = 4, 5, 6, 7, 8
+_F_REQUIRED = 1
+
+# proto type -> (kind, numpy slot dtype or None for spans)
+_WIRE_KINDS = {
+    FD.TYPE_INT64: (_K_VARINT64, np.int64),
+    FD.TYPE_UINT64: (_K_VARINT64, np.int64),   # raw bits = UINT_64 wrap
+    FD.TYPE_SINT64: (_K_SINT64, np.int64),
+    FD.TYPE_FIXED64: (_K_FIXED64, np.int64),
+    FD.TYPE_SFIXED64: (_K_FIXED64, np.int64),
+    FD.TYPE_INT32: (_K_VARINT32, np.int32),
+    FD.TYPE_UINT32: (_K_VARINT32, np.int32),   # raw bits = UINT_32 wrap
+    FD.TYPE_SINT32: (_K_SINT32, np.int32),
+    FD.TYPE_FIXED32: (_K_FIXED32, np.int32),
+    FD.TYPE_SFIXED32: (_K_FIXED32, np.int32),
+    FD.TYPE_BOOL: (_K_BOOL, np.bool_),
+    FD.TYPE_DOUBLE: (_K_FIXED64, np.float64),
+    FD.TYPE_FLOAT: (_K_FIXED32, np.float32),
+    FD.TYPE_STRING: (_K_SPAN, None),
+    FD.TYPE_BYTES: (_K_SPAN, None),
+    # TYPE_ENUM deliberately absent: proto2 closed-enum semantics (unknown
+    # values land in unknown fields) need the Python path
+}
+
+
+class _WirePlan:
+    """Precomputed arrays driving kpw_proto_shred for a flat schema."""
+
+    __slots__ = ("fnum", "kinds", "flags", "dtypes", "optional")
+
+    def __init__(self, fnum, kinds, flags, dtypes, optional) -> None:
+        self.fnum = fnum          # uint32 (n_fields,)
+        self.kinds = kinds        # uint8
+        self.flags = flags        # uint8
+        self.dtypes = dtypes      # numpy dtype or None (span) per field
+        self.optional = optional  # bool per field (needs presence/def levels)
+
+
 class _LeafBuffer:
     __slots__ = ("values", "defs", "reps")
 
@@ -135,7 +203,9 @@ class ProtoColumnarizer:
         for col in self.schema.columns:
             fd = desc.fields_by_name[col.path[0]]
             if fd.type == FD.TYPE_STRING:
-                conv = lambda v: v.encode("utf-8")
+                # proto2 runtimes surface invalid-UTF-8 strings as bytes;
+                # pass them through unchanged (same output as the wire path)
+                conv = lambda v: v.encode("utf-8") if isinstance(v, str) else bytes(v)
             elif fd.type == FD.TYPE_ENUM:
                 values_by_number = fd.enum_type.values_by_number
 
@@ -173,6 +243,114 @@ class ProtoColumnarizer:
                 values = [conv(v) for v in values]
             chunks.append(ColumnChunkData(
                 col, self._finalize_values(col, values), defs, None, n))
+        return ColumnBatch(chunks, n)
+
+    # -- native wire-format fast path --------------------------------------
+    def _wire_plan(self):
+        """Build (once) the kpw_proto_shred plan, or None when the schema or
+        environment disqualifies the fast path (non-flat schema, enum
+        fields, native lib unavailable)."""
+        desc = self.msg_class.DESCRIPTOR
+        if any(_is_repeated(fd) or fd.type in (FD.TYPE_MESSAGE, FD.TYPE_GROUP,
+                                               FD.TYPE_ENUM)
+               for fd in desc.fields):
+            return None
+        try:
+            from ..native import lib as _native_lib
+
+            if _native_lib() is None:
+                return None
+        except Exception:
+            return None
+        syntax = _file_syntax(desc.file)
+        if syntax not in ("proto2", "proto3"):
+            # editions (and anything newer): per-field UTF-8/presence
+            # semantics this plan does not model — Python path only
+            return None
+        fnum, kinds, flags, dtypes, optional = [], [], [], [], []
+        for col in self.schema.columns:
+            fd = desc.fields_by_name[col.path[0]]
+            kd = _WIRE_KINDS.get(fd.type)
+            if kd is None:
+                return None
+            if fd.number > 65535:
+                # beyond the C++ decoder's direct-address field table;
+                # legal in proto (up to 2^29-1) but rare — Python path
+                return None
+            kind, dtype = kd
+            if kind == _K_SPAN and fd.type == FD.TYPE_STRING and syntax == "proto3":
+                kind = _K_SPAN_UTF8  # proto3 parsers reject invalid UTF-8
+            fnum.append(fd.number)
+            kinds.append(kind)
+            flags.append(_F_REQUIRED if _is_required(fd) else 0)
+            dtypes.append(dtype)
+            optional.append(_repetition_for(fd) == Repetition.OPTIONAL)
+        return _WirePlan(np.asarray(fnum, np.uint32),
+                         np.asarray(kinds, np.uint8),
+                         np.asarray(flags, np.uint8),
+                         dtypes, optional)
+
+    @property
+    def wire_capable(self) -> bool:
+        """True when columnarize_payloads can take the native path."""
+        plan = getattr(self, "_wire", False)
+        if plan is False:
+            plan = self._wire = self._wire_plan()
+        return plan is not None
+
+    def columnarize_payloads(self, payloads: list) -> ColumnBatch:
+        """Shred serialized (un-parsed) messages straight to a ColumnBatch
+        via the C++ wire decoder — no Python message objects.  Raises
+        WireShredError when any record needs the Python fallback; raises
+        ValueError when the schema is not wire-capable (check
+        :attr:`wire_capable` first)."""
+        if not self.wire_capable:
+            raise ValueError("schema is not wire-shreddable")
+        plan: _WirePlan = self._wire
+        from ..native import lib as _native_lib
+
+        L = _native_lib()
+        n = len(payloads)
+        lens = np.fromiter(map(len, payloads), np.int64, count=n)
+        offs = np.zeros(n + 1, np.int64)
+        np.cumsum(lens, out=offs[1:])
+        buf = b"".join(payloads)
+        nf = len(plan.fnum)
+        out_vals, out_pos, out_len, out_pres = [], [], [], []
+        for f in range(nf):
+            dt = plan.dtypes[f]
+            if dt is None:
+                out_vals.append(None)
+                out_pos.append(np.zeros(n, np.int64))
+                out_len.append(np.zeros(n, np.int32))
+            else:
+                out_vals.append(np.zeros(n, dt))
+                out_pos.append(None)
+                out_len.append(None)
+            out_pres.append(np.zeros(n, np.uint8) if plan.optional[f] else None)
+        err = L.proto_shred(buf, offs, nf, plan.fnum, plan.kinds, plan.flags,
+                            out_vals, out_pos, out_len, out_pres)
+        if err >= 0:
+            raise WireShredError(int(err))
+        chunks = []
+        for f, col in enumerate(self.schema.columns):
+            pres = out_pres[f]
+            def_levels = None
+            if pres is not None:
+                mask = pres.view(np.bool_)
+                def_levels = pres.astype(np.int32)
+            if plan.dtypes[f] is None:
+                pos, ln = out_pos[f], out_len[f]
+                if pres is not None:
+                    pos, ln = pos[mask], ln[mask]
+                offsets = np.zeros(len(ln) + 1, np.int64)
+                np.cumsum(ln, out=offsets[1:])
+                values = ByteColumn(L.gather_spans(buf, pos, ln), offsets)
+            else:
+                values = out_vals[f]
+                if pres is not None:
+                    values = values[mask]
+            chunks.append(ColumnChunkData(col, values, def_levels, None, n))
         return ColumnBatch(chunks, n)
 
     def columnarize(self, records) -> ColumnBatch:
@@ -259,7 +437,8 @@ class ProtoColumnarizer:
     @staticmethod
     def _emit_value(buf: _LeafBuffer, fd, value, r: int, d: int) -> None:
         if fd.type == FD.TYPE_STRING:
-            value = value.encode("utf-8")
+            value = (value.encode("utf-8") if isinstance(value, str)
+                     else bytes(value))
         elif fd.type == FD.TYPE_ENUM:
             ev = fd.enum_type.values_by_number.get(value)
             # open enums (proto3): unknown numbers survive parsing; encode a

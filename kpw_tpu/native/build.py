@@ -14,7 +14,8 @@ import tempfile
 
 _SRC_DIR = os.path.dirname(os.path.abspath(__file__))
 _SRCS = [os.path.join(_SRC_DIR, "src", "codecs.cc"),
-         os.path.join(_SRC_DIR, "src", "encode.cc")]
+         os.path.join(_SRC_DIR, "src", "encode.cc"),
+         os.path.join(_SRC_DIR, "src", "shred.cc")]
 _SO = os.path.join(_SRC_DIR, "_kpw_native.so")
 
 
@@ -145,6 +146,15 @@ class NativeLib:
         cdll.kpw_rle_hybrid_u32.restype = ctypes.c_int
         cdll.kpw_rle_hybrid_u32.argtypes = [
             c_u32p, c_sz, ctypes.c_int, c_p, ctypes.POINTER(c_sz)]
+        c_vpp = ctypes.POINTER(ctypes.c_void_p)
+        cdll.kpw_proto_shred.restype = ctypes.c_int64
+        cdll.kpw_proto_shred.argtypes = [
+            c_p, c_i64p, ctypes.c_int64, ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_uint32), c_p, c_p,
+            c_vpp, c_vpp, c_vpp, c_vpp]
+        cdll.kpw_gather_spans.restype = None
+        cdll.kpw_gather_spans.argtypes = [
+            c_p, c_i64p, c_i32p, ctypes.c_int64, c_p]
 
     # -- snappy ------------------------------------------------------------
     def snappy_compress(self, data: bytes) -> bytes:
@@ -315,6 +325,50 @@ class NativeLib:
         if rc != 0:
             raise RuntimeError(f"kpw_delta_bp rc={rc}")
         return out.raw[: out_len.value]
+
+    def proto_shred(self, buf: bytes, rec_offsets, n_fields: int,
+                    fnum, kinds, flags, out_vals, out_pos, out_len,
+                    out_pres) -> int:
+        """Batch wire-format decode (kpw_proto_shred).  ``out_*`` are lists
+        (len n_fields) of numpy arrays or None; returns the first failing
+        record index, or -1 when the whole batch decoded clean."""
+        import numpy as np
+
+        offs = np.ascontiguousarray(rec_offsets, np.int64)
+        n_rec = len(offs) - 1
+
+        def ptr_array(arrs):
+            a = (ctypes.c_void_p * n_fields)()
+            for i, arr in enumerate(arrs):
+                a[i] = arr.ctypes.data if arr is not None else None
+            return ctypes.cast(a, ctypes.POINTER(ctypes.c_void_p))
+
+        rc = self._c.kpw_proto_shred(
+            buf, offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            n_rec, n_fields,
+            np.ascontiguousarray(fnum, np.uint32).ctypes.data_as(
+                ctypes.POINTER(ctypes.c_uint32)),
+            bytes(np.ascontiguousarray(kinds, np.uint8)),
+            bytes(np.ascontiguousarray(flags, np.uint8)),
+            ptr_array(out_vals), ptr_array(out_pos), ptr_array(out_len),
+            ptr_array(out_pres))
+        if rc == -2:
+            raise RuntimeError("kpw_proto_shred: field number table overflow")
+        return rc
+
+    def gather_spans(self, src: bytes, pos, lens) -> bytes:
+        """Concatenate spans (pos[i], lens[i]) of ``src`` — the string-column
+        payload assembly after proto_shred."""
+        import numpy as np
+
+        p = np.ascontiguousarray(pos, np.int64)
+        ln = np.ascontiguousarray(lens, np.int32)
+        total = int(ln.sum(dtype=np.int64))
+        out = ctypes.create_string_buffer(max(total, 1))
+        self._c.kpw_gather_spans(
+            src, p.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            ln.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), len(p), out)
+        return out.raw[:total]
 
     def rle_hybrid(self, values, width: int) -> bytes:
         """RLE/bit-pack hybrid stream, byte-identical to

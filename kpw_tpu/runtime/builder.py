@@ -326,6 +326,9 @@ class Builder:
                 "cover max_expected_throughput * max_file_open_duration "
                 f"({self._offset_tracker_max_open_pages} * "
                 f"{self._offset_tracker_page_size} < {int(need)})")
+        # a custom parser (envelope stripping, transforms) disqualifies the
+        # wire-shred fast path: the raw payload is then NOT the message bytes
+        self._parser_is_default = self._parser is None
         if self._parser is None:
             self._parser = self._proto_class.FromString
         if self._group_id is None:

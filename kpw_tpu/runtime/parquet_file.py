@@ -62,6 +62,31 @@ class ParquetFile:
         self._batch.extend(records)
         self._num_records += len(records)
 
+    def append_batch(self, batch) -> None:
+        """Pure-memory append of an already-columnarized ColumnBatch (the
+        wire-shred fast path: records never exist as Python messages).
+        Cannot fail; pair with :meth:`maybe_flush_row_group` for the
+        retryable IO step.
+
+        Callers interleaving this with the record-buffer path must drain the
+        record buffer first (:meth:`flush_buffered`) or rows would reorder:
+        buffered records only reach the writer at the next threshold flush,
+        which would land them AFTER this batch."""
+        self._writer.append_batch(batch)
+        self._num_records += batch.num_rows
+
+    def flush_buffered(self) -> None:
+        """Columnarize + hand over any buffered records now (regardless of
+        the batch threshold).  Row-order seam between the record-buffer path
+        and :meth:`append_batch`.  Safe to retry: records move out of the
+        buffer before any IO can raise; a retried call re-runs only the
+        pending row-group flush."""
+        self._flush_batch()
+
+    def maybe_flush_row_group(self) -> None:
+        """Idempotent, retry-safe row-group flush for the fast path."""
+        self._writer.maybe_flush_row_group()
+
     def flush_if_full(self) -> None:
         """Idempotent: encodes the pending batch when it crossed the
         threshold; safe to retry after transient IO failures (records are

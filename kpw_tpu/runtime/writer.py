@@ -177,7 +177,10 @@ class _Worker:
             daemon=True,
         )
         self.current_file: ParquetFile | None = None
-        self._written_offsets: list[PartitionOffset] = []
+        # acks held until publish, as contiguous runs [partition, start, end)
+        # — poll batches arrive as runs, and per-record PartitionOffset
+        # bookkeeping was a measurable slice of the hot loop
+        self._written_runs: list[list[int]] = []
         self._file_records = 0
 
     def start(self) -> None:
@@ -198,6 +201,15 @@ class _Worker:
             # rotation loses its ~1% bound (same cap as the flush batch)
             poll_batch = min(max(64, b._batch_size),
                              _rotation_batch_cap(b._max_file_size))
+            # wire fast path: flat schemas shred serialized payloads straight
+            # to columnar via the C++ decoder — no Python message objects
+            # (the round-1 streaming bottleneck); errors fall back to the
+            # exact per-record Python path below, which owns the poison-pill
+            # policies.  Only valid when the payload IS the serialized
+            # message — a custom parser() transforms payloads, so it
+            # disqualifies the raw-bytes path.
+            use_wire = (getattr(b, "_parser_is_default", False)
+                        and self.p.columnarizer.wire_capable)
             while not self._stop.is_set():
                 if (self.current_file is not None
                         and self._is_file_timed_out()):
@@ -205,6 +217,10 @@ class _Worker:
                 recs = self.p.consumer.poll_many(poll_batch)
                 if not recs:
                     time.sleep(0.001)
+                    continue
+                if use_wire and self._try_wire_batch(recs):
+                    if self._is_file_full():
+                        self._finalize_current_file()
                     continue
                 parsed = []  # (record, message) — parsed in bulk so the
                 # per-record loop overhead amortizes (design capacity is
@@ -247,8 +263,7 @@ class _Worker:
                 self.current_file.append_records([m for _, m in parsed])
                 try_until_succeeds(self.current_file.flush_if_full,
                                    stop_event=self._stop)
-                self._written_offsets.extend(
-                    PartitionOffset(r.partition, r.offset) for r, _ in parsed)
+                self._note_written(r for r, _ in parsed)
                 self.p._written_records.mark(len(parsed))
                 self.p._written_bytes.mark(nbytes)
                 self._file_records += len(parsed)
@@ -258,6 +273,45 @@ class _Worker:
             pass
         except Exception:
             logger.exception("worker %d terminated", self.index)
+
+    def _try_wire_batch(self, recs) -> bool:
+        """Shred a poll batch through the native wire decoder and append it
+        columnar.  Returns False when any record needs the Python fallback
+        (the whole batch re-runs there; shredder outputs are discarded)."""
+        from ..models.proto_bridge import WireShredError
+
+        try:
+            batch = self.p.columnarizer.columnarize_payloads(
+                [r.value for r in recs])
+        except WireShredError:
+            return False
+        if self.current_file is None:
+            self._open_file()
+        # row order: records a fallback batch left in the file's record
+        # buffer are OLDER than this batch — hand them to the writer first
+        try_until_succeeds(self.current_file.flush_buffered,
+                           stop_event=self._stop)
+        self.current_file.append_batch(batch)  # pure memory
+        try_until_succeeds(self.current_file.maybe_flush_row_group,
+                           stop_event=self._stop)
+        self._note_written(recs)
+        self.p._written_records.mark(len(recs))
+        self.p._written_bytes.mark(sum(len(r.value) for r in recs))
+        self._file_records += len(recs)
+        return True
+
+    def _note_written(self, records) -> None:
+        """Fold records into the held ack runs (extends the last run when
+        contiguous in the same partition — the common case, since poll
+        batches are fetch-batch slices)."""
+        runs = self._written_runs
+        run = runs[-1] if runs else None
+        for r in records:
+            if run is not None and run[0] == r.partition and run[2] == r.offset:
+                run[2] += 1
+            else:
+                run = [r.partition, r.offset, r.offset + 1]
+                runs.append(run)
 
     def _is_file_timed_out(self) -> bool:
         return (time.time() - self.current_file.get_creation_time()
@@ -333,9 +387,9 @@ class _Worker:
         self._rename_and_move(f.path)
         self.current_file = None
         # ack strictly after durable publish (KPW.java:347-350)
-        for po in self._written_offsets:
-            self.p.consumer.ack(po)
-        self._written_offsets.clear()
+        for partition, start, end in self._written_runs:
+            self.p.consumer.ack_run(partition, start, end - start)
+        self._written_runs.clear()
 
     def _rename_and_move(self, tmp_path: str) -> None:
         # (KPW.java:359-378)

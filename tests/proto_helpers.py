@@ -21,14 +21,14 @@ def _field(name, number, ftype, label=_F.LABEL_OPTIONAL, type_name=None):
 _pool_counter = [0]
 
 
-def build_classes(file_name: str, messages: dict) -> dict:
+def build_classes(file_name: str, messages: dict, syntax: str = "proto2") -> dict:
     """messages: {MsgName: [FieldDescriptorProto, ...]} -> {MsgName: class}"""
     _pool_counter[0] += 1
     pool = descriptor_pool.DescriptorPool()
     fdp = descriptor_pb2.FileDescriptorProto(
         name=f"{file_name}_{_pool_counter[0]}.proto",
         package="kpwtest",
-        syntax="proto2",
+        syntax=syntax,
     )
     for msg_name, fields in messages.items():
         m = fdp.message_type.add(name=msg_name)

@@ -1,0 +1,175 @@
+"""Native wire-format shredder (kpw_proto_shred) vs the Python columnarizer.
+
+The C++ fast path must produce a ColumnBatch identical to
+ProtoColumnarizer.columnarize() over parsed messages — same values, same
+def levels, same ByteColumn payloads — and must flag (not mis-decode) every
+record the Python parser would reject, so the worker's fallback keeps exact
+poison-pill semantics (reference KafkaProtoParquetWriter.java:271-275)."""
+
+import numpy as np
+import pytest
+
+from kpw_tpu.core.bytecol import ByteColumn
+from kpw_tpu.models.proto_bridge import ProtoColumnarizer, WireShredError
+
+from proto_helpers import build_classes, _field, _F
+
+
+def wide_message_class(syntax="proto2"):
+    """Every wire-shreddable field type in one flat message."""
+    label = _F.LABEL_OPTIONAL if syntax == "proto3" else _F.LABEL_REQUIRED
+    fields = [
+        _field("i64", 1, _F.TYPE_INT64, label),
+        _field("u64", 2, _F.TYPE_UINT64),
+        _field("s64", 3, _F.TYPE_SINT64),
+        _field("f64", 4, _F.TYPE_FIXED64),
+        _field("sf64", 5, _F.TYPE_SFIXED64),
+        _field("i32", 6, _F.TYPE_INT32),
+        _field("u32", 7, _F.TYPE_UINT32),
+        _field("s32", 8, _F.TYPE_SINT32),
+        _field("f32", 9, _F.TYPE_FIXED32),
+        _field("sf32", 10, _F.TYPE_SFIXED32),
+        _field("b", 11, _F.TYPE_BOOL),
+        _field("d", 12, _F.TYPE_DOUBLE),
+        _field("fl", 13, _F.TYPE_FLOAT),
+        _field("s", 14, _F.TYPE_STRING),
+        _field("by", 15, _F.TYPE_BYTES),
+        # a high field number exercises the lookup table sizing
+        _field("hi", 1234, _F.TYPE_INT64),
+    ]
+    return build_classes("wide", {"Wide": fields}, syntax=syntax)["Wide"]
+
+
+def random_wide(cls, rng, i, syntax="proto2"):
+    m = cls()
+    m.i64 = int(rng.integers(-1 << 62, 1 << 62))
+    if syntax == "proto3" or rng.random() < 0.8:  # proto2: leave some unset
+        m.u64 = int(rng.integers(0, 1 << 63)) * 2 + int(rng.integers(0, 2))
+        m.s64 = int(rng.integers(-1 << 62, 1 << 62))
+        m.f64 = int(rng.integers(0, 1 << 63)) * 2 + int(rng.integers(0, 2))
+        m.sf64 = int(rng.integers(-1 << 62, 1 << 62))
+        m.i32 = int(rng.integers(-1 << 31, 1 << 31))
+        m.u32 = int(rng.integers(0, 1 << 32))
+        m.s32 = int(rng.integers(-1 << 31, 1 << 31))
+        m.f32 = int(rng.integers(0, 1 << 32))
+        m.sf32 = int(rng.integers(-1 << 31, 1 << 31))
+        m.b = bool(rng.integers(0, 2))
+        m.d = float(rng.normal())
+        m.fl = float(np.float32(rng.normal()))
+        m.s = f"héllo-{i}-" + "x" * int(rng.integers(0, 20))
+        m.by = rng.bytes(int(rng.integers(0, 16)))
+        m.hi = i
+    return m
+
+
+def assert_batches_equal(a, b):
+    assert a.num_rows == b.num_rows
+    for ca, cb in zip(a.chunks, b.chunks):
+        assert ca.column.path == cb.column.path
+        if isinstance(ca.values, np.ndarray):
+            np.testing.assert_array_equal(ca.values, cb.values)
+        else:
+            va = list(ca.values) if isinstance(ca.values, ByteColumn) else ca.values
+            vb = list(cb.values) if isinstance(cb.values, ByteColumn) else cb.values
+            assert va == vb
+        # None means "all present at max level" (required) / "no repetition"
+        # — normalize so an all-NULL zeros array can never pass as equal
+        n = a.num_rows
+        for attr, full in (("def_levels", ca.column.max_def),
+                           ("rep_levels", 0)):
+            la, lb = getattr(ca, attr), getattr(cb, attr)
+            la = la if la is not None else np.full(n, full, np.int32)
+            lb = lb if lb is not None else np.full(n, full, np.int32)
+            np.testing.assert_array_equal(la, lb)
+
+
+@pytest.mark.parametrize("syntax", ["proto2", "proto3"])
+def test_wire_shred_matches_python(syntax):
+    cls = wide_message_class(syntax)
+    colz = ProtoColumnarizer(cls)
+    assert colz.wire_capable
+    rng = np.random.default_rng(17)
+    msgs = [random_wide(cls, rng, i, syntax) for i in range(500)]
+    payloads = [m.SerializeToString() for m in msgs]
+    got = colz.columnarize_payloads(payloads)
+    want = colz.columnarize([cls.FromString(p) for p in payloads])
+    assert_batches_equal(got, want)
+
+
+def test_wire_shred_rejects_what_python_rejects():
+    cls = wide_message_class("proto2")
+    colz = ProtoColumnarizer(cls)
+    ok = random_wide(cls, np.random.default_rng(0), 0).SerializeToString()
+
+    # truncated payload (mid-field: drop the final varint's value byte)
+    with pytest.raises(WireShredError) as ei:
+        colz.columnarize_payloads([ok, ok[:-1], ok])
+    assert ei.value.record_index == 1
+    with pytest.raises(Exception):
+        cls.FromString(ok[:-1])
+
+    # missing proto2 required field (i64 is field 1): the shredder flags it
+    # so the Python fallback decides — this runtime's FromString (upb)
+    # tolerates it (IsInitialized()=False) and the fallback encodes defaults;
+    # the Java reference parser would throw.  Either way the fallback, not
+    # the fast path, owns the semantics.
+    m = cls()
+    m.u64 = 7
+    bad = m.SerializePartialToString()
+    with pytest.raises(WireShredError):
+        colz.columnarize_payloads([bad])
+    assert not cls.FromString(bad).IsInitialized()
+
+    # garbage bytes
+    with pytest.raises(WireShredError):
+        colz.columnarize_payloads([b"\xff\xff\xff\xff"])
+
+
+def test_wire_shred_proto3_utf8_and_defaults():
+    cls = wide_message_class("proto3")
+    colz = ProtoColumnarizer(cls)
+    # invalid UTF-8 in a proto3 string field -> flagged (Python parser raises)
+    m = cls()
+    m.by = b"fine"
+    good = m.SerializeToString()
+    # field 14 (string "s"), wire type 2, bad continuation byte
+    bad = good + bytes([14 << 3 | 2, 2, 0xC3, 0x28])
+    with pytest.raises(WireShredError):
+        colz.columnarize_payloads([bad])
+    with pytest.raises(Exception):
+        cls.FromString(bad)
+
+    # absent proto3 fields decode as defaults, matching the Python path
+    empty = cls().SerializeToString()
+    got = colz.columnarize_payloads([empty, good])
+    want = colz.columnarize([cls.FromString(empty), cls.FromString(good)])
+    assert_batches_equal(got, want)
+
+
+def test_wire_shred_unknown_fields_and_last_wins():
+    cls = wide_message_class("proto2")
+    colz = ProtoColumnarizer(cls)
+    base = random_wide(cls, np.random.default_rng(3), 0).SerializeToString()
+    # append an unknown varint field (#99: tag 792 -> 0xB8 0x06) and an
+    # unknown length-delimited (#100: tag 802 -> 0xA2 0x06), then a second
+    # occurrence of i64 (#1) — last value must win
+    extra = bytes([0xB8, 0x06, 42]) + bytes([0xA2, 0x06, 3]) + b"abc"
+    rewrite = extra + bytes([1 << 3 | 0, 9])  # i64 = 9
+    payload = base + rewrite
+    got = colz.columnarize_payloads([payload])
+    want = colz.columnarize([cls.FromString(payload)])
+    assert_batches_equal(got, want)
+    i64_col = [c for c in got.chunks if c.column.path == ("i64",)][0]
+    assert i64_col.values[0] == 9
+
+
+def test_wire_plan_fallbacks():
+    """Schemas outside the fast path report not-capable instead of lying."""
+    from proto_helpers import nested_message_classes, sample_message_class
+
+    assert not ProtoColumnarizer(nested_message_classes()).wire_capable
+    assert ProtoColumnarizer(sample_message_class()).wire_capable
+    enum_cls = build_classes("withenum", {"E": [
+        _field("x", 1, _F.TYPE_INT64),
+    ]})["E"]
+    assert ProtoColumnarizer(enum_cls).wire_capable

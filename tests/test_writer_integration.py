@@ -515,3 +515,55 @@ def test_builder_compression_level():
         (Builder().broker(broker).topic("t").proto_class(cls)
          .target_dir("/x").filesystem(MemoryFileSystem())
          .compression_level(3).build())
+
+
+def test_wire_fallback_preserves_row_order():
+    """A poison pill routes one poll batch through the Python path (buffered
+    below the flush threshold); the next clean batch takes the wire fast
+    path.  Published rows must still be in offset order — the fast path must
+    drain the older buffered records first."""
+    broker = FakeBroker()
+    broker.create_topic(TOPIC, 1)
+    fs = MemoryFileSystem()
+    cls = sample_message_class()
+    for i in range(10):
+        broker.produce(TOPIC, cls(query=f"a-{i}", timestamp=i).SerializeToString())
+    broker.produce(TOPIC, b"\xff\xff\xff\xff")  # pill -> Python path batch
+    for i in range(10, 20):
+        broker.produce(TOPIC, cls(query=f"a-{i}", timestamp=i).SerializeToString())
+    w = make_writer_builder(
+        broker, fs, cls,
+        batch_size=1024,  # buffered records stay below the flush threshold
+        on_parse_error="skip",
+        max_file_open_duration_seconds=0.8,
+    ).build()
+    with w:
+        files = wait_for_files(fs, "/out", ".parquet", 1)
+        rows = read_messages(fs, files)
+    assert [r["timestamp"] for r in rows] == list(range(20))
+
+
+def test_custom_parser_disables_wire_path():
+    """Builder.parser() transforms payloads, so the raw-bytes wire shred
+    must not engage — content comes from the parser, not the wire."""
+    broker = FakeBroker()
+    broker.create_topic(TOPIC, 1)
+    fs = MemoryFileSystem()
+    cls = sample_message_class()
+
+    def enveloped(b: bytes):
+        return cls.FromString(b[4:])  # strip a 4-byte envelope
+
+    for i in range(50):
+        broker.produce(
+            TOPIC, b"ENV!" + cls(query=f"e-{i}", timestamp=i).SerializeToString())
+    w = make_writer_builder(
+        broker, fs, cls,
+        parser=enveloped,
+        max_file_open_duration_seconds=0.8,
+    ).build()
+    with w:
+        files = wait_for_files(fs, "/out", ".parquet", 1)
+        rows = read_messages(fs, files)
+    assert sorted(r["timestamp"] for r in rows) == list(range(50))
+    assert all(r["query"].startswith("e-") for r in rows)
