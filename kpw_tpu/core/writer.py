@@ -11,6 +11,9 @@ KafkaProtoParquetWriter.java:473).
 from __future__ import annotations
 
 import io
+import queue
+import threading
+import time
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -22,6 +25,14 @@ from .schema import PhysicalType, Schema
 from ..utils.tracing import stage
 
 MAGIC = b"PAR1"
+
+
+class PipelineError(RuntimeError):
+    """A pipeline stage failed after its row group was detached from the
+    pending buffer: the data cannot be recovered by retrying, so the writer
+    is poisoned — every subsequent operation re-raises.  Deliberately NOT an
+    OSError: the runtime's infinite-IO-retry must not spin on it; the worker
+    dies un-acked and the records are redelivered (at-least-once)."""
 
 
 @dataclass
@@ -71,7 +82,7 @@ class ParquetFileWriter:
     """
 
     def __init__(self, sink, schema: Schema, properties: WriterProperties | None = None,
-                 encoder=None) -> None:
+                 encoder=None, pipeline: bool = False) -> None:
         self.sink = sink
         self.schema = schema
         self.properties = properties or WriterProperties()
@@ -83,6 +94,19 @@ class ParquetFileWriter:
         self._pending_bytes = 0
         self._num_rows = 0
         self._closed = False
+        # 3-stage pipeline (SURVEY.md §2.4): caller accumulates batch N+2
+        # while the encode thread encodes row group N+1 and the IO thread
+        # writes row group N.  Bounded queues (depth 1 each) cap in-flight
+        # memory at ~3 row groups and backpressure the producer naturally.
+        self._pipeline = pipeline
+        self._enc_q: queue.Queue | None = None
+        self._io_q: queue.Queue | None = None
+        self._enc_thread: threading.Thread | None = None
+        self._io_thread: threading.Thread | None = None
+        self._inflight_bytes = 0  # detached but not yet durable (estimate)
+        self._inflight_lock = threading.Lock()  # += / -= from two threads
+        self._pipe_error: BaseException | None = None
+        self._abandoned = threading.Event()
         self._write(MAGIC)
 
     # -- low level ---------------------------------------------------------
@@ -105,10 +129,11 @@ class ParquetFileWriter:
         return self._pos
 
     def estimated_size(self) -> int:
-        """In-flight size estimate: bytes on disk + buffered batch estimate.
-        The reference's rotation check reads in-flight ParquetWriter
-        getDataSize() (ParquetFile.java:77-79); this is the equivalent."""
-        return self._pos + self._pending_bytes
+        """In-flight size estimate: bytes on disk + buffered batch estimate
+        + row groups queued in the pipeline.  The reference's rotation check
+        reads in-flight ParquetWriter getDataSize() (ParquetFile.java:77-79);
+        this is the equivalent."""
+        return self._pos + self._pending_bytes + self._inflight_bytes
 
     def append_batch(self, batch: ColumnBatch) -> None:
         """Pure-memory append: buffers the batch, never touches the sink
@@ -128,9 +153,194 @@ class ParquetFileWriter:
 
     def maybe_flush_row_group(self) -> None:
         """Flush iff the pending bytes crossed row_group_size (idempotent,
-        retry-safe)."""
+        retry-safe).  In pipeline mode the flush is handed to the encode/IO
+        threads and this returns as soon as the detach is queued."""
         if self._pending_bytes >= self.properties.row_group_size:
-            self.flush_row_group()
+            if self._pipeline:
+                self._launch_flush()
+            else:
+                self.flush_row_group()
+
+    # -- pipelined flush ---------------------------------------------------
+    def _check_pipe_error(self) -> None:
+        """Poisoned-writer check: once a stage failed with detached data the
+        error is permanent (never cleared) — retrying cannot recover the
+        dropped row group, and acking its offsets would break at-least-once."""
+        if self._pipe_error is not None:
+            raise PipelineError(
+                "row-group pipeline failed; file must be abandoned"
+            ) from self._pipe_error
+
+    def _ensure_pipe(self) -> None:
+        if self._enc_thread is not None:
+            return
+        self._enc_q = queue.Queue(maxsize=1)
+        self._io_q = queue.Queue(maxsize=1)
+        self._enc_thread = threading.Thread(
+            target=self._encode_loop, name="kpw-rg-encode", daemon=True)
+        self._io_thread = threading.Thread(
+            target=self._io_loop, name="kpw-rg-io", daemon=True)
+        self._enc_thread.start()
+        self._io_thread.start()
+
+    def _launch_flush(self) -> None:
+        """Detach the pending row group and queue it for encode+IO.  Blocks
+        (bounded queue) when two row groups are already in flight."""
+        self._check_pipe_error()
+        if not self._pending or self._pending_rows == 0:
+            return
+        self._ensure_pipe()
+        parts, rows = self._pending, self._pending_rows
+        est = self._pending_bytes
+        self._pending = None
+        self._pending_rows = 0
+        self._pending_bytes = 0
+        with self._inflight_lock:
+            self._inflight_bytes += est
+        self._enc_q.put((parts, rows, est))
+
+    def _encode_chunks(self, chunks: list[ColumnChunkData]):
+        """Encode merged chunks at base offset 0 (absolute offsets are
+        assigned at commit time) — shared by the sync and pipelined paths."""
+        with stage("rowgroup.encode"):
+            if hasattr(self.encoder, "encode_many"):
+                return self.encoder.encode_many(chunks, 0)
+            encoded, off = [], 0
+            for chunk in chunks:
+                e = self.encoder.encode(chunk, off)
+                off += len(e.blob)
+                encoded.append(e)
+            return encoded
+
+    def _relay_io_sentinel(self) -> None:
+        """Tell the IO thread to exit; never blocks forever (the IO thread
+        may already be gone after an abandon)."""
+        while True:
+            try:
+                self._io_q.put(None, timeout=0.2)
+                return
+            except queue.Full:
+                if self._abandoned.is_set():
+                    return  # IO thread drains or exits on its own timeout
+
+    def _encode_loop(self) -> None:
+        """Stage B: merge + encode one row group at a time, at base offset 0
+        (absolute offsets are assigned by the IO stage — the native encoder
+        does the same shift for its column-parallel path)."""
+        while True:
+            try:
+                item = self._enc_q.get(timeout=0.2)
+            except queue.Empty:
+                if self._abandoned.is_set():
+                    self._relay_io_sentinel()
+                    return
+                continue
+            if item is None:
+                self._relay_io_sentinel()
+                return
+            if self._abandoned.is_set() or self._pipe_error is not None:
+                continue  # drain without work (abandoned or poisoned)
+            parts, rows, est = item
+            try:
+                encoded = self._encode_chunks(
+                    [self._merge_chunks(p) for p in parts])
+                self._io_q.put((encoded, rows, est))
+            except BaseException as e:  # noqa: BLE001 - poisons the writer
+                self._pipe_error = e
+                with self._inflight_lock:
+                    self._inflight_bytes -= est
+
+    def _io_loop(self) -> None:
+        """Stage C: sequential positioned writes + footer bookkeeping.
+        Transient IO failures retry forever (reference tryUntilSucceeds,
+        KPW.java:410-428) unless the file is abandoned; anything else
+        poisons the writer rather than killing this thread silently."""
+        while True:
+            try:
+                item = self._io_q.get(timeout=0.2)
+            except queue.Empty:
+                if self._abandoned.is_set():
+                    return
+                continue
+            if item is None:
+                return
+            if self._abandoned.is_set():
+                continue
+            encoded, rows, est = item
+            while not self._abandoned.is_set() and self._pipe_error is None:
+                try:
+                    self._commit_encoded(encoded, rows)
+                    break
+                except OSError:
+                    time.sleep(0.1)
+                except BaseException as e:  # noqa: BLE001 - poison, don't die
+                    self._pipe_error = e
+            with self._inflight_lock:
+                self._inflight_bytes -= est
+
+    def _commit_encoded(self, encoded_chunks, num_rows: int) -> None:
+        """Write encoded-at-offset-0 chunks at the current position and
+        record the row group.  Raises before any state change on IO failure
+        (the positioned _write seeks back on retry)."""
+        rg_start = self._pos
+        blobs = []
+        columns: list[ColumnChunk] = []
+        total_byte_size = 0
+        total_compressed = 0
+        for e in encoded_chunks:
+            m = e.meta
+            blobs.append(e.blob)
+            total_byte_size += m.total_uncompressed_size
+            total_compressed += m.total_compressed_size
+        with stage("rowgroup.io_write"):
+            self._write(b"".join(blobs))  # raises => nothing mutated yet
+        for e in encoded_chunks:
+            # metas carry running offsets based at 0 (encode_many's base);
+            # shift the whole row group to its absolute file position
+            m = e.meta
+            if m.dictionary_page_offset is not None:
+                m.dictionary_page_offset += rg_start
+            m.data_page_offset += rg_start
+            columns.append(ColumnChunk(file_offset=m.data_page_offset,
+                                       meta_data=m))
+        self._row_groups.append(RowGroup(
+            columns=columns,
+            total_byte_size=total_byte_size,
+            num_rows=num_rows,
+            file_offset=rg_start,
+            total_compressed_size=total_compressed,
+            ordinal=len(self._row_groups),
+        ))
+        self._num_rows += num_rows
+
+    def _drain_pipe(self) -> None:
+        """Flush the tail through the pipeline and join both threads."""
+        if self._enc_thread is None:
+            return
+        self._enc_q.put(None)
+        self._enc_thread.join()
+        self._io_thread.join()
+        self._enc_thread = self._io_thread = None
+        self._check_pipe_error()
+
+    def abandon(self) -> None:
+        """Stop pipeline threads without finishing the file (the reference
+        abandons the open tmp on close — KPW.java:381-398)."""
+        self._abandoned.set()
+        if self._enc_thread is not None:
+            try:
+                self._enc_q.put_nowait(None)
+            except queue.Full:
+                pass
+            self._enc_thread.join(timeout=10)
+            if self._io_thread is not None:
+                try:
+                    self._io_q.put_nowait(None)
+                except queue.Full:
+                    pass
+                self._io_thread.join(timeout=10)
+            self._enc_thread = self._io_thread = None
+        self._closed = True
 
     def write_batch(self, batch: ColumnBatch) -> None:
         """Append a batch; flushes a row group when the threshold crosses.
@@ -180,53 +390,25 @@ class ParquetFileWriter:
         """Transactional: encode everything, then write, and only then mutate
         writer state — so a transient IO failure leaves ``_pending`` intact
         and a retried flush re-encodes and overwrites (no dropped rows, no
-        desynced offsets)."""
+        desynced offsets).  Same encode-at-0 + commit path the pipeline
+        threads use (one bookkeeping implementation, byte-identical)."""
         if not self._pending or self._pending_rows == 0:
             return
         chunks = [self._merge_chunks(parts) for parts in self._pending]
         num_rows = self._pending_rows
-
-        rg_start = self._pos
-        columns: list[ColumnChunk] = []
-        blobs: list[bytes] = []
-        total_byte_size = 0
-        total_compressed = 0
-        with stage("rowgroup.encode"):
-            if hasattr(self.encoder, "encode_many"):
-                encoded_chunks = self.encoder.encode_many(chunks, rg_start)
-            else:
-                encoded_chunks, offset = [], rg_start
-                for chunk in chunks:
-                    e = self.encoder.encode(chunk, offset)
-                    offset += len(e.blob)
-                    encoded_chunks.append(e)
-        for encoded in encoded_chunks:
-            blobs.append(encoded.blob)
-            columns.append(ColumnChunk(
-                file_offset=encoded.meta.data_page_offset,
-                meta_data=encoded.meta,
-            ))
-            total_byte_size += encoded.meta.total_uncompressed_size
-            total_compressed += encoded.meta.total_compressed_size
-        with stage("rowgroup.io_write"):
-            self._write(b"".join(blobs))  # raises => state untouched, retry safe
+        encoded_chunks = self._encode_chunks(chunks)
+        self._commit_encoded(encoded_chunks, num_rows)  # raises => retry safe
         self._pending = None
         self._pending_rows = 0
         self._pending_bytes = 0
-        self._row_groups.append(RowGroup(
-            columns=columns,
-            total_byte_size=total_byte_size,
-            num_rows=num_rows,
-            file_offset=rg_start,
-            total_compressed_size=total_compressed,
-            ordinal=len(self._row_groups),
-        ))
-        self._num_rows += num_rows
 
     def close(self) -> None:
         if self._closed:
             return
-        self.flush_row_group()
+        if self._pipeline and self._enc_thread is not None:
+            self._launch_flush()  # tail row group rides the pipe, in order
+            self._drain_pipe()
+        self.flush_row_group()  # no-op unless something is still pending
         meta = FileMetaData(
             schema_fields=self.schema.flatten(),
             num_rows=self._num_rows,

@@ -55,6 +55,7 @@ class Builder:
         self._metric_registry = None
         self._filesystem: FileSystem | None = None
         self._backend = "cpu"
+        self._pipeline = True  # 3-stage ingest/encode/flush overlap
         self._batch_size = 4096
         self._on_parse_error = "raise"  # parity: poison pill kills the worker
         self._clean_abandoned_tmp = False  # opt-in tmp GC at start()
@@ -217,6 +218,14 @@ class Builder:
 
     def batch_size(self, n: int) -> "Builder":
         self._batch_size = n
+        return self
+
+    def pipeline(self, flag: bool) -> "Builder":
+        """Overlap ingest/shred, row-group encode, and IO in three stages
+        per worker (SURVEY.md §2.4 pipeline parallelism — the reference's
+        hot loop is serial).  On by default; disable for strictly
+        single-threaded operation."""
+        self._pipeline = flag
         return self
 
     def clean_abandoned_tmp(self, flag: bool) -> "Builder":

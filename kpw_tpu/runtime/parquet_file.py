@@ -28,12 +28,14 @@ class ParquetFile:
         properties: WriterProperties,
         batch_size: int = 4096,
         encoder=None,
+        pipeline: bool = False,
     ) -> None:
         self.path = path
         self._fs = fs
         self._sink = fs.open_write(path)
         self._writer = ParquetFileWriter(self._sink, columnarizer.schema,
-                                         properties, encoder=encoder)
+                                         properties, encoder=encoder,
+                                         pipeline=pipeline)
         self._columnarizer = columnarizer
         self._batch: list = []
         self._batch_size = batch_size
@@ -101,6 +103,16 @@ class ParquetFile:
             return
         self._flush_batch()
         self._writer.close()
+        self._sink.close()
+        self._closed = True
+
+    def abandon(self) -> None:
+        """Drop the file without footer or publish (reference close-time
+        semantics: the open tmp is abandoned, KPW.java:381-398).  Stops any
+        pipeline threads so a rotated-away worker leaks nothing."""
+        if self._closed:
+            return
+        self._writer.abandon()
         self._sink.close()
         self._closed = True
 

@@ -189,9 +189,13 @@ class _Worker:
     def close(self) -> None:
         """Stop; the open tmp file is abandoned, its offsets never acked —
         those records are redelivered on restart (at-least-once;
-        KPW.java:381-398 + SURVEY §3.5 note)."""
+        KPW.java:381-398 + SURVEY §3.5 note).  Abandoning also stops the
+        file's pipeline threads."""
         self._stop.set()
         self._thread.join(timeout=30)
+        if self.current_file is not None:
+            self.current_file.abandon()
+            self.current_file = None
 
     # -- loop (KPW.java:253-292) -------------------------------------------
     def _run(self) -> None:
@@ -356,6 +360,7 @@ class _Worker:
                 self.p.properties,
                 batch_size=batch,
                 encoder=self.p._encoder_factory(),
+                pipeline=self.p._b._pipeline,
             )
 
         self.current_file = try_until_succeeds(make, stop_event=self._stop)

@@ -420,3 +420,114 @@ def test_byte_column_end_to_end_statistics():
     buf.seek(0)
     col = pq.read_metadata(buf).row_group(0).column(0)
     assert col.statistics.min == "a" and col.statistics.max == "z"
+
+
+def test_pipelined_writer_byte_identical():
+    """The 3-stage pipelined writer (encode thread + IO thread) must produce
+    byte-for-byte the same file as the synchronous path, across multiple row
+    groups, dictionary + plain columns, and a tail partial group."""
+    import io as _io
+
+    import numpy as np
+
+    from kpw_tpu.core import (ParquetFileWriter, Schema, WriterProperties,
+                              columns_from_arrays, leaf)
+    from kpw_tpu.core.bytecol import ByteColumn
+
+    rng = np.random.default_rng(11)
+    schema = Schema([leaf("a", "int64"), leaf("b", "int32"),
+                     leaf("s", "string")])
+    props = WriterProperties(row_group_size=40_000, data_page_size=8_000)
+    pool = [f"v{j}".encode() for j in range(50)]
+
+    def batches():
+        for i in range(7):
+            n = 1500 if i < 6 else 333  # tail partial row group
+            yield columns_from_arrays(schema, {
+                "a": rng.integers(0, 1000, n).astype(np.int64),
+                "b": rng.integers(-50, 50, n).astype(np.int32),
+                "s": ByteColumn.from_list(
+                    [pool[k] for k in rng.integers(0, 50, n)]),
+            })
+
+    outs = {}
+    for pipe in (False, True):
+        rng = np.random.default_rng(11)  # same data both runs
+        buf = _io.BytesIO()
+        w = ParquetFileWriter(buf, schema, props, pipeline=pipe)
+        for b in batches():
+            w.append_batch(b)
+            w.maybe_flush_row_group()
+        w.close()
+        outs[pipe] = buf.getvalue()
+    assert outs[True] == outs[False]
+    assert len(outs[True]) > 40_000  # several row groups actually happened
+
+    import pyarrow.parquet as pq
+
+    t = pq.read_table(_io.BytesIO(outs[True]))
+    assert t.num_rows == 6 * 1500 + 333
+    assert pq.read_metadata(_io.BytesIO(outs[True])).num_row_groups >= 3
+
+
+def test_pipelined_writer_abandon_stops_threads():
+    import io as _io
+    import threading
+
+    import numpy as np
+
+    from kpw_tpu.core import (ParquetFileWriter, Schema, WriterProperties,
+                              columns_from_arrays, leaf)
+
+    schema = Schema([leaf("a", "int64")])
+    before = threading.active_count()
+    buf = _io.BytesIO()
+    w = ParquetFileWriter(buf, schema,
+                          WriterProperties(row_group_size=1000),
+                          pipeline=True)
+    for _ in range(5):
+        w.append_batch(columns_from_arrays(
+            schema, {"a": np.arange(500, dtype=np.int64)}))
+        w.maybe_flush_row_group()
+    w.abandon()
+    deadline = __import__("time").time() + 5
+    while threading.active_count() > before and __import__("time").time() < deadline:
+        __import__("time").sleep(0.01)
+    assert threading.active_count() <= before
+
+
+def test_pipelined_writer_poisoned_on_encode_failure():
+    """An encode failure after detach cannot be retried (the row group left
+    the pending buffer): the writer must poison permanently — close() raises
+    PipelineError, never writes a footer, and never clears the error —
+    so the runtime abandons the file and the records get redelivered."""
+    import io as _io
+
+    import numpy as np
+    import pytest as _pytest
+
+    from kpw_tpu.core import (ParquetFileWriter, Schema, WriterProperties,
+                              columns_from_arrays, leaf)
+    from kpw_tpu.core.pages import CpuChunkEncoder
+    from kpw_tpu.core.writer import PipelineError
+
+    class Exploding(CpuChunkEncoder):
+        def encode_many(self, chunks, base_offset):
+            raise ValueError("boom")
+
+    schema = Schema([leaf("a", "int64")])
+    buf = _io.BytesIO()
+    props = WriterProperties(row_group_size=1000)
+    w = ParquetFileWriter(buf, schema, pipeline=True, properties=props,
+                          encoder=Exploding(props.encoder_options()))
+    w.append_batch(columns_from_arrays(schema, {"a": np.arange(500, dtype=np.int64)}))
+    w.maybe_flush_row_group()  # detaches; encode thread explodes async
+    deadline = __import__("time").time() + 5
+    while w._pipe_error is None and __import__("time").time() < deadline:
+        __import__("time").sleep(0.01)
+    with _pytest.raises(PipelineError):
+        w.close()
+    with _pytest.raises(PipelineError):  # poison is permanent
+        w.close()
+    assert not buf.getvalue().endswith(b"PAR1") or len(buf.getvalue()) == 4
+    w.abandon()
