@@ -179,6 +179,27 @@ class CpuChunkEncoder:
         (the TPU delta planner)."""
         return self._values_body(chunk.values[va:vb], pt, encoding)
 
+    def _values_page_parts(self, chunk: "ColumnChunkData", va: int, vb: int,
+                           pt: int, encoding: int) -> list:
+        """Value body as a list of buffers (bytes/memoryview).  Default wraps
+        the single-body boundary; backends override to avoid materializing
+        big concatenations (e.g. DELTA_LENGTH_BYTE_ARRAY = tiny delta header
+        + multi-MB payload) when the codec can stream parts."""
+        return [self._values_page_body(chunk, va, vb, pt, encoding)]
+
+    def _compress_parts(self, parts: list, body_len: int):
+        """Compress a page given as buffer parts.  Returns (buffer, length);
+        buffer is None for UNCOMPRESSED (caller appends the parts verbatim).
+        The returned buffer may be scratch reused by the NEXT page — consume
+        immediately."""
+        opts = self.options
+        if opts.codec == Codec.UNCOMPRESSED:
+            return None, body_len
+        data = parts[0] if len(parts) == 1 else b"".join(parts)
+        comp = compress(bytes(data) if not isinstance(data, bytes) else data,
+                        opts.codec, opts.compression_level)
+        return comp, len(comp)
+
     def _levels_page_blob(self, chunk: "ColumnChunkData", a: int, b: int) -> bytes:
         """rep + def level streams for slots [a, b) — the per-page boundary
         the TPU backend overrides with planned device-encoded bodies."""
@@ -296,19 +317,20 @@ class CpuChunkEncoder:
         data_page_offset = None
 
         if use_dict:
-            body = dict_plain
-            comp = compress(body, opts.codec, opts.compression_level)
+            comp_buf, comp_len = self._compress_parts([dict_plain],
+                                                      len(dict_plain))
             header = write_page_header(
                 PageType.DICTIONARY_PAGE,
-                len(body),
-                len(comp),
+                len(dict_plain),
+                comp_len,
                 dict_header=DictionaryPageHeader(len(dict_values), Encoding.PLAIN_DICTIONARY),
             )
             dictionary_page_offset = base_offset
-            blob += header + comp
-            dict_page_len = len(header) + len(comp)
-            total_uncompressed += len(header) + len(body)
-            total_compressed += len(header) + len(comp)
+            blob += header
+            blob += dict_plain if comp_buf is None else comp_buf
+            dict_page_len = len(header) + comp_len
+            total_uncompressed += len(header) + len(dict_plain)
+            total_compressed += len(header) + comp_len
             value_encoding = Encoding.PLAIN_DICTIONARY
             encodings.update([Encoding.PLAIN_DICTIONARY, Encoding.RLE])
         else:
@@ -329,16 +351,19 @@ class CpuChunkEncoder:
                 va, vb = a, b
             levels_blob = self._levels_page_blob(chunk, a, b)
             if use_dict:
-                values_body = self._indices_body(indices, va, vb, len(dict_values))
+                parts = [self._indices_body(indices, va, vb,
+                                            len(dict_values))]
             else:
-                values_body = self._values_page_body(chunk, va, vb, pt,
-                                                     value_encoding)
-            body = levels_blob + values_body
-            comp = compress(body, opts.codec, opts.compression_level)
+                parts = self._values_page_parts(chunk, va, vb, pt,
+                                                value_encoding)
+            if levels_blob:
+                parts.insert(0, levels_blob)
+            body_len = sum(len(p) for p in parts)
+            comp_buf, comp_len = self._compress_parts(parts, body_len)
             header = write_page_header(
                 PageType.DATA_PAGE,
-                len(body),
-                len(comp),
+                body_len,
+                comp_len,
                 data_header=DataPageHeader(
                     num_values=b - a,
                     encoding=value_encoding,
@@ -348,9 +373,14 @@ class CpuChunkEncoder:
             )
             if data_page_offset is None:
                 data_page_offset = base_offset + len(blob)
-            blob += header + comp
-            total_uncompressed += len(header) + len(body)
-            total_compressed += len(header) + len(comp)
+            blob += header
+            if comp_buf is None:
+                for p in parts:  # uncompressed: append verbatim, no concat
+                    blob += p
+            else:
+                blob += comp_buf
+            total_uncompressed += len(header) + body_len
+            total_compressed += len(header) + comp_len
 
         stats = None
         if opts.write_statistics:

@@ -406,8 +406,15 @@ class ParquetFileWriter:
         if self._closed:
             return
         if self._pipeline and self._enc_thread is not None:
-            self._launch_flush()  # tail row group rides the pipe, in order
-            self._drain_pipe()
+            try:
+                self._launch_flush()  # tail row group rides the pipe, in order
+                self._drain_pipe()
+            except Exception:
+                # poisoned: stop the threads, then surface.  Deliberately NOT
+                # BaseException — a KeyboardInterrupt mid-drain leaves state
+                # intact so a retried close() can still finish the file.
+                self.abandon()
+                raise
         self.flush_row_group()  # no-op unless something is still pending
         meta = FileMetaData(
             schema_fields=self.schema.flatten(),

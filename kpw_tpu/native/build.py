@@ -63,7 +63,7 @@ def _build() -> str:
         for cflags, zstd in ((fast, True), (plain, True),
                              (fast, False), (plain, False)):
             args = (["g++"] + cflags + tail + [tmp] + list(_SRCS)
-                    + (["-lzstd"] if zstd else ["-DKPW_NO_ZSTD"]))
+                    + (["-lzstd", "-ldl"] if zstd else ["-DKPW_NO_ZSTD"]))
             try:
                 subprocess.run(args, check=True, capture_output=True)
                 break
@@ -146,6 +146,11 @@ class NativeLib:
         cdll.kpw_rle_hybrid_u32.restype = ctypes.c_int
         cdll.kpw_rle_hybrid_u32.argtypes = [
             c_u32p, c_sz, ctypes.c_int, c_p, ctypes.POINTER(c_sz)]
+        if self.has_zstd:
+            cdll.kpw_zstd_compress_parts.restype = ctypes.c_int
+            cdll.kpw_zstd_compress_parts.argtypes = [
+                ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(c_sz),
+                ctypes.c_int, c_p, c_sz, ctypes.POINTER(c_sz), ctypes.c_int]
         c_vpp = ctypes.POINTER(ctypes.c_void_p)
         cdll.kpw_proto_shred.restype = ctypes.c_int64
         cdll.kpw_proto_shred.argtypes = [
@@ -191,6 +196,44 @@ class NativeLib:
         if rc != 0:
             raise RuntimeError("zstd compress failed")
         return out.raw[: out_len.value]
+
+    def zstd_compress_parts(self, parts: list, level: int = 3, out=None):
+        """Compress discontiguous parts (bytes / memoryview / ndarray) as
+        one zstd frame into ``out`` (a uint8 ndarray scratch, grown as
+        needed, NOT zeroed) — returns (out, n_written) or None without
+        libzstd.  The caller slices ``memoryview(out)[:n]`` and must consume
+        it before the next call reusing the same scratch."""
+        if not self.has_zstd:
+            return None
+        import numpy as np
+
+        n = len(parts)
+        ptrs = (ctypes.c_void_p * n)()
+        lens = (ctypes.c_size_t * n)()
+        keep = []  # keep frombuffer views alive through the call
+        total = 0
+        for i, p in enumerate(parts):
+            if isinstance(p, bytes):
+                ptrs[i] = ctypes.cast(ctypes.c_char_p(p), ctypes.c_void_p)
+                lens[i] = len(p)
+                total += len(p)
+            else:
+                a = np.frombuffer(p, np.uint8)
+                keep.append(a)
+                ptrs[i] = a.ctypes.data
+                lens[i] = a.nbytes
+                total += a.nbytes
+        cap = self._c.kpw_zstd_max_compressed_length(total)
+        if out is None or out.nbytes < cap:
+            out = np.empty(cap, np.uint8)
+        out_len = ctypes.c_size_t(0)
+        rc = self._c.kpw_zstd_compress_parts(
+            ptrs, lens, n,
+            out.ctypes.data_as(ctypes.c_char_p), out.nbytes,
+            ctypes.byref(out_len), level)
+        if rc != 0:
+            raise RuntimeError(f"kpw_zstd_compress_parts rc={rc}")
+        return out, out_len.value
 
     def zstd_decompress(self, data: bytes) -> bytes | None:
         if not self.has_zstd:
@@ -387,5 +430,37 @@ class NativeLib:
         return out.raw[: out_len.value]
 
 
+def _prefer_bundled_zstd() -> None:
+    """Point the native lib's runtime zstd dispatch (codecs.cc zdl::) at the
+    newest libzstd in the environment: the `zstandard` package bundles a
+    newer build than most distros (1.5.7 vs 1.5.4 here — ~1.5x compression
+    throughput on the page hot path).  Respect an operator-set value; unset
+    or unloadable paths fall back to the linked system libzstd inside the
+    native lib itself."""
+    if "KPW_ZSTD_LIB" in os.environ:
+        return
+    try:
+        import glob
+
+        import zstandard
+
+        try:
+            system_ver = ctypes.CDLL("libzstd.so.1").ZSTD_versionNumber()
+        except (OSError, AttributeError):
+            system_ver = 0
+        cands = glob.glob(os.path.join(os.path.dirname(zstandard.__file__),
+                                       "_cffi*.so"))
+        for so in cands:
+            try:
+                if ctypes.CDLL(so).ZSTD_versionNumber() > system_ver:
+                    os.environ["KPW_ZSTD_LIB"] = so
+                    return
+            except (OSError, AttributeError):
+                continue
+    except ImportError:
+        pass
+
+
 def load() -> NativeLib:
+    _prefer_bundled_zstd()
     return NativeLib(ctypes.CDLL(_build()))

@@ -52,6 +52,7 @@ class NativeChunkEncoder(CpuChunkEncoder):
     def __init__(self, options: EncoderOptions) -> None:
         super().__init__(options)
         self._lib = lib()
+        self._tl = threading.local()  # per-thread compression scratch
 
     def encode_many(self, chunks, base_offset: int):
         """Column-parallel encode: the hot primitives (dictionary build,
@@ -152,6 +153,42 @@ class NativeChunkEncoder(CpuChunkEncoder):
             lens, payload = lens_and_payload(values)
             return L.delta_binary_packed(lens, 32) + payload
         return super()._values_body(values, pt, encoding)
+
+    def _values_page_parts(self, chunk, va: int, vb: int, pt: int,
+                           encoding: int) -> list:
+        """DELTA_LENGTH_BYTE_ARRAY without materializing the concatenation:
+        [tiny delta-of-lengths header, zero-copy payload view] — the codec
+        streams the parts (page bytes unchanged)."""
+        from ..core.schema import Encoding
+
+        v = chunk.values
+        if (self._lib is not None
+                and encoding == Encoding.DELTA_LENGTH_BYTE_ARRAY
+                and isinstance(v, ByteColumn)):
+            o = v.offsets
+            lens = np.diff(o[va:vb + 1])
+            delta = self._lib.delta_binary_packed(lens, 32)
+            payload = memoryview(v.data)[int(o[va]):int(o[vb])]
+            return [delta, payload]
+        return super()._values_page_parts(chunk, va, vb, pt, encoding)
+
+    def _compress_parts(self, parts: list, body_len: int):
+        """ZSTD pages compress straight from the parts into per-thread
+        scratch (no body concatenation, no zeroed bounce buffers, no
+        compressed-bytes copy); other codecs take the base path."""
+        from ..core.schema import Codec
+
+        opts = self.options
+        if (self._lib is not None and opts.codec == Codec.ZSTD
+                and self._lib.has_zstd):
+            level = 3 if opts.compression_level is None else opts.compression_level
+            res = self._lib.zstd_compress_parts(
+                parts, level, getattr(self._tl, "zscratch", None))
+            if res is not None:
+                arr, n = res
+                self._tl.zscratch = arr  # reuse; consumer copies immediately
+                return memoryview(arr)[:n], n
+        return super()._compress_parts(parts, body_len)
 
     def _stats_min_max(self, values, pt: int):
         if (self._lib is not None and isinstance(values, ByteColumn)

@@ -22,6 +22,8 @@
 
 #ifndef KPW_NO_ZSTD
 #include <zstd.h>
+#include <dlfcn.h>
+#include <mutex>
 #endif
 
 namespace {
@@ -318,28 +320,158 @@ int kpw_snappy_uncompress(const uint8_t* in, size_t n, uint8_t* out,
 // ---------------------------------------------------------------------------
 
 #ifndef KPW_NO_ZSTD
-size_t kpw_zstd_max_compressed_length(size_t n) { return ZSTD_compressBound(n); }
+// ---------------------------------------------------------------------------
+// Runtime zstd dispatch: the public ZSTD_* API is version-stable, and the
+// Python environment often ships a newer, faster libzstd inside the
+// `zstandard` extension than the distro's (1.5.7 vs 1.5.4 here, ~1.5x
+// compression throughput).  When KPW_ZSTD_LIB names a loadable library that
+// exports the needed symbols, use it; otherwise fall back to the libzstd we
+// linked against.  RTLD_LAZY: the donor .so may be a Python extension whose
+// *other* symbols only resolve inside the interpreter.
+// ---------------------------------------------------------------------------
+namespace zdl {
+typedef size_t (*compressBound_t)(size_t);
+typedef ZSTD_CCtx* (*createCCtx_t)(void);
+typedef size_t (*freeCCtx_t)(ZSTD_CCtx*);
+typedef size_t (*compressCCtx_t)(ZSTD_CCtx*, void*, size_t, const void*, size_t, int);
+typedef size_t (*cctxReset_t)(ZSTD_CCtx*, ZSTD_ResetDirective);
+typedef size_t (*cctxSetParameter_t)(ZSTD_CCtx*, ZSTD_cParameter, int);
+typedef size_t (*cctxSetPledged_t)(ZSTD_CCtx*, unsigned long long);
+typedef size_t (*compressStream2_t)(ZSTD_CCtx*, ZSTD_outBuffer*, ZSTD_inBuffer*, ZSTD_EndDirective);
+typedef unsigned (*isError_t)(size_t);
+typedef unsigned long long (*getFrameContentSize_t)(const void*, size_t);
+typedef size_t (*decompress_t)(void*, size_t, const void*, size_t);
+typedef size_t (*oneshot_t)(void*, size_t, const void*, size_t, int);
+
+struct Api {
+  oneshot_t oneshot = ZSTD_compress;
+  compressBound_t compressBound = ZSTD_compressBound;
+  createCCtx_t createCCtx = ZSTD_createCCtx;
+  freeCCtx_t freeCCtx = ZSTD_freeCCtx;
+  compressCCtx_t compressCCtx = ZSTD_compressCCtx;
+  cctxReset_t cctxReset = ZSTD_CCtx_reset;
+  cctxSetParameter_t cctxSetParameter = ZSTD_CCtx_setParameter;
+  cctxSetPledged_t cctxSetPledged = ZSTD_CCtx_setPledgedSrcSize;
+  compressStream2_t compressStream2 = ZSTD_compressStream2;
+  isError_t isError = ZSTD_isError;
+  getFrameContentSize_t getFrameContentSize = ZSTD_getFrameContentSize;
+  decompress_t decompress = ZSTD_decompress;
+};
+
+static Api g_api;
+static std::once_flag g_once;
+
+static void init_api() {
+  const char* path = getenv("KPW_ZSTD_LIB");
+  if (path == nullptr || path[0] == '\0') return;
+  void* h = dlopen(path, RTLD_LAZY | RTLD_LOCAL);
+  if (h == nullptr) return;
+  Api a;
+  bool ok = true;
+  auto resolve = [&](const char* name) -> void* {
+    void* p = dlsym(h, name);
+    if (p == nullptr) ok = false;
+    return p;
+  };
+  a.compressBound = (compressBound_t)resolve("ZSTD_compressBound");
+  a.createCCtx = (createCCtx_t)resolve("ZSTD_createCCtx");
+  a.freeCCtx = (freeCCtx_t)resolve("ZSTD_freeCCtx");
+  a.compressCCtx = (compressCCtx_t)resolve("ZSTD_compressCCtx");
+  a.cctxReset = (cctxReset_t)resolve("ZSTD_CCtx_reset");
+  a.cctxSetParameter = (cctxSetParameter_t)resolve("ZSTD_CCtx_setParameter");
+  a.cctxSetPledged = (cctxSetPledged_t)resolve("ZSTD_CCtx_setPledgedSrcSize");
+  a.compressStream2 = (compressStream2_t)resolve("ZSTD_compressStream2");
+  a.isError = (isError_t)resolve("ZSTD_isError");
+  a.getFrameContentSize = (getFrameContentSize_t)resolve("ZSTD_getFrameContentSize");
+  a.decompress = (decompress_t)resolve("ZSTD_decompress");
+  a.oneshot = (oneshot_t)resolve("ZSTD_compress");
+  if (ok) g_api = a; else dlclose(h);
+}
+
+static const Api& api() {
+  std::call_once(g_once, init_api);
+  return g_api;
+}
+}  // namespace zdl
+
+size_t kpw_zstd_max_compressed_length(size_t n) { return zdl::api().compressBound(n); }
+
+int kpw_zstd_compress_parts(const uint8_t* const* parts, const size_t* lens,
+                            int n_parts, uint8_t* out, size_t out_cap,
+                            size_t* out_len, int level);
 
 int kpw_zstd_compress(const uint8_t* in, size_t n, uint8_t* out,
                       size_t out_cap, size_t* out_len, int level) {
-  // context reuse across pages (thread-local: pages compress from the
-  // column-parallel pool) — ZSTD_compress allocates a fresh cctx per call.
-  // RAII holder so exiting threads free their context.
+  // one implementation: the streaming parts path with a single part (same
+  // frame bytes — pledged content size keeps headers identical) and one
+  // shared thread-local context per thread.
+  return kpw_zstd_compress_parts(&in, &n, 1, out, out_cap, out_len, level);
+}
+
+// Compress several discontiguous input parts as ONE zstd frame (streaming
+// API) — the page-assembly hot path hands [levels blob, delta header,
+// string payload] without pre-concatenating them into a scratch buffer.
+// Byte-compatibility note: the frame differs from ZSTD_compress output only
+// in header flags (no content-size field); parquet stores the uncompressed
+// size in the page header, and every decompressor (ours included) streams.
+int kpw_zstd_compress_parts(const uint8_t* const* parts, const size_t* lens,
+                            int n_parts, uint8_t* out, size_t out_cap,
+                            size_t* out_len, int level) {
+  const zdl::Api& z = zdl::api();
   struct CtxHolder {
-    ZSTD_CCtx* ctx = ZSTD_createCCtx();
-    ~CtxHolder() { ZSTD_freeCCtx(ctx); }
+    ZSTD_CCtx* ctx = zdl::api().createCCtx();
+    ~CtxHolder() { zdl::api().freeCCtx(ctx); }
   };
   static thread_local CtxHolder holder;
-  size_t rc = holder.ctx != nullptr
-                  ? ZSTD_compressCCtx(holder.ctx, out, out_cap, in, n, level)
-                  : ZSTD_compress(out, out_cap, in, n, level);
-  if (ZSTD_isError(rc)) return -1;
-  *out_len = rc;
+  if (holder.ctx == nullptr) holder.ctx = z.createCCtx();  // retry after OOM
+  if (holder.ctx == nullptr) {
+    // stateless fallback: concatenate (if needed) and one-shot compress —
+    // survivable degraded mode instead of poisoning the file
+    unsigned long long total = 0;
+    for (int i = 0; i < n_parts; i++) total += lens[i];
+    const uint8_t* src = n_parts == 1 ? parts[0] : nullptr;
+    uint8_t* tmp = nullptr;
+    if (src == nullptr) {
+      tmp = static_cast<uint8_t*>(std::malloc(total ? total : 1));
+      if (tmp == nullptr) return -2;
+      size_t off = 0;
+      for (int i = 0; i < n_parts; i++) {
+        std::memcpy(tmp + off, parts[i], lens[i]);
+        off += lens[i];
+      }
+      src = tmp;
+    }
+    size_t rc = z.oneshot(out, out_cap, src, total, level);
+    std::free(tmp);
+    if (z.isError(rc)) return -1;
+    *out_len = rc;
+    return 0;
+  }
+  ZSTD_CCtx* c = holder.ctx;
+  z.cctxReset(c, ZSTD_reset_session_only);
+  if (z.isError(z.cctxSetParameter(c, ZSTD_c_compressionLevel, level)))
+    return -3;
+  unsigned long long total = 0;
+  for (int i = 0; i < n_parts; i++) total += lens[i];
+  // keep the frame identical to the one-shot API: record the content size
+  z.cctxSetPledged(c, total);
+  ZSTD_outBuffer ob{out, out_cap, 0};
+  for (int i = 0; i < n_parts; i++) {
+    ZSTD_inBuffer ib{parts[i], lens[i], 0};
+    ZSTD_EndDirective mode = (i == n_parts - 1) ? ZSTD_e_end : ZSTD_e_continue;
+    while (true) {
+      size_t rc = z.compressStream2(c, &ob, &ib, mode);
+      if (z.isError(rc)) return -1;
+      if (mode == ZSTD_e_end ? rc == 0 : ib.pos == ib.size) break;
+      if (ob.pos == ob.size) return -4;  // out_cap too small (caller bug)
+    }
+  }
+  *out_len = ob.pos;
   return 0;
 }
 
 int kpw_zstd_uncompressed_length(const uint8_t* in, size_t n, size_t* result) {
-  unsigned long long sz = ZSTD_getFrameContentSize(in, n);
+  unsigned long long sz = zdl::api().getFrameContentSize(in, n);
   if (sz == ZSTD_CONTENTSIZE_ERROR || sz == ZSTD_CONTENTSIZE_UNKNOWN) return -1;
   *result = static_cast<size_t>(sz);
   return 0;
@@ -347,8 +479,9 @@ int kpw_zstd_uncompressed_length(const uint8_t* in, size_t n, size_t* result) {
 
 int kpw_zstd_uncompress(const uint8_t* in, size_t n, uint8_t* out,
                         size_t out_cap, size_t* out_len) {
-  size_t rc = ZSTD_decompress(out, out_cap, in, n);
-  if (ZSTD_isError(rc)) return -1;
+  const zdl::Api& z = zdl::api();
+  size_t rc = z.decompress(out, out_cap, in, n);
+  if (z.isError(rc)) return -1;
   *out_len = rc;
   return 0;
 }

@@ -362,12 +362,25 @@ int kpw_delta_bp64(const int64_t* v, size_t n, uint8_t* out, size_t* out_len) {
 void kpw_bytes_min_max(const uint8_t* data, const int64_t* offsets, size_t n,
                        size_t* min_idx, size_t* max_idx) {
   size_t mn = 0, mx = 0;
+  // first-byte pruning: only values whose first byte ties the current
+  // min/max first byte need a full lexicographic compare — on realistic
+  // string columns this skips the memcmp for almost every row
+  int mn_first = (offsets[1] > offsets[0]) ? data[offsets[0]] : -1;
+  int mx_first = mn_first;
   for (size_t i = 1; i < n; ++i) {
-    const BytesView v{data + offsets[i], offsets[i + 1] - offsets[i]};
-    const BytesView m{data + offsets[mn], offsets[mn + 1] - offsets[mn]};
-    const BytesView M{data + offsets[mx], offsets[mx + 1] - offsets[mx]};
-    if (view_lt(v, m)) mn = i;
-    if (view_lt(M, v)) mx = i;
+    const int64_t off = offsets[i];
+    const int64_t len = offsets[i + 1] - off;
+    const int first = len > 0 ? data[off] : -1;
+    if (first > mn_first && first < mx_first) continue;
+    const BytesView v{data + off, len};
+    if (first <= mn_first) {
+      const BytesView m{data + offsets[mn], offsets[mn + 1] - offsets[mn]};
+      if (view_lt(v, m)) { mn = i; mn_first = first; }
+    }
+    if (first >= mx_first) {
+      const BytesView M{data + offsets[mx], offsets[mx + 1] - offsets[mx]};
+      if (view_lt(M, v)) { mx = i; mx_first = first; }
+    }
   }
   *min_idx = mn;
   *max_idx = mx;

@@ -697,16 +697,32 @@ class TpuChunkEncoder(NativeChunkEncoder):
                 return delta_length_byte_array_device(values)
         return super()._values_body(values, pt, encoding)
 
-    def _values_page_body(self, chunk, va: int, vb: int, pt: int,
-                          encoding: int) -> bytes:
+    def _planned_body(self, chunk, va: int, vb: int) -> bytes | None:
+        """Device-plan lookup shared by the body and parts overrides: one
+        place owns the id()-keyed cache protocol and its identity re-check."""
         plans = getattr(self, "_delta_plans", None)
         if plans:
             hit = plans.get(id(chunk))
             if hit is not None and hit[0] is chunk:  # guard against id() reuse
-                body = hit[1].get((va, vb))
-                if body is not None:
-                    return body
+                return hit[1].get((va, vb))
+        return None
+
+    def _values_page_body(self, chunk, va: int, vb: int, pt: int,
+                          encoding: int) -> bytes:
+        body = self._planned_body(chunk, va, vb)
+        if body is not None:
+            return body
         return super()._values_page_body(chunk, va, vb, pt, encoding)
+
+    def _values_page_parts(self, chunk, va: int, vb: int, pt: int,
+                           encoding: int) -> list:
+        """Planned device-encoded bodies take precedence: without this, the
+        native superclass's DELTA_LENGTH parts override would re-encode on
+        host what the batched device plan already produced."""
+        body = self._planned_body(chunk, va, vb)
+        if body is not None:
+            return [body]
+        return super()._values_page_parts(chunk, va, vb, pt, encoding)
 
     def _levels_page_blob(self, chunk, a: int, b: int) -> bytes:
         plans = getattr(self, "_level_plans", None)

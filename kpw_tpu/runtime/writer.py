@@ -277,6 +277,14 @@ class _Worker:
             pass
         except Exception:
             logger.exception("worker %d terminated", self.index)
+            # a dying worker must not leak its open file's pipeline threads
+            # or sink; the tmp stays on disk un-published (at-least-once:
+            # its offsets were never acked)
+            if self.current_file is not None:
+                try:
+                    self.current_file.abandon()
+                finally:
+                    self.current_file = None
 
     def _try_wire_batch(self, recs) -> bool:
         """Shred a poll batch through the native wire decoder and append it

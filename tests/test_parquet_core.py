@@ -527,7 +527,10 @@ def test_pipelined_writer_poisoned_on_encode_failure():
         __import__("time").sleep(0.01)
     with _pytest.raises(PipelineError):
         w.close()
-    with _pytest.raises(PipelineError):  # poison is permanent
-        w.close()
+    # the raising close() abandoned the file: pipeline threads stopped,
+    # writer unusable, repeated close() a no-op, and no footer was written
+    w.close()
+    with _pytest.raises(ValueError, match="closed"):
+        w.append_batch(columns_from_arrays(
+            schema, {"a": np.arange(5, dtype=np.int64)}))
     assert not buf.getvalue().endswith(b"PAR1") or len(buf.getvalue()) == 4
-    w.abandon()
