@@ -333,7 +333,6 @@ namespace zdl {
 typedef size_t (*compressBound_t)(size_t);
 typedef ZSTD_CCtx* (*createCCtx_t)(void);
 typedef size_t (*freeCCtx_t)(ZSTD_CCtx*);
-typedef size_t (*compressCCtx_t)(ZSTD_CCtx*, void*, size_t, const void*, size_t, int);
 typedef size_t (*cctxReset_t)(ZSTD_CCtx*, ZSTD_ResetDirective);
 typedef size_t (*cctxSetParameter_t)(ZSTD_CCtx*, ZSTD_cParameter, int);
 typedef size_t (*cctxSetPledged_t)(ZSTD_CCtx*, unsigned long long);
@@ -348,7 +347,6 @@ struct Api {
   compressBound_t compressBound = ZSTD_compressBound;
   createCCtx_t createCCtx = ZSTD_createCCtx;
   freeCCtx_t freeCCtx = ZSTD_freeCCtx;
-  compressCCtx_t compressCCtx = ZSTD_compressCCtx;
   cctxReset_t cctxReset = ZSTD_CCtx_reset;
   cctxSetParameter_t cctxSetParameter = ZSTD_CCtx_setParameter;
   cctxSetPledged_t cctxSetPledged = ZSTD_CCtx_setPledgedSrcSize;
@@ -376,7 +374,6 @@ static void init_api() {
   a.compressBound = (compressBound_t)resolve("ZSTD_compressBound");
   a.createCCtx = (createCCtx_t)resolve("ZSTD_createCCtx");
   a.freeCCtx = (freeCCtx_t)resolve("ZSTD_freeCCtx");
-  a.compressCCtx = (compressCCtx_t)resolve("ZSTD_compressCCtx");
   a.cctxReset = (cctxReset_t)resolve("ZSTD_CCtx_reset");
   a.cctxSetParameter = (cctxSetParameter_t)resolve("ZSTD_CCtx_setParameter");
   a.cctxSetPledged = (cctxSetPledged_t)resolve("ZSTD_CCtx_setPledgedSrcSize");

@@ -362,6 +362,10 @@ int kpw_delta_bp64(const int64_t* v, size_t n, uint8_t* out, size_t* out_len) {
 void kpw_bytes_min_max(const uint8_t* data, const int64_t* offsets, size_t n,
                        size_t* min_idx, size_t* max_idx) {
   size_t mn = 0, mx = 0;
+  if (n == 0) {  // keep the C entry point n==0-safe (no offsets[1] read)
+    *min_idx = *max_idx = 0;
+    return;
+  }
   // first-byte pruning: only values whose first byte ties the current
   // min/max first byte need a full lexicographic compare — on realistic
   // string columns this skips the memcmp for almost every row

@@ -12,12 +12,11 @@ Algorithm (all static shapes, runs under shard_map over the ``shard`` axis):
   2. ``all_gather`` the per-shard unique sets over ICI;
   3. merge: sort-unique the gathered sets -> the global dictionary in
      ascending key order (deterministic regardless of shard count);
-  4. per-shard index lookup by the concat-sort-rank trick: sort
-     [dict entries, local values] together; since dict slots ascend in value
-     order, every value's index is (number of dict entries sorted at or
-     before it) - 1 — one lexsort + cumsum, no searchsorted needed (works
-     for 64-bit keys split into (hi, lo) uint32 halves, which plain
-     searchsorted cannot do);
+  4. per-shard index lookup by a vectorized lexicographic binary search of
+     each (hi, lo) value pair against the ascending dictionary — O(n log G)
+     gathers instead of sorting dict+values together (plain searchsorted
+     cannot compare 64-bit keys split into uint32 halves; a pairwise
+     compare in the search body can);
   5. ``psum`` the per-shard row counts -> global row count for the footer,
      and an overflow flag if any shard exceeded ``cap``.
 
@@ -40,15 +39,29 @@ from ..ops.packing import pad_bucket
 AXIS = "shard"
 
 
-def _local_unique(hi, lo, valid, cap: int):
+def _local_unique(hi, lo, valid, cap: int, has_hi: bool = True):
     """Sorted-unique of the valid (hi, lo) keys, padded to ``cap``.
-    Returns (uhi, ulo, uvalid, k) with uniques in ascending key order."""
+    Returns (uhi, ulo, uvalid, k) with uniques in ascending key order.
+
+    Invalid slots are lifted to the MAX key instead of carrying a validity
+    sort key: they land at the tail (or merge into a real max-key run, where
+    dedupe counts the key once — correct either way), and the valid region
+    is exactly the first sum(valid) slots.  That makes the 32-bit case
+    (``has_hi=False``, statically known zero hi plane) a SINGLE-operand
+    ``jnp.sort`` — XLA's fast path, ~5x quicker on CPU than the variadic
+    comparator sort, which round 1 paid three times over via lexsort."""
     n = lo.shape[0]
-    inv = (~valid).astype(jnp.int32)
-    order = jnp.lexsort((lo, hi, inv))
-    shi, slo, sval = hi[order], lo[order], valid[order]
-    same = jnp.concatenate(
-        [jnp.zeros((1,), bool), (shi[1:] == shi[:-1]) & (slo[1:] == slo[:-1])])
+    big = jnp.uint32(0xFFFFFFFF)
+    llo = jnp.where(valid, lo, big)
+    if has_hi:
+        shi, slo = jax.lax.sort((jnp.where(valid, hi, big), llo), num_keys=2)
+    else:
+        slo = jnp.sort(llo)
+        shi = jnp.zeros_like(slo)
+    sval = jnp.arange(n, dtype=jnp.int32) < jnp.sum(valid.astype(jnp.int32))
+    same = (shi[1:] == shi[:-1]) & (slo[1:] == slo[:-1]) if has_hi else (
+        slo[1:] == slo[:-1])
+    same = jnp.concatenate([jnp.zeros((1,), bool), same])
     is_new = sval & ~same
     k = jnp.sum(is_new.astype(jnp.int32))
     # compact the uniques to the front: rank = cumsum(is_new)-1, scatter-drop
@@ -59,54 +72,80 @@ def _local_unique(hi, lo, valid, cap: int):
     return uhi, ulo, uvalid, k
 
 
-def _rank_against_dict(dhi, dlo, dvalid, vhi, vlo, vvalid):
-    """Index of each (vhi, vlo) key in the ascending dict (dhi, dlo).
-    Values not present map to arbitrary indices (callers guarantee coverage);
-    invalid value slots map to garbage and must be masked by the caller."""
+def _rank_against_dict(dhi, dlo, dvalid, vhi, vlo, vvalid, k=None,
+                       has_hi: bool = True):
+    """Index of each (vhi, vlo) key in the ascending dict (dhi, dlo) by a
+    vectorized lexicographic binary search with early exit — the round count
+    tracks the dict's VALID cardinality ``k`` (when given), not its padded
+    capacity, so a 1k-entry dictionary in a 16k-slot gather costs ~10 gather
+    rounds, not 15.  Values not present map to arbitrary indices (callers
+    guarantee coverage); invalid value slots map to garbage and must be
+    masked by the caller."""
     G = dhi.shape[0]
-    n = vhi.shape[0]
-    cat_hi = jnp.concatenate([dhi, vhi])
-    cat_lo = jnp.concatenate([dlo, vlo])
-    # dict entries first on ties so the cumsum assigns their slot to the run;
-    # invalid dict pads sort last (their flag=2 exceeds values' flag=1)
-    flag = jnp.concatenate([jnp.where(dvalid, 0, 3),
-                            jnp.where(vvalid, 1, 2).astype(jnp.int32)])
-    order = jnp.lexsort((flag, cat_lo, cat_hi))
-    is_dict = flag[order] == 0
-    slots = jnp.cumsum(is_dict.astype(jnp.int32)) - 1
-    unscrambled = jnp.zeros(G + n, jnp.int32).at[order].set(slots)
-    return unscrambled[G:]
+    # pads live past the valid prefix; lift them to the max key so the whole
+    # array is ascending for the search
+    big = jnp.uint32(0xFFFFFFFF)
+    dh = jnp.where(dvalid, dhi, big)
+    dl = jnp.where(dvalid, dlo, big)
+    lo_b = jnp.zeros(vhi.shape, jnp.int32)
+    upper = jnp.int32(G) if k is None else jnp.minimum(jnp.int32(G),
+                                                       k.astype(jnp.int32))
+    hi_b = jnp.broadcast_to(upper, vhi.shape).astype(jnp.int32)
+
+    def cond(c):
+        lo_bound, hi_bound = c
+        return jnp.any(lo_bound < hi_bound)
+
+    def body(c):
+        lo_bound, hi_bound = c
+        mid = (lo_bound + hi_bound) // 2
+        ml = dl[mid]
+        if has_hi:
+            mh = dh[mid]
+            lt = (mh < vhi) | ((mh == vhi) & (ml < vlo))  # dict[mid] < value
+        else:
+            lt = ml < vlo
+        return (jnp.where(lt, mid + 1, lo_bound),
+                jnp.where(lt, hi_bound, mid))
+
+    lo_b, _ = jax.lax.while_loop(cond, body, (lo_b, hi_b))
+    return lo_b  # leftmost index with dict >= value == the match slot
 
 
-def _merge_kernel(hi, lo, count, cap: int):
+def _merge_kernel(hi, lo, count, cap: int, has_hi: bool = True):
     """shard_map body: per-shard local view -> (indices, gdict, gk, rows)."""
     n = lo.shape[0]
     valid = jnp.arange(n, dtype=jnp.int32) < count
-    uhi, ulo, uvalid, k = _local_unique(hi, lo, valid, cap)
+    uhi, ulo, uvalid, k = _local_unique(hi, lo, valid, cap, has_hi=has_hi)
     overflow = jax.lax.psum((k > cap).astype(jnp.int32), AXIS)
 
-    ghi = jax.lax.all_gather(uhi, AXIS).reshape(-1)
     glo = jax.lax.all_gather(ulo, AXIS).reshape(-1)
     gvalid = jax.lax.all_gather(uvalid, AXIS).reshape(-1)
-    G = ghi.shape[0]
-    mhi, mlo, mvalid, gk = _local_unique(ghi, glo, gvalid, G)
+    if has_hi:
+        ghi = jax.lax.all_gather(uhi, AXIS).reshape(-1)
+    else:
+        ghi = jnp.zeros_like(glo)  # one less ICI gather for 32-bit columns
+    G = glo.shape[0]
+    mhi, mlo, mvalid, gk = _local_unique(ghi, glo, gvalid, G, has_hi=has_hi)
 
-    indices = _rank_against_dict(mhi, mlo, mvalid, hi, lo, valid)
+    indices = _rank_against_dict(mhi, mlo, mvalid, hi, lo, valid, k=gk,
+                                 has_hi=has_hi)
     rows = jax.lax.psum(count, AXIS)
     return (indices.astype(jnp.uint32), mhi, mlo, gk, rows, overflow)
 
 
-@functools.partial(jax.jit, static_argnames=("mesh", "cap"))
-def _merge_sharded(hi, lo, counts, *, mesh: Mesh, cap: int):
+@functools.partial(jax.jit, static_argnames=("mesh", "cap", "has_hi"))
+def _merge_sharded(hi, lo, counts, *, mesh: Mesh, cap: int,
+                   has_hi: bool = True):
     sharded = P(AXIS)
     rep = P()
     fn = jax.shard_map(
-        lambda h, l, c: _merge_kernel(h, l, c[0], cap),
+        lambda h, l, c: _merge_kernel(h, l, c[0], cap, has_hi=has_hi),
         mesh=mesh,
         in_specs=(sharded, sharded, sharded),
         out_specs=(sharded, rep, rep, rep, rep, rep),
         # the merged dict is replicated by construction (computed from
-        # all_gather'd data), but VMA can't see that through lexsort/scatter
+        # all_gather'd data), but VMA can't see that through sort/scatter
         check_vma=False,
     )
     return fn(hi, lo, counts)
@@ -142,7 +181,8 @@ def global_dictionary_encode(values: np.ndarray, mesh: Mesh, cap: int = 65536):
     lo_d = jax.device_put(lo_p, shard_sharding)
     cnt_d = jax.device_put(counts, shard_sharding)
     indices, mhi, mlo, gk, rows, overflow = _merge_sharded(
-        hi_d, lo_d, cnt_d, mesh=mesh, cap=cap)
+        hi_d, lo_d, cnt_d, mesh=mesh, cap=cap,
+        has_hi=hi is not None)  # 32-bit dtypes ride the single-key sorts
     if int(overflow):
         raise ValueError(f"per-shard dictionary cardinality exceeded cap={cap}")
     gk = int(gk)

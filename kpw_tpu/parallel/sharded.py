@@ -24,23 +24,26 @@ from ..ops.packing import bitpack_device
 from .dict_merge import AXIS, _local_unique, _merge_kernel, _rank_against_dict
 
 
-@functools.partial(jax.jit, static_argnames=("mesh", "cap", "width"))
+@functools.partial(jax.jit, static_argnames=("mesh", "cap", "width", "has_hi"))
 def sharded_encode_step(hi, lo, counts, *, mesh: Mesh, cap: int = 4096,
-                        width: int = 16):
+                        width: int = 16, has_hi: bool = True):
     """One SPMD encode step.
 
     hi, lo: (C, N) uint32 key halves, sharded over rows (N) across the mesh;
     counts: (n_shards,) valid rows per shard.  Returns per-shard packed index
     bytes (C, N*width//8 sharded), per-column global dictionaries (replicated
     (C, G) key halves + (C,) sizes), the psum'd global row count, and an
-    overflow indicator.
+    overflow indicator.  Pass ``has_hi=False`` when the hi plane is
+    statically zero (32-bit column dtypes): sorts and searches then run
+    single-key, the CPU-mesh fast path and one less gather on ICI.
     """
 
     def kernel(h, l, c):
         count = c[0]
 
         def one_column(hc, lc):
-            indices, mhi, mlo, gk, rows, ovf = _merge_kernel(hc, lc, count, cap)
+            indices, mhi, mlo, gk, rows, ovf = _merge_kernel(
+                hc, lc, count, cap, has_hi=has_hi)
             n = indices.shape[0]
             masked = jnp.where(jnp.arange(n, dtype=jnp.int32) < count, indices, 0)
             packed = bitpack_device(masked, width)
@@ -73,8 +76,9 @@ def encode_step_single(lo, count):
 
     def one_column(lc):
         zero = jnp.zeros_like(lc)
-        uhi, ulo, uvalid, k = _local_unique(zero, lc, valid, n)
-        indices = _rank_against_dict(uhi, ulo, uvalid, zero, lc, valid)
+        uhi, ulo, uvalid, k = _local_unique(zero, lc, valid, n, has_hi=False)
+        indices = _rank_against_dict(uhi, ulo, uvalid, zero, lc, valid, k=k,
+                                     has_hi=False)
         masked = jnp.where(valid, indices, 0)
         packed = bitpack_device(masked.astype(jnp.uint32), 16)
         return packed, ulo, k
