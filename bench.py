@@ -206,8 +206,85 @@ def bench_config2() -> dict:
     table = pa.table({k: pa.array(v) for k, v in arrays.items()})
     t_base, size_base = _bench_pyarrow(table, "cfg2", compression="NONE",
                                        use_dictionary=True, write_statistics=True)
-    return _result("rows_per_sec_64col_dict_rle", ROWS, t_ours, t_base,
-                   _input_bytes(arrays), size_ours, size_base)
+    out = _result("rows_per_sec_64col_dict_rle", ROWS, t_ours, t_base,
+                  _input_bytes(arrays), size_ours, size_base)
+    try:
+        # real-chip evidence rides the headline line the driver records
+        chip = tpu_kernel_probe()
+        if chip:
+            out.update(chip)
+    except Exception as e:  # never let the probe sink the headline number
+        print(f"[bench:cfg2] tpu kernel probe failed: {e!r}", file=sys.stderr)
+    return out
+
+
+def tpu_kernel_probe(n_steps: int = 32) -> dict | None:
+    """On-chip kernel timing, defensible despite the ~110 ms tunnel: K
+    iterations of the flagship encode step (per-column dictionary
+    sort-unique + index binary-search + 16-bit bit-pack) run INSIDE one
+    jitted ``fori_loop`` — one dispatch, K kernel executions, a scalar out.
+    Each iteration XORs the input with the loop index so XLA cannot hoist
+    the body.  Returns {tpu_kernel_ms_per_step, tpu_kernel_mb_per_sec_per_chip,
+    tpu_platform} or None on CPU."""
+    import jax
+    import jax.numpy as jnp
+
+    dev = jax.devices()[0]
+    if dev.platform == "cpu":
+        return None
+    from kpw_tpu.parallel.dict_merge import _local_unique, _rank_against_dict
+    from kpw_tpu.ops.packing import bitpack_device
+
+    C, N = 64, 1 << 16
+    rng = np.random.default_rng(7)
+    lo_host = rng.integers(0, 1000, (C, N)).astype(np.uint32)
+    count = jnp.int32(N)
+
+    @jax.jit
+    def loop(lo):
+        valid = jnp.arange(N, dtype=jnp.int32) < count
+
+        def one_column(lc):
+            zero = jnp.zeros_like(lc)
+            # production dictionary bound (sharded default), not N: the
+            # rank step scales with G + N
+            uhi, ulo, uvalid, k = _local_unique(zero, lc, valid, 4096,
+                                                has_hi=False)
+            idx = _rank_against_dict(uhi, ulo, uvalid, zero, lc, valid,
+                                     k=k, has_hi=False)
+            return bitpack_device(idx.astype(jnp.uint32), 16)
+
+        def body(i, acc):
+            packed = jax.vmap(one_column)(lo ^ i.astype(jnp.uint32))
+            return acc + jnp.sum(packed, dtype=jnp.uint32)
+
+        return jax.lax.fori_loop(0, n_steps, body, jnp.uint32(0))
+
+    lo = jax.device_put(jnp.asarray(lo_host), dev)
+    np.asarray(loop(lo))  # compile + first dispatch outside the timing
+    from kpw_tpu.runtime.select import probe_link
+
+    dispatch_s = probe_link()["dispatch_ms"] / 1e3
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        np.asarray(loop(lo))
+        best = min(best, time.perf_counter() - t0)
+    if best <= dispatch_s * 1.5:
+        # the K-step loop should dwarf one dispatch; if it doesn't, the
+        # dispatch estimate is noise-dominated — drop the metric rather
+        # than fabricate an on-chip number
+        print(f"[bench] tpu kernel probe inconclusive: loop {best:.3f}s vs "
+              f"dispatch {dispatch_s:.3f}s", file=sys.stderr)
+        return None
+    on_chip = best - dispatch_s
+    step_bytes = C * N * 4
+    return {
+        "tpu_platform": dev.platform,
+        "tpu_kernel_ms_per_step": round(on_chip / n_steps * 1e3, 3),
+        "tpu_kernel_mb_per_sec_per_chip": round(
+            step_bytes * n_steps / on_chip / 1e6, 1),
+    }
 
 
 # ---------------------------------------------------------------------------

@@ -39,7 +39,8 @@ from ..ops.packing import pad_bucket
 AXIS = "shard"
 
 
-def _local_unique(hi, lo, valid, cap: int, has_hi: bool = True):
+def _local_unique(hi, lo, valid, cap: int, has_hi: bool = True,
+                  method: str | None = None):
     """Sorted-unique of the valid (hi, lo) keys, padded to ``cap``.
     Returns (uhi, ulo, uvalid, k) with uniques in ascending key order.
 
@@ -64,29 +65,82 @@ def _local_unique(hi, lo, valid, cap: int, has_hi: bool = True):
     same = jnp.concatenate([jnp.zeros((1,), bool), same])
     is_new = sval & ~same
     k = jnp.sum(is_new.astype(jnp.int32))
-    # compact the uniques to the front: rank = cumsum(is_new)-1, scatter-drop
-    rank = jnp.where(is_new, jnp.cumsum(is_new.astype(jnp.int32)) - 1, cap)
-    uhi = jnp.zeros(cap + 1, jnp.uint32).at[rank].set(shi, mode="drop")[:cap]
-    ulo = jnp.zeros(cap + 1, jnp.uint32).at[rank].set(slo, mode="drop")[:cap]
+    rank = jnp.where(is_new, jnp.cumsum(is_new.astype(jnp.int32)) - 1, n)
+    if (method or default_rank_method()) == "sortrank":
+        # TPU: compact by one more (fast) sort on rank — scatters are as
+        # slow as gathers on the vector units
+        if n >= cap:
+            _, chi, clo = jax.lax.sort((rank, shi, slo), num_keys=1)
+            uhi, ulo = chi[:cap], clo[:cap]
+        else:  # pad up so the slice below is well-defined
+            pad = jnp.full(cap - n, n, jnp.int32)
+            _, chi, clo = jax.lax.sort(
+                (jnp.concatenate([rank, pad]),
+                 jnp.concatenate([shi, jnp.zeros(cap - n, shi.dtype)]),
+                 jnp.concatenate([slo, jnp.zeros(cap - n, slo.dtype)])),
+                num_keys=1)
+            uhi, ulo = chi[:cap], clo[:cap]
+    else:
+        # CPU: compact by scatter-drop (cheap there)
+        rank = jnp.where(is_new, rank, cap)
+        uhi = jnp.zeros(cap + 1, jnp.uint32).at[rank].set(shi, mode="drop")[:cap]
+        ulo = jnp.zeros(cap + 1, jnp.uint32).at[rank].set(slo, mode="drop")[:cap]
     uvalid = jnp.arange(cap) < k
     return uhi, ulo, uvalid, k
 
 
+def default_rank_method() -> str:
+    """'search' (vectorized binary search, gather-bound) wins on CPU meshes
+    where gathers are cheap and variadic sorts are 4-5x slower than the
+    single-key fast path; 'sortrank' (two stable sorts + cumsum, zero
+    gathers) wins on TPU where sorts run ~12 GB/s on the vector units but
+    per-element gathers are catastrophic (measured 454 ms vs 1.4 ms per
+    64x64k step on v5e)."""
+    import jax as _jax
+
+    return "search" if _jax.devices()[0].platform == "cpu" else "sortrank"
+
+
 def _rank_against_dict(dhi, dlo, dvalid, vhi, vlo, vvalid, k=None,
-                       has_hi: bool = True):
-    """Index of each (vhi, vlo) key in the ascending dict (dhi, dlo) by a
-    vectorized lexicographic binary search with early exit — the round count
-    tracks the dict's VALID cardinality ``k`` (when given), not its padded
-    capacity, so a 1k-entry dictionary in a 16k-slot gather costs ~10 gather
-    rounds, not 15.  Values not present map to arbitrary indices (callers
-    guarantee coverage); invalid value slots map to garbage and must be
-    masked by the caller."""
+                       has_hi: bool = True, method: str | None = None):
+    """Index of each (vhi, vlo) key in the ascending dict (dhi, dlo).
+    Values not present map to arbitrary indices (callers guarantee
+    coverage); invalid value slots map to garbage and must be masked by the
+    caller.  ``method`` picks the hardware-appropriate implementation (see
+    :func:`default_rank_method`); both produce identical indices."""
+    if method is None:
+        method = default_rank_method()
     G = dhi.shape[0]
     # pads live past the valid prefix; lift them to the max key so the whole
-    # array is ascending for the search
+    # array is ascending
     big = jnp.uint32(0xFFFFFFFF)
     dh = jnp.where(dvalid, dhi, big)
     dl = jnp.where(dvalid, dlo, big)
+
+    if method == "sortrank":
+        # Stable sort of [dict, values]: on ties the dict entry (earlier
+        # concat index) sorts first, so a running count of dict entries
+        # assigns every value its dictionary slot; a second stable sort by
+        # original position unscrambles — no gathers or scatters anywhere.
+        # Only the VALID dict prefix counts: lifted pads share the max key
+        # with real max-key values and must not inflate their slots.
+        kk = jnp.sum(dvalid.astype(jnp.int32)) if k is None else k
+        n = vlo.shape[0]
+        iota = jnp.arange(G + n, dtype=jnp.int32)
+        cat_lo = jnp.concatenate([dl, vlo])
+        if has_hi:
+            cat_hi = jnp.concatenate([dh, vhi])
+            _, _, pos = jax.lax.sort((cat_hi, cat_lo, iota), num_keys=2)
+        else:
+            _, pos = jax.lax.sort((cat_lo, iota), num_keys=1)
+        slots = jnp.cumsum((pos < kk).astype(jnp.int32)) - 1
+        _, unscrambled = jax.lax.sort((pos, slots), num_keys=1)
+        return unscrambled[G:]
+
+    # 'search': lexicographic binary search with early exit — the round
+    # count tracks the dict's VALID cardinality ``k`` (when given), not its
+    # padded capacity, so a 1k-entry dictionary in a 16k-slot gather costs
+    # ~10 gather rounds, not 15.
     lo_b = jnp.zeros(vhi.shape, jnp.int32)
     upper = jnp.int32(G) if k is None else jnp.minimum(jnp.int32(G),
                                                        k.astype(jnp.int32))

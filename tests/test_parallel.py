@@ -95,3 +95,43 @@ def test_encode_step_single_shapes():
     packed, ulo, k = encode_step_single(lo, jnp.int32(N))
     assert packed.shape == (C, N * 2)  # 16 bits/value
     assert (np.asarray(k) == 50).all()
+
+
+def test_rank_methods_agree():
+    """'search' (CPU) and 'sortrank' (TPU) rank implementations must produce
+    identical indices — including max-key values colliding with lifted pads
+    and invalid value slots (masked, but the valid ones must match)."""
+    import jax.numpy as jnp
+
+    from kpw_tpu.parallel.dict_merge import _local_unique, _rank_against_dict
+
+    rng = np.random.default_rng(77)
+    n, cap = 4096, 2048  # cap must hold every unique (coverage guarantee)
+    for has_hi in (False, True):
+        lo = jnp.asarray(rng.integers(0, 500, n).astype(np.uint32))
+        lo = lo.at[::911].set(jnp.uint32(0xFFFFFFFF))
+        hi = (jnp.asarray(rng.integers(0, 3, n).astype(np.uint32))
+              if has_hi else jnp.zeros(n, jnp.uint32))
+        valid = jnp.asarray(rng.random(n) > 0.1)
+        for um in ("search", "sortrank"):  # both compaction branches on CPU
+            uhi, ulo, uvalid, k = _local_unique(hi, lo, valid, cap,
+                                                has_hi=has_hi, method=um)
+            a = _rank_against_dict(uhi, ulo, uvalid, hi, lo, valid, k=k,
+                                   has_hi=has_hi, method="search")
+            b = _rank_against_dict(uhi, ulo, uvalid, hi, lo, valid, k=k,
+                                   has_hi=has_hi, method="sortrank")
+            va = np.asarray(valid)
+            np.testing.assert_array_equal(np.asarray(a)[va], np.asarray(b)[va])
+            # and both decode correctly
+            d_lo = np.asarray(ulo)[:int(k)]
+            np.testing.assert_array_equal(d_lo[np.asarray(a)[va]],
+                                          np.asarray(lo)[va])
+        if not has_hi:
+            # the n < cap pad-up branch of the sortrank compaction
+            small = lo[:1024]
+            sh, sl, sv, sk = _local_unique(hi[:1024], small,
+                                           valid[:1024], 2048,
+                                           has_hi=False, method="sortrank")
+            d = np.asarray(sl)[:int(sk)]
+            assert np.array_equal(np.sort(d), np.unique(
+                np.asarray(small)[np.asarray(valid[:1024])]))
