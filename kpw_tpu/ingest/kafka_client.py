@@ -66,6 +66,12 @@ class KafkaBrokerClient:
         self._poll_timeout_ms = poll_timeout_ms
         self._reg_lock = threading.Lock()  # guards the member registry only
         self._members: dict[tuple[str, str], _Member] = {}
+        # generation() must be MONOTONE per group: a departing member takes
+        # its rebalance count out of the sum, which could cancel a
+        # survivor's increment and hide the rebalance from the smart
+        # consumer — fold removed members' counts (plus one for the leave
+        # itself) into a per-group base
+        self._gen_base: dict[str, int] = {}
 
     # -- group membership --------------------------------------------------
     def join_group(self, group: str, topic: str, member_id: str) -> None:
@@ -97,6 +103,9 @@ class KafkaBrokerClient:
     def leave_group(self, group: str, topic: str, member_id: str) -> None:
         with self._reg_lock:
             member = self._members.pop((group, member_id), None)
+            if member is not None:
+                self._gen_base[group] = (self._gen_base.get(group, 0)
+                                         + member.generation + 1)
         if member is not None:
             with member.lock:
                 member.consumer.close()
@@ -110,8 +119,14 @@ class KafkaBrokerClient:
         assignment changes.  Also pumps the group protocol: a member that
         has no assignment yet only completes its join inside poll(), and the
         smart consumer calls generation() every fetch-loop iteration."""
-        total = 0
-        for member in self._group_members(group):
+        with self._reg_lock:
+            # base + snapshot under ONE lock round: a concurrent leave_group
+            # folds the departed member's count into the base, and reading
+            # them separately could transiently dip below the last reported
+            # value — the exact hidden-rebalance window this base closes
+            total = self._gen_base.get(group, 0)
+            members = [m for (g, _), m in self._members.items() if g == group]
+        for member in members:
             with member.lock:
                 if not member.consumer.assignment():
                     member.consumer.poll(timeout_ms=self._poll_timeout_ms,
@@ -154,18 +169,38 @@ class KafkaBrokerClient:
         return int(got or 0)
 
     def commit(self, group: str, topic: str, partition: int, offset: int) -> None:
+        """Commit via the partition's owning member.  During a rebalance the
+        ownership snapshot can go stale between resolve and commit — the
+        broker then rejects the commit (CommitFailedError).  That window is
+        retriable, not fatal: re-resolve the owner and try again for a
+        bounded number of rounds before surfacing (a raise here would kill
+        the worker mid-rebalance for a transient condition)."""
+        import time as _time
+
         from kafka import TopicPartition
+        from kafka.errors import CommitFailedError
         from kafka.structs import OffsetAndMetadata
 
-        member = self._owner(group, topic, partition)
-        if member is None:
-            members = self._group_members(group)
-            if not members:
-                raise RuntimeError(f"no consumer joined for group {group}")
-            member = members[0]
-        with member.lock:
-            member.consumer.commit({TopicPartition(topic, partition):
-                                    OffsetAndMetadata(offset, None, -1)})
+        last_err: Exception | None = None
+        for attempt in range(8):
+            member = self._owner(group, topic, partition)
+            if member is None:
+                members = self._group_members(group)
+                if not members:
+                    raise RuntimeError(f"no consumer joined for group {group}")
+                member = members[0]
+            try:
+                with member.lock:
+                    member.consumer.commit({TopicPartition(topic, partition):
+                                            OffsetAndMetadata(offset, None, -1)})
+                return
+            except CommitFailedError as e:  # the rebalance window; anything
+                last_err = e                # else is not retriable here
+                # let the group protocol make progress before re-resolving
+                _time.sleep(0.05 * (attempt + 1))
+        raise RuntimeError(
+            f"commit of {topic}/{partition}@{offset} kept failing across "
+            "rebalance retries") from last_err
 
     # -- records -----------------------------------------------------------
     def fetch(self, topic: str, partition: int, offset: int,

@@ -58,7 +58,9 @@ class HdfsFileSystem(FileSystem):
         return self._fs.open_append_stream(path)
 
     def open_read(self, path: str):
-        return self._fs.open_input_stream(path)
+        # random-access reader: Local/Memory open_read are seekable, and
+        # parquet read-back (footer-first) requires seeks
+        return self._fs.open_input_file(path)
 
     def rename(self, src: str, dst: str) -> None:
         self._fs.move(src, dst)  # HDFS NameNode rename: atomic

@@ -1,0 +1,227 @@
+"""Scripted in-process stand-in for the ``kafka`` (kafka-python) package.
+
+Installed into ``sys.modules`` by tests so ``kpw_tpu.ingest.kafka_client``
+exercises its real seek/pause/resume/rebalance/commit logic against a
+deterministic broker — the closest this image can get to the reference's
+embedded-Kafka strategy (KafkaProtoParquetWriterTest.java:58-83).
+
+Faithful bits of the kafka-python surface used by the adapter:
+- ``KafkaConsumer(bootstrap_servers=..., **config)``, ``subscribe([topic],
+  listener=...)``, ``poll(timeout_ms, max_records, update_offsets)``,
+  ``assignment()``, ``position(tp)``, ``seek``, ``pause``, ``resume``,
+  ``commit({tp: OffsetAndMetadata})``, ``committed(tp)``, ``close()``;
+- group membership only makes progress inside ``poll()`` (the reason the
+  adapter pumps unassigned members from ``generation()``);
+- rebalance listeners fire inside ``poll()``;
+- committing a partition the consumer does not currently own raises
+  ``errors.CommitFailedError`` (the rebalance-window failure the adapter
+  must survive).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import namedtuple
+
+TopicPartition = namedtuple("TopicPartition", ["topic", "partition"])
+ConsumerRecord = namedtuple(
+    "ConsumerRecord", ["topic", "partition", "offset", "key", "value",
+                       "timestamp"])
+
+
+class ConsumerRebalanceListener:
+    def on_partitions_revoked(self, revoked):
+        pass
+
+    def on_partitions_assigned(self, assigned):
+        pass
+
+
+class _Structs:
+    class OffsetAndMetadata(namedtuple("OffsetAndMetadata",
+                                       ["offset", "metadata", "leader_epoch"])):
+        pass
+
+
+structs = _Structs
+
+
+class _Errors:
+    class CommitFailedError(Exception):
+        pass
+
+
+errors = _Errors
+
+
+class FakeCluster:
+    """One broker shared by every consumer in the test (module-global so the
+    adapter's plain ``KafkaConsumer(...)`` constructor finds it)."""
+
+    def __init__(self) -> None:
+        self.lock = threading.RLock()
+        self.logs: dict[tuple[str, int], list[ConsumerRecord]] = {}
+        self.partitions: dict[str, int] = {}
+        self.committed: dict[tuple[str, str, int], int] = {}
+        # (group, topic) -> membership generation bookkeeping
+        self.members: dict[tuple[str, str], list["KafkaConsumer"]] = {}
+        self.generation: dict[tuple[str, str], int] = {}
+
+    def create_topic(self, topic: str, partitions: int) -> None:
+        with self.lock:
+            self.partitions[topic] = partitions
+            for p in range(partitions):
+                self.logs.setdefault((topic, p), [])
+
+    def produce(self, topic: str, partition: int, value: bytes,
+                key: bytes | None = None) -> None:
+        with self.lock:
+            log = self.logs[(topic, partition)]
+            log.append(ConsumerRecord(topic, partition, len(log), key, value,
+                                      1_700_000_000_000))
+
+    # -- group protocol ----------------------------------------------------
+    def join(self, consumer: "KafkaConsumer", topic: str) -> None:
+        with self.lock:
+            key = (consumer.group_id, topic)
+            self.members.setdefault(key, []).append(consumer)
+            self.generation[key] = self.generation.get(key, 0) + 1
+
+    def leave(self, consumer: "KafkaConsumer", topic: str) -> None:
+        with self.lock:
+            key = (consumer.group_id, topic)
+            if consumer in self.members.get(key, []):
+                self.members[key].remove(consumer)
+                self.generation[key] = self.generation.get(key, 0) + 1
+
+    def assignment_for(self, consumer: "KafkaConsumer", topic: str):
+        """Range assignment over the sorted membership."""
+        with self.lock:
+            key = (consumer.group_id, topic)
+            members = sorted(self.members.get(key, []), key=id)
+            if consumer not in members:
+                return []
+            n_parts = self.partitions.get(topic, 0)
+            idx = members.index(consumer)
+            per, extra = divmod(n_parts, len(members))
+            start = idx * per + min(idx, extra)
+            count = per + (1 if idx < extra else 0)
+            return [TopicPartition(topic, p)
+                    for p in range(start, start + count)]
+
+
+CLUSTER = FakeCluster()
+
+
+def reset_cluster() -> None:
+    global CLUSTER
+    CLUSTER = FakeCluster()
+
+
+class KafkaConsumer:
+    def __init__(self, bootstrap_servers=None, group_id=None,
+                 enable_auto_commit=True, **config) -> None:
+        assert enable_auto_commit is False, \
+            "smart-commit invariant: auto commit must be forced off"
+        self.group_id = group_id
+        self.config = config
+        self._topic: str | None = None
+        self._listener: ConsumerRebalanceListener | None = None
+        self._assignment: list[TopicPartition] = []
+        self._seen_generation = -1
+        self._positions: dict[TopicPartition, int] = {}
+        self._paused: set[TopicPartition] = set()
+        self._closed = False
+        self.poll_calls = 0
+
+    # -- membership --------------------------------------------------------
+    def subscribe(self, topics, listener=None) -> None:
+        (self._topic,) = topics
+        self._listener = listener
+        CLUSTER.join(self, self._topic)
+
+    def _maybe_rebalance(self) -> None:
+        """Group progress happens only here (inside poll), like the real
+        client."""
+        key = (self.group_id, self._topic)
+        gen = CLUSTER.generation.get(key, 0)
+        if gen == self._seen_generation:
+            return
+        new = CLUSTER.assignment_for(self, self._topic)
+        if self._listener is not None and self._assignment:
+            self._listener.on_partitions_revoked(list(self._assignment))
+        self._assignment = new
+        self._seen_generation = gen
+        for tp in new:
+            if tp not in self._positions:
+                self._positions[tp] = CLUSTER.committed.get(
+                    (self.group_id, tp.topic, tp.partition), 0)
+        if self._listener is not None:
+            self._listener.on_partitions_assigned(list(new))
+
+    # -- consumption -------------------------------------------------------
+    def poll(self, timeout_ms=0, max_records=500, update_offsets=True):
+        if self._closed:
+            raise RuntimeError("consumer closed")
+        self.poll_calls += 1
+        self._maybe_rebalance()
+        out: dict[TopicPartition, list[ConsumerRecord]] = {}
+        budget = max_records
+        for tp in self._assignment:
+            if budget <= 0:
+                break
+            if tp in self._paused:
+                continue
+            pos = self._positions.get(tp, 0)
+            with CLUSTER.lock:
+                recs = CLUSTER.logs.get((tp.topic, tp.partition), [])[
+                    pos: pos + budget]
+            if recs:
+                out[tp] = list(recs)
+                budget -= len(recs)
+                if update_offsets:
+                    self._positions[tp] = recs[-1].offset + 1
+        return out
+
+    def assignment(self):
+        return set(self._assignment)
+
+    def position(self, tp):
+        if tp not in self._assignment:
+            raise errors.CommitFailedError(f"not assigned: {tp}")
+        return self._positions.get(tp, 0)
+
+    def seek(self, tp, offset):
+        self._positions[tp] = offset
+
+    def pause(self, *tps):
+        self._paused.update(tps)
+
+    def resume(self, *tps):
+        self._paused.difference_update(tps)
+
+    def paused(self):
+        return set(self._paused)
+
+    # -- offsets -----------------------------------------------------------
+    def commit(self, offsets) -> None:
+        self._maybe_rebalance()  # a stale snapshot surfaces here, like real
+        for tp, om in offsets.items():
+            if tp not in self._assignment:
+                raise errors.CommitFailedError(
+                    f"{tp} not assigned to this consumer (generation moved)")
+            with CLUSTER.lock:
+                key = (self.group_id, tp.topic, tp.partition)
+                CLUSTER.committed[key] = om.offset
+
+    def committed(self, tp):
+        with CLUSTER.lock:
+            got = CLUSTER.committed.get((self.group_id, tp.topic, tp.partition))
+        if got is None:
+            return None
+        return structs.OffsetAndMetadata(got, None, -1)
+
+    def close(self) -> None:
+        if self._topic is not None:
+            CLUSTER.leave(self, self._topic)
+        self._closed = True

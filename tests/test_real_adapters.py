@@ -1,0 +1,356 @@
+"""Logic-level tests for the real-backend adapters, driven by scripted
+fakes (tests/fake_kafka.py; a local pyarrow filesystem for HDFS) — the
+in-image counterpart of the reference's embedded-Kafka + MiniDFS strategy
+(KafkaProtoParquetWriterTest.java:58-83).  Every branch of
+kpw_tpu/ingest/kafka_client.py's join/pump/assign/fetch/commit logic and
+kpw_tpu/io/hdfs.py's filesystem surface executes here."""
+
+import sys
+import threading
+import time
+import types
+
+import pytest
+
+import fake_kafka
+
+
+@pytest.fixture()
+def kafka_env(monkeypatch):
+    fake_kafka.reset_cluster()
+    monkeypatch.setitem(sys.modules, "kafka", fake_kafka)
+    structs_mod = types.ModuleType("kafka.structs")
+    structs_mod.OffsetAndMetadata = fake_kafka.structs.OffsetAndMetadata
+    errors_mod = types.ModuleType("kafka.errors")
+    errors_mod.CommitFailedError = fake_kafka.errors.CommitFailedError
+    monkeypatch.setitem(sys.modules, "kafka.structs", structs_mod)
+    monkeypatch.setitem(sys.modules, "kafka.errors", errors_mod)
+    return fake_kafka
+
+
+def make_client():
+    from kpw_tpu.ingest.kafka_client import KafkaBrokerClient
+
+    return KafkaBrokerClient("broker:9092", poll_timeout_ms=1)
+
+
+def pump_until(client, group, topic, member, want_parts, deadline=5.0):
+    """generation() drives the join protocol (poll inside), like the smart
+    consumer's fetch loop does every iteration."""
+    end = time.time() + deadline
+    while time.time() < end:
+        client.generation(group, topic)
+        got = client.assignment(group, topic, member)
+        if len(got) == want_parts:
+            return got
+        time.sleep(0.001)
+    raise AssertionError(
+        f"assignment never reached {want_parts} partitions: "
+        f"{client.assignment(group, topic, member)}")
+
+
+def test_join_pump_assign_fetch_commit(kafka_env):
+    """Single member: join -> generation pump completes the join inside
+    poll() -> range assignment -> fetch with seek/pause/resume -> commit ->
+    committed readback."""
+    kafka_env.CLUSTER.create_topic("t", 4)
+    for p in range(4):
+        for i in range(10):
+            kafka_env.CLUSTER.produce("t", p, f"p{p}-{i}".encode())
+
+    c = make_client()
+    c.join_group("g", "t", "m1")
+    assert c.assignment("g", "t", "m1") == []  # no progress before the pump
+    parts = pump_until(c, "g", "t", "m1", 4)
+    assert parts == [0, 1, 2, 3]
+
+    # fetch: only the requested partition's records come back even though
+    # every partition has data (the others are paused for the call)...
+    recs = c.fetch("t", 2, 0, max_records=5)
+    assert [r.value for r in recs] == [f"p2-{i}".encode() for i in range(5)]
+    assert all(r.partition == 2 for r in recs)
+    # ...and the pauses are undone afterwards
+    member = next(iter(c._members.values()))
+    assert member.consumer.paused() == set()
+
+    # replay fetch at a lower offset exercises the seek branch
+    recs = c.fetch("t", 2, 2, max_records=3)
+    assert [r.offset for r in recs] == [2, 3, 4]
+
+    # commit routes to the owner; committed() reads it back
+    c.commit("g", "t", 2, 5)
+    assert c.committed("g", "t", 2) == 5
+    assert c.committed("g", "t", 3) == 0  # never committed
+
+    c.leave_group("g", "t", "m1")
+    assert c.assignment("g", "t", "m1") == []
+
+
+def test_two_members_split_and_rebalance(kafka_env):
+    """Two members of one client split the topic; a member leaving
+    rebalances the rest onto the survivor, and fetch/commit re-route."""
+    kafka_env.CLUSTER.create_topic("t", 4)
+    for p in range(4):
+        kafka_env.CLUSTER.produce("t", p, f"v{p}".encode())
+
+    c = make_client()
+    c.join_group("g", "t", "a")
+    c.join_group("g", "t", "b")
+    end = time.time() + 5
+    while time.time() < end:
+        c.generation("g", "t")
+        pa = c.assignment("g", "t", "a")
+        pb = c.assignment("g", "t", "b")
+        if len(pa) == 2 and len(pb) == 2:
+            break
+        time.sleep(0.001)
+    assert sorted(pa + pb) == [0, 1, 2, 3]
+    gen_before = c.generation("g", "t")
+
+    # both members fetch their own partitions through the shared client
+    for p in range(4):
+        recs = c.fetch("t", p, 0, max_records=10)
+        assert [r.value for r in recs] == [f"v{p}".encode()]
+
+    # one member leaves: the survivor absorbs all partitions.  Already-
+    # assigned members only re-poll inside fetch() (generation() pumps the
+    # unassigned), so keep fetching like the production loop does.
+    c.leave_group("g", "t", "b")
+    survivor = "a"
+    still_owned = c.assignment("g", "t", survivor)[0]
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        c.fetch("t", still_owned, 1, max_records=1)  # drives the owner's poll
+        c.generation("g", "t")
+        if len(c.assignment("g", "t", survivor)) == 4:
+            break
+        time.sleep(0.001)
+    parts = c.assignment("g", "t", survivor)
+    assert parts == [0, 1, 2, 3]
+    assert c.generation("g", "t") != gen_before
+
+    # commit for a partition formerly owned by the departed member
+    c.commit("g", "t", 3, 1)
+    assert c.committed("g", "t", 3) == 1
+
+
+def test_commit_retries_across_rebalance_window(kafka_env):
+    """A commit hitting a stale ownership snapshot (CommitFailedError) must
+    re-resolve the owner and succeed — not kill the worker (round-1 advisor
+    finding: kafka_client commit fallback)."""
+    kafka_env.CLUSTER.create_topic("t", 2)
+    c = make_client()
+    c.join_group("g", "t", "a")
+    pump_until(c, "g", "t", "a", 2)  # 'a' owns both partitions
+
+    # membership changes; 'a' has a stale view until its next poll, and the
+    # new member only completes its join if something pumps — here a
+    # background pumper stands in for the production fetcher thread
+    c.join_group("g", "t", "b")
+    stop = threading.Event()
+
+    def pumper():
+        while not stop.is_set():
+            c.generation("g", "t")
+            time.sleep(0.005)
+
+    th = threading.Thread(target=pumper, daemon=True)
+    th.start()
+    try:
+        # partition 1 moves to 'b' under range assignment (a<b by id sort is
+        # not guaranteed, so find one 'a' no longer owns)
+        deadline = time.time() + 5
+        moved = None
+        while time.time() < deadline and moved is None:
+            pa = set(c.assignment("g", "t", "a"))
+            pb = set(c.assignment("g", "t", "b"))
+            if pa and pb and pa | pb == {0, 1}:
+                moved = next(iter(pb))
+            time.sleep(0.002)
+        assert moved is not None
+        c.commit("g", "t", moved, 7)  # survives the stale-ownership window
+        assert c.committed("g", "t", moved) == 7
+    finally:
+        stop.set()
+        th.join()
+
+
+def test_smart_consumer_end_to_end_over_kafka_client(kafka_env):
+    """The full smart-commit consumer running against the adapter: fetch
+    loop pumps the group, records flow, run-acks advance the broker-side
+    committed offsets."""
+    from kpw_tpu.ingest.consumer import SmartCommitConsumer
+
+    kafka_env.CLUSTER.create_topic("t", 3)
+    total = 300
+    for i in range(total):
+        kafka_env.CLUSTER.produce("t", i % 3, f"r{i}".encode())
+
+    client = make_client()
+    sc = SmartCommitConsumer(client, "g", page_size=50,
+                             max_open_pages_per_partition=10,
+                             fetch_max_records=40)
+    sc.subscribe("t")
+    sc.start()
+    try:
+        got = []
+        deadline = time.time() + 10
+        while len(got) < total and time.time() < deadline:
+            batch = sc.poll_many(64)
+            if not batch:
+                time.sleep(0.002)
+                continue
+            got.extend(batch)
+            # ack in contiguous runs per partition, like the worker does
+            by_part = {}
+            for r in batch:
+                by_part.setdefault(r.partition, []).append(r.offset)
+            for p, offs in by_part.items():
+                start = offs[0]
+                count = 1
+                for o in offs[1:]:
+                    if o == start + count:
+                        count += 1
+                    else:
+                        sc.ack_run(p, start, count)
+                        start, count = o, 1
+                sc.ack_run(p, start, count)
+        assert len(got) == total
+        assert sorted(r.value for r in got) == sorted(
+            f"r{i}".encode() for i in range(total))
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            done = all(client.committed("g", "t", p) == total // 3
+                       for p in range(3))
+            if done:
+                break
+            time.sleep(0.005)
+        assert done, [client.committed("g", "t", p) for p in range(3)]
+    finally:
+        sc.close()
+
+
+# ---------------------------------------------------------------------------
+# HDFS adapter over a real (local) pyarrow filesystem
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def hdfs(monkeypatch, tmp_path):
+    """HdfsFileSystem with pyarrow's HadoopFileSystem swapped for a
+    SubTreeFileSystem over a local directory: every adapter method runs its
+    real pyarrow logic, only the libhdfs transport is substituted."""
+    import pyarrow.fs as pafs
+
+    def fake_hadoop(host, port, user=None, **kwargs):
+        assert host == "namenode" and port == 9000
+        return pafs.SubTreeFileSystem(str(tmp_path), pafs.LocalFileSystem())
+
+    monkeypatch.setattr(pafs, "HadoopFileSystem", fake_hadoop)
+    from kpw_tpu.io.hdfs import HdfsFileSystem
+
+    return HdfsFileSystem(host="namenode", port=9000)
+
+
+def test_hdfs_adapter_full_surface(hdfs):
+    fs = hdfs
+    fs.mkdirs("out/tmp")
+    with fs.open_write("out/tmp/a.tmp") as f:
+        f.write(b"hello")
+    assert fs.exists("out/tmp/a.tmp")
+    assert fs.size("out/tmp/a.tmp") == 5
+
+    # append semantics
+    with fs.open_append("out/tmp/a.tmp") as f:
+        f.write(b" world")
+    with fs.open_read("out/tmp/a.tmp") as f:
+        assert f.read() == b"hello world"
+
+    # atomic-publish rename
+    fs.rename("out/tmp/a.tmp", "out/a.parquet")
+    assert not fs.exists("out/tmp/a.tmp")
+    with fs.open_read("out/a.parquet") as f:
+        assert f.read() == b"hello world"
+
+    # listing: extension filter + recursion
+    fs.mkdirs("out/sub")
+    with fs.open_write("out/sub/b.parquet") as f:
+        f.write(b"x")
+    files = fs.list_files("out", extension=".parquet", recursive=True)
+    assert [p.rsplit("/", 1)[-1] for p in files] == ["a.parquet", "b.parquet"]
+    flat = fs.list_files("out", extension=".parquet", recursive=False)
+    assert [p.rsplit("/", 1)[-1] for p in flat] == ["a.parquet"]
+
+    # delete contracts
+    with pytest.raises(FileNotFoundError):
+        fs.delete("out/nope")
+    with pytest.raises(IsADirectoryError):
+        fs.delete("out/sub")
+    fs.delete("out/sub/b.parquet")
+    assert not fs.exists("out/sub/b.parquet")
+    with pytest.raises(FileNotFoundError):
+        fs.size("out/nope")
+
+
+def test_writer_black_box_over_hdfs_adapter(hdfs):
+    """The reference's integration pattern (produce -> rotate -> read back
+    with an independent reader) over the HDFS adapter surface."""
+    import pyarrow.parquet as pq
+
+    from kpw_tpu import Builder, FakeBroker
+    from proto_helpers import sample_message_class
+
+    broker = FakeBroker()
+    broker.create_topic("logs", 1)
+    cls = sample_message_class()
+    msgs = []
+    for i in range(120):
+        m = cls(query=f"q-{i}", timestamp=i)
+        broker.produce("logs", m.SerializeToString())
+        msgs.append(m)
+    w = (Builder().broker(broker).topic("logs").proto_class(cls)
+         .target_dir("out").filesystem(hdfs).instance_name("hdfs-test")
+         .max_file_open_duration_seconds(0.8).build())
+    with w:
+        deadline = time.time() + 10
+        files = []
+        while time.time() < deadline and not files:
+            files = hdfs.list_files("out", extension=".parquet",
+                                    recursive=False)
+            time.sleep(0.01)
+    assert files
+    rows = []
+    for p in files:
+        rows.extend(pq.read_table(hdfs.open_read(p)).to_pylist())
+    assert sorted(r["timestamp"] for r in rows) == list(range(120))
+
+
+def test_kafka_client_edge_branches(kafka_env):
+    """The less-happy paths: double join is a no-op, ownerless
+    committed()/fetch() degrade gracefully, commit with no members raises
+    immediately, and commit to a partition nobody owns exhausts its
+    rebalance retries with a clear error."""
+    kafka_env.CLUSTER.create_topic("t", 2)
+    c = make_client()
+
+    # no members yet
+    assert c.committed("g", "t", 0) == 0
+    with pytest.raises(RuntimeError, match="no consumer joined"):
+        c.commit("g", "t", 0, 1)
+
+    c.join_group("g", "t", "m")
+    c.join_group("g", "t", "m")  # duplicate join: no-op, no second consumer
+    assert len(c._members) == 1
+
+    # before the pump: no owner anywhere -> committed falls back, fetch
+    # returns nothing
+    assert c.committed("g", "t", 0) == 0
+    assert c.fetch("t", 0, 0, max_records=5) == []
+
+    pump_until(c, "g", "t", "m", 2)
+    # a partition outside the topic: never owned, fetch empty
+    assert c.fetch("t", 9, 0, max_records=5) == []
+
+    # commit to a partition no member owns: bounded retries, then a clear
+    # failure (not a silent drop)
+    with pytest.raises(RuntimeError, match="kept failing"):
+        c.commit("g", "t", 9, 1)
