@@ -92,6 +92,7 @@ class ParquetFileWriter:
         self._pending: list[ColumnChunkData] | None = None
         self._pending_rows = 0
         self._pending_bytes = 0
+        self._size_ratio = 1.0  # EWMA of on-disk bytes / raw-estimate bytes
         self._num_rows = 0
         self._closed = False
         # 3-stage pipeline (SURVEY.md §2.4): caller accumulates batch N+2
@@ -128,12 +129,23 @@ class ParquetFileWriter:
     def bytes_written(self) -> int:
         return self._pos
 
+    @property
+    def size_ratio(self) -> float:
+        """Measured on-disk/raw-estimate byte ratio of committed row groups
+        (1.0 until the first commit)."""
+        return self._size_ratio
+
     def estimated_size(self) -> int:
         """In-flight size estimate: bytes on disk + buffered batch estimate
         + row groups queued in the pipeline.  The reference's rotation check
         reads in-flight ParquetWriter getDataSize() (ParquetFile.java:77-79);
-        this is the equivalent."""
-        return self._pos + self._pending_bytes + self._inflight_bytes
+        this is the equivalent.  Buffered/in-flight raw bytes are scaled by
+        the measured encoded/raw ratio of already-committed row groups so
+        size-based rotation tracks what will actually land on disk
+        (dictionary/RLE/compression can shrink — or stats can grow — the
+        raw columnar estimate substantially)."""
+        return self._pos + int(
+            self._size_ratio * (self._pending_bytes + self._inflight_bytes))
 
     def append_batch(self, batch: ColumnBatch) -> None:
         """Pure-memory append: buffers the batch, never touches the sink
@@ -269,7 +281,7 @@ class ParquetFileWriter:
             encoded, rows, est = item
             while not self._abandoned.is_set() and self._pipe_error is None:
                 try:
-                    self._commit_encoded(encoded, rows)
+                    self._commit_encoded(encoded, rows, raw_estimate=est)
                     break
                 except OSError:
                     time.sleep(0.1)
@@ -278,10 +290,13 @@ class ParquetFileWriter:
             with self._inflight_lock:
                 self._inflight_bytes -= est
 
-    def _commit_encoded(self, encoded_chunks, num_rows: int) -> None:
+    def _commit_encoded(self, encoded_chunks, num_rows: int,
+                        raw_estimate: int = 0) -> None:
         """Write encoded-at-offset-0 chunks at the current position and
         record the row group.  Raises before any state change on IO failure
-        (the positioned _write seeks back on retry)."""
+        (the positioned _write seeks back on retry).  ``raw_estimate`` is the
+        pre-encode pending-bytes estimate for this row group; it feeds the
+        encoded/raw size-ratio EWMA behind :meth:`estimated_size`."""
         rg_start = self._pos
         blobs = []
         columns: list[ColumnChunk] = []
@@ -294,6 +309,11 @@ class ParquetFileWriter:
             total_compressed += m.total_compressed_size
         with stage("rowgroup.io_write"):
             self._write(b"".join(blobs))  # raises => nothing mutated yet
+        if raw_estimate > 0:
+            actual = sum(len(b) for b in blobs)
+            if actual > 0:
+                self._size_ratio += 0.5 * (actual / raw_estimate
+                                           - self._size_ratio)
         for e in encoded_chunks:
             # metas carry running offsets based at 0 (encode_many's base);
             # shift the whole row group to its absolute file position
@@ -397,7 +417,9 @@ class ParquetFileWriter:
         chunks = [self._merge_chunks(parts) for parts in self._pending]
         num_rows = self._pending_rows
         encoded_chunks = self._encode_chunks(chunks)
-        self._commit_encoded(encoded_chunks, num_rows)  # raises => retry safe
+        # raises => retry safe (state mutates only after a successful write)
+        self._commit_encoded(encoded_chunks, num_rows,
+                             raw_estimate=self._pending_bytes)
         self._pending = None
         self._pending_rows = 0
         self._pending_bytes = 0

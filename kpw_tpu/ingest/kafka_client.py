@@ -27,9 +27,12 @@ family the reference uses from the JVM, KafkaProtoParquetWriter.java:30-32):
 
 ``kafka-python`` is an optional dependency — constructing the client without
 it raises ImportError with install guidance; nothing here is imported at
-package import time.  Not covered by in-repo tests (no broker in the test
-image); the FakeBroker-backed integration suite drives the identical
-consumer surface (tests/test_ingest.py, test_writer_integration.py).
+package import time.  No broker exists in the test image, but every branch
+here (join/pump/assign/fetch/seek/pause/resume/commit/rebalance) is driven
+by a scripted fake ``kafka.KafkaConsumer`` in tests/test_real_adapters.py
+(see tests/fake_kafka.py); the FakeBroker-backed integration suite drives
+the identical consumer surface (tests/test_ingest.py,
+test_writer_integration.py).
 """
 
 from __future__ import annotations

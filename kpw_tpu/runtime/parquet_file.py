@@ -29,6 +29,7 @@ class ParquetFile:
         batch_size: int = 4096,
         encoder=None,
         pipeline: bool = False,
+        est_record_bytes: float = 64.0,
     ) -> None:
         self.path = path
         self._fs = fs
@@ -40,7 +41,10 @@ class ParquetFile:
         self._batch: list = []
         self._batch_size = batch_size
         self._num_records = 0
-        self._est_record_bytes = 64.0  # EWMA of encoded bytes per record
+        # EWMA of encoded bytes per record; seedable so a rotated-away
+        # file's measured estimate carries into its successor (tight
+        # size-based rotation needs a warm estimate from record one)
+        self._est_record_bytes = float(est_record_bytes)
         self._creation_time = time.time()
         self._closed = False
 
@@ -75,6 +79,7 @@ class ParquetFile:
         buffered records only reach the writer at the next threshold flush,
         which would land them AFTER this batch."""
         self._writer.append_batch(batch)
+        self._observe_record_bytes(batch)
         self._num_records += batch.num_rows
 
     def flush_buffered(self) -> None:
@@ -122,6 +127,24 @@ class ParquetFile:
         return self._writer.estimated_size() + int(
             len(self._batch) * self._est_record_bytes)
 
+    def _observe_record_bytes(self, batch) -> None:
+        """Fold one columnar batch into the bytes/record EWMA.  Uses the
+        batch's raw estimate scaled by the writer's measured encoded/raw
+        ratio — NOT a before/after diff of estimated_size(), which the
+        pipeline's IO thread mutates concurrently (a row-group commit
+        between the two reads would inject its estimate-vs-actual delta
+        into this sample)."""
+        n = batch.num_rows
+        grew = self._writer.size_ratio * batch.estimated_bytes()
+        if n and grew > 0:
+            self._est_record_bytes += 0.5 * (grew / n - self._est_record_bytes)
+
+    @property
+    def est_record_bytes(self) -> float:
+        """Live EWMA of encoded bytes per record — the worker's rotation
+        poll cap reads this to stop polling just past the size threshold."""
+        return self._est_record_bytes
+
     def get_creation_time(self) -> float:
         return self._creation_time
 
@@ -133,11 +156,6 @@ class ParquetFile:
         if not self._batch:
             return
         batch = self._columnarizer.columnarize(self._batch)
-        n = len(self._batch)
         self._batch = []
-        before = self._writer.estimated_size()
         self._writer.write_batch(batch)
-        grew = self._writer.estimated_size() - before
-        if n and grew > 0:
-            per = grew / n
-            self._est_record_bytes += 0.5 * (per - self._est_record_bytes)
+        self._observe_record_bytes(batch)

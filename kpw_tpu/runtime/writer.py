@@ -182,6 +182,9 @@ class _Worker:
         # bookkeeping was a measurable slice of the hot loop
         self._written_runs: list[list[int]] = []
         self._file_records = 0
+        # encoded-bytes/record estimate carried across rotations so every
+        # file (not just the first's successors) rotates tightly
+        self._carry_est = 64.0
 
     def start(self) -> None:
         self._thread.start()
@@ -218,7 +221,8 @@ class _Worker:
                 if (self.current_file is not None
                         and self._is_file_timed_out()):
                     self._finalize_current_file()
-                recs = self.p.consumer.poll_many(poll_batch)
+                recs = self.p.consumer.poll_many(
+                    self._poll_cap(poll_batch))
                 if not recs:
                     time.sleep(0.001)
                     continue
@@ -325,6 +329,21 @@ class _Worker:
                 run = [r.partition, r.offset, r.offset + 1]
                 runs.append(run)
 
+    def _poll_cap(self, base: int) -> int:
+        """Shrink the poll batch as the open file nears its size threshold:
+        never ask for more records than the live bytes/record estimate says
+        fit in the remaining budget (plus one).  This is what restores the
+        reference's ~1% rotation overshoot (KafkaProtoParquetWriterTest.java:
+        166-173) without giving up large batches far from the threshold."""
+        f = self.current_file
+        if f is None:
+            return base
+        remaining = self.p._b._max_file_size - f.get_data_size()
+        if remaining <= 0:
+            return 1  # next append rotates immediately
+        est = max(f.est_record_bytes, 1.0)
+        return max(1, min(base, int(remaining / est) + 1))
+
     def _is_file_timed_out(self) -> bool:
         return (time.time() - self.current_file.get_creation_time()
                 >= self.p._b._max_file_open_duration)
@@ -369,6 +388,7 @@ class _Worker:
                 batch_size=batch,
                 encoder=self.p._encoder_factory(),
                 pipeline=self.p._b._pipeline,
+                est_record_bytes=self._carry_est,
             )
 
         self.current_file = try_until_succeeds(make, stop_event=self._stop)
@@ -385,6 +405,7 @@ class _Worker:
         f = self.current_file
         if f is None:
             return
+        self._carry_est = f.est_record_bytes
         if f.get_num_written_records() == 0:
             # never publish empty files; just drop the tmp
             try_until_succeeds(f.close, stop_event=self._stop)
