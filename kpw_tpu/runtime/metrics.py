@@ -9,37 +9,106 @@ written≠flushed distinction (buffered vs durable) is load-bearing and kept.
 
 from __future__ import annotations
 
+import math
 import threading
 import time
 
+_TICK_INTERVAL = 5.0  # seconds per EWMA tick (Dropwizard's constant)
+
+
+class _EWMA:
+    """One exponentially-weighted moving average over a fixed window,
+    advanced in discrete 5-second ticks (Dropwizard EWMA semantics: the
+    first tick seeds the rate with the instantaneous value; later ticks
+    blend with alpha = 1 - e^(-interval/window))."""
+
+    def __init__(self, window_minutes: float) -> None:
+        self._alpha = 1.0 - math.exp(-_TICK_INTERVAL / (window_minutes * 60.0))
+        self._rate = 0.0
+        self._initialized = False
+        self._uncounted = 0
+
+    def update(self, n: int) -> None:
+        self._uncounted += n
+
+    def tick(self) -> None:
+        inst = self._uncounted / _TICK_INTERVAL
+        self._uncounted = 0
+        if self._initialized:
+            self._rate += self._alpha * (inst - self._rate)
+        else:
+            self._rate = inst
+            self._initialized = True
+
+    @property
+    def rate(self) -> float:
+        return self._rate
+
 
 class Meter:
-    """Monotonic counter + exponentially-weighted 1-minute rate."""
+    """Monotonic counter + Dropwizard-fidelity moving-average rates.
 
-    def __init__(self) -> None:
+    The reference registers Dropwizard ``Meter``s (KafkaProtoParquetWriter.
+    java:111-119): a count plus 1/5/15-minute exponentially-weighted rates
+    ticked every 5 seconds, and a lifetime mean rate.  Rates advance lazily
+    (on mark or read) like Dropwizard's ``tickIfNecessary``; an idle gap
+    replays the missed ticks so rates decay exactly as if ticked on time."""
+
+    def __init__(self, clock=time.monotonic) -> None:
+        self._clock = clock
         self._count = 0
         self._lock = threading.Lock()
-        self._rate = 0.0
-        self._last = time.monotonic()
+        self._start = clock()
+        self._last_tick = self._start
+        self._m1 = _EWMA(1.0)
+        self._m5 = _EWMA(5.0)
+        self._m15 = _EWMA(15.0)
+
+    def _tick_if_necessary(self) -> None:
+        age = self._clock() - self._last_tick
+        if age < _TICK_INTERVAL:
+            return
+        ticks = int(age // _TICK_INTERVAL)
+        self._last_tick += ticks * _TICK_INTERVAL
+        for _ in range(ticks):
+            self._m1.tick()
+            self._m5.tick()
+            self._m15.tick()
 
     def mark(self, n: int = 1) -> None:
         with self._lock:
-            now = time.monotonic()
-            dt = now - self._last
-            if dt > 0:
-                inst = n / dt if dt < 60 else 0.0
-                alpha = min(1.0, dt / 60.0)
-                self._rate += alpha * (inst - self._rate)
-                self._last = now
+            self._tick_if_necessary()
             self._count += n
+            self._m1.update(n)
+            self._m5.update(n)
+            self._m15.update(n)
 
     @property
     def count(self) -> int:
         return self._count
 
+    def _rate(self, ewma: _EWMA) -> float:
+        with self._lock:
+            self._tick_if_necessary()
+            return ewma.rate
+
     @property
     def one_minute_rate(self) -> float:
-        return self._rate
+        return self._rate(self._m1)
+
+    @property
+    def five_minute_rate(self) -> float:
+        return self._rate(self._m5)
+
+    @property
+    def fifteen_minute_rate(self) -> float:
+        return self._rate(self._m15)
+
+    @property
+    def mean_rate(self) -> float:
+        with self._lock:
+            elapsed = self._clock() - self._start
+            return self._count / elapsed if elapsed > 0 else 0.0
 
 
 class Histogram:
