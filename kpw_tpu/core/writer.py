@@ -48,6 +48,7 @@ class WriterProperties:
     write_statistics: bool = True
     delta_fallback: bool = False
     encoder_threads: int = 0
+    page_checksums: bool = False
     key_value_metadata: dict = field(default_factory=dict)
 
     def encoder_options(self) -> EncoderOptions:
@@ -59,6 +60,7 @@ class WriterProperties:
             write_statistics=self.write_statistics,
             delta_fallback=self.delta_fallback,
             encoder_threads=self.encoder_threads,
+            page_checksums=self.page_checksums,
         )
 
 

@@ -48,6 +48,7 @@ class Builder:
         self._enable_dictionary = True  # (:489)
         self._delta_fallback = False  # BASELINE config 3 opt-in
         self._encoder_threads = 0  # native column-parallel encode (0 = auto)
+        self._page_checksums = False  # parquet-mr 1.10 parity: no page CRCs
         self._file_date_time_pattern = "%Y%m%d-%H%M%S%f"  # (:486-487 analog)
         self._directory_date_time_pattern: str | None = None
         self._file_extension = ".parquet"  # (:488)
@@ -150,6 +151,15 @@ class Builder:
 
     def enable_dictionary(self, flag: bool) -> "Builder":
         self._enable_dictionary = flag
+        return self
+
+    def page_checksums(self, flag: bool) -> "Builder":
+        """Write the optional CRC-32 field (gzip polynomial, PARQUET-1539)
+        in every page header so readers that verify checksums (e.g. pyarrow
+        page_checksum_verification) detect torn/corrupt pages.  Off by
+        default — parity with parquet-mr 1.10, which doesn't write page
+        CRCs."""
+        self._page_checksums = flag
         return self
 
     def delta_fallback(self, flag: bool) -> "Builder":
@@ -359,4 +369,5 @@ class Builder:
             enable_dictionary=self._enable_dictionary,
             delta_fallback=self._delta_fallback,
             encoder_threads=self._encoder_threads,
+            page_checksums=self._page_checksums,
         )
