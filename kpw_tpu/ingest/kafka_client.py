@@ -75,6 +75,10 @@ class KafkaBrokerClient:
         # consumer — fold removed members' counts (plus one for the leave
         # itself) into a per-group base
         self._gen_base: dict[str, int] = {}
+        # last known (group, TopicPartition) -> owning member; a pure
+        # accelerator for _owner() — every hit is re-validated against the
+        # member's live assignment, so stale entries only cost a rescan
+        self._owner_cache: dict[tuple, _Member] = {}
 
     # -- group membership --------------------------------------------------
     def join_group(self, group: str, topic: str, member_id: str) -> None:
@@ -109,6 +113,12 @@ class KafkaBrokerClient:
             if member is not None:
                 self._gen_base[group] = (self._gen_base.get(group, 0)
                                          + member.generation + 1)
+                # a closed kafka-python consumer can still report its old
+                # assignment, so the cache's validity check would pass and
+                # route commits to a dead consumer — drop its entries now
+                for key in [k for k, m in self._owner_cache.items()
+                            if m is member]:
+                    self._owner_cache.pop(key, None)
         if member is not None:
             with member.lock:
                 member.consumer.close()
@@ -150,10 +160,25 @@ class KafkaBrokerClient:
         from kafka import TopicPartition
 
         tp = TopicPartition(topic, partition)
+        # Fast path: the last known owner, validated with one O(1)
+        # assignment lookup under its own lock.  commit() runs per ack round
+        # — a full members scan (locking every consumer) per commit is
+        # O(members) of lock traffic that this cache avoids; a rebalance
+        # invalidates the entry naturally (the membership check fails).
+        cached = self._owner_cache.get((group, tp))
+        if cached is not None:
+            try:
+                with cached.lock:
+                    if tp in cached.consumer.assignment():
+                        return cached
+            except Exception:
+                pass  # closed/leaving consumer: fall through to the scan
         for member in self._group_members(group):
             with member.lock:
                 if tp in member.consumer.assignment():
+                    self._owner_cache[(group, tp)] = member
                     return member
+        self._owner_cache.pop((group, tp), None)
         return None
 
     # -- offsets -----------------------------------------------------------
@@ -223,15 +248,25 @@ class KafkaBrokerClient:
                     continue
                 if consumer.position(tp) != offset:
                     consumer.seek(tp, offset)
-                others = [p for p in consumer.assignment() if p != tp]
-                if others:
-                    consumer.pause(*others)
-                try:
-                    batch = consumer.poll(timeout_ms=self._poll_timeout_ms,
-                                          max_records=max_records)
-                finally:
-                    if others:
-                        consumer.resume(*others)
+                # Steady state keeps every partition except the fetch target
+                # paused, issuing pause/resume only for the DELTA vs the
+                # consumer's current pause set — consecutive fetches of the
+                # same partition cost zero calls, round-robining costs two,
+                # versus 2*(n-1) for pause-all/resume-all per fetch.  A
+                # rebalance self-heals: revoked partitions drop out of
+                # paused(), newly assigned ones arrive unpaused and land in
+                # want_paused on the next call.
+                assigned = set(consumer.assignment())
+                cur_paused = set(consumer.paused())
+                want_paused = assigned - {tp}
+                to_pause = want_paused - cur_paused
+                if to_pause:
+                    consumer.pause(*to_pause)
+                to_resume = cur_paused - want_paused
+                if to_resume:
+                    consumer.resume(*to_resume)
+                batch = consumer.poll(timeout_ms=self._poll_timeout_ms,
+                                      max_records=max_records)
                 return [Record(topic=topic, partition=partition,
                                offset=r.offset, key=r.key, value=r.value,
                                timestamp=r.timestamp / 1000.0)

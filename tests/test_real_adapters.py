@@ -65,17 +65,24 @@ def test_join_pump_assign_fetch_commit(kafka_env):
     assert parts == [0, 1, 2, 3]
 
     # fetch: only the requested partition's records come back even though
-    # every partition has data (the others are paused for the call)...
+    # every partition has data (the others stay paused as the steady state;
+    # fetch only issues pause/resume for the delta vs the current set)
     recs = c.fetch("t", 2, 0, max_records=5)
     assert [r.value for r in recs] == [f"p2-{i}".encode() for i in range(5)]
     assert all(r.partition == 2 for r in recs)
-    # ...and the pauses are undone afterwards
     member = next(iter(c._members.values()))
-    assert member.consumer.paused() == set()
+    paused = {tp.partition for tp in member.consumer.paused()}
+    assert paused == {0, 1, 3}
 
-    # replay fetch at a lower offset exercises the seek branch
+    # replay fetch at a lower offset exercises the seek branch; same target
+    # partition => the pause set is already right, zero pause/resume calls
     recs = c.fetch("t", 2, 2, max_records=3)
     assert [r.offset for r in recs] == [2, 3, 4]
+    # switching the fetch target swaps exactly one pair in the pause set
+    recs = c.fetch("t", 1, 0, max_records=2)
+    assert all(r.partition == 1 for r in recs)
+    paused = {tp.partition for tp in member.consumer.paused()}
+    assert paused == {0, 2, 3}
 
     # commit routes to the owner; committed() reads it back
     c.commit("g", "t", 2, 5)
