@@ -114,17 +114,26 @@ class ParquetFileWriter:
 
     # -- low level ---------------------------------------------------------
     def _write(self, data: bytes) -> None:
-        """Positioned write: on retry after a partially-failed earlier write,
-        seek back to the logical position so garbage bytes are overwritten and
-        footer/page offsets stay true (at-least-once: a transient IO failure
-        must never silently drop or shift data)."""
+        self._write_parts([data])
+
+    def _write_parts(self, parts: list) -> int:
+        """Positioned write of one or more buffers without concatenation: on
+        retry after a partially-failed earlier write, seek back to the
+        logical position so garbage bytes are overwritten and footer/page
+        offsets stay true (at-least-once: a transient IO failure must never
+        silently drop or shift data).  _pos only advances after every part
+        is written.  Returns the bytes written."""
         if self._pos and hasattr(self.sink, "seek"):
             try:
                 self.sink.seek(self._pos)
             except (OSError, io.UnsupportedOperation):
                 pass
-        self.sink.write(data)
-        self._pos += len(data)
+        written = 0
+        for p in parts:
+            self.sink.write(p)
+            written += len(p)
+        self._pos += written
+        return written
 
     # -- public ------------------------------------------------------------
     @property
@@ -310,12 +319,13 @@ class ParquetFileWriter:
             total_byte_size += m.total_uncompressed_size
             total_compressed += m.total_compressed_size
         with stage("rowgroup.io_write"):
-            self._write(b"".join(blobs))  # raises => nothing mutated yet
-        if raw_estimate > 0:
-            actual = sum(len(b) for b in blobs)
-            if actual > 0:
-                self._size_ratio += 0.5 * (actual / raw_estimate
-                                           - self._size_ratio)
+            # one seek, then per-chunk writes: no b"".join bounce copy of
+            # the whole row group (tens of MB at default block size);
+            # raises => nothing mutated yet (_pos only advances at the end)
+            actual = self._write_parts(blobs)
+        if raw_estimate > 0 and actual > 0:
+            self._size_ratio += 0.5 * (actual / raw_estimate
+                                       - self._size_ratio)
         for e in encoded_chunks:
             # metas carry running offsets based at 0 (encode_many's base);
             # shift the whole row group to its absolute file position

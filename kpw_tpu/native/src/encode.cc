@@ -130,9 +130,16 @@ int dict_build(const K* vals, size_t n, K* dict_out, uint32_t* idx_out,
       keys[s] = uniq[id];
     }
   };
-  for (size_t i = 0; i < n; ++i) {
-    const K val = vals[i];
-    size_t s = static_cast<size_t>(mix(static_cast<uint64_t>(val))) & mask;
+  uint32_t gen = 0;  // bumped by grow(); invalidates precomputed slots
+  auto grow_gen = [&]() {
+    grow();
+    ++gen;
+  };
+  // resolve one value starting at slot s; returns 1 iff dictionary
+  // infeasible.  Output is independent of processing order: the final
+  // dictionary is the SORTED unique set and indices are remapped through
+  // the rank permutation below, so discovery ids never leak out.
+  auto resolve = [&](const K val, size_t s, size_t i) -> int {
     for (;;) {
       const uint32_t id = ids[s];
       if (id == UINT32_MAX) {
@@ -141,15 +148,52 @@ int dict_build(const K* vals, size_t n, K* dict_out, uint32_t* idx_out,
         idx_out[i] = static_cast<uint32_t>(uniq.size());
         uniq.push_back(val);
         if (uniq.size() > max_k) return 1;  // dictionary infeasible
-        if (2 * uniq.size() >= cap) grow();
-        break;
+        if (2 * uniq.size() >= cap) grow_gen();
+        return 0;
       }
       if (keys[s] == val) {
         idx_out[i] = id;
-        break;
+        return 0;
       }
       s = (s + 1) & mask;
     }
+  };
+  // 4-way interleaved probing: hash four values up front and prefetch
+  // their slots so the mix() latency and the dependent table loads of
+  // consecutive values overlap instead of serializing (~2x on
+  // medium-cardinality 64-bit keys, e.g. float bit patterns).
+  size_t i = 0;
+  for (; i + 4 <= n; i += 4) {
+    const uint32_t g0 = gen;
+    size_t s0 = static_cast<size_t>(mix(static_cast<uint64_t>(vals[i]))) & mask;
+    size_t s1 = static_cast<size_t>(mix(static_cast<uint64_t>(vals[i + 1]))) & mask;
+    size_t s2 = static_cast<size_t>(mix(static_cast<uint64_t>(vals[i + 2]))) & mask;
+    size_t s3 = static_cast<size_t>(mix(static_cast<uint64_t>(vals[i + 3]))) & mask;
+    __builtin_prefetch(&ids[s0]);
+    __builtin_prefetch(&ids[s1]);
+    __builtin_prefetch(&ids[s2]);
+    __builtin_prefetch(&ids[s3]);
+    __builtin_prefetch(&keys[s0]);
+    __builtin_prefetch(&keys[s1]);
+    __builtin_prefetch(&keys[s2]);
+    __builtin_prefetch(&keys[s3]);
+    // a grow() mid-block stales the remaining precomputed slots (mask
+    // changed) — recompute those from the value
+    if (resolve(vals[i], s0, i)) return 1;
+    if (gen != g0)
+      s1 = static_cast<size_t>(mix(static_cast<uint64_t>(vals[i + 1]))) & mask;
+    if (resolve(vals[i + 1], s1, i + 1)) return 1;
+    if (gen != g0)
+      s2 = static_cast<size_t>(mix(static_cast<uint64_t>(vals[i + 2]))) & mask;
+    if (resolve(vals[i + 2], s2, i + 2)) return 1;
+    if (gen != g0)
+      s3 = static_cast<size_t>(mix(static_cast<uint64_t>(vals[i + 3]))) & mask;
+    if (resolve(vals[i + 3], s3, i + 3)) return 1;
+  }
+  for (; i < n; ++i) {
+    const size_t s =
+        static_cast<size_t>(mix(static_cast<uint64_t>(vals[i]))) & mask;
+    if (resolve(vals[i], s, i)) return 1;
   }
   // Canonical ascending order: sort the (small) unique set, then remap the
   // discovery-order ids through the rank permutation in one linear pass.
@@ -416,12 +460,53 @@ int kpw_rle_hybrid_u32(const uint32_t* v, size_t n, int width, uint8_t* out,
     return 0;
   }
   // Long-run mass decides pure-bitpack vs mixed (mirrors the numpy oracle).
+  //
+  // The scalar run scan below mispredicts on every short run, which makes
+  // it the dominant cost on random low-cardinality data — exactly the data
+  // that has NO long runs.  So first answer "is there any run of >= 8 equal
+  // values?" branchlessly: build a bitmap of adjacent-equal pairs (a value
+  // run of length L is L-1 consecutive set bits) and AND seven shifted
+  // copies over a 128-bit window so cross-word runs are seen.  Only when a
+  // long run exists (runny data, where the scalar scan is cheap — few run
+  // boundaries) does the exact mass computation run.
   uint64_t long_mass = 0;
-  for (size_t i = 0; i < n;) {
-    size_t j = i + 1;
-    while (j < n && v[j] == v[i]) ++j;
-    if (j - i >= 8) long_mass += j - i;
-    i = j;
+  bool any_long = false;
+  {
+    // rolling two-word window: test starts in `prev` with `cur` appended
+    // so cross-word runs are seen; early-exits on the first hit (an
+    // all-equal column is detected after ~two words), no allocation
+    const size_t pairs = n - 1;
+    const size_t words = (pairs + 63) / 64;
+    auto window_hit = [](uint64_t low, uint64_t high) -> bool {
+      const unsigned __int128 x =
+          static_cast<unsigned __int128>(low) |
+          (static_cast<unsigned __int128>(high) << 64);
+      unsigned __int128 t = x;
+      for (int s = 1; s <= 6; ++s) t &= x >> s;
+      return static_cast<uint64_t>(t) != 0;  // a 7-pair start in `low`
+    };
+    uint64_t prev = 0;
+    for (size_t w = 0; w < words; ++w) {
+      const size_t base = w * 64;
+      const size_t m = std::min<size_t>(64, pairs - base);
+      uint64_t bits = 0;
+      for (size_t b = 0; b < m; ++b)
+        bits |= static_cast<uint64_t>(v[base + b] == v[base + b + 1]) << b;
+      if (w > 0 && window_hit(prev, bits)) {
+        any_long = true;
+        break;
+      }
+      prev = bits;
+    }
+    if (!any_long && words > 0 && window_hit(prev, 0)) any_long = true;
+  }
+  if (any_long) {
+    for (size_t i = 0; i < n;) {
+      size_t j = i + 1;
+      while (j < n && v[j] == v[i]) ++j;
+      if (j - i >= 8) long_mass += j - i;
+      i = j;
+    }
   }
   uint64_t thresh = n / 10;
   if (thresh < 8) thresh = 8;
