@@ -68,6 +68,11 @@ class ColumnBatch:
     """A batch of rows in columnar form: list of ColumnChunkData, one per
     schema leaf, all covering the same rows."""
 
+    # serialized-payload bytes this batch was shredded from (set by the wire
+    # shredder; None for batches built from parsed records/arrays) — lets
+    # the worker meter written bytes without re-walking the records
+    wire_bytes: int | None = None
+
     def __init__(self, chunks: list[ColumnChunkData], num_rows: int) -> None:
         self.chunks = chunks
         self.num_rows = num_rows

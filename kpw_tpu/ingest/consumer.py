@@ -98,23 +98,42 @@ class SmartCommitConsumer:
         with self._buf_cond:
             return self._drain_locked(max_records)
 
-    def _drain_locked(self, max_records: int) -> list[Record]:
+    def poll_many_runs(self, max_records: int):
+        """Like :meth:`poll_many` but also returns the drained records as
+        contiguous (partition, start_offset, count) runs, in record order.
+        Buffered batches are single-partition fetch slices, so runs come out
+        O(1) per slice instead of the caller re-deriving them per record —
+        the ack-bookkeeping fast path for the streaming worker."""
+        runs: list[tuple[int, int, int]] = []
+        with self._buf_cond:
+            recs = self._drain_locked(max_records, runs)
+        return recs, runs
+
+    def _drain_locked(self, max_records: int,
+                      runs: list | None = None) -> list[Record]:
         out: list[Record] = []
         while self._buf and len(out) < max_records:
             head = self._buf[0]
             avail = len(head) - self._head_pos
             take = max_records - len(out)
             if take >= avail:
-                out.extend(head[self._head_pos:] if self._head_pos else head)
+                chunk = head[self._head_pos:] if self._head_pos else head
                 self._buf.popleft()
                 self._head_pos = 0
                 self._buf_count -= avail
             else:
                 # partial drain: advance an index into the head batch (O(1)
                 # per-record consumption for poll() users; no reslicing)
-                out.extend(head[self._head_pos: self._head_pos + take])
+                chunk = head[self._head_pos: self._head_pos + take]
                 self._head_pos += take
                 self._buf_count -= take
+            out.extend(chunk)
+            if runs is not None and chunk:
+                first, last = chunk[0], chunk[-1]
+                if last.offset - first.offset == len(chunk) - 1:
+                    runs.append((first.partition, first.offset, len(chunk)))
+                else:  # gap inside a batch (compacted topic): exact per record
+                    runs.extend((r.partition, r.offset, 1) for r in chunk)
         if out:
             self._buf_cond.notify_all()
         return out
@@ -175,16 +194,25 @@ class SmartCommitConsumer:
         accepted_until = 0  # index into records
         i = 0
         n = len(records)
+        # a partition fetch is one contiguous offset run in the common case
+        # (gaps only on compacted topics): one O(1) check replaces the
+        # per-record walk below — offsets are strictly increasing, so
+        # last-first == n-1 proves contiguity
+        contiguous = n > 0 and (records[-1].offset - records[0].offset
+                                == n - 1)
         while i < n:
             if tr.is_backpressured(partition):
                 break
             # contiguous run starting at i, clipped at the next page boundary
             start = records[i].offset
             page_end_off = (start // page + 1) * page
-            j = i + 1
-            while (j < n and records[j].offset == records[j - 1].offset + 1
-                   and records[j].offset < page_end_off):
-                j += 1
+            if contiguous:
+                j = i + min(n - i, page_end_off - start)
+            else:
+                j = i + 1
+                while (j < n and records[j].offset == records[j - 1].offset + 1
+                       and records[j].offset < page_end_off):
+                    j += 1
             tr.track_run(partition, start, records[j - 1].offset - start + 1)
             accepted_until = j
             i = j
@@ -217,6 +245,8 @@ class SmartCommitConsumer:
     def _fetch_loop_inner(self) -> None:
         import time
 
+        from ..utils.tracing import stage
+
         while self._running:
             self._refresh_assignment()
             fetched = 0
@@ -226,8 +256,11 @@ class SmartCommitConsumer:
                 if self.tracker.is_backpressured(p):
                     continue  # open-page backpressure (KPW.java:596-611)
                 pos = self._positions.get(p, 0)
-                records = self.broker.fetch(self._topic, p, pos, self._fetch_max)
-                accepted = self._track_batch(p, records)
+                with stage("consumer.fetch"):
+                    records = self.broker.fetch(self._topic, p, pos,
+                                                self._fetch_max)
+                with stage("consumer.track"):
+                    accepted = self._track_batch(p, records)
                 if not accepted:
                     continue
                 if not self._put_batch(accepted):

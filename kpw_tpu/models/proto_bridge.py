@@ -351,7 +351,9 @@ class ProtoColumnarizer:
                 if pres is not None:
                     values = values[mask]
             chunks.append(ColumnChunkData(col, values, def_levels, None, n))
-        return ColumnBatch(chunks, n)
+        batch = ColumnBatch(chunks, n)
+        batch.wire_bytes = int(offs[-1])  # payload bytes, for byte metering
+        return batch
 
     def columnarize(self, records) -> ColumnBatch:
         plan = getattr(self, "_flat", False)

@@ -113,6 +113,40 @@ int dict_build(const K* vals, size_t n, K* dict_out, uint32_t* idx_out,
   // touch a big table) and rehash at 50% load; rehashing only moves the
   // unique set, so total cost stays O(n + k).
   size_t cap = 1024;
+  if (n >= 8192) {
+    // Strided-sample cardinality probe — purely a table SIZING hint
+    // (insertion order, output, and the max_k abort point are unchanged,
+    // so backend byte-identity holds).  Near-unique columns are the
+    // expensive case: they either abort at max_k or complete at large k,
+    // and either way the 1024-start rehash cascade moves every survivor
+    // log2(k/1024) times.  8192-slot fingerprint set on the stack; rare
+    // fingerprint collisions only under-size, which the grow path absorbs.
+    constexpr size_t kSample = 4096;
+    const size_t stride = n / kSample;
+    uint64_t fp[2 * kSample];
+    std::memset(fp, 0, sizeof(fp));
+    size_t sample_k = 0;
+    for (size_t i = 0; i < kSample; ++i) {
+      const uint64_t h =
+          mix(static_cast<uint64_t>(vals[i * stride])) | 1;
+      size_t s = h & (2 * kSample - 1);
+      while (fp[s] && fp[s] != h) s = (s + 1) & (2 * kSample - 1);
+      if (!fp[s]) {
+        fp[s] = h;
+        ++sample_k;
+      }
+    }
+    size_t want = cap;
+    if (sample_k > kSample * 9 / 10) {
+      // near-unique: size past the abort bound so no grow ever fires
+      want = 2 * (static_cast<size_t>(max_k) + 2);
+    } else if (sample_k > 256) {
+      // mid-cardinality: the sample floor is a lower bound on k
+      want = 8 * sample_k;
+    }
+    if (want > (1u << 26)) want = 1u << 26;
+    while (cap < want) cap <<= 1;
+  }
   std::vector<K> keys(cap);
   std::vector<uint32_t> ids(cap, UINT32_MAX);
   std::vector<K> uniq;

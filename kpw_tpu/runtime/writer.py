@@ -221,12 +221,12 @@ class _Worker:
                 if (self.current_file is not None
                         and self._is_file_timed_out()):
                     self._finalize_current_file()
-                recs = self.p.consumer.poll_many(
+                recs, runs = self.p.consumer.poll_many_runs(
                     self._poll_cap(poll_batch))
                 if not recs:
                     time.sleep(0.001)
                     continue
-                if use_wire and self._try_wire_batch(recs):
+                if use_wire and self._try_wire_batch(recs, runs):
                     if self._is_file_full():
                         self._finalize_current_file()
                     continue
@@ -290,15 +290,20 @@ class _Worker:
                 finally:
                     self.current_file = None
 
-    def _try_wire_batch(self, recs) -> bool:
+    def _try_wire_batch(self, recs, runs) -> bool:
         """Shred a poll batch through the native wire decoder and append it
-        columnar.  Returns False when any record needs the Python fallback
-        (the whole batch re-runs there; shredder outputs are discarded)."""
+        columnar.  ``runs`` is the batch as (partition, start, count) runs
+        from poll_many_runs — ack bookkeeping and byte metering fold whole
+        runs instead of walking 150k records per second in Python.  Returns
+        False when any record needs the Python fallback (the whole batch
+        re-runs there; shredder outputs are discarded)."""
         from ..models.proto_bridge import WireShredError
+        from ..utils.tracing import stage
 
         try:
-            batch = self.p.columnarizer.columnarize_payloads(
-                [r.value for r in recs])
+            with stage("worker.shred"):
+                batch = self.p.columnarizer.columnarize_payloads(
+                    [r.value for r in recs])
         except WireShredError:
             return False
         if self.current_file is None:
@@ -307,12 +312,15 @@ class _Worker:
         # buffer are OLDER than this batch — hand them to the writer first
         try_until_succeeds(self.current_file.flush_buffered,
                            stop_event=self._stop)
-        self.current_file.append_batch(batch)  # pure memory
+        with stage("worker.append"):
+            self.current_file.append_batch(batch)  # pure memory
         try_until_succeeds(self.current_file.maybe_flush_row_group,
                            stop_event=self._stop)
-        self._note_written(recs)
+        self._note_written_runs(runs)
         self.p._written_records.mark(len(recs))
-        self.p._written_bytes.mark(sum(len(r.value) for r in recs))
+        self.p._written_bytes.mark(batch.wire_bytes
+                                   if batch.wire_bytes is not None
+                                   else sum(len(r.value) for r in recs))
         self._file_records += len(recs)
         return True
 
@@ -328,6 +336,18 @@ class _Worker:
             else:
                 run = [r.partition, r.offset, r.offset + 1]
                 runs.append(run)
+
+    def _note_written_runs(self, polled_runs) -> None:
+        """Fold (partition, start, count) runs from poll_many_runs into the
+        held ack runs — O(runs), not O(records)."""
+        runs = self._written_runs
+        last = runs[-1] if runs else None
+        for part, start, count in polled_runs:
+            if last is not None and last[0] == part and last[2] == start:
+                last[2] = start + count
+            else:
+                last = [part, start, start + count]
+                runs.append(last)
 
     def _poll_cap(self, base: int) -> int:
         """Shrink the poll batch as the open file nears its size threshold:
