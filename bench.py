@@ -220,20 +220,20 @@ def bench_config2() -> dict:
 
 def tpu_kernel_probe(n_steps: int = 32) -> dict | None:
     """On-chip kernel timing, defensible despite the ~110 ms tunnel: K
-    iterations of the flagship encode step (per-column dictionary
-    sort-unique + index binary-search + 16-bit bit-pack) run INSIDE one
-    jitted ``fori_loop`` — one dispatch, K kernel executions, a scalar out.
-    Each iteration XORs the input with the loop index so XLA cannot hoist
-    the body.  Returns {tpu_kernel_ms_per_step, tpu_kernel_mb_per_sec_per_chip,
-    tpu_platform} or None on CPU."""
+    iterations of the flagship encode step (the driver-checked
+    ``encode_step_single`` math: fused per-column dictionary build-and-rank
+    by sorts + 16-bit bit-pack) run INSIDE one jitted ``fori_loop`` — one
+    dispatch, K kernel executions, a scalar out.  Each iteration XORs the
+    input with the loop index so XLA cannot hoist the body.  Returns
+    {tpu_kernel_ms_per_step, tpu_kernel_mb_per_sec_per_chip, tpu_platform}
+    or None on CPU."""
     import jax
     import jax.numpy as jnp
 
     dev = jax.devices()[0]
     if dev.platform == "cpu":
         return None
-    from kpw_tpu.parallel.dict_merge import _local_unique, _rank_against_dict
-    from kpw_tpu.ops.packing import bitpack_device
+    from kpw_tpu.parallel.sharded import encode_step_single
 
     C, N = 64, 1 << 16
     rng = np.random.default_rng(7)
@@ -242,20 +242,9 @@ def tpu_kernel_probe(n_steps: int = 32) -> dict | None:
 
     @jax.jit
     def loop(lo):
-        valid = jnp.arange(N, dtype=jnp.int32) < count
-
-        def one_column(lc):
-            zero = jnp.zeros_like(lc)
-            # production dictionary bound (sharded default), not N: the
-            # rank step scales with G + N
-            uhi, ulo, uvalid, k = _local_unique(zero, lc, valid, 4096,
-                                                has_hi=False)
-            idx = _rank_against_dict(uhi, ulo, uvalid, zero, lc, valid,
-                                     k=k, has_hi=False)
-            return bitpack_device(idx.astype(jnp.uint32), 16)
-
         def body(i, acc):
-            packed = jax.vmap(one_column)(lo ^ i.astype(jnp.uint32))
+            packed, _, _ = encode_step_single(lo ^ i.astype(jnp.uint32),
+                                              count)
             return acc + jnp.sum(packed, dtype=jnp.uint32)
 
         return jax.lax.fori_loop(0, n_steps, body, jnp.uint32(0))

@@ -68,19 +68,42 @@ def sharded_encode_step(hi, lo, counts, *, mesh: Mesh, cap: int = 4096,
 def encode_step_single(lo, count):
     """Single-chip flagship forward step: vmapped dictionary build + index
     bit-pack over a (C, N) batch of 32-bit column keys.  Width fixed at 16
-    (dictionaries capped at 65536 entries) so the program is fully static."""
+    (dictionaries capped at 65536 entries) so the program is fully static.
+
+    Fused build: because the dictionary IS the unique set of these same
+    values, ranking falls out of the build sort — three sorts of N
+    (value+position, rank compaction, position unscramble) replace the
+    sharded path's unique-then-rank composition (a sort of N plus two
+    sorts of 2N).  ``packed``, ``k`` and ``ulo[:k]`` are identical to
+    composing ``_local_unique(cap=n)`` + ``_rank_against_dict``; the
+    ``ulo[k:]`` pad region is unspecified (leftover sorted duplicates and
+    lifted-max sentinels — do not read past k).  No gathers or scatters
+    anywhere (TPU vector units, see default_rank_method)."""
     n = lo.shape[1]
     if n > (1 << 16):
         raise ValueError("encode_step_single packs at 16 bits; N must be <= 65536")
-    valid = jnp.arange(n, dtype=jnp.int32) < count
+    iota = jnp.arange(n, dtype=jnp.int32)
+    valid = iota < count
+    nvalid = jnp.sum(valid.astype(jnp.int32))
+    big = jnp.uint32(0xFFFFFFFF)
 
     def one_column(lc):
-        zero = jnp.zeros_like(lc)
-        uhi, ulo, uvalid, k = _local_unique(zero, lc, valid, n, has_hi=False)
-        indices = _rank_against_dict(uhi, ulo, uvalid, zero, lc, valid, k=k,
-                                     has_hi=False)
-        masked = jnp.where(valid, indices, 0)
-        packed = bitpack_device(masked.astype(jnp.uint32), 16)
+        llo = jnp.where(valid, lc, big)  # invalids sort to the tail
+        slo, spos = jax.lax.sort((llo, iota), num_keys=1)
+        sval = iota < nvalid
+        same = jnp.concatenate(
+            [jnp.zeros((1,), bool), slo[1:] == slo[:-1]])
+        is_new = sval & ~same
+        k = jnp.sum(is_new.astype(jnp.int32))
+        uid = jnp.cumsum(is_new.astype(jnp.int32)) - 1
+        # ascending sort => uid is the dictionary slot; compact the keys
+        # to the front by one more sort on rank (pads rank n, tail)
+        rank = jnp.where(is_new, uid, n)
+        _, ulo = jax.lax.sort((rank, slo), num_keys=1)
+        # unscramble: indices back to original row order, sort-not-scatter
+        _, indices = jax.lax.sort((spos, uid), num_keys=1)
+        masked = jnp.where(valid, indices.astype(jnp.uint32), 0)
+        packed = bitpack_device(masked, 16)
         return packed, ulo, k
 
     return jax.vmap(one_column)(lo)

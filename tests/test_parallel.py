@@ -97,6 +97,27 @@ def test_encode_step_single_shapes():
     assert (np.asarray(k) == 50).all()
 
 
+def test_encode_step_single_matches_numpy_oracle():
+    """The fused build-and-rank must equal dict=np.unique + searchsorted
+    indices, bit-packed — including with a short valid prefix."""
+    from kpw_tpu.core import encodings as enc
+    from kpw_tpu.parallel.sharded import encode_step_single
+
+    rng = np.random.default_rng(9)
+    C, N, count = 5, 768, 700
+    vals = rng.integers(0, 300, (C, N)).astype(np.uint32)
+    packed, ulo, k = encode_step_single(jnp.asarray(vals), jnp.int32(count))
+    packed, ulo, k = np.asarray(packed), np.asarray(ulo), np.asarray(k)
+    for c in range(C):
+        d = np.unique(vals[c, :count])
+        assert k[c] == len(d)
+        np.testing.assert_array_equal(ulo[c, :k[c]], d)
+        want_idx = np.searchsorted(d, vals[c, :count]).astype(np.uint64)
+        want_idx = np.concatenate([want_idx,
+                                   np.zeros(N - count, np.uint64)])
+        assert packed[c].tobytes() == enc.bitpack(want_idx, 16)
+
+
 def test_rank_methods_agree():
     """'search' (CPU) and 'sortrank' (TPU) rank implementations must produce
     identical indices — including max-key values colliding with lifted pads
