@@ -76,15 +76,21 @@ class NativeChunkEncoder(CpuChunkEncoder):
             offset += len(e.blob)
         return encoded
 
-    def _native_ok(self, values, pt: int) -> bool:
+    @staticmethod
+    def _fixed_width_ok(values, pt: int) -> bool:
+        """Shared eligibility shape test for fixed-width numeric fast paths
+        (native primitives here, mesh-global dictionaries in
+        parallel/mesh_encoder.py)."""
         return (
-            self._lib is not None
-            and isinstance(values, np.ndarray)
+            isinstance(values, np.ndarray)
             and values.dtype.kind in "iuf"
             and values.dtype.itemsize in (4, 8)
             and pt not in (PhysicalType.BOOLEAN, PhysicalType.BYTE_ARRAY,
                            PhysicalType.FIXED_LEN_BYTE_ARRAY)
         )
+
+    def _native_ok(self, values, pt: int) -> bool:
+        return self._lib is not None and self._fixed_width_ok(values, pt)
 
     def _bytes_native_ok(self, values, pt: int) -> bool:
         return (self._lib is not None

@@ -1,0 +1,75 @@
+"""Mesh-global encoder backend: the multi-chip encode path, reachable from
+the writer runtime.
+
+The reference scales out with one consumer-group instance per host
+(KafkaProtoParquetWriter.java:72-76), so records from different Kafka
+partitions always land in different files.  The TPU-native design instead
+lets partitions SHARE a row group (SURVEY §2.4 / BASELINE config 4): a row
+batch is split over the chips of a ``jax.sharding.Mesh`` and every eligible
+column's dictionary is built mesh-globally — per-shard sort-unique, an
+``all_gather`` of the unique sets over ICI, a merged sort-unique, and a
+sortrank of each shard's rows against the merged dictionary
+(parallel/dict_merge.py).  One jitted SPMD program; XLA schedules the
+collectives.
+
+The merged dictionary is the ascending-bit-pattern unique set of ALL rows —
+exactly what every single-chip builder produces — so with the default
+(adaptive) shard capacity, files written through this backend are
+byte-identical to the cpu/native/tpu backends (asserted in
+tests/test_parallel.py; an explicit undersized ``cap`` trades identity for
+ICI payload, see class docstring).  Page assembly, levels, non-dictionary
+encodings, strings and compression ride the native host path unchanged.
+
+Select with ``Builder.encoder_backend(MeshChunkEncoder(options))`` or the
+string ``"mesh"`` (runtime/select.py); ``choose_backend()`` never picks it
+automatically — sharing row groups across partitions is a topology decision,
+not a link-speed one.
+"""
+
+from __future__ import annotations
+
+from ..native.encoder import NativeChunkEncoder
+from ..ops.packing import pad_bucket
+from .dict_merge import global_dictionary_encode
+from .mesh import make_mesh
+
+
+class MeshChunkEncoder(NativeChunkEncoder):
+    """Chunk encoder whose dictionary build runs mesh-globally on device.
+
+    ``cap`` bounds each shard's local unique capacity (the all_gather
+    payload is ``n_shards * cap`` keys).  By default it adapts per column to
+    the padded per-shard row count — a shard can never hold more uniques
+    than rows, so overflow is impossible and byte-identity with the host
+    backends holds unconditionally.  Passing an explicit ``cap`` trades
+    that guarantee for a smaller ICI payload: a column whose per-shard
+    cardinality overflows it falls back to plain/delta (which the host
+    backends may not do for the same column)."""
+
+    def __init__(self, options, mesh=None, cap: int | None = None) -> None:
+        super().__init__(options)
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self.cap = cap
+
+    def _try_dictionary(self, chunk):
+        values = chunk.values
+        pt = chunk.column.leaf.physical_type
+        if not (self._fixed_width_ok(values, pt) and len(values) > 0):
+            # strings/bool ride the native host dictionary
+            return super()._try_dictionary(chunk)
+        n = len(values)
+        opts = self.options
+        max_k = min(max(1, int(n * opts.max_dictionary_ratio)),
+                    opts.dictionary_page_size_limit // values.dtype.itemsize)
+        if self.cap is not None:
+            cap = self.cap
+        else:
+            shards = int(self.mesh.devices.size)
+            cap = pad_bucket(-(-n // shards))  # >= per-shard rows: no overflow
+        try:
+            d, idx = global_dictionary_encode(values, self.mesh, cap=cap)
+        except ValueError:
+            return None  # per-shard cardinality overflow (explicit cap)
+        if len(d) > max_k:
+            return None  # encode() would reject it; skip the wasted pages
+        return d, idx

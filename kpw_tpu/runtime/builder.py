@@ -222,7 +222,9 @@ class Builder:
         return self
 
     def encoder_backend(self, backend) -> "Builder":
-        """'cpu', 'tpu', or an object with encode(chunk, offset)."""
+        """'cpu' | 'native' | 'tpu' | 'auto' | 'mesh' (multi-chip
+        mesh-global dictionary merge, parallel/mesh_encoder.py), or an
+        object with encode(chunk, offset)."""
         self._backend = backend
         return self
 

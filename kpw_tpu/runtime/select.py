@@ -83,7 +83,7 @@ def choose_backend() -> str:
 
 def make_encoder(options, backend: str = "auto"):
     """Instantiate a chunk encoder for ``backend`` ('auto' | 'tpu' |
-    'native' | 'cpu')."""
+    'native' | 'cpu' | 'mesh')."""
     if backend == "auto":
         backend = choose_backend()
     if backend == "tpu":
@@ -98,4 +98,11 @@ def make_encoder(options, backend: str = "auto"):
         from ..core.pages import CpuChunkEncoder
 
         return CpuChunkEncoder(options)
+    if backend == "mesh":
+        # multi-chip: mesh-global dictionary merge over every visible
+        # device (never auto-selected — a topology decision, not a link
+        # probe; see parallel/mesh_encoder.py)
+        from ..parallel.mesh_encoder import MeshChunkEncoder
+
+        return MeshChunkEncoder(options)
     raise ValueError(f"unknown encoder backend: {backend!r}")

@@ -73,13 +73,20 @@ class KafkaProtoParquetWriter:
     def _make_encoder_factory(self, backend):
         if backend == "cpu" or backend is None:
             return lambda: None  # ParquetFileWriter builds the CPU encoder
-        if backend in ("tpu", "native", "auto"):
+        if backend in ("tpu", "native", "auto", "mesh"):
             if backend == "tpu":  # fail fast at construction, not in a worker
                 try:
                     from ..ops import backend as _ops_backend  # noqa: F401
                 except ImportError as e:
                     raise NotImplementedError(
                         "TPU encoder backend unavailable in this build") from e
+            if backend == "mesh":  # same fail-fast: a worker-thread
+                # ImportError is not retried and would kill workers silently
+                try:
+                    from ..parallel import mesh_encoder as _mesh  # noqa: F401
+                except ImportError as e:
+                    raise NotImplementedError(
+                        "mesh encoder backend unavailable in this build") from e
             from .select import make_encoder
 
             opts = self.properties.encoder_options()
