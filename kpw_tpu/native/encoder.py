@@ -92,6 +92,15 @@ class NativeChunkEncoder(CpuChunkEncoder):
     def _native_ok(self, values, pt: int) -> bool:
         return self._lib is not None and self._fixed_width_ok(values, pt)
 
+    def _fixed_width_max_k(self, n: int, itemsize: int) -> int:
+        """Largest dictionary size that survives encode()'s rejection
+        checks (the ratio bound and the dictionary-page byte budget) for a
+        fixed-width column — shared by the native and mesh early-aborts so
+        they can't drift from encode()'s actual acceptance."""
+        opts = self.options
+        return min(max(1, int(n * opts.max_dictionary_ratio)),
+                   opts.dictionary_page_size_limit // itemsize)
+
     def _bytes_native_ok(self, values, pt: int) -> bool:
         return (self._lib is not None
                 and pt in (PhysicalType.BYTE_ARRAY,
@@ -133,12 +142,8 @@ class NativeChunkEncoder(CpuChunkEncoder):
             return self._bytes_dictionary(values, max_k)
         if not self._native_ok(values, pt):
             return super()._try_dictionary(chunk)
-        # Largest k that would survive the rejection checks in encode():
-        # the ratio bound and the dictionary-page byte budget.
         n = len(values)
-        opts = self.options
-        max_k = min(max(1, int(n * opts.max_dictionary_ratio)),
-                    opts.dictionary_page_size_limit // values.dtype.itemsize)
+        max_k = self._fixed_width_max_k(n, values.dtype.itemsize)
         key = values.view(np.uint32 if values.dtype.itemsize == 4 else np.uint64)
         built = self._lib.dict_build(key, max_k=max_k)
         if built is None:

@@ -344,15 +344,51 @@ inline bool view_lt(const BytesView& a, const BytesView& b) {
   return a.len < b.len;
 }
 
-inline uint64_t hash_bytes(const uint8_t* p, int64_t len) {
-  uint64_t h = 0xCBF29CE484222325ull;
-  for (int64_t i = 0; i < len; ++i) h = (h ^ p[i]) * 0x100000001B3ull;
+inline uint64_t hash_bytes(const uint8_t* p, int64_t len,
+                           const uint8_t* hard_end) {
+  // Word-at-a-time FNV-style fold: one multiply per 8 bytes instead of
+  // per byte (typical string-column values are 4-40 B, so this is the
+  // dict_build_bytes hot spot).  The tail reads a full (unaligned) word
+  // and masks — a fixed-size load the compiler inlines, unlike a
+  // variable-length memcpy (measured 2x slower) — except within the last
+  // 8 bytes before ``hard_end`` (the packed column buffer's end), where a
+  // byte loop avoids the over-read.  Only the table layout depends on the
+  // hash; the emitted dictionary/indices are sorted + rank-remapped, so
+  // changing it cannot change output bytes.
+  uint64_t h = 0xCBF29CE484222325ull ^ static_cast<uint64_t>(len);
+  while (len >= 8) {
+    uint64_t w;
+    std::memcpy(&w, p, 8);
+    h = (h ^ w) * 0x100000001B3ull;
+    h = (h << 31) | (h >> 33);
+    p += 8;
+    len -= 8;
+  }
+  if (len > 0) {
+    uint64_t w;
+#if defined(__BYTE_ORDER__) && __BYTE_ORDER__ == __ORDER_LITTLE_ENDIAN__
+    // the mask keeps the value's own (low-address) bytes only on
+    // little-endian; big-endian takes the bytewise path so equal strings
+    // hash equally regardless of where they sit in the buffer
+    if (p + 8 <= hard_end) {
+      std::memcpy(&w, p, 8);  // fixed-size: one unaligned load
+      w &= (~0ull) >> (8 * (8 - len));
+    } else
+#endif
+    {
+      w = 0;
+      for (int64_t i = 0; i < len; ++i)
+        w |= static_cast<uint64_t>(p[i]) << (8 * i);
+    }
+    h = (h ^ w) * 0x100000001B3ull;
+  }
   return mix(h);
 }
 
 int dict_build_bytes(const uint8_t* data, const int64_t* offsets, size_t n,
                      int64_t* uniq_pos_out, uint32_t* idx_out, uint32_t max_k,
                      uint32_t* k_out) {
+  const uint8_t* hard_end = data + (n ? offsets[n] : 0);
   size_t cap = 1024;
   std::vector<uint32_t> ids(cap, UINT32_MAX);
   std::vector<BytesView> uniq;
@@ -365,14 +401,16 @@ int dict_build_bytes(const uint8_t* data, const int64_t* offsets, size_t n,
     mask = cap - 1;
     ids.assign(cap, UINT32_MAX);
     for (uint32_t id = 0; id < uniq.size(); ++id) {
-      size_t s = static_cast<size_t>(hash_bytes(uniq[id].p, uniq[id].len)) & mask;
+      size_t s =
+          static_cast<size_t>(hash_bytes(uniq[id].p, uniq[id].len, hard_end)) &
+          mask;
       while (ids[s] != UINT32_MAX) s = (s + 1) & mask;
       ids[s] = id;
     }
   };
   for (size_t i = 0; i < n; ++i) {
     const BytesView v{data + offsets[i], offsets[i + 1] - offsets[i]};
-    size_t s = static_cast<size_t>(hash_bytes(v.p, v.len)) & mask;
+    size_t s = static_cast<size_t>(hash_bytes(v.p, v.len, hard_end)) & mask;
     for (;;) {
       const uint32_t id = ids[s];
       if (id == UINT32_MAX) {

@@ -39,6 +39,12 @@ from ..ops.packing import pad_bucket
 AXIS = "shard"
 
 
+class DictionaryOverflow(ValueError):
+    """A shard's local cardinality exceeded the requested cap.  Distinct
+    from other ValueErrors so callers falling back to plain encoding don't
+    swallow real bugs (shape/sharding mismatches) as 'overflow'."""
+
+
 def _local_unique(hi, lo, valid, cap: int, has_hi: bool = True,
                   method: str | None = None):
     """Sorted-unique of the valid (hi, lo) keys, padded to ``cap``.
@@ -205,18 +211,24 @@ def _merge_sharded(hi, lo, counts, *, mesh: Mesh, cap: int,
     return fn(hi, lo, counts)
 
 
-def global_dictionary_encode(values: np.ndarray, mesh: Mesh, cap: int = 65536):
+def global_dictionary_encode(values: np.ndarray, mesh: Mesh,
+                             cap: int | None = 65536):
     """Encode ``values`` against a mesh-global dictionary.
 
     Rows are split evenly over the mesh's shards (the partitions->chips
     assignment); returns (dict_values ascending by bit pattern, indices)
-    as host arrays.  Raises ValueError when a shard's local cardinality
-    exceeds ``cap`` (caller should fall back to plain encoding, the same
-    escape hatch parquet-mr uses for oversized dictionaries)."""
+    as host arrays.  Raises :class:`DictionaryOverflow` when a shard's
+    local cardinality exceeds ``cap`` (caller should fall back to plain
+    encoding, the same escape hatch parquet-mr uses for oversized
+    dictionaries).  ``cap=None`` sizes the cap to the padded per-shard row
+    block — a shard can never hold more uniques than rows, so overflow
+    becomes impossible (the MeshChunkEncoder byte-identity guarantee)."""
     n_shards = mesh.devices.size
     n = len(values)
     rows_per = max((n + n_shards - 1) // n_shards, 1)  # even split over shards
     per = pad_bucket(rows_per)  # static per-shard block, padded
+    if cap is None:
+        cap = per
     hi, lo = split_keys(np.ascontiguousarray(values))
     hi_p = np.zeros(n_shards * per, np.uint32)
     lo_p = np.zeros(n_shards * per, np.uint32)
@@ -238,7 +250,8 @@ def global_dictionary_encode(values: np.ndarray, mesh: Mesh, cap: int = 65536):
         hi_d, lo_d, cnt_d, mesh=mesh, cap=cap,
         has_hi=hi is not None)  # 32-bit dtypes ride the single-key sorts
     if int(overflow):
-        raise ValueError(f"per-shard dictionary cardinality exceeded cap={cap}")
+        raise DictionaryOverflow(
+            f"per-shard dictionary cardinality exceeded cap={cap}")
     gk = int(gk)
     assert int(rows) == n
     mhi_np = np.asarray(mhi)[:gk].astype(np.uint64)

@@ -29,8 +29,7 @@ not a link-speed one.
 from __future__ import annotations
 
 from ..native.encoder import NativeChunkEncoder
-from ..ops.packing import pad_bucket
-from .dict_merge import global_dictionary_encode
+from .dict_merge import DictionaryOverflow, global_dictionary_encode
 from .mesh import make_mesh
 
 
@@ -38,18 +37,29 @@ class MeshChunkEncoder(NativeChunkEncoder):
     """Chunk encoder whose dictionary build runs mesh-globally on device.
 
     ``cap`` bounds each shard's local unique capacity (the all_gather
-    payload is ``n_shards * cap`` keys).  By default it adapts per column to
-    the padded per-shard row count — a shard can never hold more uniques
-    than rows, so overflow is impossible and byte-identity with the host
-    backends holds unconditionally.  Passing an explicit ``cap`` trades
-    that guarantee for a smaller ICI payload: a column whose per-shard
-    cardinality overflows it falls back to plain/delta (which the host
-    backends may not do for the same column)."""
+    payload is ``n_shards * cap`` keys).  The default (None) lets
+    ``global_dictionary_encode`` size it to the padded per-shard row block
+    — a shard can never hold more uniques than rows, so overflow is
+    impossible and byte-identity with the host backends holds
+    unconditionally.  Passing an explicit ``cap`` trades that guarantee
+    for a smaller ICI payload: a column whose per-shard cardinality
+    overflows it falls back to plain/delta (which the host backends may
+    not do for the same column)."""
 
     def __init__(self, options, mesh=None, cap: int | None = None) -> None:
         super().__init__(options)
         self.mesh = mesh if mesh is not None else make_mesh()
         self.cap = cap
+
+    def encode_many(self, chunks, base_offset: int):
+        """Sequential: each eligible column launches a multi-device SPMD
+        collective program, and concurrent multi-device dispatch from a
+        host thread pool adds contention without parallelism (device work
+        serializes on the same chips anyway) — so the native backend's
+        column-threaded encode_many is deliberately bypassed."""
+        from ..core.pages import CpuChunkEncoder
+
+        return CpuChunkEncoder.encode_many(self, chunks, base_offset)
 
     def _try_dictionary(self, chunk):
         values = chunk.values
@@ -57,18 +67,10 @@ class MeshChunkEncoder(NativeChunkEncoder):
         if not (self._fixed_width_ok(values, pt) and len(values) > 0):
             # strings/bool ride the native host dictionary
             return super()._try_dictionary(chunk)
-        n = len(values)
-        opts = self.options
-        max_k = min(max(1, int(n * opts.max_dictionary_ratio)),
-                    opts.dictionary_page_size_limit // values.dtype.itemsize)
-        if self.cap is not None:
-            cap = self.cap
-        else:
-            shards = int(self.mesh.devices.size)
-            cap = pad_bucket(-(-n // shards))  # >= per-shard rows: no overflow
+        max_k = self._fixed_width_max_k(len(values), values.dtype.itemsize)
         try:
-            d, idx = global_dictionary_encode(values, self.mesh, cap=cap)
-        except ValueError:
+            d, idx = global_dictionary_encode(values, self.mesh, cap=self.cap)
+        except DictionaryOverflow:
             return None  # per-shard cardinality overflow (explicit cap)
         if len(d) > max_k:
             return None  # encode() would reject it; skip the wasted pages
