@@ -15,5 +15,5 @@ over a ``jax.sharding.Mesh``:
 """
 
 from .mesh import make_mesh  # noqa: F401
-from .dict_merge import global_dictionary_encode  # noqa: F401
+from .dict_merge import DictionaryOverflow, global_dictionary_encode  # noqa: F401
 from .sharded import sharded_encode_step  # noqa: F401

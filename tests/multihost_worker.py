@@ -1,0 +1,62 @@
+"""Worker for test_multihost.py: one JAX process of a multi-process run.
+
+Each process owns 4 virtual CPU devices; together they form an 8-device
+global mesh whose collectives cross process boundaries (Gloo over
+localhost) — the in-image stand-in for multi-host DCN (parallel/mesh.py:
+"JAX process boundaries play the role of the reference's scale-out
+consumer-group instances", KafkaProtoParquetWriter.java:72-76).
+
+Runs the full sharded encode step over the global mesh and asserts this
+process observes the GLOBAL dictionary (replicated output): the merged
+sorted unique set of rows held by every process.
+"""
+
+import sys
+
+
+def main() -> int:
+    pid, n_proc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(coordinator_address=f"127.0.0.1:{port}",
+                               num_processes=n_proc, process_id=pid)
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from kpw_tpu.parallel.sharded import sharded_encode_step
+
+    n_shards = len(jax.devices())
+    assert n_shards == 8 and len(jax.local_devices()) == 4
+    mesh = Mesh(np.array(jax.devices()), ("shard",))
+    C, per = 4, 512
+    N = n_shards * per
+    rng = np.random.default_rng(42)  # same seed in every process: full view
+    vals = rng.integers(0, 300, (C, N)).astype(np.uint32)
+    counts = np.full(n_shards, per, np.int32)
+
+    row_sh = NamedSharding(mesh, P(None, "shard"))
+    cnt_sh = NamedSharding(mesh, P("shard"))
+    local = jax.make_array_from_process_local_data
+    cols = N // n_proc
+    lo = local(row_sh, vals[:, pid * cols:(pid + 1) * cols])
+    hi = local(row_sh, np.zeros((C, cols), np.uint32))
+    shards_per = n_shards // n_proc
+    cnt = local(cnt_sh, counts[pid * shards_per:(pid + 1) * shards_per])
+
+    packed, mhi, mlo, gk, rows, ovf = sharded_encode_step(
+        hi, lo, cnt, mesh=mesh, cap=1024, width=16)
+    gk = np.asarray(jax.device_get(gk))
+    mlo_np = np.asarray(jax.device_get(mlo))
+    assert int(np.asarray(jax.device_get(rows))) == N
+    assert int(np.asarray(jax.device_get(ovf))) == 0
+    for c in range(C):
+        want = np.unique(vals[c])
+        got = mlo_np[c][: int(gk[c])]
+        assert np.array_equal(got, want), (c, got[:5], want[:5])
+    print(f"MULTIHOST-OK proc={pid} k={[int(x) for x in gk]}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
