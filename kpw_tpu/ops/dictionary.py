@@ -41,7 +41,8 @@ import numpy as np
 from .packing import pad_bucket
 
 
-def _dict_build_one(hi, lo, count, wide: bool):
+def _dict_build_one(hi, lo, count, wide: bool,
+                    scatters: bool | None = None):
     """Fused sort-based build-and-rank, gather/scatter-free (TPU vector
     units pay catastrophically for per-element scatters — see
     parallel/dict_merge.default_rank_method): value+position sort, rank
@@ -69,23 +70,42 @@ def _dict_build_one(hi, lo, count, wide: bool):
     uid = jnp.cumsum(is_new.astype(jnp.int32)) - 1
     k = jnp.sum(is_new.astype(jnp.int32))
 
-    # ascending sort => uid is the dictionary slot; compact keys to the
-    # front by one more sort on rank (non-new slots rank n: tail)
+    # ascending sort => uid is the dictionary slot.  Compaction and the
+    # row-order unscramble are hardware-selected (same principle as
+    # parallel/dict_merge.default_rank_method): CPU scatters are cheap and
+    # variadic sorts are not, TPU is the reverse.
+    if _prefers_scatters() if scatters is None else scatters:
+        indices = jnp.zeros(n, jnp.uint32).at[spos].set(uid.astype(jnp.uint32))
+        slot = jnp.where(is_new, uid, n)
+        dlo = jnp.zeros(n + 1, jnp.uint32).at[slot].set(slo, mode="drop")[:n]
+        if wide:
+            dhi = jnp.zeros(n + 1, jnp.uint32).at[slot].set(shi,
+                                                            mode="drop")[:n]
+        else:
+            dhi = dlo  # unused placeholder
+        return dhi, dlo, indices, k
+    # TPU: compact keys to the front by one more sort on rank (non-new
+    # slots rank n: tail), unscramble uid by original position — sorts,
+    # never scatters
     rank = jnp.where(is_new, uid, n)
     if wide:
         _, dhi, dlo = jax.lax.sort((rank, shi, slo), num_keys=1)
     else:
         _, dlo = jax.lax.sort((rank, slo), num_keys=1)
         dhi = dlo  # unused placeholder
-    # unscramble uid back to original row order: sort, not scatter
     _, suid = jax.lax.sort((spos, uid), num_keys=1)
     return dhi, dlo, suid.astype(jnp.uint32), k
 
 
-@functools.partial(jax.jit, static_argnums=(3,))
-def _dict_build_batch(hi, lo, counts, wide: bool):
-    """Vmapped over columns: hi/lo (C, N), counts (C,)."""
-    return jax.vmap(lambda h, l, c: _dict_build_one(h, l, c, wide))(hi, lo, counts)
+@functools.partial(jax.jit, static_argnums=(3, 4))
+def _dict_build_batch(hi, lo, counts, wide: bool,
+                      scatters: bool | None = None):
+    """Vmapped over columns: hi/lo (C, N), counts (C,).  ``scatters``
+    overrides the hardware selection (None = auto; a static jit arg so
+    both branches stay testable on any platform)."""
+    return jax.vmap(
+        lambda h, l, c: _dict_build_one(h, l, c, wide, scatters))(
+            hi, lo, counts)
 
 
 def _dict_build_bins_one(ids, count, R: int):
@@ -276,12 +296,13 @@ def build_dictionaries(columns: list[np.ndarray]):
     """
     groups: dict = {}
     metas: list = [None] * len(columns)
+    use_bins = _prefers_scatters()
     for i, arr in enumerate(columns):
         # group key carries the EXACT length: a batch stacks columns into one
         # (C, N) array, so all members must share N (nullable columns with
         # different null counts land in different batches)
         mode = None
-        if arr.dtype.kind in "iu" and len(arr):
+        if use_bins and arr.dtype.kind in "iu" and len(arr):
             vmin, vmax = int(arr.min()), int(arr.max())
             if vmin >= 0 and (vmax - vmin) < RANGE_MAX:
                 R = pad_bucket((vmax - vmin) + 1)
@@ -300,6 +321,16 @@ def build_dictionaries(columns: list[np.ndarray]):
         for j, i in enumerate(idxs):
             handles[i] = (batch, j)
     return handles
+
+
+@functools.lru_cache(maxsize=1)
+def _prefers_scatters() -> bool:
+    """Hardware selection shared by the bins gate and the build kernel's
+    compaction branch: per-element scatters/gathers are cheap on CPU and
+    catastrophic on TPU vector units (bins path measured 69 vs 12 ms/step
+    for the same 64x65k batch on a v5e, where the sort path wins 6x; same
+    principle as parallel/dict_merge.default_rank_method)."""
+    return jax.default_backend() == "cpu"
 
 
 class DictBuildHandle:

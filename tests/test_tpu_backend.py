@@ -287,3 +287,43 @@ def test_string_dictionary_planner_nullable():
     got = pq.read_table(buf)["s"].to_pylist()
     want = [v.decode() if ok else None for v, ok in zip(vals, valid)]
     assert got == want
+
+
+@pytest.mark.parametrize("scatters", [True, False])
+@pytest.mark.parametrize("wide", [False, True])
+def test_dict_build_both_hardware_branches(scatters, wide):
+    """The build kernel hardware-selects scatter-compaction (CPU) vs
+    sort-compaction (TPU); both must match the numpy oracle on any
+    platform — including a valid 0xFFFFFFFF value colliding with lifted
+    pads and a short valid prefix."""
+    from kpw_tpu.ops.dictionary import _dict_build_batch, split_keys
+
+    rng = np.random.default_rng(21)
+    C, N, count = 3, 1024, 900
+    if wide:
+        vals = rng.integers(0, 1 << 40, (C, N)).astype(np.uint64)
+        vals[:, 0] = (1 << 64) - 1  # all-ones bit pattern, valid slot
+    else:
+        vals = rng.integers(0, 700, (C, N)).astype(np.uint64)
+        vals[:, 0] = 0xFFFFFFFF  # collides with the lifted-pad sentinel
+    his, los = [], []
+    for c in range(C):
+        hi, lo = split_keys(vals[c] if wide else vals[c].astype(np.uint32))
+        his.append(hi if hi is not None else np.zeros(N, np.uint32))
+        los.append(lo)
+    counts = np.full(C, count, np.int32)
+    dhi, dlo, idx, k = _dict_build_batch(
+        jnp.asarray(np.stack(his)), jnp.asarray(np.stack(los)),
+        jnp.asarray(counts), wide, scatters)
+    dhi, dlo = np.asarray(dhi), np.asarray(dlo)
+    idx, k = np.asarray(idx), np.asarray(k)
+    for c in range(C):
+        want = np.unique(vals[c, :count])
+        assert k[c] == len(want)
+        got = (dlo[c].astype(np.uint64)
+               | (dhi[c].astype(np.uint64) << np.uint64(32))) if wide \
+            else dlo[c].astype(np.uint64)
+        np.testing.assert_array_equal(got[:k[c]], want)
+        np.testing.assert_array_equal(
+            got[:k[c]][idx[c, :count]], vals[c, :count])
+
