@@ -38,7 +38,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .packing import pad_bucket
+from .packing import pad_bucket, prefers_scatters as _prefers_scatters
 
 
 def _dict_build_one(hi, lo, count, wide: bool,
@@ -321,16 +321,6 @@ def build_dictionaries(columns: list[np.ndarray]):
         for j, i in enumerate(idxs):
             handles[i] = (batch, j)
     return handles
-
-
-@functools.lru_cache(maxsize=1)
-def _prefers_scatters() -> bool:
-    """Hardware selection shared by the bins gate and the build kernel's
-    compaction branch: per-element scatters/gathers are cheap on CPU and
-    catastrophic on TPU vector units (bins path measured 69 vs 12 ms/step
-    for the same 64x65k batch on a v5e, where the sort path wins 6x; same
-    principle as parallel/dict_merge.default_rank_method)."""
-    return jax.default_backend() == "cpu"
 
 
 class DictBuildHandle:

@@ -10,10 +10,11 @@ bits) with two regimes:
   on TPU);
 - run-dominated streams (the common case: def levels are mostly max_def)
   take the mixed RLE path.  There the O(n) work is the run *scan*; the
-  assembly is O(runs).  So the scan runs on device (cumsum + scatter,
-  vmapped over pages) and only the compact run list is transferred, which
-  the host replays through core.encodings.rle_hybrid_from_runs for a
-  byte-identical stream.
+  assembly is O(runs).  So the scan runs on device (cumsum + max-scan run
+  labeling, hardware-selected scatter/sort compaction — see
+  ops.packing._run_scan/compact_by_rank — vmapped over pages) and only the
+  compact run list is transferred, which the host replays through
+  core.encodings.rle_hybrid_from_runs for a byte-identical stream.
 
 Both programs window into one stacked (K, maxN) array of every level stream
 in the row group, so the whole group costs two round trips regardless of
@@ -27,7 +28,7 @@ import functools
 import jax
 import jax.numpy as jnp
 
-from .packing import window_run_scan
+from .packing import compact_by_rank, window_run_scan
 
 
 @functools.partial(jax.jit, static_argnums=(4,))
@@ -39,9 +40,10 @@ def level_stats_multi(levels_all: jax.Array, stream_ids: jax.Array,
     padded = jnp.pad(levels_all, ((0, 0), (0, bucket)))
 
     def one(sid, start, count):
-        _, valid, run_id, run_lens = window_run_scan(
-            padded, sid, start, count, bucket, bucket)
-        long_sum = jnp.sum(jnp.where(run_lens >= 8, run_lens, 0))
+        _, valid, run_id, run_len_here, is_end = window_run_scan(
+            padded, sid, start, count, bucket)
+        long_sum = jnp.sum(jnp.where(is_end & (run_len_here >= 8),
+                                     run_len_here, 0))
         n_runs = jnp.max(jnp.where(valid, run_id, -1)) + 1
         return long_sum, n_runs
 
@@ -58,11 +60,15 @@ def level_runs_multi(levels_all: jax.Array, stream_ids: jax.Array,
     padded = jnp.pad(levels_all, ((0, 0), (0, bucket)))
 
     def one(sid, start, count):
-        v, valid, run_id, run_lens = window_run_scan(
-            padded, sid, start, count, bucket, run_bucket)
-        safe_rid = jnp.where(valid, run_id, run_bucket)
-        run_vals = jnp.zeros(run_bucket + 1, jnp.uint32).at[safe_rid].set(
-            v, mode="drop")[:run_bucket]
+        v, _, run_id, run_len_here, is_end = window_run_scan(
+            padded, sid, start, count, bucket)
+        # one compaction keyed on run ENDS covers both outputs: a run's
+        # value is constant, so v at the end position is the run value.
+        # Run ids are a dense prefix: hardware-selected scatter/sort
+        # (see compact_by_rank)
+        end_rank = jnp.where(is_end, run_id, run_bucket)
+        run_vals, run_lens = compact_by_rank(
+            end_rank, (v, run_len_here), run_bucket)
         return run_vals, run_lens
 
     return jax.vmap(one)(stream_ids, starts, counts)

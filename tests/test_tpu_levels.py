@@ -183,3 +183,32 @@ def test_level_plan_cleared_between_row_groups():
     w.close()
     buf.seek(0)
     assert pq.read_table(buf).num_rows == 12000
+
+
+def test_compact_by_rank_branches_agree():
+    """Scatter (CPU) and sort (TPU) compaction must agree on dense-prefix
+    ranks — single and multi-value forms, including empty ranks."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kpw_tpu.ops.packing import compact_by_rank
+
+    rng = np.random.default_rng(33)
+    n, out = 512, 128
+    for trial in range(5):
+        m = int(rng.integers(0, out + 1))
+        # m dense ranks scattered over n positions; the rest padded to out
+        rank = np.full(n, out, np.int32)
+        pos = rng.choice(n, size=m, replace=False)
+        rank[np.sort(pos)] = np.arange(m)
+        vals = rng.integers(0, 1 << 30, n).astype(np.uint32)
+        lens = rng.integers(1, 100, n).astype(np.int32)
+        r = jnp.asarray(rank)
+        a_v, a_l = compact_by_rank(r, (jnp.asarray(vals), jnp.asarray(lens)),
+                                   out, scatters=True)
+        b_v, b_l = compact_by_rank(r, (jnp.asarray(vals), jnp.asarray(lens)),
+                                   out, scatters=False)
+        np.testing.assert_array_equal(np.asarray(a_v), np.asarray(b_v))
+        np.testing.assert_array_equal(np.asarray(a_l), np.asarray(b_l))
+        single = compact_by_rank(r, jnp.asarray(vals), out, scatters=False)
+        np.testing.assert_array_equal(np.asarray(single), np.asarray(a_v))
