@@ -588,3 +588,37 @@ def test_max_file_size_property(seed):
     assert_size_rotation_band(max_size=int(rng.integers(60, 220)) * 1024,
                               block_size=int(rng.integers(4, 24)) * 1024,
                               chunk=4000)
+
+
+def test_explicit_fromstring_parser_keeps_wire_fast_path():
+    """Passing proto_class.FromString explicitly (the README quickstart
+    pattern) IS the default parse, so it must keep the wire-shred fast
+    path; a genuinely custom parser must disqualify it (the payload may
+    not be the message bytes)."""
+    broker = FakeBroker()
+    broker.create_topic(TOPIC, 1)
+    cls = sample_message_class()
+
+    def mk(parser):
+        b = make_writer_builder(broker, MemoryFileSystem(), cls)
+        if parser is not None:
+            b.parser(parser)
+        b.build()
+        return b
+
+    assert mk(None)._parser_is_default is True
+    assert mk(cls.FromString)._parser_is_default is True
+    assert mk(lambda raw: cls.FromString(raw))._parser_is_default is False
+
+    # and the custom-parser path still delivers content correctly
+    fs = MemoryFileSystem()
+    msgs = produce_samples(broker, cls, 60)
+    w = make_writer_builder(
+        broker, fs, cls,
+        parser=lambda raw: cls.FromString(raw),
+        max_file_open_duration_seconds=0.5,
+    ).build()
+    with w:
+        files = wait_for_files(fs, "/out", ".parquet", 1)
+        rows = read_messages(fs, files)
+        assert rows_multiset(rows) == as_multiset(msgs)
