@@ -461,14 +461,8 @@ class TpuChunkEncoder(NativeChunkEncoder):
 
     # -- eligibility -------------------------------------------------------
     def _device_eligible(self, values, pt: int) -> bool:
-        return (
-            isinstance(values, np.ndarray)
-            and values.dtype.kind in "iuf"
-            and values.dtype.itemsize in (4, 8)
-            and pt not in (PhysicalType.BOOLEAN, PhysicalType.BYTE_ARRAY,
-                           PhysicalType.FIXED_LEN_BYTE_ARRAY)
-            and len(values) >= self.min_device_rows
-        )
+        return (self._fixed_width_ok(values, pt)
+                and len(values) >= self.min_device_rows)
 
     # -- batched launch (pipelined via encode_many) ------------------------
     def encode_many(self, chunks: list[ColumnChunkData], base_offset: int):

@@ -49,7 +49,10 @@ class Builder:
         self._delta_fallback = False  # BASELINE config 3 opt-in
         self._encoder_threads = 0  # native column-parallel encode (0 = auto)
         self._page_checksums = False  # parquet-mr 1.10 parity: no page CRCs
-        self._file_date_time_pattern = "%Y%m%d-%H%M%S%f"  # (:486-487 analog)
+        # reference default yyyyMMdd-HHmmssSSS (:486-487): %3f is this
+        # framework's millisecond token (strftime has none; %f would be
+        # 6-digit microseconds and change the file-name shape)
+        self._file_date_time_pattern = "%Y%m%d-%H%M%S%3f"
         self._directory_date_time_pattern: str | None = None
         self._file_extension = ".parquet"  # (:488)
         self._group_id: str | None = None
@@ -179,6 +182,9 @@ class Builder:
 
     # -- naming / placement ------------------------------------------------
     def file_date_time_pattern(self, strftime_pattern: str) -> "Builder":
+        """strftime pattern for the published file-name timestamp; ``%3f``
+        expands to zero-padded milliseconds (the reference's ``SSS``,
+        KPW.java:486-487 — plain strftime has no millisecond token)."""
         self._file_date_time_pattern = strftime_pattern
         return self
 

@@ -28,6 +28,16 @@ from .retry import RetryInterrupted, try_until_succeeds
 logger = logging.getLogger(__name__)
 
 
+def _format_now(pattern: str) -> str:
+    """strftime of now, plus ``%3f`` = zero-padded milliseconds — the
+    reference's file-name pattern is yyyyMMdd-HHmmssSSS (KPW.java:486-487)
+    and strftime has no millisecond directive (%f is microseconds)."""
+    now = datetime.now()
+    if "%3f" in pattern:
+        pattern = pattern.replace("%3f", f"{now.microsecond // 1000:03d}")
+    return now.strftime(pattern)
+
+
 def _rotation_batch_cap(max_file_size: int, est_record_bytes: int = 64) -> int:
     """Rotation granularity: get_data_size() only moves per flushed batch,
     so both the poll batch and the encode batch are capped at ~1/16 of the
@@ -423,7 +433,7 @@ class _Worker:
 
     def _new_file_name(self) -> str:
         # {timestamp}_{instance}_{workerIdx}{ext} (KPW.java:313-318)
-        ts = datetime.now().strftime(self.p._b._file_date_time_pattern)
+        ts = _format_now(self.p._b._file_date_time_pattern)
         return f"{ts}_{self.p._b._instance_name}_{self.index}{self.p._b._file_extension}"
 
     def _finalize_current_file(self) -> None:
@@ -458,9 +468,21 @@ class _Worker:
             dest_dir = self.p.target_dir
             pattern = self.p._b._directory_date_time_pattern
             if pattern:
-                dest_dir = f"{dest_dir}/{datetime.now().strftime(pattern)}"
+                dest_dir = f"{dest_dir}/{_format_now(pattern)}"
                 self.p.fs.mkdirs(dest_dir)
-            dest = f"{dest_dir}/{self._new_file_name()}"
+            name = self._new_file_name()
+            dest = f"{dest_dir}/{name}"
+            # millisecond timestamps can collide when one worker finalizes
+            # twice in the same tick; rename here overwrites (os.replace /
+            # HDFS-adapter replace), which would silently destroy an
+            # already-acked published file — disambiguate instead (the
+            # suffix only ever appears under collision)
+            seq = 0
+            while self.p.fs.exists(dest):
+                seq += 1
+                stem, ext = (name.rsplit(".", 1) + [""])[:2]
+                dest = (f"{dest_dir}/{stem}-{seq}.{ext}" if ext
+                        else f"{dest_dir}/{stem}-{seq}")
             self.p.fs.rename(tmp_path, dest)
             logger.info("Published %s", dest)
 

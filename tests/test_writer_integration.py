@@ -622,3 +622,58 @@ def test_explicit_fromstring_parser_keeps_wire_fast_path():
         files = wait_for_files(fs, "/out", ".parquet", 1)
         rows = read_messages(fs, files)
         assert rows_multiset(rows) == as_multiset(msgs)
+
+
+def test_default_file_name_has_millisecond_timestamp():
+    """Default published name is {yyyyMMdd-HHmmssSSS}_{instance}_{worker}
+    (KPW.java:313-318,486-487): 3-digit milliseconds, not strftime's
+    6-digit %f."""
+    import re
+
+    broker = FakeBroker()
+    broker.create_topic(TOPIC, 1)
+    fs = MemoryFileSystem()
+    cls = sample_message_class()
+    produce_samples(broker, cls, 10)
+    w = make_writer_builder(
+        broker, fs, cls, max_file_open_duration_seconds=0.3).build()
+    with w:
+        files = wait_for_files(fs, "/out", ".parquet", 1)
+    name = files[0].rsplit("/", 1)[-1]
+    assert re.fullmatch(r"\d{8}-\d{6}\d{3}_test_0\.parquet", name), name
+
+
+def test_published_name_collision_never_overwrites(monkeypatch):
+    """Two finalizations inside one millisecond tick must not clobber an
+    already-published (acked) file — the collision gets a -N suffix."""
+    import kpw_tpu.runtime.writer as W
+
+    monkeypatch.setattr(W, "_format_now", lambda pattern: "frozen")
+    broker = FakeBroker()
+    broker.create_topic(TOPIC, 1)
+    fs = MemoryFileSystem()
+    cls = sample_message_class()
+    max_size = 100 * 1024
+    w = make_writer_builder(
+        broker, fs, cls,
+        max_file_size=max_size,
+        block_size=10 * 1024,
+        max_file_open_duration_seconds=300.0,
+    ).build()
+    produced = 0
+    with w:
+        while True:
+            produce_samples(broker, cls, 2000, start=produced)
+            produced += 2000
+            files = fs.list_files("/out", extension=".parquet")
+            if len(files) >= 3:
+                break
+            time.sleep(0.02)
+            assert produced < 1_000_000
+        files = sorted(fs.list_files("/out", extension=".parquet"))
+    names = [f.rsplit("/", 1)[-1] for f in files]
+    assert "frozen_test_0.parquet" in names
+    assert "frozen_test_0-1.parquet" in names and "frozen_test_0-2.parquet" in names
+    # every file holds a full threshold's worth: nothing was overwritten
+    for f in files:
+        assert fs.size(f) > max_size * 0.99
