@@ -28,9 +28,23 @@ not a link-speed one.
 
 from __future__ import annotations
 
+import threading
+
 from ..native.encoder import NativeChunkEncoder
 from .dict_merge import DictionaryOverflow, global_dictionary_encode
 from .mesh import make_mesh
+
+# One collective launch at a time, process-wide: multiple writer workers
+# (thread_count > 1) each own a MeshChunkEncoder, and concurrent
+# multi-device program dispatch from different host threads can interleave
+# collective enqueue order across devices — a deadlock class on real
+# meshes.  The lock deliberately spans the whole encode call (host prep +
+# dispatch + reassembly), so concurrent workers serialize their host-side
+# dictionary work too; that's an accepted cost — the device phase is the
+# bulk on real meshes and correctness beats overlap here.  Narrowing to
+# enqueue-only would need a prep/dispatch split inside
+# global_dictionary_encode.
+_DISPATCH_LOCK = threading.Lock()
 
 
 class MeshChunkEncoder(NativeChunkEncoder):
@@ -69,7 +83,9 @@ class MeshChunkEncoder(NativeChunkEncoder):
             return super()._try_dictionary(chunk)
         max_k = self._fixed_width_max_k(len(values), values.dtype.itemsize)
         try:
-            d, idx = global_dictionary_encode(values, self.mesh, cap=self.cap)
+            with _DISPATCH_LOCK:
+                d, idx = global_dictionary_encode(values, self.mesh,
+                                                  cap=self.cap)
         except DictionaryOverflow:
             return None  # per-shard cardinality overflow (explicit cap)
         if len(d) > max_k:
