@@ -410,12 +410,71 @@ def bench_config4() -> dict:
           f"{n_shards}-shard {t_multi:.3f}s -> {speedup:.2f}x "
           f"(ideal ~{n_shards}x on chips, ~1.0x on a shared-core virtual "
           "mesh)", file=sys.stderr)
-    return {
+    out = {
         "metric": f"sharded_dict_merge_x{n_shards}",
         "value": round(N / t_multi, 1),
         "unit": "rows/s",
         "vs_baseline": round(speedup, 3),
     }
+    out["weak_scaling"] = _cfg4_weak_scaling(n_shards)
+    return out
+
+
+def _cfg4_weak_scaling(max_shards: int) -> dict:
+    """Weak-scaling sweep: per-shard rows FIXED, shard count 1/2/4/...;
+    reports per-shard step time and weak-scaling efficiency.  On real chips
+    the ideal is a flat step time (each chip does the same local sort work;
+    only the all_gather payload grows with k).  On a virtual CPU mesh every
+    shard shares one core, so total time growing ~k is expected — the
+    normalized per-(shard*step) time is the comparable number, and growth
+    beyond ~k is collective/partitioning overhead."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from kpw_tpu.parallel import make_mesh, sharded_encode_step
+
+    rng = np.random.default_rng(44)
+    C = 16
+    per = 1 << 15  # fixed per-shard rows (weak scaling)
+    curve = {}
+    ks = [k for k in (1, 2, 4, 8) if k <= max_shards]
+    for k in ks:
+        mesh = make_mesh(k)
+        N = k * per
+        vals = rng.integers(0, 1000, (C, N)).astype(np.uint32)
+        counts = np.full(k, per, np.int32)
+        row_sharded = NamedSharding(mesh, P(None, "shard"))
+        hi = jax.device_put(jnp.zeros((C, N), jnp.uint32), row_sharded)
+        lo = jax.device_put(jnp.asarray(vals), row_sharded)
+        cnt = jax.device_put(jnp.asarray(counts), NamedSharding(mesh, P("shard")))
+
+        def run():
+            packed, *_ = sharded_encode_step(hi, lo, cnt, mesh=mesh,
+                                             cap=2048, width=16, has_hi=False)
+            jax.block_until_ready(packed)
+
+        run()  # compile
+        best = float("inf")
+        for _ in range(5):
+            t0 = time.perf_counter()
+            run()
+            best = min(best, time.perf_counter() - t0)
+        curve[str(k)] = {
+            "step_ms": round(best * 1e3, 2),
+            "per_shard_step_ms": round(best / k * 1e3, 2),
+            "rows_per_sec": round(N / best, 1),
+        }
+        print(f"[bench:cfg4] weak-scaling k={k}: {best * 1e3:.2f} ms/step "
+              f"({per} rows/shard, {N / best:,.0f} rows/s total)",
+              file=sys.stderr)
+    base = curve[str(ks[0])]["step_ms"]
+    for k in ks[1:]:
+        # efficiency vs a flat step time (real-chip ideal); on a virtual
+        # mesh expect ~1/k since the shards share one core
+        curve[str(k)]["efficiency_vs_flat"] = round(
+            base / curve[str(k)]["step_ms"], 3)
+    return curve
 
 
 # ---------------------------------------------------------------------------
@@ -583,8 +642,20 @@ def main() -> None:
     print(f"[bench] devices: {jax.devices()}", file=sys.stderr)
 
     if "--all" in sys.argv:
+        # self-record the sweep (VERDICT r2 "next" #8): per-config claims
+        # are checkable from the committed artifact without a re-run
+        record = {"configs": {}, "devices": str(jax.devices())}
         for n in (1, 3, 4, 5, 6, 2):  # headline (2) last
-            print(json.dumps(CONFIGS[n]()), flush=True)
+            result = CONFIGS[n]()
+            record["configs"][f"config{n}"] = result
+            print(json.dumps(result), flush=True)
+        sweep_path = os.environ.get(
+            "KPW_BENCH_SWEEP_PATH",
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "BENCH_SWEEP_r03.json"))
+        with open(sweep_path, "w") as f:
+            json.dump(record, f, indent=1)
+        print(f"[bench] sweep recorded to {sweep_path}", file=sys.stderr)
         return
     if "--config" in sys.argv:
         n = int(sys.argv[sys.argv.index("--config") + 1])

@@ -128,7 +128,7 @@ class ParquetFileWriter:
         offsets stay true (at-least-once: a transient IO failure must never
         silently drop or shift data).  _pos only advances after every part
         is written.  Returns the bytes written."""
-        if self._pos and hasattr(self.sink, "seek"):
+        if hasattr(self.sink, "seek"):
             try:
                 self.sink.seek(self._pos)
             except (OSError, io.UnsupportedOperation):

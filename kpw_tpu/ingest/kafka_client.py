@@ -43,12 +43,16 @@ from .broker import Record
 
 
 class _Member:
-    __slots__ = ("consumer", "lock", "generation")
+    __slots__ = ("consumer", "lock", "generation", "closed")
 
     def __init__(self, consumer) -> None:
         self.consumer = consumer
         self.lock = threading.Lock()
         self.generation = 0
+        # set (under lock) by leave_group before consumer.close(): a closed
+        # kafka-python consumer can still report its last assignment, so
+        # liveness cannot be inferred from assignment() alone
+        self.closed = False
 
 
 class KafkaBrokerClient:
@@ -121,6 +125,7 @@ class KafkaBrokerClient:
                     self._owner_cache.pop(key, None)
         if member is not None:
             with member.lock:
+                member.closed = True
                 member.consumer.close()
 
     def _group_members(self, group: str) -> list[_Member]:
@@ -169,13 +174,19 @@ class KafkaBrokerClient:
         if cached is not None:
             try:
                 with cached.lock:
-                    if tp in cached.consumer.assignment():
+                    # closed check under the SAME lock as the assignment
+                    # probe: an entry fetched just before leave_group's
+                    # purge would otherwise pass the assignment check
+                    # against a closed consumer whose assignment() persists
+                    if not cached.closed and tp in cached.consumer.assignment():
                         return cached
             except Exception:
                 pass  # closed/leaving consumer: fall through to the scan
         for member in self._group_members(group):
             with member.lock:
-                if tp in member.consumer.assignment():
+                # same closed check as the fast path: the members snapshot
+                # can include one that leave_group closed a moment later
+                if not member.closed and tp in member.consumer.assignment():
                     self._owner_cache[(group, tp)] = member
                     return member
         self._owner_cache.pop((group, tp), None)

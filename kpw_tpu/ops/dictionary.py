@@ -54,11 +54,17 @@ def _dict_build_one(hi, lo, count, wide: bool,
     valid = pos < count
     big = jnp.uint32(0xFFFFFFFF)
     llo = jnp.where(valid, lo, big)  # invalids sort to the tail
+    # is_stable is load-bearing: a VALID value whose bit pattern equals the
+    # 0xFFFFFFFF pad sentinel (int -1, some NaNs) ties with the pads, and
+    # the prefix-validity claim below (sval = valid) holds only if
+    # stability keeps the valid entries (earlier input positions) ahead of
+    # the pads on that tie.
     if wide:
         lhi = jnp.where(valid, hi, big)
-        shi, slo, spos = jax.lax.sort((lhi, llo, pos), num_keys=2)
+        shi, slo, spos = jax.lax.sort((lhi, llo, pos), num_keys=2,
+                                      is_stable=True)
     else:
-        slo, spos = jax.lax.sort((llo, pos), num_keys=1)
+        slo, spos = jax.lax.sort((llo, pos), num_keys=1, is_stable=True)
 
     # valid is a prefix predicate, so post-sort validity is the same mask
     sval = valid

@@ -27,14 +27,16 @@ def pad_bucket(n: int, minimum: int = 256) -> int:
     return 1 << max(int(math.ceil(math.log2(max(n, 1)))), int(math.log2(minimum)))
 
 
-@functools.lru_cache(maxsize=1)
 def prefers_scatters() -> bool:
     """Hardware selection shared by every device kernel with a
     scatter-or-sort choice (dictionary compaction, bins gate, run
     compaction): per-element scatters/gathers are cheap on CPU and
     catastrophic on TPU vector units — measured 69 vs 12 ms/step for the
     bins dictionary build and 161 vs 12 ms/step for the scatter dictionary
-    compaction on the same 64x65k batch on a v5e."""
+    compaction on the same 64x65k batch on a v5e.  Evaluated per call (no
+    process-lifetime cache) so a platform flip after first use — test
+    harnesses toggling jax_platforms, late TPU init — re-selects the right
+    kernel variant; jax.default_backend() is itself cached per config."""
     return jax.default_backend() == "cpu"
 
 

@@ -38,12 +38,10 @@ from .mesh import make_mesh
 # (thread_count > 1) each own a MeshChunkEncoder, and concurrent
 # multi-device program dispatch from different host threads can interleave
 # collective enqueue order across devices — a deadlock class on real
-# meshes.  The lock deliberately spans the whole encode call (host prep +
-# dispatch + reassembly), so concurrent workers serialize their host-side
-# dictionary work too; that's an accepted cost — the device phase is the
-# bulk on real meshes and correctness beats overlap here.  Narrowing to
-# enqueue-only would need a prep/dispatch split inside
-# global_dictionary_encode.
+# meshes.  Passed INTO global_dictionary_encode so it covers only the
+# device section (transfers + collective launch + materialization); each
+# worker's host-side key splitting, shard padding, and index reassembly
+# run outside it and overlap freely.
 _DISPATCH_LOCK = threading.Lock()
 
 
@@ -83,9 +81,8 @@ class MeshChunkEncoder(NativeChunkEncoder):
             return super()._try_dictionary(chunk)
         max_k = self._fixed_width_max_k(len(values), values.dtype.itemsize)
         try:
-            with _DISPATCH_LOCK:
-                d, idx = global_dictionary_encode(values, self.mesh,
-                                                  cap=self.cap)
+            d, idx = global_dictionary_encode(values, self.mesh, cap=self.cap,
+                                              dispatch_lock=_DISPATCH_LOCK)
         except DictionaryOverflow:
             return None  # per-shard cardinality overflow (explicit cap)
         if len(d) > max_k:

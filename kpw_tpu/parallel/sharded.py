@@ -64,11 +64,33 @@ def sharded_encode_step(hi, lo, counts, *, mesh: Mesh, cap: int = 4096,
     return fn(hi, lo, counts)
 
 
-@jax.jit
-def encode_step_single(lo, count):
+# Static pack-width buckets for the device kernels: a fully static program
+# per (batch bucket, width) pair, so lifting the old fixed-16 cap costs at
+# most 5 extra compiles, not one per cardinality.
+_WIDTH_BUCKETS = (16, 20, 24, 28, 32)
+
+
+def index_width_bucket(k_bound: int) -> int:
+    """Smallest static width bucket whose bit budget covers dictionary
+    indices 0..k_bound-1.  Pass the ROW COUNT N: ``encode_step_single``
+    guards on N <= 2**width (k <= N always holds, and the kernel cannot
+    verify a tighter data-dependent cardinality bound statically — a wrong
+    one would silently wrap the pack)."""
+    need = max((max(k_bound, 1) - 1).bit_length(), 1)
+    for w in _WIDTH_BUCKETS:
+        if need <= w:
+            return w
+    raise ValueError(f"dictionary indices need {need} bits; max is 32")
+
+
+@functools.partial(jax.jit, static_argnames=("width",))
+def encode_step_single(lo, count, width: int = 16):
     """Single-chip flagship forward step: vmapped dictionary build + index
-    bit-pack over a (C, N) batch of 32-bit column keys.  Width fixed at 16
-    (dictionaries capped at 65536 entries) so the program is fully static.
+    bit-pack over a (C, N) batch of 32-bit column keys.  ``width`` is the
+    static pack width (pick it with :func:`index_width_bucket` from any
+    host-known cardinality bound); N is bounded only by ``2**width`` —
+    indices are dictionary slots < k <= N, so N <= 2**width guarantees the
+    pack never wraps, at any row count or cardinality.
 
     Fused build: because the dictionary IS the unique set of these same
     values, ranking falls out of the build sort — three sorts of N
@@ -80,8 +102,10 @@ def encode_step_single(lo, count):
     lifted-max sentinels — do not read past k).  No gathers or scatters
     anywhere (TPU vector units, see default_rank_method)."""
     n = lo.shape[1]
-    if n > (1 << 16):
-        raise ValueError("encode_step_single packs at 16 bits; N must be <= 65536")
+    if n > (1 << width):
+        raise ValueError(
+            f"N={n} rows could hold up to {n} uniques, which do not fit "
+            f"{width}-bit indices; pick width with index_width_bucket(N)")
     iota = jnp.arange(n, dtype=jnp.int32)
     valid = iota < count
     nvalid = jnp.sum(valid.astype(jnp.int32))
@@ -89,7 +113,12 @@ def encode_step_single(lo, count):
 
     def one_column(lc):
         llo = jnp.where(valid, lc, big)  # invalids sort to the tail
-        slo, spos = jax.lax.sort((llo, iota), num_keys=1)
+        # is_stable is load-bearing: a VALID value whose bit pattern equals
+        # the 0xFFFFFFFF pad sentinel (int -1, some NaNs) ties with the
+        # pads, and the prefix-validity claim below (sval = iota < nvalid)
+        # holds only if stability keeps the valid entries (earlier input
+        # positions) ahead of the pads on that tie.
+        slo, spos = jax.lax.sort((llo, iota), num_keys=1, is_stable=True)
         sval = iota < nvalid
         same = jnp.concatenate(
             [jnp.zeros((1,), bool), slo[1:] == slo[:-1]])
@@ -103,7 +132,7 @@ def encode_step_single(lo, count):
         # unscramble: indices back to original row order, sort-not-scatter
         _, indices = jax.lax.sort((spos, uid), num_keys=1)
         masked = jnp.where(valid, indices.astype(jnp.uint32), 0)
-        packed = bitpack_device(masked, 16)
+        packed = bitpack_device(masked, width)
         return packed, ulo, k
 
     return jax.vmap(one_column)(lo)

@@ -111,24 +111,60 @@ class Meter:
             return self._count / elapsed if elapsed > 0 else 0.0
 
 
+_RESCALE_SECONDS = 3600.0  # Dropwizard ExponentiallyDecayingReservoir
+
+
 class Histogram:
-    def __init__(self, reservoir: int = 1024) -> None:
-        self._values: list[float] = []
-        self._reservoir = reservoir
+    """File-size histogram with Dropwizard's exponentially-decaying
+    reservoir (KPW.java:118 registers a default ``Histogram``, whose
+    reservoir is ``ExponentiallyDecayingReservoir(1028, 0.015)``): samples
+    carry forward-decay weights ``e^(alpha*(t-landmark))`` with priority
+    ``weight/uniform()``, the lowest-priority sample is evicted at
+    capacity, and the landmark rescales hourly so priorities never
+    overflow.  Snapshot quantiles are weight-based (Dropwizard
+    ``WeightedSnapshot``), which biases toward the most recent ~5 minutes
+    of data under load instead of the uniform all-history view."""
+
+    def __init__(self, reservoir: int = 1028, alpha: float = 0.015,
+                 clock=time.monotonic) -> None:
+        self._size = reservoir
+        self._alpha = alpha
+        self._clock = clock
         self._count = 0
         self._lock = threading.Lock()
+        # priority -> (value, weight); kept small (<= size+1), so O(n)
+        # min-eviction beats a heap's constant factor at n ~ 1k
+        self._samples: dict[float, tuple[float, float]] = {}
+        self._start = clock()
+        self._next_rescale = self._start + _RESCALE_SECONDS
+
+    def _rescale_if_needed(self, now: float) -> None:
+        if now < self._next_rescale:
+            return
+        old_start, self._start = self._start, now
+        self._next_rescale = now + _RESCALE_SECONDS
+        factor = math.exp(-self._alpha * (now - old_start))
+        self._samples = {
+            k * factor: (v, w * factor)
+            for k, (v, w) in self._samples.items() if w * factor > 0.0
+        }
 
     def update(self, value: float) -> None:
         import random
 
         with self._lock:
+            now = self._clock()
+            self._rescale_if_needed(now)
             self._count += 1
-            if len(self._values) < self._reservoir:
-                self._values.append(value)
+            weight = math.exp(self._alpha * (now - self._start))
+            priority = weight / max(random.random(), 1e-12)
+            if len(self._samples) < self._size:
+                self._samples[priority] = (value, weight)
             else:
-                i = random.randrange(self._count)
-                if i < self._reservoir:
-                    self._values[i] = value
+                lowest = min(self._samples)
+                if priority > lowest and priority not in self._samples:
+                    del self._samples[lowest]
+                    self._samples[priority] = (value, weight)
 
     @property
     def count(self) -> int:
@@ -136,17 +172,26 @@ class Histogram:
 
     def snapshot(self) -> dict:
         with self._lock:
-            vals = sorted(self._values)
-        if not vals:
+            self._rescale_if_needed(self._clock())
+            entries = sorted(self._samples.values())  # by value
+        if not entries:
             return {"min": 0, "max": 0, "mean": 0, "p50": 0, "p95": 0}
+        total_w = sum(w for _, w in entries)
 
         def q(p: float) -> float:
-            return vals[min(len(vals) - 1, int(p * len(vals)))]
+            # Dropwizard WeightedSnapshot: first value whose cumulative
+            # normalized weight crosses the quantile
+            acc = 0.0
+            for v, w in entries:
+                acc += w / total_w
+                if acc >= p:
+                    return v
+            return entries[-1][0]
 
         return {
-            "min": vals[0],
-            "max": vals[-1],
-            "mean": sum(vals) / len(vals),
+            "min": entries[0][0],
+            "max": entries[-1][0],
+            "mean": sum(v * w for v, w in entries) / total_w,
             "p50": q(0.5),
             "p95": q(0.95),
         }

@@ -90,3 +90,38 @@ def test_histogram_snapshot():
     assert s["min"] == 1.0 and s["max"] == 100.0
     assert h.count == 100
     assert 45 <= s["p50"] <= 55
+
+
+def test_histogram_decays_toward_recent_data():
+    """Dropwizard ExponentiallyDecayingReservoir semantics (KPW.java:118):
+    under a forward-dated clock, old samples' weights decay so the snapshot
+    is dominated by recent data — a uniform reservoir would report a 50/50
+    mixture forever."""
+    clk = FakeClock()
+    h = Histogram(reservoir=128, clock=clk)
+    for _ in range(1000):
+        h.update(100.0)  # old regime
+    clk.t += 20 * 60.0  # 20 minutes later: e^(0.015*1200) ~ 6.6e7 weight gap
+    for _ in range(200):
+        h.update(900.0)  # new regime: fewer samples, but recent
+    s = h.snapshot()
+    assert s["p50"] == 900.0
+    assert s["p95"] == 900.0
+    assert s["mean"] > 850.0
+    assert h.count == 1200
+
+
+def test_histogram_rescale_preserves_snapshot():
+    """Crossing the hourly rescale boundary renormalizes priorities and
+    weights in place; values and relative ordering survive."""
+    clk = FakeClock()
+    h = Histogram(reservoir=64, clock=clk)
+    for v in range(1, 65):
+        h.update(float(v))
+    clk.t += 2 * 3600.0  # two rescale periods
+    s = h.snapshot()
+    assert s["min"] == 1.0 and s["max"] == 64.0
+    # post-rescale updates still land and dominate
+    for _ in range(64):
+        h.update(500.0)
+    assert h.snapshot()["p50"] == 500.0

