@@ -15,7 +15,8 @@ import tempfile
 _SRC_DIR = os.path.dirname(os.path.abspath(__file__))
 _SRCS = [os.path.join(_SRC_DIR, "src", "codecs.cc"),
          os.path.join(_SRC_DIR, "src", "encode.cc"),
-         os.path.join(_SRC_DIR, "src", "shred.cc")]
+         os.path.join(_SRC_DIR, "src", "shred.cc"),
+         os.path.join(_SRC_DIR, "src", "shred_nested.cc")]
 _SO = os.path.join(_SRC_DIR, "_kpw_native.so")
 
 
@@ -81,6 +82,56 @@ def _build() -> str:
         if os.path.exists(tmp):
             os.unlink(tmp)
     return _SO
+
+
+class NestedShredResult:
+    """Owner of one kpw_proto_shred_nested output; numpy views are COPIES
+    (the C++ arena is freed on close / GC)."""
+
+    def __init__(self, cdll, handle) -> None:
+        self._c = cdll
+        self._h = handle
+
+    def _copy(self, ptr, n, dtype):
+        import numpy as np
+
+        if n == 0 or not ptr:
+            return np.zeros(0, dtype)
+        return np.ctypeslib.as_array(
+            ctypes.cast(ptr, ctypes.POINTER(ctypes.c_uint8)),
+            shape=(n * np.dtype(dtype).itemsize,)).view(dtype).copy()
+
+    def values(self, leaf: int, dtype):
+        import numpy as np
+
+        nbytes = self._c.kpw_nested_value_bytes(self._h, leaf)
+        n = nbytes // np.dtype(dtype).itemsize
+        return self._copy(self._c.kpw_nested_values(self._h, leaf), n, dtype)
+
+    def spans(self, leaf: int):
+        import numpy as np
+
+        n = self._c.kpw_nested_nspans(self._h, leaf)
+        return (self._copy(self._c.kpw_nested_spos(self._h, leaf), n, np.int64),
+                self._copy(self._c.kpw_nested_slen(self._h, leaf), n, np.int32))
+
+    def levels(self, leaf: int):
+        import numpy as np
+
+        n = self._c.kpw_nested_nlevels(self._h, leaf)
+        return (self._copy(self._c.kpw_nested_defs(self._h, leaf), n, np.uint8),
+                self._copy(self._c.kpw_nested_reps(self._h, leaf), n, np.uint8))
+
+    def close(self) -> None:
+        if self._h:
+            self._c.kpw_nested_free(self._h)
+            self._h = None
+
+    def __del__(self) -> None:  # pragma: no cover - GC safety net
+        try:
+            self.close()
+        except Exception:
+            pass
 
 
 class NativeLib:
@@ -160,6 +211,26 @@ class NativeLib:
         cdll.kpw_gather_spans.restype = None
         cdll.kpw_gather_spans.argtypes = [
             c_p, c_i64p, c_i32p, ctypes.c_int64, c_p]
+        h_p = ctypes.c_void_p
+        cdll.kpw_proto_shred_nested.restype = ctypes.c_int64
+        cdll.kpw_proto_shred_nested.argtypes = (
+            [c_p, c_i64p, ctypes.c_int64, ctypes.c_int32, ctypes.c_int32,
+             c_u32p, c_p, c_p] + [c_i32p] * 12 + [ctypes.POINTER(h_p)])
+        for name in ("kpw_nested_value_bytes", "kpw_nested_nspans",
+                     "kpw_nested_nlevels"):
+            fn = getattr(cdll, name)
+            fn.restype = ctypes.c_int64
+            fn.argtypes = [h_p, ctypes.c_int32]
+        for name, rt in (("kpw_nested_values", ctypes.c_void_p),
+                         ("kpw_nested_spos", ctypes.POINTER(ctypes.c_int64)),
+                         ("kpw_nested_slen", ctypes.POINTER(ctypes.c_int32)),
+                         ("kpw_nested_defs", ctypes.POINTER(ctypes.c_uint8)),
+                         ("kpw_nested_reps", ctypes.POINTER(ctypes.c_uint8))):
+            fn = getattr(cdll, name)
+            fn.restype = rt
+            fn.argtypes = [h_p, ctypes.c_int32]
+        cdll.kpw_nested_free.restype = None
+        cdll.kpw_nested_free.argtypes = [h_p]
 
     # -- snappy ------------------------------------------------------------
     def snappy_compress(self, data: bytes) -> bytes:
@@ -398,6 +469,45 @@ class NativeLib:
         if rc == -2:
             raise RuntimeError("kpw_proto_shred: field number table overflow")
         return rc
+
+    def proto_shred_nested(self, buf: bytes, rec_offsets, plan):
+        """Batch nested wire-format decode (kpw_proto_shred_nested).
+        ``plan`` carries the node-table arrays (models.proto_bridge
+        _NestedPlan).  Returns a :class:`NestedShredResult` on success or
+        the failing record index (int) when the batch needs the Python
+        fallback."""
+        import numpy as np
+
+        offs = np.ascontiguousarray(rec_offsets, np.int64)
+        n_rec = len(offs) - 1
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        u32p = ctypes.POINTER(ctypes.c_uint32)
+        keep = []  # anchor temporaries across the C call
+
+        def ip(a):
+            arr = np.ascontiguousarray(a, np.int32)
+            keep.append(arr)
+            return arr.ctypes.data_as(i32p)
+
+        fnum = np.ascontiguousarray(plan.fnum, np.uint32)
+        keep.append(fnum)
+        handle = ctypes.c_void_p()
+        rc = self._c.kpw_proto_shred_nested(
+            buf, offs.ctypes.data_as(i64p), n_rec,
+            plan.n_nodes, plan.n_leaves,
+            fnum.ctypes.data_as(u32p),
+            bytes(np.ascontiguousarray(plan.kind, np.uint8)),
+            bytes(np.ascontiguousarray(plan.flags, np.uint8)),
+            ip(plan.child_begin), ip(plan.child_end), ip(plan.leaf_idx),
+            ip(plan.ftab), ip(plan.ftab_off), ip(plan.max_fn),
+            ip(plan.enum_vals), ip(plan.enum_off), ip(plan.enum_len),
+            ip(plan.null_leaves), ip(plan.null_off), ip(plan.null_len),
+            ctypes.byref(handle))
+        del keep
+        if rc >= 0:
+            return int(rc)
+        return NestedShredResult(self._c, handle)
 
     def gather_spans(self, src: bytes, pos, lens) -> bytes:
         """Concatenate spans (pos[i], lens[i]) of ``src`` — the string-column

@@ -22,6 +22,8 @@
 #include <cstring>
 #include <vector>
 
+#include "wire_common.h"
+
 namespace {
 
 // field kinds (mirrored in kpw_tpu/models/proto_bridge.py _WIRE_KINDS)
@@ -41,58 +43,8 @@ enum Flags : uint8_t {
   F_REQUIRED = 1,  // proto2 required: absence is a record parse error
 };
 
-inline bool read_varint(const uint8_t*& p, const uint8_t* end, uint64_t* out) {
-  uint64_t v = 0;
-  int shift = 0;
-  while (p < end && shift < 64) {
-    uint8_t b = *p++;
-    v |= uint64_t(b & 0x7f) << shift;
-    if (!(b & 0x80)) {
-      *out = v;
-      return true;
-    }
-    shift += 7;
-  }
-  return false;  // truncated or > 10 bytes
-}
-
-bool utf8_ok(const uint8_t* s, int64_t n) {
-  int64_t i = 0;
-  while (i < n) {
-    uint8_t c = s[i];
-    if (c < 0x80) {
-      i++;
-      continue;
-    }
-    int extra;
-    uint32_t cp;
-    if ((c & 0xe0) == 0xc0) {
-      extra = 1;
-      cp = c & 0x1f;
-    } else if ((c & 0xf0) == 0xe0) {
-      extra = 2;
-      cp = c & 0x0f;
-    } else if ((c & 0xf8) == 0xf0) {
-      extra = 3;
-      cp = c & 0x07;
-    } else {
-      return false;
-    }
-    if (i + extra >= n) return false;
-    for (int k = 1; k <= extra; k++) {
-      uint8_t cc = s[i + k];
-      if ((cc & 0xc0) != 0x80) return false;
-      cp = (cp << 6) | (cc & 0x3f);
-    }
-    // overlong / surrogate / out-of-range rejection
-    if (extra == 1 && cp < 0x80) return false;
-    if (extra == 2 && (cp < 0x800 || (cp >= 0xd800 && cp <= 0xdfff)))
-      return false;
-    if (extra == 3 && (cp < 0x10000 || cp > 0x10ffff)) return false;
-    i += 1 + extra;
-  }
-  return true;
-}
+using kpw_wire::read_varint;
+using kpw_wire::utf8_ok;
 
 }  // namespace
 

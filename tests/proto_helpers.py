@@ -21,8 +21,11 @@ def _field(name, number, ftype, label=_F.LABEL_OPTIONAL, type_name=None):
 _pool_counter = [0]
 
 
-def build_classes(file_name: str, messages: dict, syntax: str = "proto2") -> dict:
-    """messages: {MsgName: [FieldDescriptorProto, ...]} -> {MsgName: class}"""
+def build_classes(file_name: str, messages: dict, syntax: str = "proto2",
+                  enums: dict | None = None) -> dict:
+    """messages: {MsgName: [FieldDescriptorProto, ...]} -> {MsgName: class}.
+    ``enums``: {EnumName: [(value_name, number), ...]} defined in the same
+    file (reference them via type_name='.kpwtest.EnumName')."""
     _pool_counter[0] += 1
     pool = descriptor_pool.DescriptorPool()
     fdp = descriptor_pb2.FileDescriptorProto(
@@ -30,6 +33,10 @@ def build_classes(file_name: str, messages: dict, syntax: str = "proto2") -> dic
         package="kpwtest",
         syntax=syntax,
     )
+    for enum_name, values in (enums or {}).items():
+        e = fdp.enum_type.add(name=enum_name)
+        for vname, vnum in values:
+            e.value.add(name=vname, number=vnum)
     for msg_name, fields in messages.items():
         m = fdp.message_type.add(name=msg_name)
         m.field.extend(fields)

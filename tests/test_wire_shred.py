@@ -164,11 +164,15 @@ def test_wire_shred_unknown_fields_and_last_wins():
 
 
 def test_wire_plan_fallbacks():
-    """Schemas outside the fast path report not-capable instead of lying."""
+    """Plan routing: flat scalar schemas take the lean flat decoder;
+    nested schemas are wire-capable too, via the nested decoder
+    (tests/test_nested_shred.py owns its semantics)."""
     from proto_helpers import nested_message_classes, sample_message_class
 
-    assert not ProtoColumnarizer(nested_message_classes()).wire_capable
-    assert ProtoColumnarizer(sample_message_class()).wire_capable
+    nested = ProtoColumnarizer(nested_message_classes())
+    assert nested.wire_capable and nested._wire is None
+    flat = ProtoColumnarizer(sample_message_class())
+    assert flat.wire_capable and flat._wire is not None
     enum_cls = build_classes("withenum", {"E": [
         _field("x", 1, _F.TYPE_INT64),
     ]})["E"]
