@@ -507,14 +507,10 @@ class ProtoColumnarizer:
         if self._wire is None:
             return self._columnarize_payloads_nested(payloads)
         plan: _WirePlan = self._wire
-        from ..native import lib as _native_lib
+        from ..native import lib as _native_lib, pyshred as _pyshred
 
         L = _native_lib()
         n = len(payloads)
-        lens = np.fromiter(map(len, payloads), np.int64, count=n)
-        offs = np.zeros(n + 1, np.int64)
-        np.cumsum(lens, out=offs[1:])
-        buf = b"".join(payloads)
         nf = len(plan.fnum)
         out_vals, out_pos, out_len, out_pres = [], [], [], []
         for f in range(nf):
@@ -528,10 +524,34 @@ class ProtoColumnarizer:
                 out_pos.append(None)
                 out_len.append(None)
             out_pres.append(np.zeros(n, np.uint8) if plan.optional[f] else None)
-        err = L.proto_shred(buf, offs, nf, plan.fnum, plan.kinds, plan.flags,
-                            out_vals, out_pos, out_len, out_pres)
+
+        # zero-copy C-extension entry: reads the payload bytes objects in
+        # place (no b"".join, no fromiter length walk — ~35 ms per 300k
+        # records on the streaming hot path); span positions come back
+        # record-relative and strings gather straight into their final
+        # ByteColumn payload (one copy total)
+        pys = _pyshred()
+        buf = None
+        if pys is not None:
+            try:
+                err, total = pys.shred_flat(
+                    payloads, plan.fnum, plan.kinds, plan.flags,
+                    tuple(out_vals), tuple(out_pos), tuple(out_len),
+                    tuple(out_pres))
+            except TypeError:
+                pys = None  # non-bytes payloads: ctypes join path below
+        if pys is None:
+            lens = np.fromiter(map(len, payloads), np.int64, count=n)
+            offs = np.zeros(n + 1, np.int64)
+            np.cumsum(lens, out=offs[1:])
+            buf = b"".join(payloads)
+            total = int(offs[-1])
+            err = L.proto_shred(buf, offs, nf, plan.fnum, plan.kinds,
+                                plan.flags, out_vals, out_pos, out_len,
+                                out_pres)
         if err >= 0:
             raise WireShredError(int(err))
+        all_recs = None
         chunks = []
         for f, col in enumerate(self.schema.columns):
             pres = out_pres[f]
@@ -541,18 +561,29 @@ class ProtoColumnarizer:
                 def_levels = pres.astype(np.int32)
             if plan.dtypes[f] is None:
                 pos, ln = out_pos[f], out_len[f]
+                rec_idx = None
                 if pres is not None:
                     pos, ln = pos[mask], ln[mask]
+                    if pys is not None:
+                        rec_idx = np.nonzero(mask)[0].astype(np.int32)
+                elif pys is not None:
+                    if all_recs is None:
+                        all_recs = np.arange(n, dtype=np.int32)
+                    rec_idx = all_recs
                 offsets = np.zeros(len(ln) + 1, np.int64)
                 np.cumsum(ln, out=offsets[1:])
-                values = ByteColumn(L.gather_spans(buf, pos, ln), offsets)
+                if pys is not None:
+                    payload = pys.gather_iov(payloads, rec_idx, pos, ln)
+                else:
+                    payload = L.gather_spans(buf, pos, ln)
+                values = ByteColumn(payload, offsets)
             else:
                 values = out_vals[f]
                 if pres is not None:
                     values = values[mask]
             chunks.append(ColumnChunkData(col, values, def_levels, None, n))
         batch = ColumnBatch(chunks, n)
-        batch.wire_bytes = int(offs[-1])  # payload bytes, for byte metering
+        batch.wire_bytes = int(total)  # payload bytes, for byte metering
         return batch
 
     def _columnarize_payloads_nested(self, payloads: list) -> ColumnBatch:

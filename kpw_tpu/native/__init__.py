@@ -11,6 +11,8 @@ from __future__ import annotations
 
 _lib = None
 _tried = False
+_pyshred = None
+_pyshred_tried = False
 
 
 def lib():
@@ -32,3 +34,26 @@ def lib():
                       "falling back to ctypes/python codecs")
         _lib = None
     return _lib
+
+
+def pyshred():
+    """The zero-copy CPython shred extension (src/pyshred.cc), or None —
+    callers must fall back to the ctypes join path (NativeLib.proto_shred)."""
+    global _pyshred, _pyshred_tried
+    if _pyshred_tried:
+        return _pyshred
+    _pyshred_tried = True
+    try:
+        from .build import load_pyshred
+
+        _pyshred = load_pyshred()
+    except Exception as e:
+        import os
+        import warnings
+
+        if os.environ.get("KPW_TPU_NATIVE_REQUIRE"):
+            raise
+        warnings.warn(f"kpw_tpu pyshred extension unavailable ({e!r}); "
+                      "using the ctypes shred path")
+        _pyshred = None
+    return _pyshred

@@ -64,7 +64,12 @@ def _build() -> str:
         for cflags, zstd in ((fast, True), (plain, True),
                              (fast, False), (plain, False)):
             args = (["g++"] + cflags + tail + [tmp] + list(_SRCS)
-                    + (["-lzstd", "-ldl"] if zstd else ["-DKPW_NO_ZSTD"]))
+                    # -ldl in BOTH branches: the snappy runtime dispatch
+                    # dlopens unconditionally (pre-2.34 glibc keeps dlopen
+                    # in libdl; -shared would link with it undefined and
+                    # ctypes.CDLL would fail at load)
+                    + (["-lzstd", "-ldl"] if zstd
+                       else ["-DKPW_NO_ZSTD", "-ldl"]))
             try:
                 subprocess.run(args, check=True, capture_output=True)
                 break
@@ -147,6 +152,10 @@ class NativeLib:
         cdll.kpw_snappy_compress.argtypes = [c_p, c_sz, c_p, ctypes.POINTER(c_sz)]
         cdll.kpw_snappy_uncompressed_length.restype = ctypes.c_int
         cdll.kpw_snappy_uncompressed_length.argtypes = [c_p, c_sz, ctypes.POINTER(c_sz)]
+        cdll.kpw_snappy_compress_parts.restype = ctypes.c_int
+        cdll.kpw_snappy_compress_parts.argtypes = [
+            ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(c_sz),
+            ctypes.c_int, c_p, c_sz, ctypes.POINTER(c_sz)]
         cdll.kpw_snappy_uncompress.restype = ctypes.c_int
         cdll.kpw_snappy_uncompress.argtypes = [c_p, c_sz, c_p, c_sz, ctypes.POINTER(c_sz)]
         self.has_zstd = hasattr(cdll, "kpw_zstd_compress")
@@ -267,6 +276,41 @@ class NativeLib:
         if rc != 0:
             raise RuntimeError("zstd compress failed")
         return out.raw[: out_len.value]
+
+    def snappy_compress_parts(self, parts: list, out=None):
+        """Compress discontiguous parts (bytes / memoryview / ndarray) as
+        one snappy stream into ``out`` (a uint8 ndarray scratch, grown as
+        needed, NOT zeroed) — returns (out, n_written).  The caller slices
+        ``memoryview(out)[:n]`` and must consume it before the next call
+        reusing the same scratch.  Same contract as zstd_compress_parts."""
+        import numpy as np
+
+        n = len(parts)
+        ptrs = (ctypes.c_void_p * n)()
+        lens = (ctypes.c_size_t * n)()
+        keep = []  # keep frombuffer views alive through the call
+        total = 0
+        for i, p in enumerate(parts):
+            if isinstance(p, bytes):
+                ptrs[i] = ctypes.cast(ctypes.c_char_p(p), ctypes.c_void_p)
+                lens[i] = len(p)
+                total += len(p)
+            else:
+                a = np.frombuffer(p, np.uint8)
+                keep.append(a)
+                ptrs[i] = a.ctypes.data
+                lens[i] = a.nbytes
+                total += a.nbytes
+        cap = self._c.kpw_snappy_max_compressed_length(total)
+        if out is None or out.nbytes < cap:
+            out = np.empty(cap, np.uint8)
+        out_len = ctypes.c_size_t(0)
+        rc = self._c.kpw_snappy_compress_parts(
+            ptrs, lens, n, out.ctypes.data_as(ctypes.c_char_p), out.nbytes,
+            ctypes.byref(out_len))
+        if rc != 0:
+            raise RuntimeError(f"kpw_snappy_compress_parts rc={rc}")
+        return out, out_len.value
 
     def zstd_compress_parts(self, parts: list, level: int = 3, out=None):
         """Compress discontiguous parts (bytes / memoryview / ndarray) as
@@ -574,3 +618,63 @@ def _prefer_bundled_zstd() -> None:
 def load() -> NativeLib:
     _prefer_bundled_zstd()
     return NativeLib(ctypes.CDLL(_build()))
+
+
+# -- zero-copy CPython shred extension --------------------------------------
+_PYSHRED_SRCS = [os.path.join(_SRC_DIR, "src", "pyshred.cc"),
+                 os.path.join(_SRC_DIR, "src", "shred.cc")]
+_PYSHRED_SO = os.path.join(_SRC_DIR, "_kpw_pyshred.so")
+_PYSHRED_TAG = _PYSHRED_SO + ".hosttag"
+
+
+def _build_pyshred() -> str:
+    """Compile the _kpw_pyshred extension (pyshred.cc + shred.cc — the
+    decoder compiles into both .so files from the same source, so the two
+    paths cannot drift).  Same cache/hosttag discipline as _build."""
+    if (os.path.exists(_PYSHRED_SO)
+            and all(os.path.getmtime(_PYSHRED_SO) >= os.path.getmtime(s)
+                    for s in _PYSHRED_SRCS)
+            and os.path.exists(_PYSHRED_TAG)
+            and open(_PYSHRED_TAG).read() == _host_tag()):
+        return _PYSHRED_SO
+    import sysconfig
+
+    inc = sysconfig.get_paths()["include"]
+    fast = ["-O3", "-march=native", "-funroll-loops"]
+    plain = ["-O3"]
+    tail = ["-fPIC", "-shared", "-std=c++17", f"-I{inc}", "-o"]
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=_SRC_DIR)
+    os.close(fd)
+    try:
+        last_err = b""
+        for cflags in (fast, plain):
+            args = ["g++"] + cflags + tail + [tmp] + _PYSHRED_SRCS
+            try:
+                subprocess.run(args, check=True, capture_output=True)
+                break
+            except subprocess.CalledProcessError as e:
+                last_err = e.stderr or b""
+                continue
+        else:
+            raise RuntimeError("pyshred build failed:\n"
+                               + last_err.decode(errors="replace"))
+        os.replace(tmp, _PYSHRED_SO)
+        with open(_PYSHRED_TAG, "w") as f:
+            f.write(_host_tag())
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+    return _PYSHRED_SO
+
+
+def load_pyshred():
+    import importlib.machinery
+    import importlib.util
+
+    path = _build_pyshred()
+    loader = importlib.machinery.ExtensionFileLoader("_kpw_pyshred", path)
+    spec = importlib.util.spec_from_loader("_kpw_pyshred", loader,
+                                           origin=path)
+    mod = importlib.util.module_from_spec(spec)
+    loader.exec_module(mod)
+    return mod

@@ -184,9 +184,10 @@ class NativeChunkEncoder(CpuChunkEncoder):
         return super()._values_page_parts(chunk, va, vb, pt, encoding)
 
     def _compress_parts(self, parts: list, body_len: int):
-        """ZSTD pages compress straight from the parts into per-thread
-        scratch (no body concatenation, no zeroed bounce buffers, no
-        compressed-bytes copy); other codecs take the base path."""
+        """ZSTD and SNAPPY pages compress straight from the parts into
+        per-thread scratch (no Python-side body concatenation, no zeroed
+        bounce buffers, no compressed-bytes copy); other codecs take the
+        base path."""
         from ..core.schema import Codec
 
         opts = self.options
@@ -199,6 +200,11 @@ class NativeChunkEncoder(CpuChunkEncoder):
                 arr, n = res
                 self._tl.zscratch = arr  # reuse; consumer copies immediately
                 return memoryview(arr)[:n], n
+        if self._lib is not None and opts.codec == Codec.SNAPPY:
+            arr, n = self._lib.snappy_compress_parts(
+                parts, getattr(self._tl, "sscratch", None))
+            self._tl.sscratch = arr  # reuse; consumer copies immediately
+            return memoryview(arr)[:n], n
         return super()._compress_parts(parts, body_len)
 
     def _stats_min_max(self, values, pt: int):

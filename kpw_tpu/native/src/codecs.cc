@@ -22,9 +22,10 @@
 
 #ifndef KPW_NO_ZSTD
 #include <zstd.h>
-#include <dlfcn.h>
-#include <mutex>
 #endif
+#include <dlfcn.h>  // snappy + zstd runtime dispatch (glibc>=2.34: in libc)
+#include <mutex>
+#include <vector>
 
 namespace {
 
@@ -202,15 +203,69 @@ emit_remainder:
 
 }  // namespace
 
+// Runtime dispatch to the system libsnappy when present (same pattern as
+// zdl:: for zstd): its compressor is ~2x our from-scratch one on page data
+// (measured 4.0 vs 2.0 GB/s on this host), and both emit valid snappy
+// streams.  The dispatch lives INSIDE kpw_snappy_compress so every caller
+// (native encoder, cpu oracle path via core.compression) picks the same
+// implementation — backend byte-identity holds per host.  Opt out with
+// KPW_SNAPPY_LIB="" (empty) or point KPW_SNAPPY_LIB at a specific .so;
+// decompression and the internal compressor remain available either way
+// (tests cross-validate the two).
+namespace sdl {
+typedef int (*raw_compress_t)(const char*, size_t, char*, size_t*);
+typedef size_t (*max_len_t)(size_t);
+
+struct Api {
+  raw_compress_t compress = nullptr;  // null = internal compressor
+  max_len_t max_len = nullptr;
+};
+
+static Api g_api;
+static std::once_flag g_once;
+
+static void init_api() {
+  const char* path = getenv("KPW_SNAPPY_LIB");
+  if (path != nullptr && path[0] == '\0') return;  // explicit opt-out
+  void* h = dlopen(path != nullptr ? path : "libsnappy.so.1",
+                   RTLD_LAZY | RTLD_LOCAL);
+  if (h == nullptr) return;
+  Api a;
+  a.compress = (raw_compress_t)dlsym(h, "snappy_compress");
+  a.max_len = (max_len_t)dlsym(h, "snappy_max_compressed_length");
+  if (a.compress != nullptr && a.max_len != nullptr)
+    g_api = a;
+  else
+    dlclose(h);
+}
+
+static const Api& api() {
+  std::call_once(g_once, init_api);
+  return g_api;
+}
+}  // namespace sdl
+
 extern "C" {
 
 size_t kpw_snappy_max_compressed_length(size_t n) {
+  const sdl::Api& s = sdl::api();
+  if (s.max_len != nullptr) {
+    size_t m = s.max_len(n);
+    size_t ours = 32 + n + n / 6;
+    return m > ours ? m : ours;
+  }
   return 32 + n + n / 6;
 }
 
 int kpw_snappy_compress(const uint8_t* in, size_t n, uint8_t* out,
                         size_t* out_len) {
   if (n > 0xFFFFFFFFull) return -1;
+  const sdl::Api& s = sdl::api();
+  if (s.compress != nullptr) {
+    *out_len = kpw_snappy_max_compressed_length(n);
+    return s.compress(reinterpret_cast<const char*>(in), n,
+                      reinterpret_cast<char*>(out), out_len) == 0 ? 0 : -3;
+  }
   uint8_t* op = out;
   op += varint_encode(static_cast<uint32_t>(n), op);
   uint16_t* table =
@@ -226,6 +281,28 @@ int kpw_snappy_compress(const uint8_t* in, size_t n, uint8_t* out,
   std::free(table);
   *out_len = static_cast<size_t>(op - out);
   return 0;
+}
+
+// Parts-based snappy page compression (mirrors kpw_zstd_compress_parts):
+// the page body's discontiguous parts are concatenated in C into
+// thread-local scratch (snappy's one-shot API needs contiguous input) and
+// compressed straight into the caller's scratch — no Python-side join, no
+// zeroed bounce buffers, no compressed-bytes copy.
+int kpw_snappy_compress_parts(const void* const* parts, const size_t* lens,
+                              int n_parts, uint8_t* out, size_t out_cap,
+                              size_t* out_len) {
+  size_t total = 0;
+  for (int i = 0; i < n_parts; i++) total += lens[i];
+  static thread_local std::vector<uint8_t> scratch;
+  if (scratch.size() < total) scratch.resize(total);
+  uint8_t* p = scratch.data();
+  for (int i = 0; i < n_parts; i++) {
+    std::memcpy(p, parts[i], lens[i]);
+    p += lens[i];
+  }
+  if (out_cap < kpw_snappy_max_compressed_length(total)) return -4;
+  *out_len = out_cap;
+  return kpw_snappy_compress(scratch.data(), total, out, out_len);
 }
 
 int kpw_snappy_uncompressed_length(const uint8_t* in, size_t n,

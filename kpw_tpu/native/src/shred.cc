@@ -46,12 +46,34 @@ enum Flags : uint8_t {
 using kpw_wire::read_varint;
 using kpw_wire::utf8_ok;
 
-}  // namespace
+// record sources for the shared decode core: one contiguous buffer with an
+// offsets table (the ctypes join path), or an iovec of per-record pointers
+// (the zero-copy C-extension path, native/src/pyshred.cc — payload bytes
+// objects are read in place, no join).  Span positions are relative to the
+// source's per-record base so each path's gather knows how to resolve them.
+struct ContigSrc {
+  const uint8_t* buf;
+  const int64_t* offs;
+  inline void rec(int64_t r, const uint8_t** p, const uint8_t** end,
+                  const uint8_t** base) const {
+    *p = buf + offs[r];
+    *end = buf + offs[r + 1];
+    *base = buf;  // global positions, resolved by kpw_gather_spans
+  }
+};
 
-extern "C" {
+struct IovSrc {
+  const uint8_t* const* ptrs;
+  const int64_t* lens;
+  inline void rec(int64_t r, const uint8_t** p, const uint8_t** end,
+                  const uint8_t** base) const {
+    *p = ptrs[r];
+    *end = ptrs[r] + lens[r];
+    *base = ptrs[r];  // in-record positions, resolved with the record index
+  }
+};
 
-// Decode n_rec serialized messages (concatenated in `buf`, record r at
-// [offs[r], offs[r+1])) into per-field columnar outputs.
+// Decode n_rec serialized messages into per-field columnar outputs.
 //
 //   out_vals[f]: fixed-width target (n_rec slots of 1/4/8 bytes per Kind),
 //                pre-zeroed by the caller (absent no-presence fields keep
@@ -66,12 +88,12 @@ extern "C" {
 // model).  Outputs for preceding records are valid; the caller discards the
 // batch on any error and re-parses in Python (errors are rare: poison
 // pills).
-int64_t kpw_proto_shred(const uint8_t* buf, const int64_t* offs,
-                        int64_t n_rec, int32_t n_fields,
-                        const uint32_t* fnum, const uint8_t* kind,
-                        const uint8_t* flags, void* const* out_vals,
-                        int64_t* const* out_pos, int32_t* const* out_len,
-                        uint8_t* const* out_pres) {
+template <typename Src>
+int64_t shred_impl(const Src& src, int64_t n_rec, int32_t n_fields,
+                   const uint32_t* fnum, const uint8_t* kind,
+                   const uint8_t* flags, void* const* out_vals,
+                   int64_t* const* out_pos, int32_t* const* out_len,
+                   uint8_t* const* out_pres) {
   // direct-address field-number -> plan index table
   uint32_t max_fn = 0;
   for (int32_t f = 0; f < n_fields; f++)
@@ -86,8 +108,10 @@ int64_t kpw_proto_shred(const uint8_t* buf, const int64_t* offs,
   std::vector<uint8_t> seen(any_required ? n_fields : 0);
 
   for (int64_t r = 0; r < n_rec; r++) {
-    const uint8_t* p = buf + offs[r];
-    const uint8_t* end = buf + offs[r + 1];
+    const uint8_t* p;
+    const uint8_t* end;
+    const uint8_t* base;
+    src.rec(r, &p, &end, &base);
     if (any_required) std::memset(seen.data(), 0, seen.size());
     while (p < end) {
       uint64_t tag;
@@ -162,7 +186,7 @@ int64_t kpw_proto_shred(const uint8_t* buf, const int64_t* offs,
           if (wire != 2) return r;
           if (!read_varint(p, end, &v) || uint64_t(end - p) < v) return r;
           if (k == K_SPAN_UTF8 && !utf8_ok(p, int64_t(v))) return r;
-          out_pos[f][r] = p - buf;
+          out_pos[f][r] = p - base;
           out_len[f][r] = int32_t(v);
           p += v;
           break;
@@ -180,6 +204,33 @@ int64_t kpw_proto_shred(const uint8_t* buf, const int64_t* offs,
   return -1;
 }
 
+}  // namespace
+
+extern "C" {
+
+int64_t kpw_proto_shred(const uint8_t* buf, const int64_t* offs,
+                        int64_t n_rec, int32_t n_fields,
+                        const uint32_t* fnum, const uint8_t* kind,
+                        const uint8_t* flags, void* const* out_vals,
+                        int64_t* const* out_pos, int32_t* const* out_len,
+                        uint8_t* const* out_pres) {
+  return shred_impl(ContigSrc{buf, offs}, n_rec, n_fields, fnum, kind, flags,
+                    out_vals, out_pos, out_len, out_pres);
+}
+
+// iovec variant: record r lives at [ptrs[r], ptrs[r] + lens[r]); span
+// positions come back RELATIVE TO THE RECORD (resolve with
+// kpw_gather_spans_iov).  The zero-copy entry used by the C extension.
+int64_t kpw_proto_shred_iov(const uint8_t* const* ptrs, const int64_t* lens,
+                            int64_t n_rec, int32_t n_fields,
+                            const uint32_t* fnum, const uint8_t* kind,
+                            const uint8_t* flags, void* const* out_vals,
+                            int64_t* const* out_pos, int32_t* const* out_len,
+                            uint8_t* const* out_pres) {
+  return shred_impl(IovSrc{ptrs, lens}, n_rec, n_fields, fnum, kind, flags,
+                    out_vals, out_pos, out_len, out_pres);
+}
+
 // Gather n spans (pos[i], len[i]) out of `src` back to back into `out`
 // (caller sizes `out` as sum(len)).  The string-column assembly step after
 // kpw_proto_shred.
@@ -187,6 +238,16 @@ void kpw_gather_spans(const uint8_t* src, const int64_t* pos,
                       const int32_t* len, int64_t n, uint8_t* out) {
   for (int64_t i = 0; i < n; i++) {
     std::memcpy(out, src + pos[i], size_t(len[i]));
+    out += len[i];
+  }
+}
+
+// iovec gather: span i is (pos[i], len[i]) within record rec_idx[i].
+void kpw_gather_spans_iov(const uint8_t* const* ptrs, const int32_t* rec_idx,
+                          const int64_t* pos, const int32_t* len, int64_t n,
+                          uint8_t* out) {
+  for (int64_t i = 0; i < n; i++) {
+    std::memcpy(out, ptrs[rec_idx[i]] + pos[i], size_t(len[i]));
     out += len[i];
   }
 }
