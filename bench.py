@@ -4,8 +4,10 @@ Default (no args) = the headline: config 2, 64-column dictionary+RLE parquet
 encode (NYC-taxi-shaped replay, one chip), printed as ONE JSON line
 {"metric", "value", "unit", "vs_baseline"} — what the driver records.
 
-  --config N   run one config (1-5)
-  --all        run every config, one JSON line each (headline last)
+  --config N   run one config (1-7)
+  --all        run every config, one JSON line each (headline last), and
+               self-record the sweep to BENCH_SWEEP_r03.json
+  --rowgroup   time the whole row-group device phase in ONE dispatch
   --cpu        force the virtual CPU platform (local smoke)
 
 Baseline for configs 1/2/3/5 is pyarrow's C++ parquet writer with matched
@@ -17,15 +19,18 @@ path against *itself* on a 1-device mesh (vs_baseline = work-conserving
 speedup, ~n_shards on real chips) — see bench_config4.  Extra detail goes
 to stderr.
 
-Configs (BASELINE.json `configs`):
+Configs (BASELINE.json `configs` 1-5, plus streaming replays):
   1. flat Avro-style 8 int64 + 4 string columns, Snappy
   2. NYC-taxi 64 columns, dictionary+RLE, uncompressed (headline)
   3. high-cardinality string-heavy: ZSTD + DELTA_BINARY_PACKED /
      DELTA_LENGTH_BYTE_ARRAY
   4. 16 partitions -> 8-shard mesh, shared row group with collective
      dictionary merge (runs on a virtual CPU mesh when only one real chip
-     is visible — the sharding path itself is what's measured)
+     is visible — the sharding path itself is what's measured) + a
+     weak-scaling sweep
   5. nested list<struct>: repetition/definition-level RLE on device
+  6. end-to-end flat streaming replay through the full writer
+  7. end-to-end NESTED streaming replay (cfg5 shape, nested wire shredder)
 """
 
 from __future__ import annotations
@@ -216,12 +221,35 @@ def bench_config2() -> dict:
     except Exception as e:  # never let the probe sink the headline number
         print(f"[bench:cfg2] tpu kernel probe failed: {e!r}", file=sys.stderr)
     try:
-        rg = tpu_rowgroup_probe()
+        rg = _rowgroup_probe_subprocess()
         if rg:
             out.update(rg)
     except Exception as e:
         print(f"[bench:cfg2] rowgroup probe failed: {e!r}", file=sys.stderr)
     return out
+
+
+def _rowgroup_probe_subprocess(timeout_s: int | None = None) -> dict | None:
+    """Run the whole-row-group probe in a subprocess with a hard timeout:
+    a cold compilation cache costs ~25 min of tunnel compiles for the
+    combined program, and the probe must never sink the headline bench.
+    The subprocess inherits the persistent cache (main() sets it), so a
+    primed cache finishes in ~2 min."""
+    if timeout_s is None:
+        timeout_s = int(os.environ.get("KPW_ROWGROUP_TIMEOUT", "1500"))
+    args = [sys.executable, os.path.abspath(__file__), "--rowgroup"]
+    if "--cpu" in sys.argv:
+        args.append("--cpu")  # a CPU smoke run must not grab the real chip
+    out = subprocess.run(
+        args, capture_output=True, text=True, timeout=timeout_s,
+        cwd=os.path.dirname(os.path.abspath(__file__)))
+    sys.stderr.write(out.stderr)
+    if out.returncode != 0:
+        print(f"[bench:cfg2] rowgroup subprocess rc={out.returncode}",
+              file=sys.stderr)
+        return None
+    line = out.stdout.strip().splitlines()[-1] if out.stdout.strip() else "null"
+    return json.loads(line)
 
 
 def tpu_kernel_probe(n_steps: int = 32) -> dict | None:
@@ -862,6 +890,15 @@ def main() -> None:
         jax.config.update("jax_platforms", "cpu")
     import jax
 
+    try:
+        # persistent compilation cache: the combined rowgroup-probe program
+        # costs ~14 min to compile over the tunnel; cached, reruns are free
+        jax.config.update("jax_compilation_cache_dir",
+                          os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                       ".jax_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+    except Exception as e:
+        print(f"[bench] compilation cache unavailable: {e!r}", file=sys.stderr)
     print(f"[bench] devices: {jax.devices()}", file=sys.stderr)
 
     if "--all" in sys.argv:

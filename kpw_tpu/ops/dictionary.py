@@ -90,16 +90,27 @@ def _dict_build_one(hi, lo, count, wide: bool,
         else:
             dhi = dlo  # unused placeholder
         return dhi, dlo, indices, k
-    # TPU: compact keys to the front by one more sort on rank (non-new
-    # slots rank n: tail), unscramble uid by original position — sorts,
-    # never scatters
-    rank = jnp.where(is_new, uid, n)
+    # TPU: compact keys to the front and unscramble uid by original
+    # position — sorts, never scatters.  Where shapes permit, the two
+    # reorders ride XLA's SINGLE-OPERAND sort fast path instead of
+    # variadic sorts (same reformulation as the flagship kernel,
+    # parallel/sharded.encode_step_single — each variadic (key, payload)
+    # sort costs ~2x the single-key sort on the v5e comparator network):
+    # the narrow dictionary is sorted directly from its masked values, and
+    # (pos, uid) pack into one u32 key when pos_bits + uid_bits <= 32.
     if wide:
+        rank = jnp.where(is_new, uid, n)
         _, dhi, dlo = jax.lax.sort((rank, shi, slo), num_keys=1)
     else:
-        _, dlo = jax.lax.sort((rank, slo), num_keys=1)
+        dlo = jnp.sort(jnp.where(is_new, slo, big))
         dhi = dlo  # unused placeholder
-    _, suid = jax.lax.sort((spos, uid), num_keys=1)
+    pos_bits = max((n - 1).bit_length(), 1)
+    if 2 * pos_bits <= 32:  # uid < k <= n needs at most pos_bits bits
+        key = ((spos.astype(jnp.uint32) << pos_bits)
+               | uid.astype(jnp.uint32))
+        suid = jnp.sort(key) & jnp.uint32((1 << pos_bits) - 1)
+    else:
+        _, suid = jax.lax.sort((spos, uid), num_keys=1)
     return dhi, dlo, suid.astype(jnp.uint32), k
 
 

@@ -65,10 +65,14 @@ def level_runs_multi(levels_all: jax.Array, stream_ids: jax.Array,
         # one compaction keyed on run ENDS covers both outputs: a run's
         # value is constant, so v at the end position is the run value.
         # Run ids are a dense prefix: hardware-selected scatter/sort
-        # (see compact_by_rank)
+        # (see compact_by_rank).  Static value-bit bounds let the TPU
+        # branch use packed single-operand sorts: level values fit 16 bits
+        # (parquet levels are tiny ints) and run lengths fit the window
+        # bucket.
         end_rank = jnp.where(is_end, run_id, run_bucket)
         run_vals, run_lens = compact_by_rank(
-            end_rank, (v, run_len_here), run_bucket)
+            end_rank, (v, run_len_here), run_bucket,
+            value_bits=(16, max(bucket.bit_length(), 1)))
         return run_vals, run_lens
 
     return jax.vmap(one)(stream_ids, starts, counts)

@@ -212,3 +212,20 @@ def test_compact_by_rank_branches_agree():
         np.testing.assert_array_equal(np.asarray(a_l), np.asarray(b_l))
         single = compact_by_rank(r, jnp.asarray(vals), out, scatters=False)
         np.testing.assert_array_equal(np.asarray(single), np.asarray(a_v))
+        # packed single-operand-sort branch (static value-bit bounds,
+        # rank_bits = 8 for out=128 so bounds must be <= 24): identical to
+        # both other branches
+        small = (vals >> np.uint32(8)).astype(np.uint32)  # < 2^24
+        c_v, c_l = compact_by_rank(
+            r, (jnp.asarray(small), jnp.asarray(lens)), out,
+            scatters=False, value_bits=(24, 7))
+        d_v, d_l = compact_by_rank(
+            r, (jnp.asarray(small), jnp.asarray(lens)), out, scatters=True)
+        np.testing.assert_array_equal(np.asarray(c_v), np.asarray(d_v))
+        np.testing.assert_array_equal(np.asarray(c_l), np.asarray(d_l))
+        # bounds too wide for packing -> silently takes the variadic path
+        e_v, e_l = compact_by_rank(
+            r, (jnp.asarray(vals), jnp.asarray(lens)), out,
+            scatters=False, value_bits=(31, 7))
+        np.testing.assert_array_equal(np.asarray(e_v), np.asarray(a_v))
+        np.testing.assert_array_equal(np.asarray(e_l), np.asarray(a_l))

@@ -1,0 +1,149 @@
+"""On-chip breakdown of the flagship encode step (VERDICT r2 "next" #2):
+times each pipeline prefix of the ORIGINAL three-variadic-sort formulation
+(sort, build, rank compaction, unscramble, pack XLA vs Pallas) plus the
+SHIPPED ``encode_step_single`` (single-operand-sort reformulation), inside
+one jitted fori_loop per variant, dispatch-subtracted — the old variants
+are the comparison baseline that motivated the reformulation (measured:
+old full+pack 11.7 ms/step, shipped 6.75, 64x65Ki on v5e).  Run from
+/root/repo (axon backend); CPU run is only a shape check.
+
+Usage: python tools/flagship_breakdown.py [steps]
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+
+
+def main() -> None:
+    n_steps = int(sys.argv[1]) if len(sys.argv) > 1 else 64
+    C, N = 64, 1 << 16
+    rng = np.random.default_rng(7)
+    lo_host = rng.integers(0, 1000, (C, N)).astype(np.uint32)
+    count = jnp.int32(N)
+    iota = jnp.arange(N, dtype=jnp.int32)
+    big = jnp.uint32(0xFFFFFFFF)
+
+    from kpw_tpu.ops.packing import bitpack_device
+    from kpw_tpu.ops.pallas_bitpack import bitpack_pages_core
+
+    def col_sort1(lc):
+        llo = jnp.where(iota < count, lc, big)
+        slo, spos = jax.lax.sort((llo, iota), num_keys=1, is_stable=True)
+        return jnp.sum(slo) + jnp.sum(spos.astype(jnp.uint32))
+
+    def _build(lc):
+        llo = jnp.where(iota < count, lc, big)
+        slo, spos = jax.lax.sort((llo, iota), num_keys=1, is_stable=True)
+        sval = iota < jnp.sum((iota < count).astype(jnp.int32))
+        same = jnp.concatenate([jnp.zeros((1,), bool), slo[1:] == slo[:-1]])
+        is_new = sval & ~same
+        uid = jnp.cumsum(is_new.astype(jnp.int32)) - 1
+        return slo, spos, is_new, uid
+
+    def col_build(lc):
+        slo, spos, is_new, uid = _build(lc)
+        return jnp.sum(uid.astype(jnp.uint32)) + jnp.sum(slo)
+
+    def col_rank(lc):
+        slo, spos, is_new, uid = _build(lc)
+        rank = jnp.where(is_new, uid, N)
+        _, ulo = jax.lax.sort((rank, slo), num_keys=1)
+        return jnp.sum(ulo) + jnp.sum(uid.astype(jnp.uint32))
+
+    def col_unscramble(lc):
+        slo, spos, is_new, uid = _build(lc)
+        rank = jnp.where(is_new, uid, N)
+        _, ulo = jax.lax.sort((rank, slo), num_keys=1)
+        _, indices = jax.lax.sort((spos, uid), num_keys=1)
+        return jnp.sum(ulo) + jnp.sum(indices.astype(jnp.uint32))
+
+    def col_indices(lc):
+        slo, spos, is_new, uid = _build(lc)
+        rank = jnp.where(is_new, uid, N)
+        _, ulo = jax.lax.sort((rank, slo), num_keys=1)
+        _, indices = jax.lax.sort((spos, uid), num_keys=1)
+        return jnp.where(iota < count, indices.astype(jnp.uint32), 0), ulo
+
+    def full_xla(lo):
+        def one(lc):
+            masked, ulo = col_indices(lc)
+            return jnp.sum(bitpack_device(masked, 16),
+                           dtype=jnp.uint32) + jnp.sum(ulo)
+
+        return jnp.sum(jax.vmap(one)(lo))
+
+    def full_pallas(lo):
+        def one(lc):
+            return col_indices(lc)
+
+        masked, ulo = jax.vmap(one)(lo)  # (C, N)
+        packed = bitpack_pages_core(masked, 16)
+        return jnp.sum(packed, dtype=jnp.uint32) + jnp.sum(ulo)
+
+    def vm(col_fn):
+        def f(lo):
+            return jnp.sum(jax.vmap(col_fn)(lo))
+
+        return f
+
+    from kpw_tpu.parallel.sharded import encode_step_single
+
+    def shipped(lo):
+        packed, ulo, k = encode_step_single(lo, count)
+        return (jnp.sum(packed, dtype=jnp.uint32) + jnp.sum(ulo)
+                + jnp.sum(k).astype(jnp.uint32))
+
+    variants = {
+        "old sort1": vm(col_sort1),
+        "old build(sort+scan)": vm(col_build),
+        "old rank(2 sorts)": vm(col_rank),
+        "old unscramble(3 sorts)": vm(col_unscramble),
+        "old full+pack XLA": full_xla,
+        "old full+pack Pallas": full_pallas,
+        "SHIPPED encode_step_single": shipped,
+    }
+
+    dev = jax.devices()[0]
+    print(f"device: {dev}", file=sys.stderr)
+    if dev.platform == "cpu":
+        n_steps = 2
+    lo = jax.device_put(jnp.asarray(lo_host), dev)
+    try:
+        from kpw_tpu.runtime.select import probe_link
+
+        dispatch_s = probe_link()["dispatch_ms"] / 1e3
+    except Exception:
+        dispatch_s = 0.0
+
+    for name, fn in variants.items():
+        @jax.jit
+        def loop(x, fn=fn):
+            def body(i, acc):
+                return acc + fn(x ^ i.astype(jnp.uint32))
+
+            return jax.lax.fori_loop(0, n_steps, body, jnp.uint32(0))
+
+        t0 = time.perf_counter()
+        np.asarray(loop(lo))
+        compile_s = time.perf_counter() - t0
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            np.asarray(loop(lo))
+            best = min(best, time.perf_counter() - t0)
+        per = (best - dispatch_s) / n_steps
+        print(f"{name:24s} {per * 1e3:8.3f} ms/step  "
+              f"(compile {compile_s:.1f}s, loop {best:.3f}s)")
+
+
+if __name__ == "__main__":
+    main()
