@@ -38,7 +38,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .packing import pad_bucket, prefers_scatters as _prefers_scatters
+from .packing import (
+    packed_reorder as _packed_reorder,
+    pad_bucket,
+    prefers_scatters as _prefers_scatters,
+)
 
 
 def _dict_build_one(hi, lo, count, wide: bool,
@@ -106,9 +110,7 @@ def _dict_build_one(hi, lo, count, wide: bool,
         dhi = dlo  # unused placeholder
     pos_bits = max((n - 1).bit_length(), 1)
     if 2 * pos_bits <= 32:  # uid < k <= n needs at most pos_bits bits
-        key = ((spos.astype(jnp.uint32) << pos_bits)
-               | uid.astype(jnp.uint32))
-        suid = jnp.sort(key) & jnp.uint32((1 << pos_bits) - 1)
+        suid, _ = _packed_reorder(spos, uid, pos_bits)
     else:
         _, suid = jax.lax.sort((spos, uid), num_keys=1)
     return dhi, dlo, suid.astype(jnp.uint32), k

@@ -20,7 +20,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-from ..ops.packing import bitpack_device
+from ..ops.packing import bitpack_device, packed_reorder
 from .dict_merge import AXIS, _local_unique, _merge_kernel, _rank_against_dict
 
 
@@ -164,9 +164,7 @@ def _encode_step_single_impl(lo, count, width: int, pack: str):
         # dictionary by single-operand sort (see docstring)
         ulo = jnp.sort(jnp.where(is_new, slo, big))
         if fast_unscramble:
-            key = ((spos.astype(jnp.uint32) << width)
-                   | uid.astype(jnp.uint32))
-            indices = jnp.sort(key) & jnp.uint32((1 << width) - 1)
+            indices, _ = packed_reorder(spos, uid, width)
         else:
             _, indices = jax.lax.sort((spos, uid), num_keys=1)
             indices = indices.astype(jnp.uint32)

@@ -513,6 +513,54 @@ def _rand_scalar(rng, fd):
     raise AssertionError(t)
 
 
+def test_corruption_soak_no_silent_divergence():
+    """Random byte corruption property: whenever the C++ decoder ACCEPTS a
+    batch, protobuf must also accept every record and the outputs must be
+    identical — corrupted-but-valid bytes (bit flips inside values) decode
+    to exactly what a parser sees; anything else is rejected into the
+    Python fallback.  Silent divergence is the only failure mode that
+    matters for an at-least-once pipeline."""
+    import random
+
+    from kpw_tpu.models.proto_bridge import WireShredError
+
+    rng = np.random.default_rng(2026)
+    py_rng = random.Random(2026)
+    accepted = rejected = 0
+    for trial in range(14):
+        Msg, _ = _random_schema(rng, 20_000 + trial)
+        col = _nested_columnarizer(Msg)
+        msgs = []
+        for _ in range(120):
+            m = Msg()
+            _fill_random(rng, m)
+            msgs.append(m)
+        payloads = [m.SerializeToString() for m in msgs]
+        for i in range(len(payloads)):
+            if py_rng.random() < 0.02 and payloads[i]:
+                b = bytearray(payloads[i])
+                op = py_rng.random()
+                if op < 0.8 and b:  # bit flip: often still valid protobuf
+                    j = py_rng.randrange(len(b))
+                    b[j] ^= 1 << py_rng.randrange(8)
+                elif op < 0.9:
+                    b = b[: py_rng.randrange(len(b) + 1)]
+                else:
+                    b += bytes(py_rng.randrange(256)
+                               for _ in range(py_rng.randrange(1, 5)))
+                payloads[i] = bytes(b)
+        try:
+            got = col.columnarize_payloads(payloads)
+        except WireShredError:
+            rejected += 1
+            continue
+        parsed = [Msg.FromString(p) for p in payloads]  # must not raise
+        assert_batches_equal(got, col.columnarize(parsed), f" trial={trial}")
+        accepted += 1
+    # both paths must actually be exercised for the property to mean much
+    assert accepted >= 3 and rejected >= 3, (accepted, rejected)
+
+
 @pytest.mark.parametrize("seed", range(12))
 def test_fuzz_random_schemas_match_oracle(seed):
     rng = np.random.default_rng(1000 + seed)
