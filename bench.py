@@ -385,7 +385,8 @@ def tpu_rowgroup_probe(n_steps: int = 12) -> dict | None:
     def level_part(i, lv):
         lv = lv ^ (i & 1).astype(jnp.uint32)  # flip polarity, same run count
         long_sum, n_runs = level_stats_multi(lv, sids, starts, counts, PAGE)
-        rv, rl = level_runs_multi(lv, sids, starts, counts, PAGE, RUN_BUCKET)
+        # width-1 def levels (flat optional columns), like the planner passes
+        rv, rl = level_runs_multi(lv, sids, starts, counts, PAGE, RUN_BUCKET, 1)
         return (jnp.sum(long_sum).astype(jnp.uint32)
                 + jnp.sum(n_runs).astype(jnp.uint32)
                 + jnp.sum(rl, dtype=jnp.int32).astype(jnp.uint32)
@@ -923,17 +924,33 @@ def main() -> None:
     if "--all" in sys.argv:
         # self-record the sweep (VERDICT r2 "next" #8): per-config claims
         # are checkable from the committed artifact without a re-run
-        import gc
-
         record = {"configs": {}, "devices": str(jax.devices())}
         for n in (1, 3, 4, 5, 6, 7, 2):  # headline (2) last
-            result = CONFIGS[n]()
+            # each config runs in a FRESH interpreter: configs measured
+            # in-process after their predecessors ran 10-20% slower than
+            # standalone (allocator/heap state left by earlier 100+ MB
+            # broker heaps) — subprocess isolation gives every config the
+            # same conditions as a standalone `--config N` run
+            sub = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--config", str(n)] + (["--cpu"] if "--cpu" in sys.argv else []),
+                stdout=subprocess.PIPE, text=True,  # stderr streams live
+                cwd=os.path.dirname(os.path.abspath(__file__)))
+            if sub.returncode != 0:
+                print(f"[bench] config {n} failed rc={sub.returncode}",
+                      file=sys.stderr)
+                continue
+            try:
+                result = json.loads(sub.stdout.strip().splitlines()[-1])
+            except (IndexError, ValueError) as e:
+                # a clean-exit child whose last stdout line isn't the result
+                # (stray atexit prints, empty output) must not abort the
+                # sweep and lose the artifact
+                print(f"[bench] config {n} output unparseable: {e!r}",
+                      file=sys.stderr)
+                continue
             record["configs"][f"config{n}"] = result
             print(json.dumps(result), flush=True)
-            # each config leaves a 100+ MB broker/fs heap behind; reclaim
-            # it so later configs (the streaming replays and the headline)
-            # aren't measured against a fragmented arena
-            gc.collect()
         sweep_path = os.environ.get(
             "KPW_BENCH_SWEEP_PATH",
             os.path.join(os.path.dirname(os.path.abspath(__file__)),

@@ -185,12 +185,15 @@ class _LevelPlanner:
             self._b_groups.append(("fast", pages, packed))
         for bucket, entries in slow.items():
             run_bucket = pad_bucket(max(n for _, n in entries))
+            # actual level width of the grouped streams (1-3 bits for real
+            # schemas): tight enough for the one-sort packed compaction
+            level_bits = max(max(p[5] for p, _ in entries), 1)
             runs = level_runs_multi(
                 self._dev,
                 jnp.asarray(np.array([p[0] for p, _ in entries], np.int32)),
                 jnp.asarray(np.array([p[3] for p, _ in entries], np.int32)),
                 jnp.asarray(np.array([p[4] - p[3] for p, _ in entries], np.int32)),
-                bucket, run_bucket)
+                bucket, run_bucket, level_bits)
             self._b_groups.append(("slow", entries, runs))
 
     def phase_b_device(self):

@@ -50,13 +50,19 @@ def level_stats_multi(levels_all: jax.Array, stream_ids: jax.Array,
     return jax.vmap(one)(stream_ids, starts, counts)
 
 
-@functools.partial(jax.jit, static_argnums=(4, 5))
+@functools.partial(jax.jit, static_argnums=(4, 5, 6))
 def level_runs_multi(levels_all: jax.Array, stream_ids: jax.Array,
                      starts: jax.Array, counts: jax.Array, bucket: int,
-                     run_bucket: int):
+                     run_bucket: int, level_bits: int = 16):
     """Extract each page window's run list: (run_vals (P, run_bucket) uint32,
     run_lens (P, run_bucket) int32).  ``run_bucket`` must be >= the page's
-    n_runs from :func:`level_stats_multi`; excess slots are zero."""
+    n_runs from :func:`level_stats_multi`; excess slots are zero.
+    ``level_bits`` is a static bound on the level VALUES' bit width (the
+    planner passes the streams' actual width, 1-3 bits for real schemas) —
+    small enough bounds let the whole compaction ride ONE single-operand
+    u32 sort per window (rank+value+length in one packed key; measured on
+    v5e: the run-extraction program dominated the level path at ~8 ms of
+    sort work per 448-window step before the packing)."""
     padded = jnp.pad(levels_all, ((0, 0), (0, bucket)))
 
     def one(sid, start, count):
@@ -65,14 +71,11 @@ def level_runs_multi(levels_all: jax.Array, stream_ids: jax.Array,
         # one compaction keyed on run ENDS covers both outputs: a run's
         # value is constant, so v at the end position is the run value.
         # Run ids are a dense prefix: hardware-selected scatter/sort
-        # (see compact_by_rank).  Static value-bit bounds let the TPU
-        # branch use packed single-operand sorts: level values fit 16 bits
-        # (parquet levels are tiny ints) and run lengths fit the window
-        # bucket.
+        # (see compact_by_rank); run lengths fit the window bucket.
         end_rank = jnp.where(is_end, run_id, run_bucket)
         run_vals, run_lens = compact_by_rank(
             end_rank, (v, run_len_here), run_bucket,
-            value_bits=(16, max(bucket.bit_length(), 1)))
+            value_bits=(level_bits, max(bucket.bit_length(), 1)))
         return run_vals, run_lens
 
     return jax.vmap(one)(stream_ids, starts, counts)

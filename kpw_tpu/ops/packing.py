@@ -86,6 +86,26 @@ def compact_by_rank(rank, values, out_size: int,
             jnp.zeros(out_size + 1, v.dtype).at[safe].set(
                 v, mode="drop")[:out_size]
             for v in vals)
+    elif (value_bits is not None and all(b is not None for b in value_bits)
+          and max(out_size.bit_length(), 1) + sum(value_bits) <= 32):
+        # ALL values + the rank fit one u32 key: fold the value fields into
+        # ONE payload and ride the shared packed_reorder transform — one
+        # single-operand sort compacts everything (the level-run
+        # extraction's case: rank_bits + level_bits + length_bits <= 32
+        # for every realistic schema)
+        total = sum(value_bits)
+        payload = jnp.zeros(rank.shape, jnp.uint32)
+        for v, bits in zip(vals, value_bits):
+            payload = (payload << bits) | v.astype(jnp.uint32)
+        sp, sr = packed_reorder(safe, payload, total)
+        keep = sr[:out_size] < out_size
+        out = []
+        shift = 0
+        for v, bits in reversed(list(zip(vals, value_bits))):
+            field = (sp[:out_size] >> shift) & jnp.uint32((1 << bits) - 1)
+            out.append(jnp.where(keep, field, 0).astype(v.dtype))
+            shift += bits
+        out = tuple(reversed(out))
     elif (value_bits is not None
           and all(b is not None
                   and max(out_size.bit_length(), 1) + b <= 32

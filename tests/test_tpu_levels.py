@@ -229,3 +229,13 @@ def test_compact_by_rank_branches_agree():
             scatters=False, value_bits=(31, 7))
         np.testing.assert_array_equal(np.asarray(e_v), np.asarray(a_v))
         np.testing.assert_array_equal(np.asarray(e_l), np.asarray(a_l))
+        # all-in-one branch (rank + every value in ONE key: 8+8+7 <= 32),
+        # the level-run extraction's shape
+        tiny = (vals & np.uint32(0xFF)).astype(np.uint32)
+        f_v, f_l = compact_by_rank(
+            r, (jnp.asarray(tiny), jnp.asarray(lens)), out,
+            scatters=False, value_bits=(8, 7))
+        g_v, g_l = compact_by_rank(
+            r, (jnp.asarray(tiny), jnp.asarray(lens)), out, scatters=True)
+        np.testing.assert_array_equal(np.asarray(f_v), np.asarray(g_v))
+        np.testing.assert_array_equal(np.asarray(f_l), np.asarray(g_l))

@@ -1,0 +1,111 @@
+"""On-chip breakdown of the level-stream device programs — the dominant
+component of the whole-row-group phase (9.8 of ~16 ms/step at the probe
+shape).  Times, per fori_loop step at the probe's 56-stream x 8 Ki-page
+shape: the raw run scan alone, the stats program, the runs-extraction
+program, and stats+runs together (what the row-group probe's level_part
+runs) — so the split between scan work and compaction sorts is measured,
+not guessed.  Run from /root/repo (axon backend).
+
+Usage: python tools/levels_breakdown.py [steps]
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+
+
+def main() -> None:
+    n_steps = int(sys.argv[1]) if len(sys.argv) > 1 else 48
+    K, N, PAGE, RUN_BUCKET = 56, 1 << 16, 8192, 1024
+    rng = np.random.default_rng(11)
+    lvl = (rng.random((K, N)) > 0.02).astype(np.uint32)
+    lvl_all = jnp.asarray(lvl)
+    pages_per = N // PAGE
+    sids = jnp.asarray(np.repeat(np.arange(K, dtype=np.int32), pages_per))
+    starts = jnp.asarray(np.tile(np.arange(0, N, PAGE, dtype=np.int32), K))
+    counts = jnp.full(K * pages_per, PAGE, jnp.int32)
+
+    from kpw_tpu.ops.levels import level_runs_multi, level_stats_multi
+    from kpw_tpu.ops.packing import window_run_scan
+
+    def scan_only(i, lv):
+        lv = lv ^ (i & 1).astype(jnp.uint32)
+        padded = jnp.pad(lv, ((0, 0), (0, PAGE)))
+
+        def one(sid, start, count):
+            v, valid, run_id, run_len_here, is_end = window_run_scan(
+                padded, sid, start, count, PAGE)
+            return (jnp.sum(run_id) + jnp.sum(run_len_here)
+                    + jnp.sum(is_end.astype(jnp.int32)))
+
+        return jnp.sum(jax.vmap(one)(sids, starts, counts)).astype(jnp.uint32)
+
+    def stats_only(i, lv):
+        lv = lv ^ (i & 1).astype(jnp.uint32)
+        long_sum, n_runs = level_stats_multi(lv, sids, starts, counts, PAGE)
+        return (jnp.sum(long_sum) + jnp.sum(n_runs)).astype(jnp.uint32)
+
+    def runs_only(i, lv):
+        lv = lv ^ (i & 1).astype(jnp.uint32)
+        rv, rl = level_runs_multi(lv, sids, starts, counts, PAGE, RUN_BUCKET,
+                                  1)  # width-1 levels: one-sort compaction
+        return (jnp.sum(rv) + jnp.sum(rl).astype(jnp.uint32))
+
+    def both(i, lv):
+        return stats_only(i, lv) + runs_only(i, lv)
+
+    variants = {
+        "scan only": scan_only,
+        "stats program": stats_only,
+        "runs program": runs_only,
+        "stats+runs (probe's level_part)": both,
+    }
+
+    dev = jax.devices()[0]
+    print(f"device: {dev}", file=sys.stderr)
+    if dev.platform == "cpu":
+        n_steps = 2
+    lv = jax.device_put(lvl_all, dev)
+    try:
+        from kpw_tpu.runtime.select import probe_link
+
+        dispatch_s = probe_link()["dispatch_ms"] / 1e3
+    except Exception:
+        dispatch_s = 0.0
+
+    for name, fn in variants.items():
+        @jax.jit
+        def loop(steps, x, fn=fn):
+            def body(i, acc):
+                return acc + fn(i, x)
+
+            return jax.lax.fori_loop(0, steps, body, jnp.uint32(0))
+
+        t0 = time.perf_counter()
+        np.asarray(loop(jnp.int32(n_steps), lv))
+        compile_s = time.perf_counter() - t0
+        steps = n_steps
+        while True:
+            best = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                np.asarray(loop(jnp.int32(steps), lv))
+                best = min(best, time.perf_counter() - t0)
+            if best >= dispatch_s * 4 or steps >= 1024:
+                break
+            steps *= 4
+        per = (best - dispatch_s) / steps
+        print(f"{name:34s} {per * 1e3:8.3f} ms/step  "
+              f"({steps} steps, compile {compile_s:.1f}s)")
+
+
+if __name__ == "__main__":
+    main()
