@@ -341,8 +341,15 @@ class ProtoColumnarizer:
         leaf_kinds = [None] * len(self.schema.columns)
         leaf_dtypes = [None] * len(self.schema.columns)
         node_path = {0: ()}
+        # A finite schema's node tree is bounded by its leaf count; a
+        # self-recursive message type (message Tree { Tree child = 1; })
+        # would otherwise grow the BFS forever — guard locally instead of
+        # relying on proto_to_schema's RecursionError upstream.
+        max_nodes = 8 * max(len(self.schema.columns), 1) + 256
         while node_queue:
             m = node_queue.pop(0)
+            if len(fnum) > max_nodes:
+                return None  # recursive (or pathologically deep) schema
             d = node_desc[m]
             child_begin[m] = len(fnum)
             for fd in d.fields:

@@ -67,6 +67,14 @@ class NativeChunkEncoder(CpuChunkEncoder):
         if self._lib is None or workers <= 1:
             return super().encode_many(chunks, base_offset)
         encoded = list(_shared_pool().map(lambda c: self.encode(c, 0), chunks))
+        return self._shift_offsets(encoded, base_offset)
+
+    @staticmethod
+    def _shift_offsets(encoded, base_offset: int):
+        """Footer-offset fixup for chunks encoded at offset 0 in parallel:
+        the ONE definition of which meta fields carry file offsets, shared
+        by this backend and TpuChunkEncoder.encode_many — a new offset
+        field added here reaches both."""
         offset = base_offset
         for e in encoded:
             m = e.meta

@@ -144,13 +144,23 @@ PyObject* py_gather_iov(PyObject*, PyObject* args) {
   std::vector<int64_t> lens;
   int64_t total_payload;
   if (!collect_iov(payloads, ptrs, lens, &total_payload)) return nullptr;
+  // idx/pos/len are mandatory here (BufferSet maps None to nullptr for the
+  // shred entry points' optional outputs; a None in THIS call would shift
+  // views[] and size the span count from the wrong buffer — over-read)
+  if (idx_o == Py_None || pos_o == Py_None || len_o == Py_None) {
+    PyErr_SetString(PyExc_TypeError,
+                    "gather_iov: rec_idx/pos/len buffers must not be None");
+    return nullptr;
+  }
   BufferSet bufs;
   void *idx_p, *pos_p, *len_p;
   if (!bufs.get(idx_o, &idx_p, PyBUF_SIMPLE) ||
       !bufs.get(pos_o, &pos_p, PyBUF_SIMPLE) ||
       !bufs.get(len_o, &len_p, PyBUF_SIMPLE))
     return nullptr;
-  Py_ssize_t n = bufs.views[0].len / sizeof(int32_t);
+  // span count from the len buffer's OWN view (views[2]), not positional
+  // assumption on views[0]
+  Py_ssize_t n = bufs.views[2].len / sizeof(int32_t);
   const int32_t* ln = static_cast<const int32_t*>(len_p);
   int64_t out_len = 0;
   for (Py_ssize_t i = 0; i < n; i++) out_len += ln[i];

@@ -27,6 +27,7 @@ part f).
 
 from __future__ import annotations
 
+import os
 import struct
 
 import numpy as np
@@ -469,16 +470,37 @@ class TpuChunkEncoder(NativeChunkEncoder):
 
     # -- batched launch (pipelined via encode_many) ------------------------
     def encode_many(self, chunks: list[ColumnChunkData], base_offset: int):
-        with stage("encode.launch"):
-            pres = self._prepare_all(chunks)
+        # _prepare_all stages itself (encode.launch / encode.bodies) so the
+        # spans don't nest — nested spans would double-count the body
+        # assembly into the launch wall in the bench attribution
+        pres = self._prepare_all(chunks)
         with stage("encode.assemble"):
-            out = []
-            offset = base_offset
             try:
-                for chunk, pre in zip(chunks, pres):
-                    e = self.encode(chunk, offset, pre=pre)
-                    offset += len(e.blob)
-                    out.append(e)
+                workers = self.options.encoder_threads or (os.cpu_count() or 1)
+                workers = min(workers, len(chunks))
+                if workers > 1 and self._lib is not None:
+                    # Column-parallel host assembly (VERDICT r3 next #2):
+                    # after _prepare_all every per-page body is resolved, so
+                    # encode() is pure host work — header/stats/blob
+                    # assembly and compression through GIL-releasing native
+                    # primitives.  Same offset protocol as the native
+                    # backend's encode_many: encode at 0, shift the footer
+                    # offsets by the running base (page bytes never embed
+                    # offsets), byte-identical to the sequential path.
+                    from ..native.encoder import _shared_pool
+
+                    out = self._shift_offsets(
+                        list(_shared_pool().map(
+                            lambda cp: self.encode(cp[0], 0, pre=cp[1]),
+                            zip(chunks, pres))),
+                        base_offset)
+                else:
+                    out = []
+                    offset = base_offset
+                    for chunk, pre in zip(chunks, pres):
+                        e = self.encode(chunk, offset, pre=pre)
+                        offset += len(e.blob)
+                        out.append(e)
             finally:
                 # keyed by id(chunk) — must not outlive the chunk objects
                 self._level_plans = {}
@@ -532,6 +554,17 @@ class TpuChunkEncoder(NativeChunkEncoder):
              finished with the host RLE assembler for byte-exact streams.
         """
         slots: list = [None] * len(chunks)
+        with stage("encode.launch"):
+            launched = self._launch_all(chunks, slots)
+        if launched is None:
+            return slots
+        with stage("encode.bodies"):
+            return self._assemble_bodies(chunks, slots, *launched)
+
+    def _launch_all(self, chunks, slots):
+        """Launch + sync phases of the planner (device dispatches and the
+        two bulk readbacks).  Returns None when nothing is device-eligible,
+        else the argument pack for :meth:`_assemble_bodies`."""
         lvl = _LevelPlanner(self, chunks)  # phase A launched here
         dlt = _DeltaPlanner(self, chunks)  # delta pages launched here
         eligible = [
@@ -546,7 +579,7 @@ class TpuChunkEncoder(NativeChunkEncoder):
         # the device dictionary builds
         sdp = _StringDictPlanner(self, chunks)
         if not eligible and lvl.empty and dlt.empty and sdp.empty:
-            return slots
+            return None
 
         batches: list = []
         for batch, _ in handles:
@@ -604,6 +637,17 @@ class TpuChunkEncoder(NativeChunkEncoder):
              dlt.device_outputs() if not dlt.empty else [],
              sdp.device_outputs() if not sdp.empty else []))
         groups_host, tables_host, lvl_host, dlt_host, sdp_host = fetched
+        return (lvl, dlt, sdp, col_plans, group_meta, groups_host,
+                tables_host, lvl_host, dlt_host, sdp_host)
+
+    def _assemble_bodies(self, chunks, slots, lvl, dlt, sdp, col_plans,
+                         group_meta, groups_host, tables_host, lvl_host,
+                         dlt_host, sdp_host):
+        """Post-fetch HOST body assembly — separated (and stage-traced as
+        ``encode.bodies``) so the bench can attribute the TPU path's host
+        side: together with ``encode.assemble`` this is the per-row-group
+        host work that neither rides the chip nor the PCIe link (VERDICT
+        r3 next #2).  Includes the rare sync-3 long-run gather."""
         if not lvl.empty:
             lvl.assemble(lvl_host)
             self._level_plans = lvl.plans

@@ -62,6 +62,11 @@ class MeshChunkEncoder(NativeChunkEncoder):
         super().__init__(options)
         self.mesh = mesh if mesh is not None else make_mesh()
         self.cap = cap
+        # Cumulative ICI payload accounting (filled by the two-phase merge:
+        # gathered bytes, max cardinality, gather capacity, column count) —
+        # read by the cfg4 bench artifact so the collective's cost is a
+        # recorded number, not prose (VERDICT r3 next #5).
+        self.ici_stats: dict = {}
 
     def encode_many(self, chunks, base_offset: int):
         """Sequential: each eligible column launches a multi-device SPMD
@@ -82,7 +87,8 @@ class MeshChunkEncoder(NativeChunkEncoder):
         max_k = self._fixed_width_max_k(len(values), values.dtype.itemsize)
         try:
             d, idx = global_dictionary_encode(values, self.mesh, cap=self.cap,
-                                              dispatch_lock=_DISPATCH_LOCK)
+                                              dispatch_lock=_DISPATCH_LOCK,
+                                              stats_out=self.ici_stats)
         except DictionaryOverflow:
             return None  # per-shard cardinality overflow (explicit cap)
         if len(d) > max_k:

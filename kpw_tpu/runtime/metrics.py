@@ -162,8 +162,12 @@ class Histogram:
                 self._samples[priority] = (value, weight)
             else:
                 lowest = min(self._samples)
-                if priority > lowest and priority not in self._samples:
-                    del self._samples[lowest]
+                if priority > lowest:
+                    # on a priority collision, overwrite the incumbent —
+                    # Dropwizard's ExponentiallyDecayingReservoir keeps one
+                    # of the two rather than dropping the new sample
+                    if priority not in self._samples:
+                        del self._samples[lowest]
                     self._samples[priority] = (value, weight)
 
     @property

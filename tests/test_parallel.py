@@ -11,6 +11,7 @@ import jax.numpy as jnp
 
 from kpw_tpu.core import encodings as enc
 from kpw_tpu.parallel import global_dictionary_encode, make_mesh, sharded_encode_step
+from kpw_tpu.parallel.dict_merge import DictionaryOverflow
 from kpw_tpu.parallel.mesh import partition_assignment
 from kpw_tpu.ops.dictionary import split_keys
 
@@ -415,3 +416,44 @@ def test_dispatch_lock_covers_only_device_section(mesh8, monkeypatch):
     for i in range(4):
         d, idx = results[i]
         np.testing.assert_array_equal(d[idx], vals[i])
+
+
+def test_two_phase_merge_identity_and_bounded_payload(mesh8):
+    """The two-phase merge (phase A: local uniques + psum-max k; phase B:
+    re-gather at pad_bucket(k_max)) must produce the exact single-phase
+    dictionary and indices while gathering a payload proportional to the
+    cardinality, not the padded per-shard row block (VERDICT r3 next #5)."""
+    rng = np.random.default_rng(41)
+    n = 8 * 8192  # 8192 rows/shard -> per-shard pad block 8192
+    for dtype, lo_card in ((np.int64, 300), (np.int32, 300)):
+        values = rng.integers(0, lo_card, n).astype(dtype)
+        stats: dict = {}
+        d2, idx2 = global_dictionary_encode(values, mesh8, cap=None,
+                                            two_phase=True, stats_out=stats)
+        d1, idx1 = global_dictionary_encode(values, mesh8, cap=None,
+                                            two_phase=False)
+        np.testing.assert_array_equal(d2, d1)
+        np.testing.assert_array_equal(idx2, idx1)
+        # payload bound: gather capacity tracks k_max (pad-bucketed, min
+        # 256), far below the 8192-slot row block
+        assert stats["k_max"] <= lo_card
+        assert stats["gather_cap"] == 512  # pad_bucket(300)
+        planes = 2 if np.dtype(dtype).itemsize == 8 else 1
+        assert stats["ici_gathered_bytes"] == 8 * (512 * 4 * planes + 4)
+
+
+def test_two_phase_merge_overflow_and_skewed_shards(mesh8):
+    """Explicit-cap overflow still raises from phase A (before any row
+    gather), and shards with wildly different cardinalities keep identity
+    (the re-slice keeps every shard's k <= k_max uniques)."""
+    rng = np.random.default_rng(43)
+    values = rng.integers(0, 100_000, 8 * 1024).astype(np.int64)
+    with pytest.raises(DictionaryOverflow):
+        global_dictionary_encode(values, mesh8, cap=256, two_phase=True)
+    # skew: shard 0 sees 7000 uniques, the rest see ~8
+    skew = np.concatenate([np.arange(7000), rng.integers(0, 8, 8 * 1024 - 7000)])
+    skew = skew.astype(np.int64)
+    d2, idx2 = global_dictionary_encode(skew, mesh8, cap=None, two_phase=True)
+    d1, idx1 = global_dictionary_encode(skew, mesh8, cap=None, two_phase=False)
+    np.testing.assert_array_equal(d2, d1)
+    np.testing.assert_array_equal(idx2, idx1)
