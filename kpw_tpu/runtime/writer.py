@@ -38,7 +38,8 @@ def _format_now(pattern: str) -> str:
     return now.strftime(pattern)
 
 
-def _rotation_batch_cap(max_file_size: int, est_record_bytes: int = 64) -> int:
+def _rotation_batch_cap(max_file_size: int,
+                        est_record_bytes: float = 64.0) -> int:
     """Rotation granularity: get_data_size() only moves per flushed batch,
     so both the poll batch and the encode batch are capped at ~1/16 of the
     size threshold (keeps the reference's ~1% overshoot bound at small
@@ -223,8 +224,7 @@ class _Worker:
         try:
             # one appended batch must stay well under max_file_size or size
             # rotation loses its ~1% bound (same cap as the flush batch)
-            poll_batch = min(max(64, b._batch_size),
-                             _rotation_batch_cap(b._max_file_size))
+            poll_batch_base = max(64, b._batch_size)
             # wire fast path: flat schemas shred serialized payloads straight
             # to columnar via the C++ decoder — no Python message objects
             # (the round-1 streaming bottleneck); errors fall back to the
@@ -238,6 +238,14 @@ class _Worker:
                 if (self.current_file is not None
                         and self._is_file_timed_out()):
                     self._finalize_current_file()
+                # batch granularity follows the LIVE bytes/record estimate,
+                # not the static 64 B guess: small-record streams (nested
+                # cfg7-shaped, ~10 B/record encoded) were capped at 1/16 of
+                # the 64 B-based record count — 4-5x smaller batches than
+                # the size band needs, and per-batch shred/append overhead
+                # dominated the measured rate (VERDICT r3 next #8)
+                poll_batch = min(poll_batch_base, _rotation_batch_cap(
+                    b._max_file_size, max(8.0, self._carry_est)))
                 recs, runs = self.p.consumer.poll_many_runs(
                     self._poll_cap(poll_batch))
                 if not recs:
@@ -412,8 +420,12 @@ class _Worker:
                 f"{self.p._b._instance_name}_{self.index}_{rand}.tmp")
 
     def _open_file(self) -> None:
+        # flush-batch granularity follows the live bytes/record estimate,
+        # same as the poll batch in _run (small-record streams would
+        # otherwise split each poll batch into undersized encode batches)
         batch = min(self.p._b._batch_size,
-                    _rotation_batch_cap(self.p._b._max_file_size))
+                    _rotation_batch_cap(self.p._b._max_file_size,
+                                        max(8.0, self._carry_est)))
 
         def make() -> ParquetFile:
             self.p.fs.mkdirs(f"{self.p.target_dir}/tmp")

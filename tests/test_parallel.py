@@ -457,3 +457,44 @@ def test_two_phase_merge_overflow_and_skewed_shards(mesh8):
     d1, idx1 = global_dictionary_encode(skew, mesh8, cap=None, two_phase=False)
     np.testing.assert_array_equal(d2, d1)
     np.testing.assert_array_equal(idx2, idx1)
+
+
+def test_mesh_string_dictionary_merge_identity(mesh8):
+    """BYTE_ARRAY dictionary columns now join the shared-row-group story
+    (VERDICT r3 next #7): per-shard host hash + sorted-union merge must be
+    byte-identical to the single-hash oracle, record its exchanged-payload
+    accounting, and ratio-overflow must fall back to plain like the
+    native path."""
+    from kpw_tpu.core import Schema, WriterProperties, leaf
+    from kpw_tpu.core.pages import CpuChunkEncoder
+    from kpw_tpu.parallel.mesh_encoder import MeshChunkEncoder
+
+    rng = np.random.default_rng(47)
+    n = 4096
+    pool = [b"cat_%03d" % j for j in range(200)]
+    arrays = {
+        "s": [pool[k] for k in rng.integers(0, 200, n)],
+        "t": [b"x" * (1 + int(k)) for k in rng.integers(0, 5, n)],
+        "u": [b"uuid-%032x" % int(v) for v in rng.integers(0, 1 << 62, n)],
+        "i": rng.integers(0, 100, n).astype(np.int64),
+    }
+    schema = Schema([leaf("s", "string"), leaf("t", "string"),
+                     leaf("u", "string"), leaf("i", "int64")])
+    props = WriterProperties(row_group_size=1 << 16)
+    opts = props.encoder_options()
+    enc = MeshChunkEncoder(opts, mesh=mesh8)
+    got = _mesh_encoder_file(enc, arrays, schema, props)
+    want = _mesh_encoder_file(CpuChunkEncoder(opts), arrays, schema, props)
+    assert got == want
+    # accounting: s and t merged ('u' is ~all-unique -> ratio overflow ->
+    # plain fallback, still byte-identical); exchanged payload is the
+    # per-shard UNIQUE set, not the row payload.  u aborts EARLY — inside
+    # the C++ hash or the running union — so its merged set never reaches
+    # a Python-level full materialization
+    assert enc.string_stats["columns"] == 3
+    assert enc.string_stats["overflow_columns"] == 1  # the u column aborted
+    # u's union bailed the moment it crossed max_k — the recorded global k
+    # stops at max_k+1 instead of u's true ~4090 cardinality
+    assert enc.string_stats["k_global_max"] == max(1, int(n * 0.67)) + 1
+    assert enc.string_stats["exchanged_payload_bytes"] > 0
+    assert enc.string_stats["merge_ms"] > 0
