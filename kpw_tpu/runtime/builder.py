@@ -59,7 +59,11 @@ class Builder:
         self._metric_registry = None
         self._filesystem: FileSystem | None = None
         self._backend = "cpu"
-        self._pipeline = True  # 3-stage ingest/encode/flush overlap
+        # 3-stage ingest/encode/flush overlap; None = auto (on for
+        # multicore hosts, inline when there is only one core to share —
+        # thread hand-offs between stages then cost ~5-10% and add
+        # run-to-run variance instead of overlapping anything)
+        self._pipeline: bool | None = None
         self._batch_size = 4096
         self._on_parse_error = "raise"  # parity: poison pill kills the worker
         self._clean_abandoned_tmp = False  # opt-in tmp GC at start()
@@ -241,8 +245,10 @@ class Builder:
     def pipeline(self, flag: bool) -> "Builder":
         """Overlap ingest/shred, row-group encode, and IO in three stages
         per worker (SURVEY.md §2.4 pipeline parallelism — the reference's
-        hot loop is serial).  On by default; disable for strictly
-        single-threaded operation."""
+        hot loop is serial).  Default is automatic: on when the host has
+        more than one core, inline on single-core hosts (the stages then
+        contend for the one core instead of overlapping).  Set explicitly
+        to pin either mode."""
         self._pipeline = flag
         return self
 
@@ -338,6 +344,15 @@ class Builder:
             raise ValueError(
                 f"max_file_size must be >= {MIN_MAX_FILE_SIZE} bytes "
                 f"(got {self._max_file_size})")
+        if self._pipeline is None:
+            # auto: stage overlap needs a second core to overlap onto —
+            # counted from the process's affinity mask (cgroup/taskset
+            # limits), not the host's physical core count
+            try:
+                avail = len(os.sched_getaffinity(0))
+            except (AttributeError, OSError):
+                avail = os.cpu_count() or 1
+            self._pipeline = avail > 1
         if self._thread_count < 1:
             raise ValueError("thread_count must be >= 1")
         # offset tracker sizing (reference :735-746): open pages must cover
