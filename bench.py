@@ -419,10 +419,12 @@ def tpu_rowgroup_probe(n_steps: int = 12) -> dict | None:
     d16 = np.concatenate([
         rng.integers(0, 8, (16, N)), rng.integers(1, 266, (16, N))])
     dict_lo16 = jnp.asarray(d16.astype(np.uint32))
-    # 17-bit quantized cents (0..125000 step 25): make_taxi_like kind 2 —
-    # too wide for the packed key at 64Ki rows, standard sort path
-    dict_lo32 = jnp.asarray(
-        (rng.integers(0, 5000, (C_D32, N)) * 25).astype(np.uint32))
+    # quantized cents (0..125000 tick 25): make_taxi_like kind 2.  The
+    # planner's gcd-stride pass (ops/dictionary.build_dictionaries) divides
+    # the 17-bit values down to 13-bit offsets ON HOST, so the device sees
+    # offsets and the packed single-operand build covers all 48 dict
+    # columns; values reconstruct as base + 25 * offset at readback
+    dict_lo32 = jnp.asarray(rng.integers(0, 5000, (C_D32, N)).astype(np.uint32))
     # near-sorted timestamps: the delta sweet spot (cfg3 shape)
     base = rng.integers(0, 50, (C_DELTA, N)).astype(np.uint64).cumsum(axis=1)
     delta_hi = jnp.asarray((base >> np.uint64(32)).astype(np.uint32))
@@ -444,7 +446,9 @@ def tpu_rowgroup_probe(n_steps: int = 12) -> dict | None:
         return jnp.sum(packed, dtype=jnp.uint32) + jnp.sum(k).astype(jnp.uint32)
 
     def dict32_part(i, lo):
-        packed, _, k = encode_step_single(lo ^ i.astype(jnp.uint32), count)
+        # XOR with i < 1024 stays under the 2^13 bound (offsets < 8192)
+        packed, _, k = encode_step_single(lo ^ i.astype(jnp.uint32), count,
+                                          value_bound=1 << 13)
         return jnp.sum(packed, dtype=jnp.uint32) + jnp.sum(k).astype(jnp.uint32)
 
     def sort_floor_part(i, lo):
@@ -555,8 +559,9 @@ def tpu_rowgroup_probe(n_steps: int = 12) -> dict | None:
         "tpu_rowgroup_input_mb": round(in_bytes / 1e6, 1),
         "tpu_rowgroup_gb_per_sec_per_chip": round(in_bytes / cfg2 / 1e9, 2),
         "tpu_rowgroup_rows_per_sec_per_chip": round(N / cfg2, 1),
-        "tpu_rowgroup_shape": "cfg2: 48 dict (32 sub-16-bit + 16 17-bit) "
-                              "+ 8 delta int64, 64Ki rows, no levels",
+        "tpu_rowgroup_shape": "cfg2: 48 dict (32 sub-16-bit + 16 "
+                              "gcd-stride-quantized to 13-bit) + 8 delta "
+                              "int64, 64Ki rows, no levels",
     }
     if nullable is not None:
         lvl_bytes = in_bytes + K_LVL * N * 4

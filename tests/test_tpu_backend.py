@@ -67,6 +67,36 @@ def test_dict_build_ascending_order():
     np.testing.assert_array_equal(np.asarray(idx)[:8], [2, 1, 2, 3, 1, 0, 3, 2])
 
 
+@pytest.mark.parametrize("scatters", [True, False])
+def test_dict_build_gcd_stride_matches_oracle(monkeypatch, scatters):
+    """A quantized column whose raw span overflows both affine paths (bins
+    RANGE_MAX and the packed sort key) but whose gcd-strided offsets fit
+    must still produce the oracle dictionary — both affine branches pinned
+    explicitly (bins via scatters=True, sort16 via scatters=False).
+    Also pins the constant-prefix sample case: gcd of all-zero offsets is
+    0, which must read as inconclusive, not a rejection."""
+    import kpw_tpu.ops.dictionary as D
+    from kpw_tpu.core import encodings as enc_mod
+
+    monkeypatch.setattr(D, "_prefers_scatters", lambda: scatters)
+    rng = np.random.default_rng(39)
+    n = 6000
+    # tick 1e6: span ~5e9 overflows RANGE_MAX (2^20) and 2^16; offsets 0..4999
+    quantized = (rng.integers(0, 5000, n) * 1_000_000 + 123).astype(np.int64)
+    # constant 2000-row prefix: the 1024-sample gcd is 0 (all offsets zero)
+    const_prefix = np.concatenate([np.full(2000, quantized.min(), np.int64),
+                                   quantized[:n - 2000]])
+    for values in (quantized, const_prefix):
+        want_dv, want_idx = enc_mod.dictionary_build(values, 0)
+        batch, j = D.build_dictionaries([values])[0]
+        assert batch.bases is not None and batch.bases[j][1] == 1_000_000
+        dv, idx = batch.result(j)
+        assert dv.dtype == np.int64
+        np.testing.assert_array_equal(dv, want_dv)
+        np.testing.assert_array_equal(np.asarray(idx)[:n],
+                                      want_idx.astype(np.uint32))
+
+
 def test_pad_bucket():
     assert pad_bucket(1) == 256
     assert pad_bucket(256) == 256
@@ -364,7 +394,8 @@ def test_batch_dict_build_biased_int64_matches_unbiased():
     rng = np.random.default_rng(31)
     cols = [rng.integers(1000, 1000 + 260, 6000).astype(np.int64),
             rng.integers(0, 9, 6000).astype(np.int64)]
-    biased = BatchDictBuild(cols, wide=False, bases=[1000, 0], val_bits=16)
+    biased = BatchDictBuild(cols, wide=False, bases=[(1000, 1), (0, 1)],
+                            val_bits=16)
     plain = BatchDictBuild(cols, wide=True)
     for j in range(2):
         dv_b, idx_b = biased.result(j)
@@ -391,6 +422,11 @@ def test_build_dictionaries_sort16_grouping(monkeypatch):
         rng.integers(-50, 50, n).astype(np.int32),     # negative: lexsort
         rng.integers(0, 1 << 40, n).astype(np.int64),  # wide range: lexsort
         rng.choice(rng.normal(size=64), n),            # float64: lexsort
+        # 17-bit span on a 25 tick: the gcd stride closes it to 13 bits
+        (rng.integers(0, 5000, n) * 25 + 700).astype(np.int64),
+        # prime offsets: gcd 1, span too wide -> lexsort despite vmin >= 0
+        (rng.integers(0, 60000, n) * 2 + (rng.integers(0, 2, n))
+         + (1 << 17)).astype(np.int64),
     ]
     handles = D.build_dictionaries(cols)
     assert handles[0][0].bases is not None
@@ -398,6 +434,9 @@ def test_build_dictionaries_sort16_grouping(monkeypatch):
     assert getattr(handles[2][0], "bases", None) is None
     assert getattr(handles[3][0], "bases", None) is None
     assert getattr(handles[4][0], "bases", None) is None
+    assert handles[5][0].bases is not None  # strided into the packed batch
+    assert handles[5][0].bases[handles[5][1]][1] == 25  # the measured gcd
+    assert getattr(handles[6][0], "bases", None) is None
     from kpw_tpu.core import encodings as enc_mod
     from kpw_tpu.core.schema import PhysicalType
 
