@@ -1,0 +1,185 @@
+"""Prototype alternatives for the level-run extraction (the dominant cost
+of the nullable-shape device phase: `ops.levels.level_runs_multi` spends
+~8 ms/step of single-operand sort work at the 448-window probe shape).
+
+The sort-based compaction (V0, production) places run payloads at their
+rank by sorting 8Ki packed keys per window.  But the positions of run
+ENDS are recoverable without any sort: with ``c = cumsum(is_end)``
+(nondecreasing), the j-th run ends at the first position where c == j+1,
+i.e. ``pos_j = #{i : c_i < j+1}`` — a monotone search.  Variants:
+
+- V1 global count: pos_j = sum over the full window of (c < t_j) —
+  one (run_bucket, bucket) broadcast compare-sum per window.
+- V2 two-level count: count at block granularity first (run_bucket x S),
+  then within the one block that contains the answer (row gather +
+  run_bucket x B compare) — hierarchical search with ~bucket/B less
+  compare work than V1.
+- V3 searchsorted: jnp.searchsorted(c, t) — XLA's binary-search lowering.
+
+All return (run_vals, run_lens) bit-identical to V0 (asserted below on
+random windows).  Run `python tools/levels_alt.py` for the CPU identity
+check; `python tools/levels_alt.py --tpu` times all variants at the
+probe's exact shape inside one jitted fori_loop, dispatch-subtracted.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import jax
+import jax.numpy as jnp
+
+from kpw_tpu.ops.levels import level_runs_multi
+from kpw_tpu.ops.packing import window_run_scan
+
+
+def _ends_payload(padded, sid, start, count, bucket):
+    v, _, _, run_len_here, is_end = window_run_scan(
+        padded, sid, start, count, bucket)
+    return v, run_len_here, is_end
+
+
+def _gather_common(v, run_len_here, pos, valid):
+    run_vals = jnp.where(valid, v[pos], 0)
+    run_lens = jnp.where(valid, run_len_here[pos], 0).astype(jnp.int32)
+    return run_vals, run_lens
+
+
+def _one_v1(padded, sid, start, count, bucket, run_bucket):
+    v, rlh, is_end = _ends_payload(padded, sid, start, count, bucket)
+    c = jnp.cumsum(is_end.astype(jnp.int32))
+    t = jnp.arange(run_bucket, dtype=jnp.int32) + 1
+    pos = jnp.sum((c[None, :] < t[:, None]).astype(jnp.int32), axis=1)
+    valid = t <= c[-1]
+    return _gather_common(v, rlh, jnp.where(valid, pos, 0), valid)
+
+
+def _one_v2(padded, sid, start, count, bucket, run_bucket, block=512):
+    v, rlh, is_end = _ends_payload(padded, sid, start, count, bucket)
+    c = jnp.cumsum(is_end.astype(jnp.int32))
+    S = bucket // block
+    cblk = c.reshape(S, block)
+    cb = cblk[:, -1]                       # ends through end of block s
+    t = jnp.arange(run_bucket, dtype=jnp.int32) + 1
+    s_j = jnp.sum((cb[None, :] < t[:, None]).astype(jnp.int32), axis=1)
+    s_j = jnp.minimum(s_j, S - 1)
+    rows = jnp.take(cblk, s_j, axis=0)     # (run_bucket, block) row gather
+    li = jnp.sum((rows < t[:, None]).astype(jnp.int32), axis=1)
+    pos = s_j * block + li
+    valid = t <= c[-1]
+    return _gather_common(v, rlh, jnp.where(valid, pos, 0), valid)
+
+
+def _one_v3(padded, sid, start, count, bucket, run_bucket):
+    v, rlh, is_end = _ends_payload(padded, sid, start, count, bucket)
+    c = jnp.cumsum(is_end.astype(jnp.int32))
+    t = jnp.arange(run_bucket, dtype=jnp.int32) + 1
+    pos = jnp.searchsorted(c, t, side="left").astype(jnp.int32)
+    valid = t <= c[-1]
+    return _gather_common(v, rlh, jnp.where(valid, jnp.minimum(pos, bucket - 1), 0),
+                          valid)
+
+
+def _multi(one, levels_all, sids, starts, counts, bucket, run_bucket, **kw):
+    padded = jnp.pad(levels_all, ((0, 0), (0, bucket)))
+    return jax.vmap(lambda s, a, c: one(padded, s, a, c, bucket, run_bucket,
+                                        **kw))(sids, starts, counts)
+
+
+VARIANTS = {
+    "v1_global_count": functools.partial(_multi, _one_v1),
+    "v2_two_level": functools.partial(_multi, _one_v2),
+    "v3_searchsorted": functools.partial(_multi, _one_v3),
+}
+
+
+def _probe_shape(seed=11, K=56, N=1 << 16, page=8192, null_p=0.02):
+    rng = np.random.default_rng(seed)
+    lvl = (rng.random((K, N)) > null_p).astype(np.uint32)
+    pages_per = N // page
+    sids = jnp.asarray(np.repeat(np.arange(K, dtype=np.int32), pages_per))
+    starts = jnp.asarray(np.tile(np.arange(0, N, page, dtype=np.int32), K))
+    counts = jnp.full(K * pages_per, page, jnp.int32)
+    return jnp.asarray(lvl), sids, starts, counts, page
+
+
+def check_identity():
+    for null_p in (0.02, 0.5, 0.0):
+        lvl, sids, starts, counts, page = _probe_shape(
+            seed=3, K=8, N=1 << 14, null_p=null_p)
+        rb = 1 << 13  # worst case: every element its own run
+        want_v, want_l = level_runs_multi(lvl, sids, starts, counts, page,
+                                          rb, 1)
+        for name, fn in VARIANTS.items():
+            got_v, got_l = fn(lvl, sids, starts, counts, page, rb)
+            np.testing.assert_array_equal(np.asarray(want_v), np.asarray(got_v),
+                                          err_msg=f"{name} vals null_p={null_p}")
+            np.testing.assert_array_equal(np.asarray(want_l), np.asarray(got_l),
+                                          err_msg=f"{name} lens null_p={null_p}")
+        # ragged tail window
+        counts2 = counts.at[0].set(1234)
+        want = level_runs_multi(lvl, sids, starts, counts2, page, rb, 1)
+        for name, fn in VARIANTS.items():
+            got = fn(lvl, sids, starts, counts2, page, rb)
+            np.testing.assert_array_equal(np.asarray(want[0]), np.asarray(got[0]))
+            np.testing.assert_array_equal(np.asarray(want[1]), np.asarray(got[1]))
+    print("identity OK: all variants byte-identical to level_runs_multi")
+
+
+def time_variants(n_steps=12):
+    from kpw_tpu.runtime.select import probe_link
+
+    lvl, sids, starts, counts, page = _probe_shape()
+    RB = 1024
+    dispatch_s = probe_link()["dispatch_ms"] / 1e3
+
+    def bench(name, fn):
+        @jax.jit
+        def loop(steps, lv):
+            def body(i, acc):
+                rv, rl = fn(lv ^ (i & 1).astype(jnp.uint32), sids, starts,
+                            counts, page, RB)
+                return (acc + jnp.sum(rl, dtype=jnp.int32).astype(jnp.uint32)
+                        + jnp.sum(rv, dtype=jnp.uint32))
+            return jax.lax.fori_loop(0, steps, body, jnp.uint32(0))
+
+        t0 = time.perf_counter()
+        np.asarray(loop(jnp.int32(n_steps), lvl))
+        print(f"[{name}] compile+first {time.perf_counter() - t0:.1f}s",
+              file=sys.stderr)
+        steps = n_steps
+        while True:
+            best = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                np.asarray(loop(jnp.int32(steps), lvl))
+                best = min(best, time.perf_counter() - t0)
+            if best >= dispatch_s * 4 or steps >= 1024:
+                break
+            steps *= 4
+        per = (best - dispatch_s) / steps
+        print(f"[{name}] {per * 1e3:.3f} ms/step ({steps} steps)")
+        return per
+
+    def v0(lv, sids, starts, counts, page, rb):
+        return level_runs_multi(lv, sids, starts, counts, page, rb, 1)
+
+    results = {"v0_sort": bench("v0_sort", v0)}
+    for name, fn in VARIANTS.items():
+        results[name] = bench(name, fn)
+    return results
+
+
+if __name__ == "__main__":
+    if "--tpu" in sys.argv:
+        time_variants()
+    else:
+        jax.config.update("jax_platforms", "cpu")
+        check_identity()
