@@ -146,6 +146,15 @@ class NativeLib:
         self._c = cdll
         c_sz = ctypes.c_size_t
         c_p = ctypes.c_char_p
+        for name, slot in (("kpw_int_stats_i64", ctypes.c_int64),
+                           ("kpw_int_stats_i32", ctypes.c_int64),
+                           ("kpw_int_stats_u64", ctypes.c_uint64),
+                           ("kpw_int_stats_u32", ctypes.c_uint64)):
+            fn = getattr(cdll, name)
+            fn.restype = None
+            fn.argtypes = [ctypes.c_void_p, c_sz, ctypes.POINTER(slot),
+                           ctypes.POINTER(slot),
+                           ctypes.POINTER(ctypes.c_uint64)]
         cdll.kpw_snappy_max_compressed_length.restype = c_sz
         cdll.kpw_snappy_max_compressed_length.argtypes = [c_sz]
         cdll.kpw_snappy_compress.restype = ctypes.c_int
@@ -448,6 +457,28 @@ class NativeLib:
         if rc != 0:
             raise RuntimeError(f"kpw_dict_build_bytes rc={rc}")
         return uniq_pos[: k.value].copy(), idx[:n]
+
+    def int_stats(self, values) -> tuple[int, int, int] | None:
+        """(min, max, gcd_of_offsets) of an int32/int64/uint32/uint64 array
+        in one fused C++ pass (kpw_int_stats_*) — the affine dictionary
+        planner's stats.  gcd is gcd{v - min} (0 for a constant column).
+        Returns None for unsupported dtypes (caller falls back to numpy)."""
+        import numpy as np
+
+        v = np.ascontiguousarray(values)
+        fn = {np.dtype(np.int64): ("kpw_int_stats_i64", ctypes.c_int64),
+              np.dtype(np.int32): ("kpw_int_stats_i32", ctypes.c_int64),
+              np.dtype(np.uint64): ("kpw_int_stats_u64", ctypes.c_uint64),
+              np.dtype(np.uint32): ("kpw_int_stats_u32", ctypes.c_uint64),
+              }.get(v.dtype)
+        if fn is None or not len(v):
+            return None
+        name, slot = fn
+        mn, mx, g = slot(0), slot(0), ctypes.c_uint64(0)
+        getattr(self._c, name)(
+            v.ctypes.data_as(ctypes.c_void_p), len(v),
+            ctypes.byref(mn), ctypes.byref(mx), ctypes.byref(g))
+        return mn.value, mx.value, g.value
 
     def bytes_min_max(self, data: bytes, offsets) -> tuple[int, int]:
         """(min_idx, max_idx) of the lexicographically smallest/largest

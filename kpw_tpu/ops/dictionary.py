@@ -371,6 +371,27 @@ class BinDictBuild:
 RANGE_MAX = 1 << 20  # largest bin table the sort-free path will allocate
 
 
+def _int_stats(arr: np.ndarray):
+    """(vmin, vmax, gcd_of_offsets | None) — one fused native pass
+    (kpw_int_stats_*) when the C++ library is available for the dtype,
+    else numpy min/max with the gcd left to the lazy sample-rejecting
+    :func:`_gcd_stride` pass (None marks it not-yet-computed)."""
+    try:
+        from ..native import lib as _native_lib
+
+        L = _native_lib()
+    except Exception:
+        L = None
+    if L is not None:
+        try:
+            st = L.int_stats(arr)
+        except Exception:
+            st = None
+        if st is not None:
+            return st
+    return int(arr.min()), int(arr.max()), None
+
+
 def _gcd_stride(arr: np.ndarray, vmin: int, span: int, limit: int):
     """Quantization stride for the affine offset paths: g = gcd of
     (arr - vmin), engaged when the raw span misses ``limit`` but span // g
@@ -420,12 +441,20 @@ def build_dictionaries(columns: list[np.ndarray]):
         # different null counts land in different batches)
         mode = None
         if arr.dtype.kind in "iu" and len(arr):
-            vmin, vmax = int(arr.min()), int(arr.max())
+            vmin, vmax, g_all = _int_stats(arr)
             span = vmax - vmin
+
+            def stride_for(limit: int):
+                if span < limit:
+                    return 1
+                if g_all is not None:  # fused native pass already knows it
+                    return (g_all if g_all > 1 and span // g_all < limit
+                            else None)
+                return _gcd_stride(arr, vmin, span, limit)
+
             if use_bins:
                 if vmin >= 0:
-                    g = (1 if span < RANGE_MAX
-                         else _gcd_stride(arr, vmin, span, RANGE_MAX))
+                    g = stride_for(RANGE_MAX)
                     if g:
                         mode = ("bins", len(arr), pad_bucket(span // g + 1))
                         metas[i] = (vmin, g)
@@ -433,8 +462,7 @@ def build_dictionaries(columns: list[np.ndarray]):
                 vbits = min(16, 32 - max((pad_bucket(len(arr)) - 1)
                                          .bit_length(), 1))
                 if vmin >= 0 and vbits >= 1:
-                    g = (1 if span < (1 << vbits)
-                         else _gcd_stride(arr, vmin, span, 1 << vbits))
+                    g = stride_for(1 << vbits)
                     if g:
                         mode = ("sort16", len(arr), vbits)
                         metas[i] = (vmin, g)
