@@ -448,3 +448,40 @@ def test_native_encoder_threaded_identity():
 
     md = pq.read_metadata(io.BytesIO(par))
     assert md.num_rows == rows * 3 and md.num_row_groups >= 2
+
+
+def test_native_int_stats_matches_object_oracle(lib):
+    """The fused min/max/gcd stats pass (kpw_int_stats_*, the affine
+    dictionary planner's one host scan) against an overflow-proof
+    object-dtype oracle: extremes of every supported dtype, even/odd
+    strides (the divisionless divisibility check has separate power-of-two
+    and odd-part legs), constant columns (gcd 0), and a randomized fuzz
+    over scales up to 2^40."""
+    rng = np.random.default_rng(57)
+    cases = [
+        (rng.integers(0, 5000, 4096) * 25 + 7).astype(np.int64),
+        rng.integers(-(2**62), 2**62, 4096).astype(np.int64),
+        np.array([-2**62, 2**62 - 1], np.int64),
+        rng.integers(0, 2**63 + 5, 4096, dtype=np.uint64),  # >2^63 min/max
+        rng.integers(0, 2**62, 4096, dtype=np.uint64) * np.uint64(3),
+        rng.integers(-50, 50, 4096).astype(np.int32),
+        rng.integers(0, 2**32 - 1, 4096, dtype=np.uint32),
+        np.full(100, 42, np.int64),
+        (rng.integers(0, 100, 4096) * 1024).astype(np.int64),  # 2^s stride
+        (rng.integers(0, 100, 4096) * 768).astype(np.int64),   # 256 * 3
+        np.array([0, 2**63], np.uint64),
+    ]
+    for t in range(100):
+        n = int(rng.integers(1, 200))
+        scale = int(rng.integers(1, 1 << int(rng.integers(1, 40))))
+        base = int(rng.integers(-2**40, 2**40))
+        cases.append((rng.integers(0, 1000, n) * scale + base).astype(np.int64))
+    for arr in cases:
+        st = lib.int_stats(arr)
+        assert st is not None
+        mn = int(arr.min())
+        g_want = int(np.gcd.reduce(arr.astype(object) - mn))
+        assert st[0] == mn and st[1] == int(arr.max()), (st, arr.dtype)
+        assert st[2] == g_want, (st[2], g_want, arr.dtype)
+    assert lib.int_stats(np.zeros(0, np.int64)) is None  # empty: caller falls back
+    assert lib.int_stats(np.zeros(4, np.int16)) is None  # unsupported dtype
