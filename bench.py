@@ -1318,6 +1318,15 @@ def main() -> None:
         # self-record the sweep (VERDICT r2 "next" #8): per-config claims
         # are checkable from the committed artifact without a re-run
         record = {"configs": {}, "devices": str(jax.devices())}
+        load_samples: list = []
+
+        def _sample_load() -> None:
+            try:
+                load_samples.append(os.getloadavg()[0])
+            except OSError:
+                pass
+
+        _sample_load()
         for n in (1, 3, 4, 5, 6, 7, 2):  # headline (2) last
             # each config runs in a FRESH interpreter: configs measured
             # in-process after their predecessors ran 10-20% slower than
@@ -1344,6 +1353,7 @@ def main() -> None:
                 continue
             record["configs"][f"config{n}"] = result
             print(json.dumps(result), flush=True)
+            _sample_load()
         sweep_path = os.environ.get(
             "KPW_BENCH_SWEEP_PATH",
             os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -1364,12 +1374,14 @@ def main() -> None:
         for result in record["configs"].values():
             result["measured_on"] = devices_str
         prev = {}
+        prev_load: list = []
         runs = 1
         if os.path.exists(sweep_path):
             try:
                 old_rec = json.load(open(sweep_path))
                 if old_rec.get("devices") == devices_str:
                     prev = old_rec.get("configs", {})
+                    prev_load = old_rec.get("loadavg_history", [])
                     runs = old_rec.get("sweep_runs", 1) + 1
                 else:
                     print(f"[bench] existing sweep measured on "
@@ -1409,6 +1421,16 @@ def main() -> None:
             best["value_dist"] = _dist(val_hist)
             record["configs"][name] = best
         record["sweep_runs"] = runs
+        # contention provenance, index-aligned with each config's
+        # vs_history: the MAX 1-min load observed across samples taken
+        # before the first config and after every config subprocess.  On
+        # this 1-core box the sweep's own work keeps the value near 1;
+        # entries >= ~2 mark sweeps whose host-bound numbers were depressed
+        # by an external contender.
+        # pad older sweeps that predate this key so indexes line up
+        prev_load = (prev_load + [None] * (runs - 1))[: runs - 1]
+        record["loadavg_history"] = prev_load + [
+            round(max(load_samples), 2) if load_samples else None]
         record["policy"] = ("headline keys = best attempt across merged "
                             "same-platform sweeps; vs_dist/value_dist "
                             "summarize the FULL history (vs_history/"
