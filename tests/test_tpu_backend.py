@@ -141,6 +141,30 @@ def test_file_identity_low_cardinality_ints():
     np.testing.assert_array_equal(table["b"].to_numpy(), arrays["b"])
 
 
+def test_file_identity_gcd_strided_columns():
+    """Quantized columns through the FULL writer on the TPU backend: the
+    gcd-stride affine dictionary path must stay byte-identical to the CPU
+    oracle and read back exactly via pyarrow.  Both strided columns' RAW
+    spans overflow BOTH affine limits (bins RANGE_MAX 2^20 and the packed
+    sort key) so the stride is load-bearing on whichever branch the
+    platform selects; 'plain' is the tick-1 control."""
+    rng = np.random.default_rng(23)
+    n = 20000
+    schema = Schema([leaf("cents", "int64"), leaf("ts", "int64"),
+                     leaf("plain", "int64")])
+    arrays = {
+        # span 2999 * 420 = 1.26M > 2^20; offsets 0..2999 after /420
+        "cents": (rng.integers(0, 3000, n) * 420).astype(np.int64),
+        "ts": (1_700_000_000_000
+               + rng.integers(0, 3000, n) * 1_000_000).astype(np.int64),
+        "plain": rng.integers(0, 200, n).astype(np.int64),
+    }
+    buf = _identity_case(schema, arrays)
+    table = pq.read_table(buf)
+    for k, v in arrays.items():
+        np.testing.assert_array_equal(table[k].to_numpy(), v)
+
+
 def test_file_identity_floats():
     rng = np.random.default_rng(3)
     pool = rng.normal(size=64)
