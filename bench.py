@@ -376,35 +376,15 @@ def tpu_kernel_probe(n_steps: int = 32) -> dict | None:
     }
 
 
-def tpu_rowgroup_probe(n_steps: int = 12) -> dict | None:
-    """Whole-row-group device phase in ONE dispatch, at TWO honest shapes
-    (VERDICT r3 "next" #1 — one conservative hybrid overstated cfg2 and
-    understated truly-nullable schemas; now each is measured as itself):
-
-    - cfg2 shape (the headline): 48 dictionary columns + 8 delta int64
-      columns at 64Ki rows, NO level streams — the 64-col cfg2 schema has
-      zero nullable columns.  The dict columns model the real taxi-like
-      ranges: 32 columns whose host-known range fits 16-bit sort keys
-      (ids/zones/flags — the planner knows min/max from its stats pass)
-      ride the packed single-operand build sort, 16 columns of 17-bit
-      quantized amounts ride the standard path.
-    - nullable shape: the same plus 56 def-level streams (every column
-      nullable) — reported separately as ``tpu_rowgroup_nullable_*``.
-
-    Also times a RAW batched single-operand u32 ``jax.lax.sort`` at the
-    kernels' exact shapes and derives ``device_sort_floor_fraction_*`` =
-    (3 sorts x raw unit) / measured kernel — the on-chip utilization
-    number VERDICT r3 next #6 asked for (3 = the kernel's per-column sort
-    count; u16/variadic sorts counted as one unit each, so the floor is an
-    approximation, stated as such in the artifact).  Returns None on CPU."""
-    import jax
+def make_rowgroup_specs(seed: int = 11) -> dict:
+    """The rowgroup probe's SHARED workload spec: probe data plus jittable
+    part functions at the honest cfg2 / nullable shapes.  Both
+    :func:`tpu_rowgroup_probe` (the committed artifact numbers) and
+    ``tools/rg_quick.py`` (fast kernel iteration) measure THIS spec, so
+    the two can never drift apart."""
     import jax.numpy as jnp
 
-    dev = jax.devices()[0]
-    if dev.platform == "cpu" and not os.environ.get("KPW_ROWGROUP_FORCE"):
-        return None
-    n_steps = int(os.environ.get("KPW_ROWGROUP_STEPS", n_steps))
-    from kpw_tpu.ops.delta import delta_pages_multi
+    from kpw_tpu.ops.delta import delta_bits_bucket, delta_pages_multi
     from kpw_tpu.ops.levels import level_runs_multi, level_stats_multi
     from kpw_tpu.parallel.sharded import encode_step_single
 
@@ -413,7 +393,7 @@ def tpu_rowgroup_probe(n_steps: int = 12) -> dict | None:
     C_DICT = C_D16 + C_D32
     PAGE = 8192  # level pages per stream: 8
     RUN_BUCKET = 1024
-    rng = np.random.default_rng(11)
+    rng = np.random.default_rng(seed)
     # 16-bit-keyed columns: 16x tiny-cardinality ids (0..7), 16x zone ids
     # (1..265) — make_taxi_like kinds 0 and 1
     d16 = np.concatenate([
@@ -459,13 +439,19 @@ def tpu_rowgroup_probe(n_steps: int = 12) -> dict | None:
         return jnp.sum(jnp.sort(lo ^ i.astype(jnp.uint32), axis=-1)[:, ::7],
                        dtype=jnp.uint32)
 
+    # the planner's static width budget, derived exactly as _DeltaPlanner
+    # does from host-known per-stream min/max (delta_bits_bucket; the XOR
+    # perturbation below shifts every value of a step by the SAME hi-plane
+    # constant, so deltas — and the budget — are unchanged)
+    delta_budget = delta_bits_bucket(int(base.max()) - int(base.min()), 64)
+
     def delta_part(i, hi, lo):
         # XOR on the hi plane only: keeps lo-plane deltas realistic
         mh, ml, ws, packs = delta_pages_multi(
             hi ^ i.astype(jnp.uint32), lo,
             jnp.arange(C_DELTA, dtype=jnp.int32),
             jnp.zeros(C_DELTA, jnp.int32),
-            jnp.full(C_DELTA, d_count), N, 64)
+            jnp.full(C_DELTA, d_count), N, 64, delta_budget)
         return (jnp.sum(packs, dtype=jnp.uint32)
                 + jnp.sum(ws).astype(jnp.uint32))
 
@@ -479,58 +465,119 @@ def tpu_rowgroup_probe(n_steps: int = 12) -> dict | None:
                 + jnp.sum(rl, dtype=jnp.int32).astype(jnp.uint32)
                 + jnp.sum(rv, dtype=jnp.uint32))
 
-    spec_dict = [(dict16_part, (dict_lo16,)), (dict32_part, (dict_lo32,))]
-    spec_delta = [(delta_part, (delta_hi, delta_lo))]
-    spec_levels = [(level_part, (lvl_all,))]
+    return {
+        "spec_dict": [(dict16_part, (dict_lo16,)), (dict32_part, (dict_lo32,))],
+        "spec_delta": [(delta_part, (delta_hi, delta_lo))],
+        "spec_levels": [(level_part, (lvl_all,))],
+        "sort_floor_part": sort_floor_part,
+        "dict_lo16": dict_lo16, "dict_lo32": dict_lo32,
+        "delta_budget": delta_budget,
+        "N": N, "C_DICT": C_DICT, "C_DELTA": C_DELTA, "K_LVL": K_LVL,
+    }
 
-    def make_loop(fns_args):
-        @jax.jit
-        def loop(steps, *arrays):
-            # rebuild the (fn, args) pairing inside the trace; `steps` is a
-            # TRACED bound so one compile serves every step count (the
-            # escalation below pays no recompile)
-            def body(i, acc):
-                off = 0
-                total = acc
-                for fn, nargs in specs:
-                    total = total + fn(i, *arrays[off:off + nargs])
-                    off += nargs
-                return total
 
-            return jax.lax.fori_loop(0, steps, body, jnp.uint32(0))
+def make_probe_loop(fns_args):
+    """One jitted fori_loop over the given (part_fn, args) pairs; `steps`
+    is a TRACED bound so one compile serves every step count (the probes'
+    escalation pays no recompile)."""
+    import jax
+    import jax.numpy as jnp
 
-        specs = [(fn, len(args)) for fn, args in fns_args]
-        flat = [a for _, args in fns_args for a in args]
-        return loop, flat
+    @jax.jit
+    def loop(steps, *arrays):
+        # rebuild the (fn, args) pairing inside the trace
+        def body(i, acc):
+            off = 0
+            total = acc
+            for fn, nargs in specs:
+                total = total + fn(i, *arrays[off:off + nargs])
+                off += nargs
+            return total
+
+        return jax.lax.fori_loop(0, steps, body, jnp.uint32(0))
+
+    specs = [(fn, len(args)) for fn, args in fns_args]
+    flat = [a for _, args in fns_args for a in args]
+    return loop, flat
+
+
+def probe_time_loop(fns_args, label: str, steps: int, dispatch_s: float,
+                    reps: int = 3, tag: str = "") -> float | None:
+    """Compile + time one probe loop, escalating the TRACED step count
+    (same executable) until the loop dwarfs the ~100 ms tunnel dispatch —
+    12-step component timings carried +-3 ms/step of dispatch noise.
+    Returns seconds/step, or None when the loop never clears the noise
+    floor.  Shared by tpu_rowgroup_probe and tools/rg_quick so the
+    escalation policy cannot drift between them."""
+    import jax.numpy as jnp
+
+    loop, flat = make_probe_loop(fns_args)
+    t0 = time.perf_counter()
+    np.asarray(loop(jnp.int32(steps), *flat))  # compile + first dispatch
+    print(f"{tag}{label}: compile+first {time.perf_counter() - t0:.1f}s",
+          file=sys.stderr)
+    while True:
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            np.asarray(loop(jnp.int32(steps), *flat))
+            best = min(best, time.perf_counter() - t0)
+        if best >= dispatch_s * 4 or steps >= 1024:
+            break
+        steps *= 4
+    if best <= dispatch_s * 1.5:
+        return None
+    per = (best - dispatch_s) / steps
+    print(f"{tag}{label}: {per * 1e3:.3f} ms/step ({steps} steps)",
+          file=sys.stderr)
+    return per
+
+
+def tpu_rowgroup_probe(n_steps: int = 12) -> dict | None:
+    """Whole-row-group device phase in ONE dispatch, at TWO honest shapes
+    (VERDICT r3 "next" #1 — one conservative hybrid overstated cfg2 and
+    understated truly-nullable schemas; now each is measured as itself):
+
+    - cfg2 shape (the headline): 48 dictionary columns + 8 delta int64
+      columns at 64Ki rows, NO level streams — the 64-col cfg2 schema has
+      zero nullable columns.  The dict columns model the real taxi-like
+      ranges: 32 columns whose host-known range fits 16-bit sort keys
+      (ids/zones/flags — the planner knows min/max from its stats pass)
+      ride the packed single-operand build sort, 16 columns of 17-bit
+      quantized amounts ride the standard path.
+    - nullable shape: the same plus 56 def-level streams (every column
+      nullable) — reported separately as ``tpu_rowgroup_nullable_*``.
+
+    Also times a RAW batched single-operand u32 ``jax.lax.sort`` at the
+    kernels' exact shapes and derives ``device_sort_floor_fraction_*`` =
+    (3 sorts x raw unit) / measured kernel — the on-chip utilization
+    number VERDICT r3 next #6 asked for (3 = the kernel's per-column sort
+    count; u16/variadic sorts counted as one unit each, so the floor is an
+    approximation, stated as such in the artifact).  Returns None on CPU."""
+    import jax
+    import jax.numpy as jnp
+
+    dev = jax.devices()[0]
+    if dev.platform == "cpu" and not os.environ.get("KPW_ROWGROUP_FORCE"):
+        return None
+    n_steps = int(os.environ.get("KPW_ROWGROUP_STEPS", n_steps))
+    sp = make_rowgroup_specs()
+    N, C_DICT, C_DELTA, K_LVL = sp["N"], sp["C_DICT"], sp["C_DELTA"], sp["K_LVL"]
+    spec_dict, spec_delta, spec_levels = (
+        sp["spec_dict"], sp["spec_delta"], sp["spec_levels"])
+    sort_floor_part = sp["sort_floor_part"]
+    dict_lo16, dict_lo32 = sp["dict_lo16"], sp["dict_lo32"]
+    # fresh stream, NOT the spec's seed: re-seeding 11 here would replay
+    # the exact draws the spec consumed for its dict data
+    rng = np.random.default_rng(12)
 
     from kpw_tpu.runtime.select import probe_link
 
     dispatch_s = probe_link()["dispatch_ms"] / 1e3
 
     def time_loop(fns_args, label, steps):
-        loop, flat = make_loop(fns_args)
-        t0 = time.perf_counter()
-        np.asarray(loop(jnp.int32(steps), *flat))  # compile + first dispatch
-        print(f"[bench:rowgroup] {label}: compile+first {time.perf_counter() - t0:.1f}s",
-              file=sys.stderr)
-        # escalate the step count (same executable: traced bound) until the
-        # loop dwarfs the ~100 ms tunnel dispatch; 12-step component
-        # timings carried +-3 ms/step of dispatch noise
-        while True:
-            best = float("inf")
-            for _ in range(3):
-                t0 = time.perf_counter()
-                np.asarray(loop(jnp.int32(steps), *flat))
-                best = min(best, time.perf_counter() - t0)
-            if best >= dispatch_s * 4 or steps >= 1024:
-                break
-            steps *= 4
-        if best <= dispatch_s * 1.5:
-            return None
-        per = (best - dispatch_s) / steps
-        print(f"[bench:rowgroup] {label}: {per * 1e3:.3f} ms/step "
-              f"({steps} steps)", file=sys.stderr)
-        return per
+        return probe_time_loop(fns_args, label, steps, dispatch_s,
+                               tag="[bench:rowgroup] ")
 
     cfg2 = time_loop(spec_dict + spec_delta, "cfg2shape", n_steps)
     if cfg2 is None:

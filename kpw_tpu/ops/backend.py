@@ -38,7 +38,7 @@ from ..native.encoder import NativeChunkEncoder
 from ..core.schema import PhysicalType
 from ..core.thrift import varint_bytes
 from ..core.bytecol import ByteColumn
-from .delta import assemble_delta_page, delta_pages_multi
+from .delta import assemble_delta_page, delta_bits_bucket, delta_pages_multi
 from .dictionary import DictBuildHandle, build_dictionaries
 from .levels import level_runs_multi, level_stats_multi
 from .packing import (gather_index_slices, pack_page, pack_page_host,
@@ -417,20 +417,31 @@ class _DeltaPlanner:
                 lo_all[r, : len(s)] = np.ascontiguousarray(s).view(np.uint32)
         hi_d = jnp.asarray(hi_all)
         lo_d = jnp.asarray(lo_all)
-        # group pages by (bucket, bit_size) and launch one program each
+        # host-known stream ranges bound every miniblock width statically
+        # (delta_bits_bucket), shrinking the pack grid — near-sorted
+        # timestamps and string lengths drop from the 256-byte worst-case
+        # slot to 4*max_bits
+        row_bits = {row: delta_bits_bucket(
+            int(s.max()) - int(s.min()) if len(s) else 0,
+            32 if s.dtype.itemsize == 4 else 64)
+            for row, s in enumerate(streams)}
+        # group pages by (bucket, bit_size); the group's budget is its
+        # WIDEST member's, so mixed-range groups still launch one program
+        # (narrower streams just ride a larger-than-needed grid)
         by_key: dict[tuple[int, int], list] = {}
         for row, chunk, bit_size, pages in self._jobs:
             for va, vb in pages:
                 by_key.setdefault((pad_bucket(vb - va), bit_size), []).append(
                     (row, chunk, va, vb))
         for (bucket, bit_size), items in by_key.items():
+            max_bits = max(row_bits[row] for row, _, _, _ in items)
             dev = delta_pages_multi(
                 hi_d, lo_d,
                 jnp.asarray(np.array([row for row, _, _, _ in items], np.int32)),
                 jnp.asarray(np.array([va for _, _, va, _ in items], np.int32)),
                 jnp.asarray(np.array([vb - va for _, _, va, vb in items],
                                      np.int32)),
-                bucket, bit_size)
+                bucket, bit_size, max_bits)
             self._groups.append((items, bit_size, dev))
 
     def device_outputs(self):

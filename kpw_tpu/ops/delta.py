@@ -46,15 +46,9 @@ def _sub64(ahi, alo, bhi, blo):
     return hi, lo
 
 
-def _signed_less(ahi, alo, bhi, blo):
-    """a < b as signed 64-bit: flip the sign bit of hi for unsigned order."""
-    f = jnp.uint32(0x8000_0000)
-    ah, bh = ahi ^ f, bhi ^ f
-    return (ah < bh) | ((ah == bh) & (alo < blo))
-
-
 def _bit_width64(hi, lo):
-    """bit_width of the unsigned 64-bit value (hi, lo): 0 for 0."""
+    """bit_width of the unsigned 64-bit value (hi, lo): 0 for 0.  Pass
+    ``hi=None`` when the hi plane is statically zero (single-plane ladder)."""
     def bw32(x):
         # 32 - clz(x) via float trick is inexact; use comparison ladder
         w = jnp.zeros(x.shape, jnp.int32)
@@ -62,12 +56,15 @@ def _bit_width64(hi, lo):
             w = jnp.where(x >= (jnp.uint32(1) << b), b + 1, w)
         return w
 
+    if hi is None:
+        return bw32(lo)
     return jnp.where(hi > 0, 32 + bw32(hi), bw32(lo))
 
 
-def _pack_mb_runtime_width(hi, lo, w) -> jnp.ndarray:
+def _pack_mb_runtime_width(hi, lo, w, max_bits: int = 64) -> jnp.ndarray:
     """LSB-first pack of 32 (hi, lo) values at RUNTIME width ``w`` into a
-    fixed (256,) uint8 slot (4*w bytes meaningful, rest zero) — branch-free.
+    fixed (4*max_bits,) uint8 slot (4*w bytes meaningful, rest zero) —
+    branch-free.
 
     Replaces the original ``lax.switch`` over 65 static-width packers:
     under ``vmap`` (per-miniblock widths differ) XLA lowers a batched
@@ -79,22 +76,37 @@ def _pack_mb_runtime_width(hi, lo, w) -> jnp.ndarray:
     contribution to byte b is ``(r_i >> (8b - i*w)) & 0xFF`` (or a left
     shift when the value starts mid-byte).  Different values' bits within
     one byte are DISJOINT, so integer summation equals bitwise OR and the
-    (32 values x 256 bytes) grid needs no carries, no gathers, and no
-    branches — one elementwise program for every width at once."""
+    (32 values x 4*max_bits bytes) grid needs no carries, no gathers, and
+    no branches — one elementwise program for every width at once.
+
+    ``max_bits`` is a STATIC budget on the runtime widths (w <= max_bits
+    must hold for every miniblock — the caller derives it from host-known
+    value range, see ``_delta_window``): the byte grid shrinks from the
+    worst-case 256 columns to 4*max_bits, and when max_bits <= 32 the hi
+    plane is statically zero so the 64-bit shift ladder collapses to the
+    lo plane alone.  A violated budget silently truncates the stream, so
+    budgets must come from a real bound, never a guess."""
     i = jnp.arange(_MB, dtype=jnp.int32)[:, None]  # value index
-    b = jnp.arange(_MB * 8, dtype=jnp.int32)[None, :]  # output byte index
+    b = jnp.arange(4 * max_bits, dtype=jnp.int32)[None, :]  # output byte index
     rel = 8 * b - i * w  # value-relative bit offset feeding byte b
-    # 64-bit right shift by rel in [0, 64): piecewise over the two planes
-    s = jnp.clip(rel, 0, 63).astype(jnp.uint32)
-    s_lo = jnp.minimum(s, 31)  # shift amounts must stay < 32 (XLA UB) --
-    s_hi = jnp.where(s >= 32, s - 32, 0)
-    # -- including inside unselected where-branches: at s_lo == 0 the raw
-    # amount (32 - s_lo) would be 32, so clamp it before the mask selects
-    up = jnp.where(s_lo > 0,
-                   hi[:, None] << (32 - jnp.maximum(s_lo, 1)), 0)
-    shr = jnp.where(s < 32,
-                    (lo[:, None] >> s_lo) | up,
-                    hi[:, None] >> s_hi)
+    if max_bits <= 32:
+        # hi plane statically zero: single-plane right shift, amounts < 32
+        # for every cell that can be valid (rel < w <= 32; clamp shields
+        # the masked-out cells from UB shift amounts)
+        s_lo = jnp.clip(rel, 0, 31).astype(jnp.uint32)
+        shr = lo[:, None] >> s_lo
+    else:
+        # 64-bit right shift by rel in [0, 64): piecewise over the planes
+        s = jnp.clip(rel, 0, 63).astype(jnp.uint32)
+        s_lo = jnp.minimum(s, 31)  # shift amounts must stay < 32 (XLA UB) --
+        s_hi = jnp.where(s >= 32, s - 32, 0)
+        # -- including inside unselected where-branches: at s_lo == 0 the raw
+        # amount (32 - s_lo) would be 32, so clamp it before the mask selects
+        up = jnp.where(s_lo > 0,
+                       hi[:, None] << (32 - jnp.maximum(s_lo, 1)), 0)
+        shr = jnp.where(s < 32,
+                        (lo[:, None] >> s_lo) | up,
+                        hi[:, None] >> s_hi)
     # left shift (value starts mid-byte): only -rel in (0, 8) matters
     t = jnp.clip(-rel, 0, 7).astype(jnp.uint32)
     shl = (lo[:, None] & 0xFF) << t
@@ -105,7 +117,7 @@ def _pack_mb_runtime_width(hi, lo, w) -> jnp.ndarray:
 
 
 def _delta_window(vhi: jax.Array, vlo: jax.Array, n: jax.Array,
-                  bit_size: int):
+                  bit_size: int, max_bits: int | None = None):
     """Traceable core: DELTA_BINARY_PACKED device phase for one window of
     ``n`` values provided as (hi, lo) uint32 pairs padded to 1 + blocks*128
     entries.
@@ -113,11 +125,24 @@ def _delta_window(vhi: jax.Array, vlo: jax.Array, n: jax.Array,
     ``bit_size`` selects the ring: 64 works on (hi, lo) pairs, 32 on the lo
     plane alone (hi fixed at zero) — one kernel body for both.
 
+    ``max_bits`` is a STATIC bound on every miniblock's bit width, i.e. on
+    ``bit_width(delta - min_delta)``.  The caller derives it from the
+    host-known value range: deltas lie in [-(vmax-vmin), vmax-vmin], so
+    ``bit_length(2*(vmax-vmin))`` always works (``delta_bits_bucket``).
+    The packed slots shrink from the worst-case 256 bytes to 4*max_bits
+    and, when max_bits <= 32, the relative deltas are provably
+    single-plane so the width scan and the pack drop the hi plane.  The
+    output is byte-identical to the unbudgeted kernel wherever the bound
+    holds; a violated bound silently truncates (same contract as
+    ``encode_step_single(value_bound=...)``).
+
     Returns (min_hi, min_lo) per block (signed min-deltas), widths
-    (blocks, 4) int32, and packed (blocks, 4, 256) uint8 miniblock slots
-    (each meaningful up to 4*width bytes; padding blocks are width 0).
+    (blocks, 4) int32, and packed (blocks, 4, 4*max_bits) uint8 miniblock
+    slots (each meaningful up to 4*width bytes; padding blocks width 0).
     """
     ring64 = bit_size == 64
+    if max_bits is None:
+        max_bits = bit_size
     blocks = (vhi.shape[0] - 1) // _BLOCK
     nd = n - 1
     if ring64:
@@ -131,42 +156,49 @@ def _delta_window(vhi: jax.Array, vlo: jax.Array, n: jax.Array,
     dhi = dhi.reshape(blocks, _BLOCK)
     dlo = dlo.reshape(blocks, _BLOCK)
     vmask = valid.reshape(blocks, _BLOCK)
-
-    def signed_less(ahi, alo, bhi, blo):
-        if ring64:
-            return _signed_less(ahi, alo, bhi, blo)
-        f = jnp.uint32(0x8000_0000)
-        return (alo ^ f) < (blo ^ f)
+    f = jnp.uint32(0x8000_0000)
+    ones = jnp.uint32(0xFFFFFFFF)
 
     def per_block(bhi, blo, bvalid):
-        # signed min over the valid deltas (pad slots excluded by masking
-        # to the first valid delta of the block — block always has >= 1)
-        def mincmp(carry, x):
-            chi, clo = carry
-            xhi, xlo, xv = x
-            take = xv & signed_less(xhi, xlo, chi, clo)
-            return (jnp.where(take, xhi, chi), jnp.where(take, xlo, clo)), None
-
-        (mhi, mlo), _ = jax.lax.scan(
-            mincmp, (bhi[0], blo[0]),
-            (bhi, blo, bvalid))
+        # signed min over the valid deltas as TWO vectorized reduces
+        # (lexicographic on the sign-flipped hi plane, then the lo plane
+        # among the hi-plane winners) — replaces a 128-step sequential
+        # lax.scan that cost ~0.4 ms of the 8-column 64Ki-row probe.
+        # Invalid slots lift to +inf; a fully-pad block keeps the scan
+        # semantics' (bhi[0], blo[0]) so outputs stay bit-identical.
+        any_v = bvalid[0]  # valid slots are a prefix of the window
+        if ring64:
+            kh = jnp.where(bvalid, bhi ^ f, ones)
+            mkh = jnp.min(kh)
+            kl = jnp.where(bvalid & (kh == mkh), blo, ones)
+            mhi = jnp.where(any_v, mkh ^ f, bhi[0])
+            mlo = jnp.where(any_v, jnp.min(kl), blo[0])
+        else:
+            kl = jnp.where(bvalid, blo ^ f, ones)
+            mhi = jnp.zeros((), jnp.uint32)
+            mlo = jnp.where(any_v, jnp.min(kl) ^ f, blo[0])
         if ring64:
             rhi, rlo = _sub64(bhi, blo, jnp.broadcast_to(mhi, bhi.shape),
                               jnp.broadcast_to(mlo, blo.shape))
         else:
             rhi, rlo = jnp.zeros_like(bhi), blo - mlo
         # pad (invalid) slots pack as zero, like the oracle's zero padding
-        rhi = jnp.where(bvalid, rhi, 0)
         rlo = jnp.where(bvalid, rlo, 0)
-        rhi_m = rhi.reshape(_MINI, _MB)
         rlo_m = rlo.reshape(_MINI, _MB)
+        if max_bits <= 32:
+            rhi_m = jnp.zeros_like(rlo_m)  # provably zero under the budget
+        else:
+            rhi_m = jnp.where(bvalid, rhi, 0).reshape(_MINI, _MB)
         mb_valid = bvalid.reshape(_MINI, _MB)
 
         def per_mb(mhi_v, mlo_v, mv):
             any_valid = jnp.any(mv)
-            w = jnp.max(jnp.where(mv, _bit_width64(mhi_v, mlo_v), 0))
+            if max_bits <= 32:
+                w = jnp.max(jnp.where(mv, _bit_width64(None, mlo_v), 0))
+            else:
+                w = jnp.max(jnp.where(mv, _bit_width64(mhi_v, mlo_v), 0))
             w = jnp.where(any_valid, w, 0)
-            packed = _pack_mb_runtime_width(mhi_v, mlo_v, w)
+            packed = _pack_mb_runtime_width(mhi_v, mlo_v, w, max_bits)
             return w, packed
 
         ws, packs = jax.vmap(per_mb)(rhi_m, rlo_m, mb_valid)
@@ -175,27 +207,52 @@ def _delta_window(vhi: jax.Array, vlo: jax.Array, n: jax.Array,
     return jax.vmap(per_block)(dhi, dlo, vmask)
 
 
-@functools.partial(jax.jit, static_argnums=(3,))
+# Static width-budget buckets: one compiled program per bucket actually
+# used; the grid cost is proportional to the bucket, so finer steps at the
+# narrow end (near-sorted timestamps, string lengths) matter most.
+_DELTA_BITS_BUCKETS = (8, 16, 24, 32, 48, 64)
+
+
+def delta_bits_bucket(value_range: int, bit_size: int) -> int:
+    """Smallest static width-budget bucket covering every possible
+    miniblock width for a stream whose values span ``value_range`` =
+    vmax - vmin (as Python ints — no ring overflow).  Any delta lies in
+    [-range, range] and the packed relative deltas in [0, 2*range], so
+    ``bit_length(2*range)`` bounds every width.  Ranges wide enough to
+    wrap the signed ring fall back to the full ``bit_size`` budget."""
+    if value_range < 0:
+        raise ValueError("value_range must be >= 0")
+    need = max((2 * value_range).bit_length(), 1)
+    for b in _DELTA_BITS_BUCKETS:
+        if need <= b <= bit_size:
+            return b
+    return bit_size
+
+
+@functools.partial(jax.jit, static_argnums=(3, 4))
 def delta_blocks_device(vhi: jax.Array, vlo: jax.Array, n: jax.Array,
-                        bit_size: int):
+                        bit_size: int, max_bits: int | None = None):
     """One full stream (see :func:`_delta_window`); jit keys bounded by the
     caller's power-of-two block padding."""
-    return _delta_window(vhi, vlo, n, bit_size)
+    return _delta_window(vhi, vlo, n, bit_size, max_bits)
 
 
-@functools.partial(jax.jit, static_argnums=(5, 6))
+@functools.partial(jax.jit, static_argnums=(5, 6, 7))
 def delta_pages_multi(hi_all: jax.Array, lo_all: jax.Array,
                       stream_ids: jax.Array, starts: jax.Array,
-                      counts: jax.Array, bucket: int, bit_size: int):
+                      counts: jax.Array, bucket: int, bit_size: int,
+                      max_bits: int | None = None):
     """Batched per-page delta encode over windows of stacked value streams —
-    the TPU backend's planner launches ONE of these per (bucket, bit_size)
-    group so a whole row group's delta pages cost one dispatch
+    the TPU backend's planner launches ONE of these per (bucket, bit_size,
+    max_bits) group so a whole row group's delta pages cost one dispatch
     (ops.backend._DeltaPlanner), mirroring pack_pages_multi.
 
     ``hi_all``/``lo_all`` are (K, maxN) uint32 planes; each page encodes the
     window [start, start + bucket] of its stream (bucket a multiple of 128,
     ops.packing.pad_bucket guarantees it), masked to ``count`` values.
-    Returns per-page stacked :func:`_delta_window` outputs.
+    ``max_bits`` is the static per-group width budget (every stream in the
+    group must satisfy it — see :func:`delta_bits_bucket`).  Returns
+    per-page stacked :func:`_delta_window` outputs.
     """
     padded_hi = jnp.pad(hi_all, ((0, 0), (0, bucket + 1)))
     padded_lo = jnp.pad(lo_all, ((0, 0), (0, bucket + 1)))
@@ -203,7 +260,7 @@ def delta_pages_multi(hi_all: jax.Array, lo_all: jax.Array,
     def one(sid, start, count):
         whi = jax.lax.dynamic_slice(padded_hi, (sid, start), (1, bucket + 1))[0]
         wlo = jax.lax.dynamic_slice(padded_lo, (sid, start), (1, bucket + 1))[0]
-        return _delta_window(whi, wlo, count, bit_size)
+        return _delta_window(whi, wlo, count, bit_size, max_bits)
 
     return jax.vmap(one)(stream_ids, starts, counts)
 
@@ -261,9 +318,12 @@ def delta_binary_packed_device(values: np.ndarray, bit_size: int = 64) -> bytes:
     padded = np.zeros(1 + pad_blocks * _BLOCK, itype)
     padded[:n] = v
     hi, lo = _split64(padded)
+    # host min/max (O(n), trivially cheap next to the encode) statically
+    # bounds every miniblock width — the kernel's pack grid shrinks to it
+    max_bits = delta_bits_bucket(int(v.max()) - int(v.min()), bit_size)
     mh, ml, widths, packed = jax.device_get(  # one bulk readback
         delta_blocks_device(jnp.asarray(hi), jnp.asarray(lo), jnp.int32(n),
-                            bit_size))
+                            bit_size, max_bits))
     return assemble_delta_page(int(v[0]), n, mh, ml, widths, packed, bit_size)
 
 

@@ -37,6 +37,33 @@ def test_device_delta64_random():
             enc.delta_binary_packed_encode(v, 64)
 
 
+@pytest.mark.parametrize("range_bits,bit_size", [
+    # one case per static width-budget bucket (delta_bits_bucket maps
+    # bit_length(2*range) -> 8/16/24/32/48/64): a regression in any one
+    # bucket's grid/plane specialization must fail here, not only in an
+    # ad-hoc fuzz
+    (5, 64), (12, 64), (20, 64), (28, 64), (40, 64), (60, 64),
+    (5, 32), (12, 32), (20, 32), (28, 32),
+])
+def test_device_delta_every_width_bucket(range_bits, bit_size):
+    from kpw_tpu.ops.delta import delta_bits_bucket
+
+    rng = np.random.default_rng(range_bits * 64 + bit_size)
+    itype = np.int64 if bit_size == 64 else np.int32
+    lo = -(1 << (range_bits - 1))
+    v = (rng.integers(0, 1 << range_bits, 700) + lo).astype(itype)
+    # the case must land EXACTLY in the intended bucket: with 700 draws,
+    # max-min deterministically has bit_length(2*range) == range_bits + 1,
+    # so a regressed delta_bits_bucket (e.g. always bit_size) fails here
+    assert (2 * (int(v.max()) - int(v.min()))).bit_length() == range_bits + 1
+    want = next(b for b in (8, 16, 24, 32, 48, 64)
+                if range_bits + 1 <= b <= bit_size)
+    b = delta_bits_bucket(int(v.max()) - int(v.min()), bit_size)
+    assert b == want, (b, want)
+    assert delta_binary_packed_device(v, bit_size) == \
+        enc.delta_binary_packed_encode(v, bit_size)
+
+
 def test_device_delta32():
     rng = np.random.default_rng(1)
     cases = [

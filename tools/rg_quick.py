@@ -1,0 +1,26 @@
+"""Focused re-run of the rowgroup probe's cfg2-shape combined loop and its
+two components (dict48 / delta8) — skips the nullable and levels programs
+whose compiles dominate the full probe's wall time.  Measures the SAME
+workload spec (bench.make_rowgroup_specs) through the SAME escalation
+policy (bench.probe_time_loop) as the committed artifact: for kernel
+iteration only; artifact numbers come from bench.py --rowgroup."""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+from bench import make_rowgroup_specs, probe_time_loop
+from kpw_tpu.runtime.select import probe_link
+
+sp = make_rowgroup_specs()
+print(f"delta_budget={sp['delta_budget']}", file=sys.stderr)
+dispatch_s = probe_link()["dispatch_ms"] / 1e3
+print(f"dispatch={dispatch_s * 1e3:.1f} ms", file=sys.stderr)
+
+probe_time_loop(sp["spec_dict"] + sp["spec_delta"], "cfg2shape", 12,
+                dispatch_s, reps=5)
+probe_time_loop(sp["spec_dict"], "dict48", 12, dispatch_s, reps=5)
+probe_time_loop(sp["spec_delta"], "delta8", 12, dispatch_s, reps=5)
