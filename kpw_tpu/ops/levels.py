@@ -66,16 +66,27 @@ def level_runs_multi(levels_all: jax.Array, stream_ids: jax.Array,
     padded = jnp.pad(levels_all, ((0, 0), (0, bucket)))
 
     def one(sid, start, count):
-        v, _, run_id, run_len_here, is_end = window_run_scan(
+        v, _, run_id, _, is_end = window_run_scan(
             padded, sid, start, count, bucket)
         # one compaction keyed on run ENDS covers both outputs: a run's
         # value is constant, so v at the end position is the run value.
         # Run ids are a dense prefix: hardware-selected scatter/sort
-        # (see compact_by_rank); run lengths fit the window bucket.
+        # (see compact_by_rank).  Lengths are NOT carried through the
+        # sort: runs partition the valid prefix, so length_j = end_pos_j -
+        # end_pos_{j-1} (end_pos_{-1} = -1) — carrying the END POSITION
+        # and diffing the compacted slots lets XLA dead-code-eliminate
+        # window_run_scan's associative max-scan (run_len_here's only use
+        # here) from this program entirely.
+        pos = jnp.arange(bucket, dtype=jnp.int32)
         end_rank = jnp.where(is_end, run_id, run_bucket)
-        run_vals, run_lens = compact_by_rank(
-            end_rank, (v, run_len_here), run_bucket,
-            value_bits=(level_bits, max(bucket.bit_length(), 1)))
+        run_vals, end_pos = compact_by_rank(
+            end_rank, (v, pos), run_bucket,
+            value_bits=(level_bits, max((bucket - 1).bit_length(), 1)))
+        n_ends = jnp.sum(is_end.astype(jnp.int32))
+        keep = jnp.arange(run_bucket, dtype=jnp.int32) < n_ends
+        prev = jnp.concatenate([jnp.full((1,), -1, jnp.int32),
+                                end_pos[:-1].astype(jnp.int32)])
+        run_lens = jnp.where(keep, end_pos.astype(jnp.int32) - prev, 0)
         return run_vals, run_lens
 
     return jax.vmap(one)(stream_ids, starts, counts)
