@@ -10,10 +10,14 @@ bits) with two regimes:
   on TPU);
 - run-dominated streams (the common case: def levels are mostly max_def)
   take the mixed RLE path.  There the O(n) work is the run *scan*; the
-  assembly is O(runs).  So the scan runs on device (cumsum + max-scan run
-  labeling, hardware-selected scatter/sort compaction — see
-  ops.packing._run_scan/compact_by_rank — vmapped over pages) and only the
-  compact run list is transferred, which the host replays through
+  assembly is O(runs).  The stats pass (classification + run-count
+  sizing) is scan-FREE — windowed shifts of the run-start mask,
+  ops.packing._run_long_stats; the extraction pass labels runs on device
+  (cumsum run ids, hardware-selected scatter/sort compaction — see
+  ops.packing._run_scan/compact_by_rank — vmapped over pages; run
+  lengths fall out as diffs of compacted end positions, so the labeling
+  max-scan is dead code XLA removes) and only the compact run list is
+  transferred, which the host replays through
   core.encodings.rle_hybrid_from_runs for a byte-identical stream.
 
 Both programs window into one stacked (K, maxN) array of every level stream
@@ -28,7 +32,8 @@ import functools
 import jax
 import jax.numpy as jnp
 
-from .packing import compact_by_rank, window_run_scan
+from .packing import (_run_long_stats, _window_slice, compact_by_rank,
+                      window_run_scan)
 
 
 @functools.partial(jax.jit, static_argnums=(4,))
@@ -40,11 +45,8 @@ def level_stats_multi(levels_all: jax.Array, stream_ids: jax.Array,
     padded = jnp.pad(levels_all, ((0, 0), (0, bucket)))
 
     def one(sid, start, count):
-        _, valid, run_id, run_len_here, is_end = window_run_scan(
-            padded, sid, start, count, bucket)
-        long_sum = jnp.sum(jnp.where(is_end & (run_len_here >= 8),
-                                     run_len_here, 0))
-        n_runs = jnp.max(jnp.where(valid, run_id, -1)) + 1
+        v, valid = _window_slice(padded, sid, start, count, bucket)
+        long_sum, n_runs, _ = _run_long_stats(v, valid)
         return long_sum, n_runs
 
     return jax.vmap(one)(stream_ids, starts, counts)

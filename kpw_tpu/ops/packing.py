@@ -161,10 +161,8 @@ def pack_page(idx_full: jax.Array, start, count, bucket: int, width: int):
     packed = bitpack_device(v, width)
 
     # run-length stats (for the hybrid decision, mirrored from the CPU path)
-    _, _, _, run_len_here, is_end = _run_scan(v, valid)
-    long_end = is_end & (run_len_here >= 8)
-    long_sum = jnp.sum(jnp.where(long_end, run_len_here, 0))
-    return packed, long_sum, jnp.any(long_end)
+    long_sum, _, any_long = _run_long_stats(v, valid)
+    return packed, long_sum, any_long
 
 
 def pack_page_host(idx_full: jax.Array, start: int, count: int, width: int,
@@ -184,7 +182,7 @@ def _run_scan(v, valid):
     run, where run_len_here is the run's total length."""
     n = v.shape[0]
     pos = jnp.arange(n, dtype=jnp.int32)
-    newrun = jnp.concatenate([jnp.ones((1,), bool), v[1:] != v[:-1]]) & valid
+    newrun = _newrun(v, valid)
     run_id = jnp.cumsum(newrun.astype(jnp.int32)) - 1
     run_start = jax.lax.associative_scan(
         jnp.maximum, jnp.where(newrun, pos, -1))
@@ -195,20 +193,70 @@ def _run_scan(v, valid):
     return newrun, run_id, run_start, run_len_here, is_end
 
 
+def _newrun(v, valid):
+    """THE run-start mask — the one definition of where runs begin, shared
+    by the labeling scan (:func:`_run_scan`) and the scan-free stats
+    (:func:`_run_long_stats`) so run semantics cannot drift between them
+    (both must stay byte-identical to core.encodings._runs)."""
+    return jnp.concatenate([jnp.ones((1,), bool), v[1:] != v[:-1]]) & valid
+
+
+def _window_slice(padded, row, start, count, bucket: int):
+    """THE window slice/mask convention: slice [start, start+bucket) of
+    ``padded[row]``, zero-mask past ``count``.  Returns (v uint32, valid
+    bool) — shared by every per-window device program in this module and
+    ops.levels."""
+    page = jax.lax.dynamic_slice(padded, (row, start), (1, bucket))[0]
+    pos = jnp.arange(bucket, dtype=jnp.int32)
+    valid = pos < count
+    return jnp.where(valid, page, 0).astype(jnp.uint32), valid
+
+
+def _run_long_stats(v, valid):
+    """Scan-free run statistics over one masked window: (long_sum, n_runs,
+    any_long), where ``long_sum`` is the total length of runs >= 8 — the
+    RLE-vs-bitpack decision mass of core.encodings.rle_hybrid_encode.
+
+    Computed from windowed SHIFTS of the run-start mask instead of the
+    labeling scans: a position is the >=8th element of its run iff no run
+    start lies at it or in the 6 positions behind it, and a run is long
+    iff it contains an exactly-8th element (a >=8th element whose run
+    start sits exactly 7 back), which each long run has exactly once, so
+
+        long_sum = #(>=8th elements) + 7 * #(exactly-8th elements).
+
+    Byte-identical to summing ``run_len_here`` at long ends (asserted by
+    the level/value identity suites); programs that only need these stats
+    drop :func:`_run_scan`'s cumsum AND associative max-scan entirely."""
+    newrun = _newrun(v, valid)
+
+    def back(x, k):  # x[q-k], False-padded at the window head
+        return jnp.concatenate([jnp.zeros((k,), bool), x[:-k]])
+
+    near_start = newrun
+    for k in range(1, 7):
+        near_start = near_start | back(newrun, k)
+    ge8 = valid & ~near_start
+    ex8 = ge8 & back(newrun, 7)
+    n_ex8 = jnp.sum(ex8.astype(jnp.int32))
+    long_sum = jnp.sum(ge8.astype(jnp.int32)) + 7 * n_ex8
+    return long_sum, jnp.sum(newrun.astype(jnp.int32)), n_ex8 > 0
+
+
 def window_run_scan(padded, row, start, count, bucket: int):
-    """The one run-scan used by every device window program (value pages in
-    this module, level streams in ops.levels) — a single definition so the
-    run semantics can never drift between paths that must stay byte-identical
-    to the CPU oracle (core.encodings._runs).
+    """The run-LABELING window program (run ids / lengths / ends), used by
+    programs that extract runs (ops.levels.level_runs_multi).  Stats-only
+    programs (pack_page, _slice_mask_stats, level_stats_multi) use the
+    scan-free :func:`_run_long_stats` instead; both build on the same
+    :func:`_newrun` run-start mask and :func:`_window_slice` masking
+    convention, so run semantics cannot drift from the CPU oracle
+    (core.encodings._runs).
 
     Slices window [start, start+bucket) of ``padded[row]``, zero-masks past
     ``count``, labels runs.  Returns (v uint32 (bucket,), valid bool
     (bucket,), run_id int32 (bucket,), run_len_here int32 (bucket,),
     is_end bool (bucket,)) — see :func:`_run_scan`."""
-    page = jax.lax.dynamic_slice(padded, (row, start), (1, bucket))[0]
-    pos = jnp.arange(bucket, dtype=jnp.int32)
-    valid = pos < count
-    v = jnp.where(valid, page, 0).astype(jnp.uint32)
+    v, valid = _window_slice(padded, row, start, count, bucket)
     _, run_id, _, run_len_here, is_end = _run_scan(v, valid)
     return v, valid, run_id, run_len_here, is_end
 
@@ -220,10 +268,8 @@ def _slice_mask_stats(idx_all, col_ids, starts, counts, bucket):
     padded = jnp.pad(idx_all, ((0, 0), (0, bucket)))
 
     def one(cid, start, count):
-        v, _, _, run_len_here, is_end = window_run_scan(
-            padded, cid, start, count, bucket)
-        long_sum = jnp.sum(jnp.where(is_end & (run_len_here >= 8),
-                                     run_len_here, 0))
+        v, valid = _window_slice(padded, cid, start, count, bucket)
+        long_sum, _, _ = _run_long_stats(v, valid)
         return v, long_sum
 
     return jax.vmap(one)(col_ids, starts, counts)
@@ -235,9 +281,8 @@ def _slice_mask(idx_all, col_ids, starts, counts, bucket):
     padded = jnp.pad(idx_all, ((0, 0), (0, bucket)))
 
     def one(cid, start, count):
-        page = jax.lax.dynamic_slice(padded, (cid, start), (1, bucket))[0]
-        pos = jnp.arange(bucket, dtype=jnp.int32)
-        return jnp.where(pos < count, page, 0).astype(jnp.uint32)
+        v, _ = _window_slice(padded, cid, start, count, bucket)
+        return v
 
     return jax.vmap(one)(col_ids, starts, counts)
 

@@ -68,6 +68,42 @@ def test_level_stats_matches_runs_oracle(pattern):
     np.testing.assert_array_equal(np.asarray(lens_d[0])[:k], ref_lens)
 
 
+def test_run_long_stats_windowed_shift_fuzz():
+    """The scan-free stats trick (packing._run_long_stats: long_sum =
+    #(>=8th elements) + 7 * #(exactly-8th elements)) against the run-list
+    oracle, across run-length distributions that straddle the >=8
+    threshold — incl. exact lengths 7/8/9, empty and full windows, and
+    ragged valid prefixes."""
+    from kpw_tpu.ops.packing import _run_long_stats
+
+    rng = np.random.default_rng(17)
+    cases = []
+    for lens_pool in ([1], [7], [8], [9], [7, 8], [1, 8, 20], [3, 30]):
+        lens, total = [], 0
+        while total < 600:
+            ln = int(rng.choice(lens_pool))
+            lens.append(ln)
+            total += ln
+        vals = rng.integers(0, 3, len(lens))
+        vals[1::2] = vals[1::2] + 4  # force adjacent runs to differ
+        cases.append(np.repeat(vals, lens)[:600])
+    cases.append(np.zeros(0, np.int64))
+    bucket = 1024
+    for lv in cases:
+        for count in {len(lv), min(len(lv), 123), min(len(lv), 599)}:
+            window = np.zeros(bucket, np.uint32)
+            window[: len(lv)] = lv
+            valid = np.arange(bucket) < count
+            window[~valid] = 0
+            long_d, runs_d, any_d = _run_long_stats(
+                jnp.asarray(window), jnp.asarray(valid))
+            _, ref_lens = enc._runs(np.asarray(lv[:count], np.uint64))
+            want_long = int(ref_lens[ref_lens >= 8].sum())
+            assert int(long_d) == want_long, (lv[:20], count)
+            assert int(runs_d) == len(ref_lens)
+            assert bool(any_d) == (want_long > 0)
+
+
 def test_rle_hybrid_from_runs_matches_slow_path():
     rng = np.random.default_rng(1)
     # run-dominated stream -> oracle takes the mixed path
