@@ -1455,9 +1455,18 @@ def main() -> None:
         # mixing one run's ms/step with another's derived rows/s would
         # fabricate a composite no run ever measured.
         def _rowgroup_keys(r):
-            return [k for k in r if k.startswith(("tpu_rowgroup_",
-                                                  "tpu_sort_unit",
-                                                  "device_sort_floor"))]
+            # the cfg2-shape program + its components + the sort-floor
+            # units/fractions computed against them; the NULLABLE-shape
+            # program merges as its own group below (it is a separate
+            # measurement whose best run need not be the cfg2 best)
+            return [k for k in r
+                    if (k.startswith(("tpu_rowgroup_", "tpu_sort_unit",
+                                      "device_sort_floor"))
+                        and "nullable" not in k and "levels56" not in k)]
+
+        def _nullable_keys(r):
+            return [k for k in r if k.startswith("tpu_rowgroup_")
+                    and ("nullable" in k or "levels56" in k)]
 
         def _kernel_keys(r):
             return [k for k in r if k.startswith("tpu_kernel_")
@@ -1476,6 +1485,8 @@ def main() -> None:
         GROUPS = (  # (key-lister, metric getter, lower_is_better)
             (_rowgroup_keys,
              lambda r: r.get("tpu_rowgroup_ms_per_step"), True),
+            (_nullable_keys,
+             lambda r: r.get("tpu_rowgroup_nullable_ms_per_step"), True),
             (_kernel_keys, lambda r: r.get("tpu_kernel_ms_per_step"), True),
             (_host_keys,
              lambda r: r.get("host_assembly_ms_per_rowgroup"), True),
@@ -1518,8 +1529,12 @@ def main() -> None:
                         best[k] = other[k]
             # flaky-tunnel backfill for probe keys OUTSIDE the merged
             # groups only — group keys must all come from the group's one
-            # winning run (no cross-run composites)
-            grouped = {k for lister, _, _ in GROUPS
+            # winning run (no cross-run composites).  A group whose metric
+            # is absent on BOTH sides was never decided (e.g. the tunnel
+            # dropped the group's headline loop but a component landed):
+            # its stray keys stay backfillable rather than vanishing.
+            grouped = {k for lister, metric, _ in GROUPS
+                       if metric(best) is not None or metric(other) is not None
                        for r in (best, other) for k in lister(r)}
             for key, val in other.items():
                 if key.startswith("tpu_") and key not in best \
