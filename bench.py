@@ -402,8 +402,8 @@ def make_rowgroup_specs(seed: int = 11) -> dict:
     # quantized cents (0..125000 tick 25): make_taxi_like kind 2.  The
     # planner's gcd-stride pass (ops/dictionary.build_dictionaries) divides
     # the 17-bit values down to 13-bit offsets ON HOST, so the device sees
-    # offsets and the packed single-operand build covers all 48 dict
-    # columns; values reconstruct as base + 25 * offset at readback
+    # offsets whose 2^13 bound routes them onto the sort-free matmul path;
+    # values reconstruct as base + 25 * offset at readback
     dict_lo32 = jnp.asarray(rng.integers(0, 5000, (C_D32, N)).astype(np.uint32))
     # near-sorted timestamps: the delta sweet spot (cfg3 shape)
     base = rng.integers(0, 50, (C_DELTA, N)).astype(np.uint64).cumsum(axis=1)
@@ -429,6 +429,25 @@ def make_rowgroup_specs(seed: int = 11) -> dict:
         # XOR with i < 1024 stays under the 2^13 bound (offsets < 8192)
         packed, _, k = encode_step_single(lo ^ i.astype(jnp.uint32), count,
                                           value_bound=1 << 13)
+        return jnp.sum(packed, dtype=jnp.uint32) + jnp.sum(k).astype(jnp.uint32)
+
+    # The AFFINE-bounded variant of the same 48 columns: the planner's
+    # stats pass knows each column's exact range (ids < 8, zones < 266,
+    # gcd offsets < 5001 for the cfg2 schema), so in production every
+    # dict column rides the sort-free matmul path
+    # (parallel/sharded._encode_step_single_matmul) with its own tiny nhi
+    # bucket.  The XOR perturbation shrinks to (i & 3) so the bound still
+    # holds every step; reported as tpu_rowgroup_affine_* alongside the
+    # conservative cfg2shape (whose dict16 half models 16-bit-wide
+    # ranges and keeps the sort).
+    def affine16_part(i, lo):
+        packed, _, k = encode_step_single(lo ^ (i & 3).astype(jnp.uint32),
+                                          count, value_bound=270)
+        return jnp.sum(packed, dtype=jnp.uint32) + jnp.sum(k).astype(jnp.uint32)
+
+    def affine32_part(i, lo):
+        packed, _, k = encode_step_single(lo ^ (i & 3).astype(jnp.uint32),
+                                          count, value_bound=1 << 13)
         return jnp.sum(packed, dtype=jnp.uint32) + jnp.sum(k).astype(jnp.uint32)
 
     def sort_floor_part(i, lo):
@@ -467,6 +486,8 @@ def make_rowgroup_specs(seed: int = 11) -> dict:
 
     return {
         "spec_dict": [(dict16_part, (dict_lo16,)), (dict32_part, (dict_lo32,))],
+        "spec_affine": [(affine16_part, (dict_lo16,)),
+                        (affine32_part, (dict_lo32,))],
         "spec_delta": [(delta_part, (delta_hi, delta_lo))],
         "spec_levels": [(level_part, (lvl_all,))],
         "sort_floor_part": sort_floor_part,
@@ -541,12 +562,16 @@ def tpu_rowgroup_probe(n_steps: int = 12) -> dict | None:
     - cfg2 shape (the headline): 48 dictionary columns + 8 delta int64
       columns at 64Ki rows, NO level streams — the 64-col cfg2 schema has
       zero nullable columns.  The dict columns model the real taxi-like
-      ranges: 32 columns whose host-known range fits 16-bit sort keys
-      (ids/zones/flags — the planner knows min/max from its stats pass)
-      ride the packed single-operand build sort, 16 columns of 17-bit
-      quantized amounts ride the standard path.
-    - nullable shape: the same plus 56 def-level streams (every column
-      nullable) — reported separately as ``tpu_rowgroup_nullable_*``.
+      ranges under CONSERVATIVE planner bounds: 32 columns bounded at
+      2^16 ride the packed single-operand build sort, 16 gcd-quantized
+      columns bounded at 2^13 ride the sort-free matmul path
+      (parallel/sharded._encode_step_single_matmul).
+    - affine shape: the SAME 48+8 columns with every dict column at its
+      planner-known exact range (ids<8, zones<266, offsets<8192 — what
+      the stats pass actually knows for the cfg2 schema), so all 48 ride
+      the matmul path — reported as ``tpu_rowgroup_affine_*``.
+    - nullable shape: the cfg2 shape plus 56 def-level streams (every
+      column nullable) — reported as ``tpu_rowgroup_nullable_*``.
 
     Also times a RAW batched single-operand u32 ``jax.lax.sort`` at the
     kernels' exact shapes and derives ``device_sort_floor_fraction_*`` =
@@ -583,6 +608,7 @@ def tpu_rowgroup_probe(n_steps: int = 12) -> dict | None:
     if cfg2 is None:
         print("[bench:rowgroup] inconclusive vs dispatch noise", file=sys.stderr)
         return None
+    affine = time_loop(sp["spec_affine"] + spec_delta, "affine", n_steps)
     nullable = time_loop(spec_dict + spec_delta + spec_levels, "nullable",
                          n_steps)
     comp = {}
@@ -606,10 +632,19 @@ def tpu_rowgroup_probe(n_steps: int = 12) -> dict | None:
         "tpu_rowgroup_input_mb": round(in_bytes / 1e6, 1),
         "tpu_rowgroup_gb_per_sec_per_chip": round(in_bytes / cfg2 / 1e9, 2),
         "tpu_rowgroup_rows_per_sec_per_chip": round(N / cfg2, 1),
-        "tpu_rowgroup_shape": "cfg2: 48 dict (32 sub-16-bit + 16 "
-                              "gcd-stride-quantized to 13-bit) + 8 delta "
-                              "int64, 64Ki rows, no levels",
+        "tpu_rowgroup_shape": "cfg2: 48 dict (32 bounded 2^16 -> packed "
+                              "build sort + 16 gcd-quantized bounded 2^13 "
+                              "-> sort-free matmul path) + 8 delta int64, "
+                              "64Ki rows, no levels",
     }
+    if affine is not None:
+        out["tpu_rowgroup_affine_ms_per_step"] = round(affine * 1e3, 3)
+        out["tpu_rowgroup_affine_rows_per_sec_per_chip"] = round(
+            N / affine, 1)
+        out["tpu_rowgroup_affine_shape"] = (
+            "same 48 dict + 8 delta cols, every dict column at its "
+            "planner-known exact range (ids<8, zones<266, offsets<8192) "
+            "-> all 48 ride the sort-free matmul path")
     if nullable is not None:
         lvl_bytes = in_bytes + K_LVL * N * 4
         out["tpu_rowgroup_nullable_ms_per_step"] = round(nullable * 1e3, 3)
@@ -633,6 +668,10 @@ def tpu_rowgroup_probe(n_steps: int = 12) -> dict | None:
           f"({in_bytes / 1e6:.1f} MB input -> {in_bytes / cfg2 / 1e9:.2f} GB/s, "
           f"{N / cfg2:,.0f} rows/s/chip at the 64-col cfg2 shape)",
           file=sys.stderr)
+    if affine is not None:
+        print(f"[bench:rowgroup] affine-bounded device phase: "
+              f"{affine * 1e3:.3f} ms/step ({N / affine:,.0f} rows/s/chip "
+              f"with every dict column on the matmul path)", file=sys.stderr)
     if nullable is not None:
         print(f"[bench:rowgroup] nullable-shape device phase: "
               f"{nullable * 1e3:.3f} ms/step ({N / nullable:,.0f} rows/s/chip "

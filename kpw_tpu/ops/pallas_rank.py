@@ -1,0 +1,169 @@
+"""Pallas TPU kernel for dictionary RANK extraction over narrow-range
+values — the matmul half of the sort-free dictionary build used by
+``parallel.sharded.encode_step_single`` for planner-bounded columns
+(``value_bound`` <= 2^13: the gcd-stride/affine offsets of the cfg2
+shape).
+
+A value v < value_bound decomposes as ``v = hi*64 + lo6``.  Given the
+per-column rank table RT (value -> ascending-unique index, from the
+histogram pass), each row's rank is the bilinear form
+
+    rank_r = H[r] @ RT2d @ L[r]^T,     RT2d = RT.reshape(nhi, 64)
+
+with H/L the one-hot matrices of hi/lo6.  The XLA formulation
+materialises H (N x nhi) and M = H @ RT2d (N x 64) in HBM — ~24 MB per
+64Ki-row column, which makes it memory-bound (measured 2.6 ms vs the
+production sort kernel's 1.8 at the 16-col probe shape).  This kernel
+keeps every intermediate in VMEM: each grid step loads a TILE of raw
+values, builds H/L on the VPU, does one small matmul on the MXU, and
+writes only the TILE of int32 ranks — one HBM read of the values, one
+write of the ranks, nothing in between.
+
+Exactness: TPU matmuls at DEFAULT precision compute in bf16 passes, so
+rank-table entries (< 8192) would round to multiples of 32.  The table
+therefore splits into two bf16-EXACT planes ``RT = RThi*128 + RTlo``
+(both < 128; one-hot H is 0/1, also exact) and the kernel does one
+``H @ [RThi | RTlo]`` matmul with f32 accumulation, recombining the
+planes on the VPU — exact at the MXU's fastest precision, no
+HIGHEST-precision multi-pass fallback needed.
+
+Masking: rows past the valid count must rank 0.  Callers pre-mask them
+to the sentinel ``nhi*64`` (any value with hi >= nhi): its H row is all
+zero, so M and the rank come out 0 — no count plumbing into the kernel.
+
+``interpret=True`` runs the Pallas interpreter on any backend (how the
+CPU CI exercises this file, same convention as ops.pallas_bitpack).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+S_LO = 64  # lo radix; nhi = padded value_bound / 64
+
+# Values per grid step: R lane-rows of 128 values.  Layout is the whole
+# game on TPU: values stay on the LANE dimension end to end (a (TILE, 1)
+# values-on-sublanes layout measured 4x SLOWER than the sort it was
+# meant to beat — 127 of 128 lanes idle and the physical array padded
+# 128-wide), bins live on sublanes, and the per-row one-hot matmul runs
+# TRANSPOSED: M^T = cat^T @ H^T with H^T (nhi x 128) built by comparing
+# a broadcast lane vector against a sublane iota.
+ROW_LANES = 128
+ROWS_PER_STEP = 16
+
+
+def _rank_kernel(lo_ref, rtt_ref, out_ref, *, nhi: int):
+    """lo_ref (1, R, 128) uint32, rtt_ref (1, 128, nhi) bf16 (transposed
+    split-plane rank table [RThi | RTlo]^T) -> out_ref (1, R, 128) int32
+    ranks (0 for sentinel-masked values)."""
+    v = lo_ref[0]      # (R, 128) uint32
+    catT = rtt_ref[0]  # (128, nhi) bf16, rows 0..63 = RThi, 64.. = RTlo
+    rows = v.shape[0]
+    bins = jax.lax.broadcasted_iota(jnp.int32, (nhi, ROW_LANES), 0)
+    lbins = jax.lax.broadcasted_iota(jnp.int32, (S_LO, ROW_LANES), 0)
+    out = []
+    for r in range(rows):
+        vr = v[r:r + 1]  # (1, 128) uint32 — one lane vector
+        hi = (vr >> jnp.uint32(6)).astype(jnp.int32)
+        lo6 = (vr & jnp.uint32(S_LO - 1)).astype(jnp.int32)
+        HT = (bins == hi).astype(jnp.bfloat16)        # (nhi, 128)
+        MT = jnp.dot(catT, HT,
+                     preferred_element_type=jnp.float32)  # (128, 128)
+        LT = (lbins == lo6).astype(jnp.float32)       # (64, 128)
+        rank = jnp.sum((MT[:S_LO] * 128.0 + MT[S_LO:]) * LT,
+                       axis=0, keepdims=True)         # (1, 128)
+        out.append(rank.astype(jnp.int32))
+    out_ref[0] = jnp.concatenate(out, axis=0)
+
+
+def _hist_kernel(lo_ref, out_ref, *, nhi: int):
+    """lo_ref (1, R, 128) uint32 -> accumulate the (nhi, 64) bin-count
+    matrix over every grid step of the column (out block revisited across
+    the row-tile axis; zero-initialised on its first step).  One
+    contract-on-lanes matmul per lane row: counts += HT . LT^T."""
+    @pl.when(pl.program_id(1) == 0)
+    def _init():
+        out_ref[0] = jnp.zeros((nhi, S_LO), jnp.float32)
+
+    v = lo_ref[0]  # (R, 128) uint32
+    rows = v.shape[0]
+    bins = jax.lax.broadcasted_iota(jnp.int32, (nhi, ROW_LANES), 0)
+    lbins = jax.lax.broadcasted_iota(jnp.int32, (S_LO, ROW_LANES), 0)
+    acc = jnp.zeros((nhi, S_LO), jnp.float32)
+    for r in range(rows):
+        vr = v[r:r + 1]
+        hi = (vr >> jnp.uint32(6)).astype(jnp.int32)
+        lo6 = (vr & jnp.uint32(S_LO - 1)).astype(jnp.int32)
+        HT = (bins == hi).astype(jnp.bfloat16)   # (nhi, 128)
+        LT = (lbins == lo6).astype(jnp.bfloat16)  # (64, 128)
+        acc = acc + jax.lax.dot_general(
+            HT, LT, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+    out_ref[0] += acc
+
+
+def hist_pages_core(lo_masked: jax.Array, nhi: int,
+                    interpret: bool = False) -> jax.Array:
+    """Traceable core: lo_masked (C, N) uint32 (invalid rows pre-masked to
+    the sentinel nhi*64) -> (C, nhi, 64) f32 bin counts (exact integers:
+    bf16 one-hot inputs, f32 accumulation).  Constraints as
+    :func:`rank_pages_core`."""
+    C, N = lo_masked.shape
+    if nhi > 128:
+        raise ValueError(f"nhi={nhi} exceeds the 2^13 value-bound design")
+    if N % ROW_LANES:
+        raise ValueError(f"N={N} must be a multiple of {ROW_LANES}")
+    rows_total = N // ROW_LANES
+    r_step = math.gcd(rows_total, ROWS_PER_STEP)
+    v3 = lo_masked.reshape(C, rows_total, ROW_LANES)
+    return pl.pallas_call(
+        functools.partial(_hist_kernel, nhi=nhi),
+        out_shape=jax.ShapeDtypeStruct((C, nhi, S_LO), jnp.float32),
+        grid=(C, rows_total // r_step),
+        in_specs=[pl.BlockSpec((1, r_step, ROW_LANES), lambda c, t: (c, t, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((1, nhi, S_LO), lambda c, t: (c, 0, 0),
+                               memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(v3)
+
+
+def rank_pages_core(lo_masked: jax.Array, rt: jax.Array,
+                    interpret: bool = False) -> jax.Array:
+    """Traceable core: lo_masked (C, N) uint32 (invalid rows pre-masked to
+    the sentinel nhi*64), rt (C, nhi, 64) int32 rank tables -> (C, N)
+    int32 ranks.  N must be a multiple of 128 (pad_bucket guarantees a
+    power of two >= 256); nhi <= 128 (value_bound <= 2^13)."""
+    C, N = lo_masked.shape
+    nhi = rt.shape[1]
+    if nhi > 128:
+        raise ValueError(f"nhi={nhi} exceeds the 2^13 value-bound design")
+    if N % ROW_LANES:
+        raise ValueError(f"N={N} must be a multiple of {ROW_LANES}")
+    # split-plane (< 128, bf16-exact) transposed table, built once in XLA
+    cat = jnp.concatenate([rt // 128, rt % 128], axis=2)  # (C, nhi, 128)
+    catT = jnp.swapaxes(cat, 1, 2).astype(jnp.bfloat16)   # (C, 128, nhi)
+    rows_total = N // ROW_LANES
+    r_step = math.gcd(rows_total, ROWS_PER_STEP)
+    v3 = lo_masked.reshape(C, rows_total, ROW_LANES)
+    ranks = pl.pallas_call(
+        functools.partial(_rank_kernel, nhi=nhi),
+        out_shape=jax.ShapeDtypeStruct((C, rows_total, ROW_LANES), jnp.int32),
+        grid=(C, rows_total // r_step),
+        in_specs=[
+            pl.BlockSpec((1, r_step, ROW_LANES), lambda c, t: (c, t, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, ROW_LANES, nhi), lambda c, t: (c, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, r_step, ROW_LANES), lambda c, t: (c, t, 0),
+                               memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(v3, catT)
+    return ranks.reshape(C, N)

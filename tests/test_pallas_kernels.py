@@ -119,3 +119,55 @@ def test_file_identity_via_pallas_route(monkeypatch):
     monkeypatch.setenv("KPW_PALLAS", "interpret")
     tpu = write(TpuChunkEncoder)
     assert cpu == tpu
+
+
+# ---------------------------------------------------------------------------
+# sort-free matmul dictionary path (ops.pallas_rank via encode_step_single)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("vb,n,c,count_off", [
+    (1 << 13, 1 << 13, 2, 0),     # nhi bucket 128, full count
+    (1 << 13, 1 << 13, 2, 37),    # ragged valid prefix
+    (5001, 4096, 3, 0),           # non-power-of-two bound (gcd/affine case)
+    (266, 1024, 2, 1023),         # zone-range bound -> tiny nhi bucket, count 1
+    (8, 512, 2, 0),               # id-range bound, k=8
+])
+def test_encode_step_single_matmul_path_identity(monkeypatch, vb, n, c,
+                                                 count_off):
+    """The histogram+rank Pallas path (value_bound <= 2^13 under
+    KPW_PALLAS) must match the sort path bit for bit: packed bytes, k,
+    and the dictionary prefix ulo[:k]."""
+    import jax.numpy as jnp
+
+    from kpw_tpu.parallel import sharded
+
+    rng = np.random.default_rng(vb * 7 + n)
+    lo = jnp.asarray(rng.integers(0, vb, (c, n)).astype(np.uint32))
+    count = jnp.int32(n - count_off)
+    monkeypatch.setenv("KPW_PALLAS", "0")
+    want_packed, want_ulo, want_k = sharded.encode_step_single(
+        lo, count, width=16, value_bound=vb)
+    monkeypatch.setenv("KPW_PALLAS", "interpret")
+    got_packed, got_ulo, got_k = sharded.encode_step_single(
+        lo, count, width=16, value_bound=vb)
+    np.testing.assert_array_equal(np.asarray(got_k), np.asarray(want_k))
+    np.testing.assert_array_equal(np.asarray(got_packed),
+                                  np.asarray(want_packed))
+    assert got_ulo.shape == want_ulo.shape
+    for cc in range(c):
+        kk = int(want_k[cc])
+        np.testing.assert_array_equal(np.asarray(got_ulo)[cc][:kk],
+                                      np.asarray(want_ulo)[cc][:kk])
+
+
+def test_encode_step_single_matmul_count_zero(monkeypatch):
+    import jax.numpy as jnp
+
+    from kpw_tpu.parallel import sharded
+
+    lo = jnp.asarray(np.arange(256, dtype=np.uint32)[None, :] % 100)
+    monkeypatch.setenv("KPW_PALLAS", "interpret")
+    packed, ulo, k = sharded.encode_step_single(
+        lo, jnp.int32(0), width=16, value_bound=100)
+    assert int(k[0]) == 0
+    assert not np.asarray(packed).any()

@@ -1,0 +1,241 @@
+"""Prototype: sort-free dictionary build + rank via MXU matmuls, for the
+value_bound <= 2^13 gcd/affine columns of the cfg2 shape (the dict32 part
+of the rowgroup probe; production path parallel/sharded.encode_step_single
+with val_bits = 13).
+
+Idea: decompose v = hi*S + lo (S = 64; hi < 128, lo < 64 for 13-bit
+values).  With one-hot matrices H (N x 128) and L (N x 64):
+
+- histogram:  C = H^T @ L  is the (128 x 64) bin-count matrix — the
+  whole 8192-bin histogram as ONE matmul (f32 accumulation is exact up
+  to 2^24, so 64Ki rows can never overflow);
+- presence/dictionary: bins with C > 0, in (hi, lo) row-major order =
+  ascending value order;
+- rank table: RT = cumsum(presence) - 1 over the flat 8192 bins maps a
+  value to its ascending-unique index — and each row's rank is the
+  bilinear form H[r] @ RT @ L[r]^T.  RT entries reach 8191, beyond
+  bf16's exact-integer range (256), so RT splits into two planes
+  RT = RThi*64 + RTlo with both planes < 256 — two bf16 matmuls
+  M = H @ RTplane (N x 64), then rank = 64*rowsum(Mhi*L) + rowsum(Mlo*L).
+
+The comparator network pays ~O(N log^2 N) data movement; this pays
+3 matmuls of N*128*64 MACs on the MXU where MACs are nearly free, plus
+one-hot builds on the VPU.  The catch is HBM traffic if H/L materialize
+(N x 192 bf16 = 24 MB per column) — this XLA prototype measures exactly
+that regime; a fused Pallas tile kernel would keep H/L in VMEM.
+
+Identity: ranks + dictionary byte-identical to encode_step_single's
+(packed, ulo, k) on CPU (asserted below).  `--tpu` times the (16, 64Ki)
+dict32 shape vs the production kernel.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+S_LO = 64  # lo radix; hi radix = value_bound // S_LO
+
+
+@functools.partial(jax.jit, static_argnames=("value_bound", "width"))
+def dict_matmul(lo, count, value_bound: int = 1 << 13, width: int = 16):
+    """(C, N) uint32 values < value_bound -> (indices (C, N) uint32,
+    ulo (C, value_bound) uint32 ascending-unique-padded, k (C,) int32).
+    Same contract as the pre-pack stage of encode_step_single: invalid
+    rows (>= count) get index 0 and join no dictionary."""
+    n = lo.shape[1]
+    nhi = value_bound // S_LO
+    iota = jnp.arange(n, dtype=jnp.int32)
+    valid = iota < count
+
+    def one_column(lc):
+        hi = (lc // S_LO).astype(jnp.int32)
+        lo_d = (lc % S_LO).astype(jnp.int32)
+        # int8 one-hots (half the HBM footprint of bf16; native int8 MXU
+        # with exact int32 accumulation); invalid rows all-zero so they
+        # join no bin
+        H = (hi[:, None] == jnp.arange(nhi)[None, :]) & valid[:, None]
+        L = (lo_d[:, None] == jnp.arange(S_LO)[None, :]) & valid[:, None]
+        Hb = H.astype(jnp.int8)
+        Lb = L.astype(jnp.int8)
+        counts = jax.lax.dot_general(
+            Hb, Lb, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32)  # (nhi, S_LO) histogram
+        present = (counts > 0).reshape(-1)  # flat, ascending value order
+        k = jnp.sum(present.astype(jnp.int32))
+        rt = jnp.cumsum(present.astype(jnp.int32)) - 1  # value -> rank
+        # dictionary: ascending present bin values compacted to the front
+        # (packed single-operand sort over the 8192 bins — tiny next to N)
+        bins = jnp.arange(value_bound, dtype=jnp.uint32)
+        ulo = jnp.sort(jnp.where(present, bins, jnp.uint32(0xFFFFFFFF)))
+        # rank per row as a bilinear form, rank-table split into int8-exact
+        # planes (< 128):  rt = rt_hi * 128 + rt_lo, valid while
+        # value_bound <= 2^14 (ranks < 16384) — assert statically
+        assert value_bound // S_LO * S_LO == value_bound
+        assert value_bound <= (1 << 14)
+        rtm = rt.reshape(nhi, S_LO)
+        rt_hi = (rtm // 128).astype(jnp.int8)
+        rt_lo = (rtm % 128).astype(jnp.int8)
+        mhi = jax.lax.dot_general(Hb, rt_hi, (((1,), (0,)), ((), ())),
+                                  preferred_element_type=jnp.int32)
+        mlo = jax.lax.dot_general(Hb, rt_lo, (((1,), (0,)), ((), ())),
+                                  preferred_element_type=jnp.int32)
+        rank = (jnp.sum(mhi * Lb.astype(jnp.int32), axis=1) * 128
+                + jnp.sum(mlo * Lb.astype(jnp.int32), axis=1))
+        indices = jnp.where(valid, rank.astype(jnp.uint32), 0)
+        return indices, ulo, k
+
+    return jax.vmap(one_column)(lo)
+
+
+@functools.partial(jax.jit, static_argnames=("value_bound", "interpret"))
+def dict_matmul_pallas(lo, count, value_bound: int = 1 << 13,
+                       interpret: bool = False):
+    """Histogram/dict via XLA one-hot matmuls + ranks via the fused Pallas
+    kernel (ops.pallas_rank) — same contract as dict_matmul."""
+    from kpw_tpu.ops.pallas_rank import rank_pages_core
+
+    n = lo.shape[1]
+    nhi = value_bound // S_LO
+    iota = jnp.arange(n, dtype=jnp.int32)
+    valid = iota < count
+
+    def hist_one(lc):
+        hi = (lc // S_LO).astype(jnp.int32)
+        lo_d = (lc % S_LO).astype(jnp.int32)
+        H = ((hi[:, None] == jnp.arange(nhi)[None, :]) & valid[:, None]
+             ).astype(jnp.bfloat16)
+        L = ((lo_d[:, None] == jnp.arange(S_LO)[None, :]) & valid[:, None]
+             ).astype(jnp.bfloat16)
+        counts = jax.lax.dot_general(
+            H, L, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        present = (counts > 0).reshape(-1)
+        k = jnp.sum(present.astype(jnp.int32))
+        rt = (jnp.cumsum(present.astype(jnp.int32)) - 1).reshape(nhi, S_LO)
+        bins = jnp.arange(value_bound, dtype=jnp.uint32)
+        ulo = jnp.sort(jnp.where(present, bins, jnp.uint32(0xFFFFFFFF)))
+        return rt, ulo, k
+
+    rt, ulo, k = jax.vmap(hist_one)(lo)
+    lo_masked = jnp.where(valid[None, :], lo, jnp.uint32(value_bound))
+    ranks = rank_pages_core(lo_masked, rt, interpret=interpret)
+    return ranks.astype(jnp.uint32), ulo, k
+
+
+@functools.partial(jax.jit, static_argnames=("value_bound", "interpret"))
+def dict_full_pallas(lo, count, value_bound: int = 1 << 13,
+                     interpret: bool = False):
+    """Histogram AND ranks via the fused Pallas kernels — the one-hot
+    matrices never exist in HBM; XLA only does presence/cumsum/dict-sort
+    over the 8192 bins."""
+    from kpw_tpu.ops.pallas_rank import hist_pages_core, rank_pages_core
+
+    n = lo.shape[1]
+    nhi = value_bound // S_LO
+    iota = jnp.arange(n, dtype=jnp.int32)
+    valid = iota < count
+    lo_masked = jnp.where(valid[None, :], lo, jnp.uint32(value_bound))
+    counts = hist_pages_core(lo_masked, nhi, interpret=interpret)
+
+    def finish_one(cnt):
+        present = (cnt > 0).reshape(-1)
+        k = jnp.sum(present.astype(jnp.int32))
+        rt = (jnp.cumsum(present.astype(jnp.int32)) - 1).reshape(nhi, S_LO)
+        bins = jnp.arange(value_bound, dtype=jnp.uint32)
+        ulo = jnp.sort(jnp.where(present, bins, jnp.uint32(0xFFFFFFFF)))
+        return rt, ulo, k
+
+    rt, ulo, k = jax.vmap(finish_one)(counts)
+    ranks = rank_pages_core(lo_masked, rt, interpret=interpret)
+    return ranks.astype(jnp.uint32), ulo, k
+
+
+def check_identity():
+    from kpw_tpu.parallel.sharded import encode_step_single
+
+    rng = np.random.default_rng(5)
+    for vb, n, c in ((1 << 13, 4096, 3), (1 << 13, 1 << 13, 2), (4096, 512, 4)):
+        lo = jnp.asarray(rng.integers(0, vb, (c, n)).astype(np.uint32))
+        for count in (n, n - 37, 1, 0):
+            want_packed, want_ulo, want_k = encode_step_single(
+                lo, jnp.int32(count), width=16, value_bound=vb)
+            from kpw_tpu.ops.packing import bitpack_device
+
+            for impl in (dict_matmul,
+                         functools.partial(dict_matmul_pallas, interpret=True),
+                         functools.partial(dict_full_pallas, interpret=True)):
+                idx, ulo, k = impl(lo, jnp.int32(count), value_bound=vb)
+                np.testing.assert_array_equal(np.asarray(k), np.asarray(want_k))
+                for cc in range(c):
+                    kk = int(k[cc])
+                    np.testing.assert_array_equal(
+                        np.asarray(ulo)[cc][:kk], np.asarray(want_ulo)[cc][:kk],
+                        err_msg=f"dict col {cc} count {count}")
+                # compare indices through the same bit-pack as production
+                packed = jax.vmap(lambda m: bitpack_device(m, 16))(idx)
+                np.testing.assert_array_equal(
+                    np.asarray(packed), np.asarray(want_packed),
+                    err_msg=f"indices count {count}")
+    print("identity OK: dict_matmul + dict_matmul_pallas == encode_step_single")
+
+
+def time_tpu(n_steps: int = 12):
+    from bench import probe_time_loop
+    from kpw_tpu.parallel.sharded import encode_step_single
+    from kpw_tpu.ops.packing import bitpack_device
+    from kpw_tpu.runtime.select import probe_link
+
+    dispatch_s = probe_link()["dispatch_ms"] / 1e3
+    rng = np.random.default_rng(11)
+    N = 1 << 16
+    C = 16  # the dict32 share of the cfg2 shape
+    lo = jnp.asarray(rng.integers(0, 5000, (C, N)).astype(np.uint32))
+    count = jnp.int32(N)
+
+    def sort_part(i, x):
+        packed, _, k = encode_step_single(x ^ i.astype(jnp.uint32), count,
+                                          value_bound=1 << 13)
+        return jnp.sum(packed, dtype=jnp.uint32) + jnp.sum(k).astype(jnp.uint32)
+
+    def matmul_part(i, x):
+        idx, ulo, k = dict_matmul(x ^ i.astype(jnp.uint32), count)
+        packed = jax.vmap(lambda m: bitpack_device(m, 16))(idx)
+        return (jnp.sum(packed, dtype=jnp.uint32)
+                + jnp.sum(ulo, dtype=jnp.uint32) + jnp.sum(k).astype(jnp.uint32))
+
+    def pallas_part(i, x):
+        idx, ulo, k = dict_matmul_pallas(x ^ i.astype(jnp.uint32), count)
+        packed = jax.vmap(lambda m: bitpack_device(m, 16))(idx)
+        return (jnp.sum(packed, dtype=jnp.uint32)
+                + jnp.sum(ulo, dtype=jnp.uint32) + jnp.sum(k).astype(jnp.uint32))
+
+    probe_time_loop([(sort_part, (lo,))], "dict16x64Ki sort kernel", n_steps,
+                    dispatch_s, reps=5)
+    probe_time_loop([(matmul_part, (lo,))], "dict16x64Ki matmul kernel", n_steps,
+                    dispatch_s, reps=5)
+    def full_part(i, x):
+        idx, ulo, k = dict_full_pallas(x ^ i.astype(jnp.uint32), count)
+        packed = jax.vmap(lambda m: bitpack_device(m, 16))(idx)
+        return (jnp.sum(packed, dtype=jnp.uint32)
+                + jnp.sum(ulo, dtype=jnp.uint32) + jnp.sum(k).astype(jnp.uint32))
+
+    probe_time_loop([(pallas_part, (lo,))], "dict16x64Ki matmul+pallas", n_steps,
+                    dispatch_s, reps=5)
+    probe_time_loop([(full_part, (lo,))], "dict16x64Ki full pallas", n_steps,
+                    dispatch_s, reps=5)
+
+
+if __name__ == "__main__":
+    if "--tpu" in sys.argv:
+        time_tpu()
+    else:
+        check_identity()
