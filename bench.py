@@ -435,11 +435,14 @@ def make_rowgroup_specs(seed: int = 11) -> dict:
     # stats pass knows each column's exact range (ids < 8, zones < 266,
     # gcd offsets < 5001 for the cfg2 schema), so in production every
     # dict column rides the sort-free matmul path
-    # (parallel/sharded._encode_step_single_matmul) with its own tiny nhi
-    # bucket.  The XOR perturbation shrinks to (i & 3) so the bound still
-    # holds every step; reported as tpu_rowgroup_affine_* alongside the
-    # conservative cfg2shape (whose dict16 half models 16-bit-wide
-    # ranges and keeps the sort).
+    # (parallel/sharded._encode_step_single_matmul).  The probe bounds
+    # the 32 id/zone columns at ONE shared 270 (they share a data array;
+    # 270 and the exact ranges land in the same nhi=8 bucket, so the
+    # compiled program is identical) and the offsets at 2^13 (bucket
+    # 128, ditto for 5001).  The XOR perturbation shrinks to (i & 3) so
+    # every bound still holds each step; reported as
+    # tpu_rowgroup_affine_* alongside the conservative cfg2shape (whose
+    # dict16 half models 16-bit-wide ranges and keeps the sort).
     def affine16_part(i, lo):
         packed, _, k = encode_step_single(lo ^ (i & 3).astype(jnp.uint32),
                                           count, value_bound=270)
@@ -642,9 +645,10 @@ def tpu_rowgroup_probe(n_steps: int = 12) -> dict | None:
         out["tpu_rowgroup_affine_rows_per_sec_per_chip"] = round(
             N / affine, 1)
         out["tpu_rowgroup_affine_shape"] = (
-            "same 48 dict + 8 delta cols, every dict column at its "
-            "planner-known exact range (ids<8, zones<266, offsets<8192) "
-            "-> all 48 ride the sort-free matmul path")
+            "same 48 dict + 8 delta cols with planner-style bounds tight "
+            "enough for the matmul path on every dict column: the 32 "
+            "id/zone cols bounded at 270 (nhi bucket 8), the 16 gcd "
+            "offset cols at 2^13 (bucket 128)")
     if nullable is not None:
         lvl_bytes = in_bytes + K_LVL * N * 4
         out["tpu_rowgroup_nullable_ms_per_step"] = round(nullable * 1e3, 3)
@@ -805,6 +809,18 @@ def _projected_system(out: dict, t_base: float, rows: int) -> dict | None:
         proj[f"projected_rows_per_sec_{k}core"] = round(rps, 1)
         proj[f"projected_vs_baseline_{k}core"] = round(
             rps / base_rows_per_sec, 2)
+    aff_ms = out.get("tpu_rowgroup_affine_ms_per_step")
+    if aff_ms:
+        # the affine-bounded device phase (every dict column on the
+        # matmul path — what the planner's stats actually enable for the
+        # cfg2 schema); the same pipeline model, PCIe becomes the
+        # bottleneck once the host keeps up
+        for k in (2, 4):
+            bottleneck = max(aff_ms, pcie_ms, host_ms / k)
+            rps = N / bottleneck * 1e3
+            proj[f"projected_affine_rows_per_sec_{k}core"] = round(rps, 1)
+            proj[f"projected_affine_vs_baseline_{k}core"] = round(
+                rps / base_rows_per_sec, 2)
     return proj
 
 

@@ -82,6 +82,26 @@ def _rank_kernel(lo_ref, rtt_ref, out_ref, *, nhi: int):
     out_ref[0] = jnp.concatenate(out, axis=0)
 
 
+def presence_to_dict(counts: jax.Array, nhi: int):
+    """The ONE definition of the histogram->dictionary step shared by the
+    production path (parallel.sharded._encode_step_single_matmul) and the
+    prototype tool: per column, (nhi, 64) bin counts -> (rank table
+    (nhi, 64) int32, ascending-unique dictionary ulo (nhi*64,) uint32
+    padded with 0xFFFFFFFF, unique count k).  One tiny nhi*64-bin sort
+    per column instead of an N-row one."""
+    vb = nhi * S_LO
+
+    def one(cnt):
+        present = (cnt > 0).reshape(-1)
+        k = jnp.sum(present.astype(jnp.int32))
+        rt = (jnp.cumsum(present.astype(jnp.int32)) - 1).reshape(nhi, S_LO)
+        bins = jnp.arange(vb, dtype=jnp.uint32)
+        ulo = jnp.sort(jnp.where(present, bins, jnp.uint32(0xFFFFFFFF)))
+        return rt, ulo, k
+
+    return jax.vmap(one)(counts)
+
+
 def _hist_kernel(lo_ref, out_ref, *, nhi: int):
     """lo_ref (1, R, 128) uint32 -> accumulate the (nhi, 64) bin-count
     matrix over every grid step of the column (out block revisited across

@@ -187,7 +187,8 @@ def _encode_step_single_matmul(lo, count, width: int, pack: str, nhi: int):
     and a rank table; a second fused kernel extracts per-row ranks.
     Output contract identical to the sort path: (packed, ulo (C, N) with
     [k:] unspecified pad, k)."""
-    from ..ops.pallas_rank import S_LO, hist_pages_core, rank_pages_core
+    from ..ops.pallas_rank import (S_LO, hist_pages_core, presence_to_dict,
+                                   rank_pages_core)
 
     n = lo.shape[1]
     vb = nhi * S_LO
@@ -197,16 +198,7 @@ def _encode_step_single_matmul(lo, count, width: int, pack: str, nhi: int):
     interp = pack == "interpret"
     lo_masked = jnp.where(valid[None, :], lo, jnp.uint32(vb))
     counts = hist_pages_core(lo_masked, nhi, interpret=interp)
-
-    def finish_one(cnt):
-        present = (cnt > 0).reshape(-1)
-        k = jnp.sum(present.astype(jnp.int32))
-        rt = (jnp.cumsum(present.astype(jnp.int32)) - 1).reshape(nhi, S_LO)
-        bins = jnp.arange(vb, dtype=jnp.uint32)
-        ulo_v = jnp.sort(jnp.where(present, bins, big))
-        return rt, ulo_v, k
-
-    rt, ulo_v, k = jax.vmap(finish_one)(counts)
+    rt, ulo_v, k = presence_to_dict(counts, nhi)
     ranks = rank_pages_core(lo_masked, rt, interpret=interp).astype(jnp.uint32)
     masked = jnp.where(valid[None, :], ranks, 0)
     # contract shape (C, n): k <= min(count, vb) uniques always fit

@@ -137,7 +137,8 @@ def dict_full_pallas(lo, count, value_bound: int = 1 << 13,
     """Histogram AND ranks via the fused Pallas kernels — the one-hot
     matrices never exist in HBM; XLA only does presence/cumsum/dict-sort
     over the 8192 bins."""
-    from kpw_tpu.ops.pallas_rank import hist_pages_core, rank_pages_core
+    from kpw_tpu.ops.pallas_rank import (hist_pages_core, presence_to_dict,
+                                         rank_pages_core)
 
     n = lo.shape[1]
     nhi = value_bound // S_LO
@@ -145,16 +146,7 @@ def dict_full_pallas(lo, count, value_bound: int = 1 << 13,
     valid = iota < count
     lo_masked = jnp.where(valid[None, :], lo, jnp.uint32(value_bound))
     counts = hist_pages_core(lo_masked, nhi, interpret=interpret)
-
-    def finish_one(cnt):
-        present = (cnt > 0).reshape(-1)
-        k = jnp.sum(present.astype(jnp.int32))
-        rt = (jnp.cumsum(present.astype(jnp.int32)) - 1).reshape(nhi, S_LO)
-        bins = jnp.arange(value_bound, dtype=jnp.uint32)
-        ulo = jnp.sort(jnp.where(present, bins, jnp.uint32(0xFFFFFFFF)))
-        return rt, ulo, k
-
-    rt, ulo, k = jax.vmap(finish_one)(counts)
+    rt, ulo, k = presence_to_dict(counts, nhi)
     ranks = rank_pages_core(lo_masked, rt, interpret=interpret)
     return ranks.astype(jnp.uint32), ulo, k
 
