@@ -991,6 +991,12 @@ def bench_config4() -> dict:
     return out
 
 
+def _bounded_payload(vb: int) -> int:
+    from kpw_tpu.parallel.sharded import bounded_psum_payload_bytes
+
+    return bounded_psum_payload_bytes(vb)
+
+
 def _cfg4_payload_probe(n_shards: int) -> dict:
     """Measured ICI-payload accounting for the mesh dictionary merge
     (VERDICT r3 next #5): the two-phase merge gathers pad_bucket(k_max)
@@ -1020,9 +1026,19 @@ def _cfg4_payload_probe(n_shards: int) -> dict:
         "single_phase_gathered_bytes": single.get("ici_gathered_bytes"),
         "reduction_x": round(single.get("ici_gathered_bytes", 1)
                              / max(two.get("ici_gathered_bytes", 1), 1), 1),
+        # planner-bounded columns (value_bound <= 2^13) skip the gather
+        # entirely: sharded_encode_step_bounded merges by ONE psum of
+        # per-shard bin counts — a CONSTANT payload independent of both
+        # rows/shard and cardinality (dryrun-validated bit-identical to
+        # the gather step); recorded at this config's k=5000 bound and
+        # at a zone-like 266 bound
+        "bounded_psum_payload_bytes": _bounded_payload(5001),
+        "bounded_psum_payload_bytes_vb266": _bounded_payload(266),
         "model": "two-phase payload = n_shards * (pad_bucket(k_max) * 4 * "
                  "key_planes + 4); single-phase = n_shards * "
-                 "pad_bucket(rows_per_shard) * (4 * key_planes + 1)",
+                 "pad_bucket(rows_per_shard) * (4 * key_planes + 1); "
+                 "bounded-psum = bucketed nhi*64*4 per column, constant "
+                 "(sharded.bounded_psum_payload_bytes)",
     }
     # the string analog: per-shard host hash + sorted-union merge over a
     # cfg1-shaped string column; only the unique payload crosses the wire

@@ -16,4 +16,4 @@ over a ``jax.sharding.Mesh``:
 
 from .mesh import make_mesh  # noqa: F401
 from .dict_merge import DictionaryOverflow, global_dictionary_encode  # noqa: F401
-from .sharded import sharded_encode_step  # noqa: F401
+from .sharded import sharded_encode_step, sharded_encode_step_bounded  # noqa: F401

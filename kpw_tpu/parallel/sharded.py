@@ -64,6 +64,116 @@ def sharded_encode_step(hi, lo, counts, *, mesh: Mesh, cap: int = 4096,
     return fn(hi, lo, counts)
 
 
+@functools.partial(jax.jit, static_argnames=("mesh", "width", "nhi", "pack"))
+def _sharded_bounded_impl(lo, counts, *, mesh: Mesh, width: int, nhi: int,
+                          pack: str):
+    from ..ops.pallas_rank import (S_LO, hist_pages_core, presence_to_dict,
+                                   rank_pages_core)
+
+    vb = nhi * S_LO
+
+    def kernel(l, c):
+        count = c[0]
+        n = l.shape[1]
+        iota = jnp.arange(n, dtype=jnp.int32)
+        valid = iota < count
+        lo_m = jnp.where(valid[None, :], l, jnp.uint32(vb))
+        if pack != "xla":
+            # the VMEM-fused kernels (ops.pallas_rank) — the one-hot
+            # matrices never exist in HBM (the XLA formulation below
+            # measured memory-bound single-chip)
+            local = hist_pages_core(lo_m, nhi, interpret=pack == "interpret")
+        else:
+            def hist_one(lc):
+                # portable fallback (virtual CPU meshes, n % 128 != 0):
+                # int8 one-hot matmul, int32 accumulation — exact on
+                # every backend; the sentinel vb maps to hi == nhi,
+                # whose one-hot row is all-zero, so invalid rows join
+                # no bin
+                hi = (lc // S_LO).astype(jnp.int32)
+                lo6 = (lc % S_LO).astype(jnp.int32)
+                H = (hi[:, None] == jnp.arange(nhi)[None, :]).astype(jnp.int8)
+                L = (lo6[:, None] == jnp.arange(S_LO)[None, :]).astype(jnp.int8)
+                return jax.lax.dot_general(H, L, (((0,), (0,)), ((), ())),
+                                           preferred_element_type=jnp.int32)
+
+            local = jax.vmap(hist_one)(lo_m)     # (C, nhi, 64)
+        gcounts = jax.lax.psum(local, AXIS)      # THE merge: one psum,
+        # constant nhi*64*4 B per column regardless of rows or k
+        rt, ulo, gk = presence_to_dict(gcounts, nhi)
+        if pack != "xla":
+            ranks = rank_pages_core(lo_m, rt,
+                                    interpret=pack == "interpret")
+            masked = jnp.where(valid[None, :], ranks.astype(jnp.uint32), 0)
+        else:
+            def rank_one(lc, rt_c):
+                safe = jnp.where(valid, lc, 0)
+                return rt_c.reshape(-1)[safe].astype(jnp.uint32)
+
+            masked = jnp.where(valid[None, :],
+                               jax.vmap(rank_one)(l, rt), 0)
+        packed = jax.vmap(lambda m: bitpack_device(m, width))(masked)
+        rows = jax.lax.psum(count, AXIS)
+        ovf = jnp.max((gk > (1 << width)).astype(jnp.int32))
+        return packed, ulo, gk, rows, ovf
+
+    fn = jax.shard_map(
+        kernel, mesh=mesh,
+        in_specs=(P(None, AXIS), P(AXIS)),
+        out_specs=(P(None, AXIS), P(), P(), P(), P()),
+        check_vma=False,  # replicated-by-construction, as in dict_merge
+    )
+    return fn(lo, counts)
+
+
+def bounded_psum_payload_bytes(value_bound: int) -> int:
+    """The histogram-psum merge's per-column ICI payload: the BUCKETED
+    bin-count matrix, nhi*64*4 bytes with nhi the smallest bucket
+    covering the bound — constant in rows/shard and cardinality."""
+    for nhi in _MATMUL_NHI_BUCKETS:
+        if nhi * 64 >= int(value_bound):
+            return nhi * 64 * 4
+    raise ValueError(f"value_bound={value_bound} exceeds "
+                     f"{_MATMUL_MAX_BOUND}")
+
+
+def sharded_encode_step_bounded(lo, counts, *, mesh: Mesh, width: int = 16,
+                                value_bound: int):
+    """The mesh encode step for planner-bounded 32-bit columns
+    (``value_bound`` <= 2^13, globally valid across every shard — derive
+    it from psum'd stats, never a guess): the global dictionary merge is
+    literally ONE ``psum`` of per-shard bin-count histograms (the
+    BASELINE config-4 north star, "psum-based global dictionary merge"),
+    so the ICI payload is a CONSTANT :func:`bounded_psum_payload_bytes`
+    = bucketed nhi*64*4 bytes per column — independent of rows per shard
+    AND of the cardinality k, vs the two-phase gather's
+    ``pad_bucket(k_max)``-proportional payload (parallel.dict_merge).
+    Presence/rank-table/dictionary then derive identically on every
+    shard (ops.pallas_rank.presence_to_dict); on TPU meshes the local
+    histogram and rank extraction run the VMEM-fused Pallas kernels,
+    with an exact int8-matmul/table-lookup XLA fallback elsewhere.
+
+    Returns (packed (C, N*width//8) sharded, gdict (C, bucketed vb)
+    uint32 ascending-unique-padded, gk (C,), rows, overflow) —
+    dictionary and indices bit-identical to :func:`sharded_encode_step`
+    with ``has_hi=False`` on the same data."""
+    from ..ops.packing import use_pallas
+
+    if int(value_bound) > _MATMUL_MAX_BOUND:
+        raise ValueError(f"value_bound={value_bound} exceeds the "
+                         f"histogram-psum design bound {_MATMUL_MAX_BOUND}")
+    n_local = lo.shape[1] // max(mesh.shape[AXIS], 1)
+    pal, interp = use_pallas(lo.shape[0] * lo.shape[1])
+    pack = ("interpret" if pal and interp else "pallas" if pal else "xla")
+    if n_local % 128:
+        pack = "xla"  # kernel layout needs whole lane rows per shard
+    for nhi in _MATMUL_NHI_BUCKETS:
+        if nhi * 64 >= int(value_bound):
+            return _sharded_bounded_impl(lo, counts, mesh=mesh, width=width,
+                                         nhi=nhi, pack=pack)
+    raise AssertionError("unreachable: buckets cover the design bound")
+
+
 # Static pack-width buckets for the device kernels: a fully static program
 # per (batch bucket, width) pair, so lifting the old fixed-16 cap costs at
 # most 5 extra compiles, not one per cardinality.

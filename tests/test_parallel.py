@@ -498,3 +498,47 @@ def test_mesh_string_dictionary_merge_identity(mesh8):
     assert enc.string_stats["k_global_max"] == max(1, int(n * 0.67)) + 1
     assert enc.string_stats["exchanged_payload_bytes"] > 0
     assert enc.string_stats["merge_ms"] > 0
+
+
+@pytest.mark.parametrize("route", ["xla", "interpret"])
+def test_sharded_encode_step_bounded_psum_identity(mesh8, route, monkeypatch):
+    """The histogram-psum mesh merge (sharded_encode_step_bounded) must be
+    bit-identical to the gather-based step on the same data: dictionary,
+    k, packed indices — including ragged per-shard counts.  Both the
+    portable int8-matmul fallback and the fused Pallas kernel route
+    (interpret mode inside shard_map) are exercised."""
+    from kpw_tpu.parallel import sharded_encode_step_bounded
+
+    if route == "interpret":
+        monkeypatch.setenv("KPW_PALLAS", "interpret")
+    else:
+        monkeypatch.setenv("KPW_PALLAS", "0")
+    rng = np.random.default_rng(9)
+    C, n_shards, per = 3, 8, 512
+    N = n_shards * per
+    for vb, counts in ((266, np.full(n_shards, per, np.int32)),
+                       (5001, np.array([512, 0, 17, 512, 1, 512, 100, 512],
+                                       np.int32)),
+                       (1 << 13, np.full(n_shards, per, np.int32))):
+        vals = rng.integers(0, vb, (C, N)).astype(np.uint32)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        mesh = mesh8
+        row_sharded = NamedSharding(mesh, P(None, "shard"))
+        hi = jax.device_put(jnp.zeros((C, N), jnp.uint32), row_sharded)
+        lo = jax.device_put(vals, row_sharded)
+        cnt = jax.device_put(np.ascontiguousarray(counts),
+                             NamedSharding(mesh, P("shard")))
+        want_packed, _, want_mlo, want_gk, want_rows, want_ovf = \
+            sharded_encode_step(hi, lo, cnt, mesh=mesh, cap=N, width=16,
+                                has_hi=False)
+        packed, gdict, gk, rows, ovf = sharded_encode_step_bounded(
+            lo, cnt, mesh=mesh, width=16, value_bound=vb)
+        assert int(rows) == int(want_rows) == int(counts.sum())
+        assert int(ovf) == int(want_ovf) == 0
+        np.testing.assert_array_equal(np.asarray(gk), np.asarray(want_gk))
+        for c in range(C):
+            k = int(np.asarray(gk)[c])
+            np.testing.assert_array_equal(np.asarray(gdict)[c][:k],
+                                          np.asarray(want_mlo)[c][:k])
+        np.testing.assert_array_equal(np.asarray(packed),
+                                      np.asarray(want_packed))
