@@ -442,7 +442,7 @@ class _DeltaPlanner:
                 jnp.asarray(np.array([vb - va for _, _, va, vb in items],
                                      np.int32)),
                 bucket, bit_size, max_bits)
-            self._groups.append((items, bit_size, dev))
+            self._groups.append((items, bit_size, dev, max_bits))
 
     def device_outputs(self):
         return [g[2] for g in self._groups]
@@ -450,13 +450,14 @@ class _DeltaPlanner:
     def assemble(self, fetched) -> None:
         from ..core.schema import Encoding
 
-        for (items, bit_size, _), host in zip(self._groups, fetched):
+        for (items, bit_size, _, max_bits), host in zip(self._groups, fetched):
             mh, ml, widths, packed = host
             for r, (row, chunk, va, vb) in enumerate(items):
                 count = vb - va
                 first = int(self._streams[row][va])  # ring dtype already
                 body = assemble_delta_page(first, count, mh[r], ml[r],
-                                           widths[r], packed[r], bit_size)
+                                           widths[r], packed[r], bit_size,
+                                           max_bits=max_bits)
                 if isinstance(chunk.values, ByteColumn):
                     body += chunk.values[va:vb].payload()
                 self.plans.setdefault(id(chunk), (chunk, {}))[1][(va, vb)] = body

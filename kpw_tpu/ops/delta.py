@@ -266,9 +266,15 @@ def delta_pages_multi(hi_all: jax.Array, lo_all: jax.Array,
 
 
 def assemble_delta_page(first_value: int, count: int, mh, ml, widths, packed,
-                        bit_size: int) -> bytes:
+                        bit_size: int, max_bits: int | None = None) -> bytes:
     """Host assembly of one page's DELTA_BINARY_PACKED stream from the
-    device outputs (O(blocks)); byte-identical to the oracle."""
+    device outputs (O(blocks)); byte-identical to the oracle.
+
+    ``max_bits`` is the static width budget the device pack ran under: a
+    miniblock width above it means the budget was violated and the packed
+    plane was silently truncated on device — the host sees every width
+    here anyway, so the check turns silent data corruption into a loud
+    error (ADVICE r4)."""
     out = bytearray()
     out += varint_bytes(_BLOCK)
     out += varint_bytes(_MINI)
@@ -288,6 +294,11 @@ def assemble_delta_page(first_value: int, count: int, mh, ml, widths, packed,
         out += bytes(int(w) for w in widths[b])
         for m in range(_MINI):
             w = int(widths[b][m])
+            if max_bits is not None and w > max_bits:
+                raise ValueError(
+                    f"delta miniblock width {w} exceeds the device pack's "
+                    f"static budget max_bits={max_bits} (block {b}, "
+                    f"miniblock {m}): the packed stream is truncated")
             if w:
                 out += packed[b, m, : 4 * w].tobytes()
     return bytes(out)
@@ -324,7 +335,8 @@ def delta_binary_packed_device(values: np.ndarray, bit_size: int = 64) -> bytes:
     mh, ml, widths, packed = jax.device_get(  # one bulk readback
         delta_blocks_device(jnp.asarray(hi), jnp.asarray(lo), jnp.int32(n),
                             bit_size, max_bits))
-    return assemble_delta_page(int(v[0]), n, mh, ml, widths, packed, bit_size)
+    return assemble_delta_page(int(v[0]), n, mh, ml, widths, packed, bit_size,
+                               max_bits=max_bits)
 
 
 def delta_length_byte_array_device(values) -> bytes:

@@ -414,6 +414,21 @@ def _gcd_stride(arr: np.ndarray, vmin: int, span: int, limit: int):
     return g if g > 1 and span // g < limit else None
 
 
+def affine_stride(arr: np.ndarray, vmin: int, span: int, g_all, limit: int):
+    """Eligibility decision for the affine/bounded offset paths, shared by
+    :func:`build_dictionaries`' mode selection and the mesh encoder's
+    bounded-route consult (parallel/mesh_encoder._bounded_route) so the
+    two cannot drift: 1 when the raw span fits ``limit``; the gcd stride
+    g > 1 when ``span // g`` fits (from the fused native pass when
+    available — ``g_all`` — else the lazy sample-rejecting
+    :func:`_gcd_stride`); None when ineligible."""
+    if span < limit:
+        return 1
+    if g_all is not None:
+        return g_all if g_all > 1 and span // g_all < limit else None
+    return _gcd_stride(arr, vmin, span, limit)
+
+
 def build_dictionaries(columns: list[np.ndarray]):
     """Launch dictionary builds for a row group's columns, batching columns
     that can share one vmapped program.  Returns one handle per column with
@@ -445,12 +460,7 @@ def build_dictionaries(columns: list[np.ndarray]):
             span = vmax - vmin
 
             def stride_for(limit: int):
-                if span < limit:
-                    return 1
-                if g_all is not None:  # fused native pass already knows it
-                    return (g_all if g_all > 1 and span // g_all < limit
-                            else None)
-                return _gcd_stride(arr, vmin, span, limit)
+                return affine_stride(arr, vmin, span, g_all, limit)
 
             if use_bins:
                 if vmin >= 0:

@@ -131,8 +131,12 @@ def _hist_kernel(lo_ref, out_ref, *, nhi: int):
 def hist_pages_core(lo_masked: jax.Array, nhi: int,
                     interpret: bool = False) -> jax.Array:
     """Traceable core: lo_masked (C, N) uint32 (invalid rows pre-masked to
-    the sentinel nhi*64) -> (C, nhi, 64) f32 bin counts (exact integers:
-    bf16 one-hot inputs, f32 accumulation).  Constraints as
+    the sentinel nhi*64) -> (C, nhi, 64) f32 bin counts.  The counts are
+    exact integers only while every bin stays below 2^24 (f32 mantissa;
+    bf16 one-hot inputs, f32 accumulation) — beyond that, and after any
+    cross-shard f32 psum of these histograms, only POSITIVITY is
+    guaranteed (cnt > 0 survives rounding), which is all
+    presence_to_dict consumes (ADVICE r4).  Constraints as
     :func:`rank_pages_core`."""
     C, N = lo_masked.shape
     if nhi > 128:

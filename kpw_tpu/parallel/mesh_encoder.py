@@ -73,6 +73,27 @@ class MeshChunkEncoder(NativeChunkEncoder):
         # union — VERDICT r3 next #7): exchanged payload bytes, global/local
         # cardinalities, wall time.
         self.string_stats: dict = {}
+        # Per-column routing record (VERDICT r4 next #2): which merge each
+        # dictionary column actually rode, with its ICI payload — read by
+        # the cfg4 bench artifact's writer_route block.  Bounded: a shared
+        # long-lived encoder appends one entry per dict column per row
+        # group, so an unbounded list would leak on a streaming writer.
+        import collections
+
+        self.route_log: collections.deque = collections.deque(maxlen=512)
+        # Workers can SHARE one encoder instance (runtime/writer.py passes
+        # the same backend object to every worker): stats accumulate from
+        # per-call local dicts under this lock, never by unlocked
+        # read-modify-writes on the shared dicts.
+        self._stats_lock = threading.Lock()
+
+    def _merge_stats(self, col_stats: dict) -> None:
+        with self._stats_lock:
+            for k, v in col_stats.items():
+                if k in ("k_max", "gather_cap", "bounded_nhi_max"):
+                    self.ici_stats[k] = max(self.ici_stats.get(k, 0), v)
+                else:  # byte/column counters sum
+                    self.ici_stats[k] = self.ici_stats.get(k, 0) + v
 
     def _mesh_string_dictionary(self, values, max_k: int | None):
         """Byte-array dictionary built the way a real multi-host mesh
@@ -200,12 +221,69 @@ class MeshChunkEncoder(NativeChunkEncoder):
             # bool / exotic value containers ride the native host dictionary
             return super()._try_dictionary(chunk)
         max_k = self._fixed_width_max_k(len(values), values.dtype.itemsize)
+        bounded = self._bounded_route(values)
+        if bounded is not None:
+            # globally-bounded column (VERDICT r4 next #2): the merge is
+            # one constant-payload psum of per-shard histograms instead of
+            # the cardinality-proportional unique-set gather.  The bound
+            # comes from the planner's fused native min/max/gcd stats over
+            # ALL rows, so it is globally valid across every shard, and
+            # k <= value_bound <= 2^13 can never overflow a cap.
+            vmin, stride, vb = bounded
+            from .sharded import (bounded_global_dictionary_encode,
+                                  bounded_psum_payload_bytes)
+
+            col_stats: dict = {}
+            d, idx = bounded_global_dictionary_encode(
+                values, self.mesh, vmin=vmin, stride=stride, value_bound=vb,
+                dispatch_lock=_DISPATCH_LOCK, stats_out=col_stats)
+            self._merge_stats(col_stats)
+            accepted = len(d) <= max_k
+            self.route_log.append({
+                "column": chunk.column.name, "route": "bounded-psum",
+                "value_bound": vb, "stride": stride, "k": len(d),
+                "accepted": accepted,  # False: encode() falls back to plain
+                "ici_payload_bytes": bounded_psum_payload_bytes(vb)})
+            if not accepted:
+                return None  # encode() would reject it; skip wasted pages
+            return d, idx
+        col_stats = {}
         try:
             d, idx = global_dictionary_encode(values, self.mesh, cap=self.cap,
                                               dispatch_lock=_DISPATCH_LOCK,
-                                              stats_out=self.ici_stats)
+                                              stats_out=col_stats)
         except DictionaryOverflow:
+            self._merge_stats(col_stats)
             return None  # per-shard cardinality overflow (explicit cap)
-        if len(d) > max_k:
+        self._merge_stats(col_stats)
+        accepted = len(d) <= max_k
+        self.route_log.append({
+            "column": chunk.column.name, "route": "two-phase-gather",
+            "k": len(d), "accepted": accepted,
+            "ici_payload_bytes": col_stats.get("ici_gathered_bytes", 0)})
+        if not accepted:
             return None  # encode() would reject it; skip the wasted pages
         return d, idx
+
+    @staticmethod
+    def _bounded_route(values) -> tuple[int, int, int] | None:
+        """(vmin, stride, value_bound) when the planner's fused
+        min/max/gcd stats prove the column's offsets fit the
+        histogram-psum design bound (<= 2^13), else None.  ``vmin >= 0``
+        is load-bearing: ascending offsets reconstruct to ascending
+        bit-pattern dictionary order — identical to the gather merge and
+        the host oracle — only for non-negative values (a negative int64's
+        bit pattern sorts ABOVE the positives)."""
+        from ..ops.dictionary import _int_stats, affine_stride
+        from .sharded import _MATMUL_MAX_BOUND
+
+        if values.dtype.kind not in "iu" or not len(values):
+            return None
+        vmin, vmax, g_all = _int_stats(values)
+        if vmin < 0:
+            return None
+        span = vmax - vmin
+        g = affine_stride(values, vmin, span, g_all, _MATMUL_MAX_BOUND)
+        if g:
+            return vmin, g, span // g + 1
+        return None

@@ -18,7 +18,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.packing import bitpack_device, packed_reorder
 from .dict_merge import AXIS, _local_unique, _merge_kernel, _rank_against_dict
@@ -64,56 +64,64 @@ def sharded_encode_step(hi, lo, counts, *, mesh: Mesh, cap: int = 4096,
     return fn(hi, lo, counts)
 
 
-@functools.partial(jax.jit, static_argnames=("mesh", "width", "nhi", "pack"))
-def _sharded_bounded_impl(lo, counts, *, mesh: Mesh, width: int, nhi: int,
-                          pack: str):
+def _bounded_merge_core(l, c, *, nhi: int, pack: str):
+    """shard_map body shared by the packed flagship step and the
+    production index route: per-shard histogram, ONE psum (the merge),
+    presence -> dictionary, per-row rank lookup.  Returns
+    (masked_indices (C, n_local) uint32, ulo, gk, rows)."""
     from ..ops.pallas_rank import (S_LO, hist_pages_core, presence_to_dict,
                                    rank_pages_core)
 
     vb = nhi * S_LO
+    count = c[0]
+    n = l.shape[1]
+    iota = jnp.arange(n, dtype=jnp.int32)
+    valid = iota < count
+    lo_m = jnp.where(valid[None, :], l, jnp.uint32(vb))
+    if pack != "xla":
+        # the VMEM-fused kernels (ops.pallas_rank) — the one-hot
+        # matrices never exist in HBM (the XLA formulation below
+        # measured memory-bound single-chip)
+        local = hist_pages_core(lo_m, nhi, interpret=pack == "interpret")
+    else:
+        def hist_one(lc):
+            # portable fallback (virtual CPU meshes, n % 128 != 0):
+            # int8 one-hot matmul, int32 accumulation — exact on
+            # every backend; the sentinel vb maps to hi == nhi,
+            # whose one-hot row is all-zero, so invalid rows join
+            # no bin
+            hi = (lc // S_LO).astype(jnp.int32)
+            lo6 = (lc % S_LO).astype(jnp.int32)
+            H = (hi[:, None] == jnp.arange(nhi)[None, :]).astype(jnp.int8)
+            L = (lo6[:, None] == jnp.arange(S_LO)[None, :]).astype(jnp.int8)
+            return jax.lax.dot_general(H, L, (((0,), (0,)), ((), ())),
+                                       preferred_element_type=jnp.int32)
 
+        local = jax.vmap(hist_one)(lo_m)     # (C, nhi, 64)
+    gcounts = jax.lax.psum(local, AXIS)      # THE merge: one psum,
+    # constant nhi*64*4 B per column regardless of rows or k
+    rt, ulo, gk = presence_to_dict(gcounts, nhi)
+    if pack != "xla":
+        ranks = rank_pages_core(lo_m, rt,
+                                interpret=pack == "interpret")
+        masked = jnp.where(valid[None, :], ranks.astype(jnp.uint32), 0)
+    else:
+        def rank_one(lc, rt_c):
+            safe = jnp.where(valid, lc, 0)
+            return rt_c.reshape(-1)[safe].astype(jnp.uint32)
+
+        masked = jnp.where(valid[None, :],
+                           jax.vmap(rank_one)(l, rt), 0)
+    rows = jax.lax.psum(count, AXIS)
+    return masked, ulo, gk, rows
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "width", "nhi", "pack"))
+def _sharded_bounded_impl(lo, counts, *, mesh: Mesh, width: int, nhi: int,
+                          pack: str):
     def kernel(l, c):
-        count = c[0]
-        n = l.shape[1]
-        iota = jnp.arange(n, dtype=jnp.int32)
-        valid = iota < count
-        lo_m = jnp.where(valid[None, :], l, jnp.uint32(vb))
-        if pack != "xla":
-            # the VMEM-fused kernels (ops.pallas_rank) — the one-hot
-            # matrices never exist in HBM (the XLA formulation below
-            # measured memory-bound single-chip)
-            local = hist_pages_core(lo_m, nhi, interpret=pack == "interpret")
-        else:
-            def hist_one(lc):
-                # portable fallback (virtual CPU meshes, n % 128 != 0):
-                # int8 one-hot matmul, int32 accumulation — exact on
-                # every backend; the sentinel vb maps to hi == nhi,
-                # whose one-hot row is all-zero, so invalid rows join
-                # no bin
-                hi = (lc // S_LO).astype(jnp.int32)
-                lo6 = (lc % S_LO).astype(jnp.int32)
-                H = (hi[:, None] == jnp.arange(nhi)[None, :]).astype(jnp.int8)
-                L = (lo6[:, None] == jnp.arange(S_LO)[None, :]).astype(jnp.int8)
-                return jax.lax.dot_general(H, L, (((0,), (0,)), ((), ())),
-                                           preferred_element_type=jnp.int32)
-
-            local = jax.vmap(hist_one)(lo_m)     # (C, nhi, 64)
-        gcounts = jax.lax.psum(local, AXIS)      # THE merge: one psum,
-        # constant nhi*64*4 B per column regardless of rows or k
-        rt, ulo, gk = presence_to_dict(gcounts, nhi)
-        if pack != "xla":
-            ranks = rank_pages_core(lo_m, rt,
-                                    interpret=pack == "interpret")
-            masked = jnp.where(valid[None, :], ranks.astype(jnp.uint32), 0)
-        else:
-            def rank_one(lc, rt_c):
-                safe = jnp.where(valid, lc, 0)
-                return rt_c.reshape(-1)[safe].astype(jnp.uint32)
-
-            masked = jnp.where(valid[None, :],
-                               jax.vmap(rank_one)(l, rt), 0)
+        masked, ulo, gk, rows = _bounded_merge_core(l, c, nhi=nhi, pack=pack)
         packed = jax.vmap(lambda m: bitpack_device(m, width))(masked)
-        rows = jax.lax.psum(count, AXIS)
         ovf = jnp.max((gk > (1 << width)).astype(jnp.int32))
         return packed, ulo, gk, rows, ovf
 
@@ -121,6 +129,21 @@ def _sharded_bounded_impl(lo, counts, *, mesh: Mesh, width: int, nhi: int,
         kernel, mesh=mesh,
         in_specs=(P(None, AXIS), P(AXIS)),
         out_specs=(P(None, AXIS), P(), P(), P(), P()),
+        check_vma=False,  # replicated-by-construction, as in dict_merge
+    )
+    return fn(lo, counts)
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "nhi", "pack"))
+def _bounded_indices_impl(lo, counts, *, mesh: Mesh, nhi: int, pack: str):
+    """The production-route variant: same psum merge, but the per-row
+    dictionary indices come back RAW (uint32, sharded) instead of
+    bit-packed — the writer's native page assembly owns the pack."""
+    fn = jax.shard_map(
+        lambda l, c: _bounded_merge_core(l, c, nhi=nhi, pack=pack),
+        mesh=mesh,
+        in_specs=(P(None, AXIS), P(AXIS)),
+        out_specs=(P(None, AXIS), P(), P(), P()),
         check_vma=False,  # replicated-by-construction, as in dict_merge
     )
     return fn(lo, counts)
@@ -163,7 +186,10 @@ def sharded_encode_step_bounded(lo, counts, *, mesh: Mesh, width: int = 16,
         raise ValueError(f"value_bound={value_bound} exceeds the "
                          f"histogram-psum design bound {_MATMUL_MAX_BOUND}")
     n_local = lo.shape[1] // max(mesh.shape[AXIS], 1)
-    pal, interp = use_pallas(lo.shape[0] * lo.shape[1])
+    # the kernels run on per-shard slices: size the Pallas heuristic by the
+    # per-shard batch, not the global one (ADVICE r4 — on a large mesh the
+    # global size can clear the minimum while each shard's slice is tiny)
+    pal, interp = use_pallas(lo.shape[0] * n_local)
     pack = ("interpret" if pal and interp else "pallas" if pal else "xla")
     if n_local % 128:
         pack = "xla"  # kernel layout needs whole lane rows per shard
@@ -172,6 +198,104 @@ def sharded_encode_step_bounded(lo, counts, *, mesh: Mesh, width: int = 16,
             return _sharded_bounded_impl(lo, counts, mesh=mesh, width=width,
                                          nhi=nhi, pack=pack)
     raise AssertionError("unreachable: buckets cover the design bound")
+
+
+def bounded_global_dictionary_encode(values, mesh: Mesh, *, vmin: int,
+                                     stride: int, value_bound: int,
+                                     dispatch_lock=None,
+                                     stats_out: dict | None = None):
+    """Writer-reachable histogram-psum dictionary merge (VERDICT r4 next
+    #2): the production counterpart of
+    ``dict_merge.global_dictionary_encode`` for planner-bounded integer
+    columns — ``(values - vmin) / stride`` lies in ``[0, value_bound)``
+    with ``value_bound <= 2^13`` (derive vmin/stride/bound from the fused
+    native min/max/gcd stats pass, ops.dictionary._int_stats — never a
+    guess: a violated bound silently corrupts the histogram).
+
+    The global merge is ONE ``psum`` of per-shard bin-count histograms —
+    a CONSTANT :func:`bounded_psum_payload_bytes` per column over ICI,
+    independent of rows/shard and cardinality, vs the gather route's
+    ``pad_bucket(k_max)``-proportional payload.  Returns
+    (dict_values ascending, indices) as host arrays, byte-identical to
+    the gather merge and the host backends: offsets are non-negative, so
+    ascending offset order IS ascending bit-pattern order of the
+    reconstructed ``vmin + stride * offset`` values (callers guard
+    ``vmin >= 0`` for exactly this reason).
+
+    ``stats_out`` accumulates ``bounded_columns`` /
+    ``bounded_psum_bytes`` next to the gather route's keys so the cfg4
+    artifact records which merge each column rode."""
+    import contextlib
+
+    import numpy as np
+
+    from ..ops.packing import pad_bucket, use_pallas
+
+    if int(value_bound) > _MATMUL_MAX_BOUND:
+        raise ValueError(f"value_bound={value_bound} exceeds the "
+                         f"histogram-psum design bound {_MATMUL_MAX_BOUND}")
+    if int(vmin) < 0:
+        # byte-identity depends on it: ascending offsets reconstruct to
+        # ascending BIT-PATTERN order only for non-negative values (a
+        # negative int64 sorts above the positives by bit pattern)
+        raise ValueError(f"vmin={vmin} < 0: bounded route requires "
+                         "non-negative values for bit-pattern dict order")
+    arr = np.ascontiguousarray(values)
+    n = len(arr)
+    t = arr.dtype.type
+    if stride > 1 and n and ((arr - t(vmin)) % t(stride)).any():
+        # a non-dividing stride floor-divides distinct values onto one
+        # offset — silent dictionary corruption; refuse loudly (the
+        # production caller derives stride from the gcd pass, which
+        # divides by construction — this guards direct callers)
+        raise ValueError(f"stride={stride} does not divide every "
+                         f"(value - vmin): offsets would collide")
+    offsets = (arr - t(vmin)) // t(stride)
+    if n and int(offsets.max()) >= int(value_bound):
+        raise ValueError(
+            f"max offset {int(offsets.max())} >= value_bound={value_bound}: "
+            "a violated bound silently corrupts the histogram")
+    n_shards = mesh.devices.size
+    rows_per = max((n + n_shards - 1) // n_shards, 1)
+    per = pad_bucket(rows_per)  # power of two >= 256: n_local % 128 == 0
+    lo_p = np.zeros(n_shards * per, np.uint32)
+    counts = np.zeros(n_shards, np.int32)
+    for s in range(n_shards):
+        a = s * rows_per
+        take = max(0, min(rows_per, n - a))
+        if take:
+            lo_p[s * per : s * per + take] = offsets[a : a + take]
+        counts[s] = take
+    pal, interp = use_pallas(per)  # per-shard batch sizes the heuristic
+    pack = "interpret" if pal and interp else "pallas" if pal else "xla"
+    nhi = next(b for b in _MATMUL_NHI_BUCKETS if b * 64 >= int(value_bound))
+    shard = NamedSharding(mesh, P(AXIS))
+    with dispatch_lock if dispatch_lock is not None else contextlib.nullcontext():
+        lo_d = jax.device_put(lo_p.reshape(1, -1),
+                              NamedSharding(mesh, P(None, AXIS)))
+        cnt_d = jax.device_put(counts, shard)
+        idx_d, ulo_d, gk_d, rows_d = _bounded_indices_impl(
+            lo_d, cnt_d, mesh=mesh, nhi=nhi, pack=pack)
+        gk = int(jax.device_get(gk_d)[0])
+        rows_i = int(jax.device_get(rows_d))
+        ulo = np.asarray(ulo_d)[0]
+        idx = np.asarray(idx_d)[0]
+        if stats_out is not None:
+            # inside the dispatch lock, like dict_merge's accounting: a
+            # shared stats dict under concurrent workers must not take
+            # unlocked read-modify-writes
+            stats_out["bounded_columns"] = (
+                stats_out.get("bounded_columns", 0) + 1)
+            stats_out["bounded_psum_bytes"] = (
+                stats_out.get("bounded_psum_bytes", 0) + nhi * 64 * 4)
+            stats_out["bounded_nhi_max"] = max(
+                stats_out.get("bounded_nhi_max", 0), nhi)
+    assert rows_i == n
+    dict_values = (ulo[:gk].astype(np.uint64) * np.uint64(stride)
+                   + np.uint64(vmin)).astype(arr.dtype)
+    parts = [idx[s * per : s * per + int(counts[s])] for s in range(n_shards)]
+    out_idx = np.concatenate(parts) if parts else np.zeros(0, np.uint32)
+    return dict_values, out_idx
 
 
 # Static pack-width buckets for the device kernels: a fully static program

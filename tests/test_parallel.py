@@ -43,6 +43,55 @@ def test_global_dictionary_roundtrip(mesh8, dtype):
     assert (np.diff(keys.astype(np.uint64)) > 0).all()
 
 
+@pytest.mark.parametrize("dtype,lo,hi,stride", [
+    (np.int64, 0, 2000, 1),        # plain bounded span
+    (np.int32, 5, 260, 1),         # nonzero vmin, int32
+    (np.int64, 0, 3000, 25),       # gcd-strided (cfg2 cent amounts)
+    (np.uint32, 0, 8192, 1),       # full design bound 2^13
+    (np.int64, 7, 8, 1),           # constant-ish: 1-2 uniques
+])
+def test_bounded_psum_dictionary_identity(mesh8, dtype, lo, hi, stride):
+    """The writer-reachable histogram-psum merge returns the exact
+    (dictionary, indices) the gather merge does, at every eligible shape
+    (VERDICT r4 next #2)."""
+    from kpw_tpu.parallel.sharded import bounded_global_dictionary_encode
+
+    rng = np.random.default_rng(int(lo) + int(hi))
+    values = (rng.integers(lo, hi, 4099) * stride).astype(dtype)
+    vmin = int(values.min())
+    vb = (int(values.max()) - vmin) // stride + 1
+    d, idx = bounded_global_dictionary_encode(
+        values, mesh8, vmin=vmin, stride=stride, value_bound=vb)
+    dg, idxg = global_dictionary_encode(values, mesh8, cap=None)
+    np.testing.assert_array_equal(d, dg)
+    np.testing.assert_array_equal(idx, idxg)
+    np.testing.assert_array_equal(d[idx], values)
+
+
+def test_bounded_psum_rejects_overwide_bound(mesh8):
+    from kpw_tpu.parallel.sharded import bounded_global_dictionary_encode
+
+    with pytest.raises(ValueError, match="design bound"):
+        bounded_global_dictionary_encode(
+            np.arange(100, dtype=np.int64), mesh8, vmin=0, stride=1,
+            value_bound=(1 << 13) + 1)
+
+
+def test_mesh_encoder_bounded_route_selection():
+    """_bounded_route consults the fused stats: engages on non-negative
+    bounded/strided ints, refuses negatives, wide spans, and floats."""
+    from kpw_tpu.parallel.mesh_encoder import MeshChunkEncoder
+
+    r = MeshChunkEncoder._bounded_route
+    rng = np.random.default_rng(3)
+    assert r(rng.integers(0, 2000, 512).astype(np.int64)) is not None
+    vmin, g, vb = r((rng.integers(0, 3000, 512) * 25 + 7).astype(np.int64))
+    assert g == 25 and vb <= 3000 and vmin >= 7
+    assert r(rng.integers(-5, 100, 512).astype(np.int64)) is None
+    assert r(rng.integers(0, 1 << 40, 512).astype(np.int64)) is None
+    assert r((rng.integers(0, 30, 512) / 4.0)) is None
+
+
 def test_global_dictionary_matches_local_set(mesh8):
     rng = np.random.default_rng(1)
     values = rng.integers(-300, 300, 5000).astype(np.int64)
@@ -291,8 +340,9 @@ def test_writer_streams_through_mesh_backend(mesh8):
     b = (Builder().broker(broker).topic("t").proto_class(cls)
          .target_dir("/out").filesystem(fs).instance_name("mesh")
          .max_file_open_duration_seconds(1.0))
-    b.encoder_backend(MeshChunkEncoder(b.writer_properties().encoder_options(),
-                                       mesh=mesh8))
+    menc = MeshChunkEncoder(b.writer_properties().encoder_options(),
+                            mesh=mesh8)
+    b.encoder_backend(menc)
     w = b.build()
     with w:
         deadline = time.time() + 30
@@ -313,6 +363,11 @@ def test_writer_streams_through_mesh_backend(mesh8):
             t = pq.read_table(io.BytesIO(fh.read()))
         got.update(t["timestamp"].to_pylist())
     assert got == sent
+    # the timestamp column (0..1999, planner-stats bounded <= 2^13) must
+    # have ridden the histogram-psum merge on the production writer path
+    # (VERDICT r4 next #2), with its constant ICI payload recorded
+    assert menc.ici_stats.get("bounded_columns", 0) >= 1, menc.ici_stats
+    assert menc.ici_stats.get("bounded_psum_bytes", 0) > 0, menc.ici_stats
 
 
 def test_mesh_backend_multi_worker_threads():
