@@ -1632,7 +1632,7 @@ def _derive_median_projection(c2: dict | None) -> None:
     proj["median"] = med
 
 
-def _attach_sweep_context(out: dict) -> None:
+def _attach_sweep_context(out: dict, same_platform: bool | None = None) -> None:
     """Attach the committed sweep's distributions (same-platform merged
     history) to the graded line so a single unlucky — or fallback — run
     never stands alone.  Runs jax-free: provenance is carried via the
@@ -1647,6 +1647,12 @@ def _attach_sweep_context(out: dict) -> None:
         c2 = rec.get("configs", {}).get("config2", {})
         ctx: dict = {"sweep_runs": rec.get("sweep_runs"),
                      "sweep_devices": rec.get("devices")}
+        if same_platform is not None:
+            # a cpu-fallback graded line still carries the TPU sweep's
+            # distributions (that is the point of the fallback — the
+            # on-chip story must not vanish with a sick tunnel), but the
+            # mismatch is declared, not implied
+            ctx["graded_on_sweep_platform"] = same_platform
         for k in ("vs_dist", "rowgroup_ms_dist"):
             if k in c2:
                 ctx[k] = c2[k]
@@ -1770,7 +1776,7 @@ def _graded_main() -> None:
     out["graded_platform"] = used
     if used == "cpu-fallback":
         out["tpu_platform"] = "cpu-fallback"
-    _attach_sweep_context(out)
+    _attach_sweep_context(out, same_platform=(used == "tpu"))
     out["bench_wall_s"] = round(time.time() - t0, 1)
     try:
         os.remove(partial_path)

@@ -330,7 +330,12 @@ class CpuChunkEncoder:
                     if len(dict_plain) <= opts.dictionary_page_size_limit:
                         use_dict = True
 
-        blob = bytearray()
+        # Pages accumulate as a PARTS LIST joined once at the end: one
+        # exact-size allocation and copy, instead of bytearray doubling
+        # plus a bytes() bounce (measured ~2x the output volume in pure
+        # memcpy on the 64-col uncompressed shape).
+        blob_parts: list = []
+        blob_len = 0
         encodings = set()
         dict_page_len = 0
         total_uncompressed = 0
@@ -350,8 +355,13 @@ class CpuChunkEncoder:
                                    else [comp_buf]),
             )
             dictionary_page_offset = base_offset
-            blob += header
-            blob += dict_plain if comp_buf is None else comp_buf
+            blob_parts.append(header)
+            # comp_buf may be a REUSED compressor scratch (native zstd/
+            # snappy paths): it must be materialized before the next page
+            # overwrites it — the join at the end reads parts lazily
+            blob_parts.append(dict_plain if comp_buf is None
+                              else bytes(comp_buf))
+            blob_len += len(header) + comp_len
             dict_page_len = len(header) + comp_len
             total_uncompressed += len(header) + len(dict_plain)
             total_compressed += len(header) + comp_len
@@ -398,13 +408,13 @@ class CpuChunkEncoder:
                                    else [comp_buf]),
             )
             if data_page_offset is None:
-                data_page_offset = base_offset + len(blob)
-            blob += header
+                data_page_offset = base_offset + blob_len
+            blob_parts.append(header)
             if comp_buf is None:
-                for p in parts:  # uncompressed: append verbatim, no concat
-                    blob += p
+                blob_parts.extend(parts)  # uncompressed: verbatim, no concat
             else:
-                blob += comp_buf
+                blob_parts.append(bytes(comp_buf))  # scratch: see dict page
+            blob_len += len(header) + comp_len
             total_uncompressed += len(header) + body_len
             total_compressed += len(header) + comp_len
 
@@ -434,4 +444,4 @@ class CpuChunkEncoder:
             dictionary_page_offset=dictionary_page_offset,
             statistics=stats,
         )
-        return EncodedChunk(bytes(blob), meta, dict_page_len)
+        return EncodedChunk(b"".join(blob_parts), meta, dict_page_len)

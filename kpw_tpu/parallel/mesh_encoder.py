@@ -236,7 +236,8 @@ class MeshChunkEncoder(NativeChunkEncoder):
             col_stats: dict = {}
             d, idx = bounded_global_dictionary_encode(
                 values, self.mesh, vmin=vmin, stride=stride, value_bound=vb,
-                dispatch_lock=_DISPATCH_LOCK, stats_out=col_stats)
+                dispatch_lock=_DISPATCH_LOCK, stats_out=col_stats,
+                trusted=True)  # vmin/stride/vb come from the fused stats
             self._merge_stats(col_stats)
             accepted = len(d) <= max_k
             self.route_log.append({
@@ -254,6 +255,12 @@ class MeshChunkEncoder(NativeChunkEncoder):
                                               stats_out=col_stats)
         except DictionaryOverflow:
             self._merge_stats(col_stats)
+            # the rejection is part of the routing record too: without it
+            # the cfg4 writer_route block would list fewer columns than
+            # the file has dict-eligible ones, with no indication why
+            self.route_log.append({
+                "column": chunk.column.name, "route": "two-phase-gather",
+                "accepted": False, "overflow": True})
             return None  # per-shard cardinality overflow (explicit cap)
         self._merge_stats(col_stats)
         accepted = len(d) <= max_k
