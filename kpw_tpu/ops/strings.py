@@ -1,0 +1,157 @@
+"""Device-side BYTE_ARRAY dictionary build (VERDICT r4 next #8 probe).
+
+Strings are the one dictionary family with no device path: the production
+route is the C++ host hash (native/src/encode.cc dict_build_bytes), with a
+k-way union for mesh merges.  This module prototypes the device
+formulation so its win/loss can be measured honestly at cfg1's shape.
+
+The trick is a SINGLE u64 sort key per string that is bijective and
+order-preserving for short strings:
+
+    key = (first 7 bytes, zero-padded, big-endian) << 8 | min(len, 8)
+
+- big-endian packing makes u64 ascending == lexicographic ascending of
+  the 7-byte prefix;
+- the length byte disambiguates zero-padding (b"a" vs b"a\\x00") and
+  orders a string before its proper extensions ("ab" < "abc"), matching
+  bytes comparison;
+- two DISTINCT strings map to the same key only when both have len >= 8
+  and share their first 7 bytes — exactly the groups that need a host
+  tie-break (suffix sort), detectable as key-groups containing a row
+  with len >= 8.  Everything else reconstructs from the key alone, no
+  per-row host work.
+
+The u64 keys then ride the existing device dictionary machinery
+(ops.dictionary.DictBuildHandle -> the fused build sort on TPU), and the
+host splices tie-broken groups into the ascending order.  Output is
+byte-identical to core.encodings.dictionary_build / the C++ host hash
+(asserted in tests/test_strings_device.py).
+
+Reference behavior anchor: parquet-mr's DictionaryValuesWriter builds one
+byte-array hash per column on the host (SURVEY.md §2.2); this is the
+TPU-native counter-design, not a translation.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.bytecol import ByteColumn
+
+
+def prefix_keys(col: ByteColumn) -> np.ndarray:
+    """(n,) uint64 sort keys: 7 zero-padded prefix bytes big-endian, then
+    min(len, 8) in the low byte (see module docstring for why this is
+    order-preserving and near-bijective)."""
+    n = len(col)
+    if n == 0:
+        return np.zeros(0, np.uint64)
+    data = np.frombuffer(col.data, np.uint8) if not isinstance(
+        col.data, np.ndarray) else col.data.view(np.uint8)
+    offs = col.offsets
+    starts = offs[:-1]
+    lens = np.diff(offs)
+    take = np.minimum(lens, 7)
+    if len(data) == 0:
+        # all rows are empty strings: no bytes to gather, keys are pure
+        # length bytes (all zero) — the fancy index below would read a
+        # zero-length array
+        return np.zeros(n, np.uint64)
+    # gather a (n, 7) byte block; rows shorter than 7 read clamped
+    # positions and are masked to the zero pad
+    j = np.arange(7)
+    idx = np.minimum(starts[:, None] + j, len(data) - 1)
+    block = np.where(j[None, :] < take[:, None], data[idx], 0)
+    key = np.zeros(n, np.uint64)
+    for b in range(7):  # 7 shifts over vectors, not a per-row loop
+        key |= block[:, b].astype(np.uint64) << np.uint64(8 * (7 - b))
+    key |= np.minimum(lens, 8).astype(np.uint64)
+    return key
+
+
+def _key_to_bytes(key: int) -> bytes:
+    """Inverse of :func:`prefix_keys` for unambiguous keys (len <= 7 or
+    the canonical prefix of a len-8 marker)."""
+    ln = key & 0xFF
+    pre = int(key >> 8).to_bytes(7, "big")
+    return pre[: min(ln, 7)]
+
+
+def device_string_dictionary(col: ByteColumn, max_k: int | None = None,
+                             timings: dict | None = None):
+    """Byte-array dictionary via the device key build + host tie-break.
+
+    Returns (dict_values list[bytes] ascending lexicographic, indices
+    uint32) identical to ``core.encodings.dictionary_build``, or None when
+    the unique count exceeds ``max_k`` (the host paths' abort contract).
+    ``timings`` (optional dict) receives the phase breakdown in ms —
+    ``prefix_ms`` (host key extraction), ``device_ms`` (key dictionary
+    build incl. readback), ``tiebreak_ms`` (host suffix resolution) — so
+    the bench probe can report where the time goes.
+    """
+    import time
+
+    from .dictionary import DictBuildHandle
+
+    n = len(col)
+    t0 = time.perf_counter()
+    keys = prefix_keys(col)
+    t1 = time.perf_counter()
+    if n == 0:
+        return [], np.zeros(0, np.uint32)
+    handle = DictBuildHandle(keys)
+    kdict, kidx = handle.result()
+    # device batches pad rows to the static bucket: trim to the real n
+    kidx = np.asarray(kidx)[:n].astype(np.uint32, copy=False)
+    t2 = time.perf_counter()
+    k_keys = len(kdict)
+    lens = np.diff(col.offsets)
+    # ambiguous key-groups: contain a row with len >= 8 (key bijective
+    # otherwise).  Distinct suffixes expand such a group into several
+    # dictionary slots; lexicographic order within the group equals
+    # suffix order (shared 7-byte prefix).
+    ambiguous = np.zeros(k_keys, bool)
+    long_rows = np.nonzero(lens >= 8)[0]
+    ambiguous[kidx[long_rows]] = True
+    t_tie0 = time.perf_counter()
+    if not ambiguous.any():
+        dict_values = [_key_to_bytes(int(k)) for k in kdict]
+        out_idx = kidx
+        if max_k is not None and len(dict_values) > max_k:
+            return None
+    else:
+        # per ambiguous group: sort the distinct full strings; splice
+        group_members: dict[int, dict[bytes, int]] = {}
+        for r in long_rows:
+            g = int(kidx[r])
+            group_members.setdefault(g, {}).setdefault(col[int(r)], 0)
+        extra = np.zeros(k_keys, np.int64)  # additional slots per group
+        group_rank: dict[int, dict[bytes, int]] = {}
+        group_order: dict[int, list[bytes]] = {}
+        for g, members in group_members.items():
+            ordered = sorted(members)
+            group_order[g] = ordered
+            group_rank[g] = {v: i for i, v in enumerate(ordered)}
+            extra[g] = len(ordered) - 1
+        base = np.concatenate([[0], np.cumsum(extra)[:-1]])  # slot shift
+        dict_values: list[bytes] = []
+        for g in range(k_keys):
+            if ambiguous[g]:
+                dict_values.extend(group_order[g])
+            else:
+                dict_values.append(_key_to_bytes(int(kdict[g])))
+        if max_k is not None and len(dict_values) > max_k:
+            return None
+        out_idx = (kidx.astype(np.int64) + base[kidx]).astype(np.uint32)
+        if long_rows.size:
+            # rows in ambiguous groups add their within-group rank
+            sub = np.fromiter(
+                (group_rank[int(kidx[r])][col[int(r)]] for r in long_rows),
+                np.uint32, long_rows.size)
+            out_idx[long_rows] += sub
+    t3 = time.perf_counter()
+    if timings is not None:
+        timings["prefix_ms"] = round((t1 - t0) * 1e3, 3)
+        timings["device_ms"] = round((t2 - t1) * 1e3, 3)
+        timings["tiebreak_ms"] = round((t3 - t_tie0) * 1e3, 3)
+    return dict_values, out_idx

@@ -1,0 +1,74 @@
+"""Device-side BYTE_ARRAY dictionary probe (ops/strings.py): output must be
+byte-identical to the CPU oracle across the tricky shapes — zero-padding
+vs short strings, shared prefixes with divergent suffixes, empties, and
+the cfg1 pool shape the bench probe measures."""
+
+import numpy as np
+import pytest
+
+from kpw_tpu.core.bytecol import ByteColumn
+from kpw_tpu.core.encodings import dictionary_build
+from kpw_tpu.core.schema import PhysicalType
+from kpw_tpu.ops.strings import device_string_dictionary, prefix_keys
+
+
+def _check(values: list[bytes], max_k=None):
+    col = ByteColumn.from_list(values)
+    want = dictionary_build(values, PhysicalType.BYTE_ARRAY)
+    got = device_string_dictionary(col, max_k=max_k)
+    assert got is not None
+    d, idx = got
+    assert d == list(want[0])
+    np.testing.assert_array_equal(idx, want[1])
+    # reconstruct
+    assert [d[i] for i in idx] == values
+
+
+def test_cfg1_pool_shape():
+    rng = np.random.default_rng(0)
+    pool = [b"cat_%03d" % j for j in range(100)]
+    _check([pool[k] for k in rng.integers(0, 100, 4096)])
+
+
+def test_short_strings_and_zero_padding():
+    # b"a" vs b"a\x00" vs b"a\x00\x00": same zero-padded prefix, distinct
+    # lengths -> distinct keys; order: "a" < "a\x00" < "a\x00\x00"
+    _check([b"a", b"a\x00", b"a\x00\x00", b"", b"a", b"b"] * 10)
+
+
+def test_long_shared_prefix_tiebreak():
+    # len >= 8 with identical first 7 bytes: one key group, host suffix sort
+    vals = [b"prefix_AAA", b"prefix_BBB", b"prefix_", b"prefix_A",
+            b"prefix_AAA", b"prefix_ABC", b"prefixZ"] * 7
+    _check(vals)
+
+
+def test_long_vs_exact7_order():
+    # a 7-byte string sorts before every 8+ extension of it
+    _check([b"abcdefg", b"abcdefgh", b"abcdefg!", b"abcdefg"] * 5)
+
+
+def test_mixed_random_lengths():
+    rng = np.random.default_rng(3)
+    vals = [bytes(rng.integers(97, 123, rng.integers(0, 14)).astype(np.uint8))
+            for _ in range(3000)]
+    _check(vals)
+
+
+def test_all_empty_strings():
+    _check([b""] * 20)
+
+
+def test_max_k_abort():
+    vals = [b"v%06d" % i for i in range(100)]
+    col = ByteColumn.from_list(vals)
+    assert device_string_dictionary(col, max_k=10) is None
+
+
+def test_prefix_keys_order_matches_bytes_order():
+    rng = np.random.default_rng(5)
+    vals = sorted(set(
+        bytes(rng.integers(0, 256, rng.integers(0, 7)).astype(np.uint8))
+        for _ in range(500)))
+    keys = prefix_keys(ByteColumn.from_list(vals))
+    assert (np.diff(keys.astype(np.int64)) > 0).all()
