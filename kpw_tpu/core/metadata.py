@@ -9,7 +9,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 
-from .schema import PhysicalType, Repetition  # noqa: F401  (re-export convenience)
+from .schema import Encoding, PageType, PhysicalType, Repetition  # noqa: F401  (re-export convenience)
 from .thrift import CT_BINARY, CT_I32, CT_I64, CT_STRUCT, CompactWriter
 
 CREATED_BY = "kpw_tpu version 0.1.0 (build tpu-native)"
@@ -90,6 +90,53 @@ class DictionaryPageHeader:
         w.struct_end()
 
 
+def _zzv(out: bytearray, n: int) -> None:
+    """zigzag varint straight into ``out`` (the compact protocol's i32/i64
+    value encoding; python ints, so one formula covers both widths)."""
+    n = (n << 1) ^ (n >> 63) if n < 0 else n << 1
+    while n >= 0x80:
+        out.append((n & 0x7F) | 0x80)
+        n >>= 7
+    out.append(n)
+
+
+def fast_data_page_header(uncompressed_size: int, compressed_size: int,
+                          num_values: int, encoding: int) -> bytes:
+    """The v1 DATA_PAGE header's exact compact-thrift bytes, composed
+    directly — byte-identical to :func:`write_page_header` for the no-CRC
+    RLE-levels shape (asserted over randomized values in
+    tests/test_parquet_core.py) but without the per-field writer dispatch,
+    which profiled at ~7% of the whole 64-column uncompressed encode."""
+    o = bytearray(b"\x15\x00\x15")  # field1 i32 type=0(zz=0); field2 hdr
+    _zzv(o, uncompressed_size)
+    o.append(0x15)  # field 3 i32
+    _zzv(o, compressed_size)
+    o.append(0x2C)  # field 5 struct (delta 2: CRC field 4 absent)
+    o.append(0x15)  # .field 1 i32 num_values
+    _zzv(o, num_values)
+    o.append(0x15)  # .field 2 i32 encoding
+    _zzv(o, encoding)
+    # .fields 3/4: definition/repetition level encoding, always RLE (3)
+    o += b"\x15\x06\x15\x06\x00\x00"  # + inner stop + outer stop
+    return bytes(o)
+
+
+def fast_dict_page_header(uncompressed_size: int, compressed_size: int,
+                          num_values: int, encoding: int) -> bytes:
+    """DICTIONARY_PAGE counterpart of :func:`fast_data_page_header`."""
+    o = bytearray(b"\x15\x04\x15")  # field1 i32 type=2 (zz=4); field2 hdr
+    _zzv(o, uncompressed_size)
+    o.append(0x15)  # field 3 i32
+    _zzv(o, compressed_size)
+    o.append(0x4C)  # field 7 struct (delta 4)
+    o.append(0x15)  # .field 1 i32 num_values
+    _zzv(o, num_values)
+    o.append(0x15)  # .field 2 i32 encoding
+    _zzv(o, encoding)
+    o += b"\x00\x00"  # inner stop + outer stop
+    return bytes(o)
+
+
 def write_page_header(
     page_type: int,
     uncompressed_size: int,
@@ -99,6 +146,21 @@ def write_page_header(
     v2_header: DataPageHeaderV2 | None = None,
     crc: int | None = None,
 ) -> bytes:
+    if crc is None and v2_header is None:
+        # hot shapes ride the direct composers (identical bytes)
+        if (data_header is not None and dict_header is None
+                and page_type == PageType.DATA_PAGE
+                and data_header.statistics is None
+                and data_header.definition_level_encoding == Encoding.RLE
+                and data_header.repetition_level_encoding == Encoding.RLE):
+            return fast_data_page_header(
+                uncompressed_size, compressed_size,
+                data_header.num_values, data_header.encoding)
+        if (dict_header is not None and data_header is None
+                and page_type == PageType.DICTIONARY_PAGE):
+            return fast_dict_page_header(
+                uncompressed_size, compressed_size,
+                dict_header.num_values, dict_header.encoding)
     w = CompactWriter()
     w.struct_begin()
     w.field_i32(1, page_type)

@@ -24,6 +24,49 @@ from kpw_tpu.core import encodings as enc
 # encoding unit tests
 # ---------------------------------------------------------------------------
 
+
+def test_fast_page_headers_match_generic_writer():
+    """The direct compact-thrift composers must produce the generic
+    CompactWriter path's exact bytes across the varint size spectrum."""
+    from kpw_tpu.core.metadata import (DataPageHeader, DictionaryPageHeader,
+                                       PageType, CompactWriter)
+    from kpw_tpu.core.schema import Encoding
+    from kpw_tpu.core import metadata as md
+
+    def generic(page_type, unc, comp, data_header=None, dict_header=None):
+        # the pre-fast-path serializer, replicated verbatim as the oracle
+        w = CompactWriter()
+        w.struct_begin()
+        w.field_i32(1, page_type)
+        w.field_i32(2, unc)
+        w.field_i32(3, comp)
+        if data_header is not None:
+            w._field_header(5, 12)  # CT_STRUCT
+            data_header.write(w)
+        if dict_header is not None:
+            w._field_header(7, 12)
+            dict_header.write(w)
+        w.struct_end()
+        return w.getvalue()
+
+    rng = np.random.default_rng(0)
+    sizes = [0, 1, 63, 64, 127, 128, 16383, 16384, 1 << 20, (1 << 31) - 1]
+    sizes += [int(v) for v in rng.integers(0, 1 << 28, 20)]
+    for unc in sizes:
+        for nv in (0, 1, 300, 65536, int(rng.integers(0, 1 << 22))):
+            for encd in (Encoding.PLAIN, Encoding.PLAIN_DICTIONARY,
+                         Encoding.DELTA_BINARY_PACKED):
+                dh = DataPageHeader(nv, encd, Encoding.RLE, Encoding.RLE)
+                assert md.write_page_header(
+                    PageType.DATA_PAGE, unc, unc // 2, data_header=dh
+                ) == generic(PageType.DATA_PAGE, unc, unc // 2,
+                             data_header=dh)
+                kh = DictionaryPageHeader(nv, encd)
+                assert md.write_page_header(
+                    PageType.DICTIONARY_PAGE, unc, unc // 2, dict_header=kh
+                ) == generic(PageType.DICTIONARY_PAGE, unc, unc // 2,
+                             dict_header=kh)
+
 def test_bitpack_roundtrip():
     rng = np.random.default_rng(0)
     for width in [1, 2, 3, 5, 7, 8, 12, 17, 31]:
