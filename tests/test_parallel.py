@@ -415,6 +415,55 @@ def test_mesh_backend_multi_worker_threads():
     assert got == sent
 
 
+def test_shared_encoder_stats_exact_under_threads(mesh8):
+    """Workers can SHARE one MeshChunkEncoder (runtime/writer.py hands the
+    same backend object to every worker): ici_stats counters and route_log
+    must come out EXACT under concurrent encodes — per-call local dicts
+    merged under the stats lock, never unlocked read-modify-writes on the
+    shared dicts (review finding, round 5)."""
+    import threading
+
+    from kpw_tpu.core import Schema, WriterProperties, leaf
+    from kpw_tpu.core.pages import ColumnChunkData
+    from kpw_tpu.parallel.mesh_encoder import MeshChunkEncoder
+
+    schema = Schema([leaf("b", "int64"), leaf("w", "int64")])
+    enc_opts = WriterProperties().encoder_options()
+    menc = MeshChunkEncoder(enc_opts, mesh=mesh8)
+    PER_THREAD, THREADS = 4, 4
+
+    def chunk_for(col_i, arr):
+        return ColumnChunkData(schema.columns[col_i], arr,
+                               num_rows=len(arr))
+
+    errs: list = []
+
+    def worker(seed):
+        try:
+            r = np.random.default_rng(seed)
+            for _ in range(PER_THREAD):
+                bounded = r.integers(0, 1500, 4096).astype(np.int64)
+                wide = r.integers(-700, 700, 4096).astype(np.int64)
+                assert menc._try_dictionary(chunk_for(0, bounded)) is not None
+                assert menc._try_dictionary(chunk_for(1, wide)) is not None
+        except Exception as e:  # pragma: no cover - failure reporting
+            errs.append(e)
+
+    ts = [threading.Thread(target=worker, args=(s,)) for s in range(THREADS)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert not errs, errs
+    total = THREADS * PER_THREAD
+    assert menc.ici_stats["bounded_columns"] == total
+    assert menc.ici_stats["columns"] == total  # gather-side counter
+    routes = [e["route"] for e in menc.route_log]
+    assert routes.count("bounded-psum") == total
+    assert routes.count("two-phase-gather") == total
+    assert all(e["accepted"] for e in menc.route_log)
+
+
 def test_dispatch_lock_covers_only_device_section(mesh8, monkeypatch):
     """The mesh dispatch lock serializes collective launches but NOT the
     host prep (key split / shard padding / reassembly): concurrent encodes

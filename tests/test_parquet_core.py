@@ -67,6 +67,51 @@ def test_fast_page_headers_match_generic_writer():
                 ) == generic(PageType.DICTIONARY_PAGE, unc, unc // 2,
                              dict_header=kh)
 
+def test_fast_column_chunk_matches_generic_writer():
+    """The direct footer composer must match ColumnChunk.write (the
+    generic per-field path) byte for byte across every optional-field
+    combination and varint width."""
+    from kpw_tpu.core.metadata import (ColumnChunk, ColumnMetaData,
+                                       CompactWriter, Statistics,
+                                       fast_column_chunk)
+
+    rng = np.random.default_rng(1)
+    stats_variants = [
+        None,
+        Statistics(),
+        Statistics(null_count=0),
+        Statistics(null_count=12345, min_value=b"\x00" * 8,
+                   max_value=b"\xff" * 8),
+        Statistics(distinct_count=7, min_value=b"a"),
+        Statistics(max_value=b"z" * 130),
+    ]
+    for trial in range(40):
+        st = stats_variants[trial % len(stats_variants)]
+        # every 8th trial exercises the long-form (>= 15 element) list
+        # headers for both the encodings and path lists
+        long_lists = trial % 8 == 7
+        cc = ColumnChunk(
+            file_offset=int(rng.integers(0, 1 << 40)),
+            meta_data=ColumnMetaData(
+                type=int(rng.integers(0, 8)),
+                encodings=sorted(int(v) for v in rng.integers(
+                    0, 9, 17 if long_lists else rng.integers(1, 5))),
+                path_in_schema=[f"seg{j}" for j in range(
+                    16 if long_lists else int(rng.integers(1, 4)))],
+                codec=int(rng.integers(0, 7)),
+                num_values=int(rng.integers(0, 1 << 33)),
+                total_uncompressed_size=int(rng.integers(0, 1 << 33)),
+                total_compressed_size=int(rng.integers(0, 1 << 33)),
+                data_page_offset=int(rng.integers(0, 1 << 40)),
+                dictionary_page_offset=(int(rng.integers(0, 1 << 40))
+                                        if trial % 2 else None),
+                statistics=st,
+            ))
+        w = CompactWriter()
+        cc.write(w)  # the generic per-field path, kept as the oracle
+        assert fast_column_chunk(cc) == w.getvalue()
+
+
 def test_bitpack_roundtrip():
     rng = np.random.default_rng(0)
     for width in [1, 2, 3, 5, 7, 8, 12, 17, 31]:
