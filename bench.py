@@ -1837,6 +1837,18 @@ def main() -> None:
         # default graded path: jax-free orchestrator (see _graded_main)
         _graded_main()
         return
+    if "--all" in sys.argv and "--cpu" not in sys.argv:
+        # the HUNG-backend mode must be caught BEFORE any in-process
+        # device use (the guarded devices print below hangs, not raises):
+        # probe in a killable subprocess, abort the sweep fast — sweeps
+        # merge same-platform only, so a sick chip leaves nothing to record
+        # two attempts: a quick transient probe failure (flaky tunnel,
+        # not a hang) deserves one retry before killing a whole sweep
+        # chain; a genuine hang costs 2 x 120 s, still minutes not hours
+        if _probe_backend(attempts=2, timeout_s=120) is None:
+            print("[bench] --all aborted: backend probe hung/failed",
+                  file=sys.stderr)
+            sys.exit(3)
     if "--cpu" in sys.argv or "--hostasm" in sys.argv:
         # --hostasm measures HOST work only and must never grab the real
         # chip; the switch must precede the first device use below
@@ -1865,8 +1877,9 @@ def main() -> None:
     if "--all" in sys.argv:
         # self-record the sweep (VERDICT r2 "next" #8): per-config claims
         # are checkable from the committed artifact without a re-run.
-        # A sick backend aborts the sweep FAST instead of hanging — sweeps
-        # merge same-platform only, so there is nothing useful to record.
+        # A fast-erroring backend aborts the sweep here; the HUNG mode was
+        # already caught by the subprocess probe at main() entry (an
+        # in-process jax.devices() hang is unkillable from this frame).
         try:
             record = {"configs": {}, "devices": str(jax.devices())}
         except Exception as e:

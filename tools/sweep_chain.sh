@@ -1,0 +1,34 @@
+#!/bin/bash
+# Run N back-to-back `bench.py --all` sweeps on an idle box (the
+# bench-discipline rule: never interleave CPU-heavy work — concurrent load
+# depresses both sides of every A/B and lands permanently in the
+# artifact's vs_history).  Each sweep merges into BENCH_SWEEP_r05.json;
+# the --all path aborts fast (rc=3) when the TPU backend is unavailable,
+# so a sick tunnel wastes minutes, not a window.
+#
+# Usage: tools/sweep_chain.sh [N]   (default 3)
+set -u
+N="${1:-3}"
+cd "$(dirname "$0")/.."
+for i in $(seq 1 "$N"); do
+  # wait until the box is actually idle — a single sleep would fall
+  # through onto a still-busy box and poison the artifact's history
+  while ! awk '{exit !($1 < 1.5)}' /proc/loadavg; do
+    echo "box busy (loadavg $(cut -d' ' -f1 /proc/loadavg)); waiting 120s"
+    sleep 120
+  done
+  echo "=== sweep $i/$N (loadavg $(cut -d' ' -f1 /proc/loadavg)) ==="
+  python bench.py --all || { rc=$?; echo "sweep $i failed rc=$rc"; \
+    [ "$rc" = 3 ] && { echo "backend unavailable; stopping chain"; exit 3; }; }
+done
+python - <<'EOF'
+import json
+try:
+    r = json.load(open("BENCH_SWEEP_r05.json"))
+except OSError:
+    print("chain done: no sweep artifact was written")
+else:
+    c2 = r.get("configs", {}).get("config2", {})
+    print(f"chain done: runs={r.get('sweep_runs')} "
+          f"cfg2 vs_dist={c2.get('vs_dist')}")
+EOF
