@@ -838,7 +838,18 @@ def host_assembly_probe(repeats: int = 3) -> dict | None:
     building through the GIL-releasing native primitives), so measuring
     them under a CPU-jax "device" is faithful; the launch stage is NOT
     (its wall time includes CPU-jax kernel compute that a real chip does
-    on device) and is reported only as a disclosed upper bound."""
+    on device) and is reported only as a disclosed upper bound.
+
+    Three measurements per invocation:
+      1-core leg (encoder_threads pinned to 1) — the projection model's
+      ``host_assembly_ms_per_rowgroup``;
+      2-core leg (encoder_threads=2, only when a second core exists) —
+      the column-parallel assembly pool measured instead of extrapolated
+      (``host_scaling: "measured"``);
+      overlap breakdown (``hostasm_overlap``) — several row groups pushed
+      through the writer's split dispatch||assembly||IO pipeline, per-stage
+      busy time vs pipelined wall, so the claim that host assembly hides
+      under the next group's launch is a recorded number."""
     import jax
 
     from kpw_tpu.core import ParquetFileWriter, Schema, WriterProperties, \
@@ -858,41 +869,129 @@ def host_assembly_probe(repeats: int = 3) -> dict | None:
     # multi-core host would double-count the parallelism
     opts.encoder_threads = 1
 
-    def run() -> int:
+    def run(o=opts) -> int:
         buf = io.BytesIO()
         w = ParquetFileWriter(buf, schema, props,
-                              encoder=TpuChunkEncoder(opts))
+                              encoder=TpuChunkEncoder(o))
         w.write_batch(columns_from_arrays(schema, arrays))
         w.close()
         return buf.tell()
 
-    run()  # warmup: CPU-jax compiles outside the timing
-    tracer = StageTimer()
-    set_tracer(tracer)
-    try:
-        t0 = time.perf_counter()
-        for _ in range(repeats):
-            run()
-        wall = time.perf_counter() - t0
-    finally:
-        set_tracer(None)
-    s = tracer.summary()
+    def timed_stages(o) -> tuple[dict, float]:
+        tracer = StageTimer()
+        set_tracer(tracer)
+        try:
+            t0 = time.perf_counter()
+            for _ in range(repeats):
+                run(o)
+            wall = time.perf_counter() - t0
+        finally:
+            set_tracer(None)
+        return tracer.summary(), wall
 
-    def ms(name: str) -> float:
-        return s.get(name, {}).get("seconds", 0.0) * 1e3 / repeats
+    run()  # warmup: CPU-jax compiles outside the timing
+    s, wall = timed_stages(opts)
+
+    def ms(name: str, summ=None) -> float:
+        return (summ or s).get(name, {}).get("seconds", 0.0) * 1e3 / repeats
 
     bodies, assemble = ms("encode.bodies"), ms("encode.assemble")
-    workers = opts.encoder_threads
-    return {
+    # affinity mask, not cpu_count: a taskset/cgroup-limited process must
+    # not record a 'measured' 2-core figure from an oversubscribed pool —
+    # same rule as the writer's split gate (ParquetFileWriter)
+    try:
+        cores = len(os.sched_getaffinity(0))
+    except (AttributeError, OSError):
+        cores = os.cpu_count() or 1
+    out = {
         "host_rows_per_rowgroup": rows,
         "host_bodies_ms": round(bodies, 3),
         "host_encode_ms": round(assemble, 3),
         "host_assembly_ms_per_rowgroup": round(bodies + assemble, 3),
         "host_launch_wall_ms": round(ms("encode.launch"), 3),
         "host_total_wall_ms": round(wall * 1e3 / repeats, 3),
-        "host_measured_cores": os.cpu_count() or 1,
-        "host_encoder_threads": workers,
+        "host_measured_cores": cores,
+        "host_encoder_threads": opts.encoder_threads,
+        "host_scaling": "extrapolated",
     }
+    if cores >= 2:
+        # measured 2-core assembly (the tentpole ask: host_measured_cores
+        # was 1, every *_2core projection extrapolated): same writer, the
+        # column-parallel pool capped at 2 workers
+        from dataclasses import replace as _dc_replace
+
+        opts2 = _dc_replace(opts, encoder_threads=2)
+        run(opts2)  # warm the pool threads
+        s2, _ = timed_stages(opts2)
+        bodies2 = ms("encode.bodies", s2)
+        assemble2 = ms("encode.assemble", s2)
+        ms2 = bodies2 + assemble2
+        out["host_assembly_ms_2core"] = round(ms2, 3)
+        out["host_scaling"] = "measured"
+        if ms2 > 0:
+            out["host_scaling_speedup_2core"] = round(
+                (bodies + assemble) / ms2, 3)
+    out["hostasm_overlap"] = _hostasm_overlap_probe(
+        schema, props, opts, arrays)
+    return out
+
+
+def _hostasm_overlap_probe(schema, props, opts, arrays, n_rowgroups: int = 6):
+    """Per-stage overlap breakdown of the writer's split pipeline: push
+    ``n_rowgroups`` cfg2-shaped row groups through ``pipeline=True`` (the
+    dispatch || assembly || IO threads) and compare each stage's busy time
+    against the pipelined wall.  ``hidden_ms_per_rg`` is host work that no
+    longer extends the critical path; on a real chip the dispatch leg is
+    device compute, so the hidden fraction is a lower bound (CPU-jax's
+    launch leg contends for the same cores the assembly thread uses)."""
+    from dataclasses import replace as _dc_replace
+
+    from kpw_tpu.core import ParquetFileWriter, WriterProperties, \
+        columns_from_arrays
+    from kpw_tpu.ops.backend import TpuChunkEncoder
+
+    # one row group per appended batch: threshold below one batch's bytes
+    rg_props = WriterProperties(
+        row_group_size=1, data_page_size=props.data_page_size)
+    o = _dc_replace(opts, encoder_threads=opts.encoder_threads)
+    batches = [columns_from_arrays(schema, arrays) for _ in range(2)]
+
+    def run_pipe() -> tuple[dict, float, bool]:
+        buf = io.BytesIO()
+        w = ParquetFileWriter(buf, schema, rg_props,
+                              encoder=TpuChunkEncoder(o), pipeline=True)
+        t0 = time.perf_counter()
+        for i in range(n_rowgroups):
+            w.write_batch(batches[i % len(batches)])
+        w.close()
+        wall = time.perf_counter() - t0
+        return dict(w.stage_busy_s), wall, w.has_assembly_stage
+
+    run_pipe()  # warmup
+    best_wall = float("inf")
+    busy: dict = {}
+    split = False
+    for _ in range(2):
+        b, wall, split = run_pipe()
+        if wall < best_wall:
+            best_wall, busy = wall, b
+    per = 1e3 / n_rowgroups
+    stage_sum = sum(busy.values())
+    out = {
+        "rowgroups": n_rowgroups,
+        "split_assembly": split,
+        "dispatch_ms_per_rg": round(busy.get("dispatch", 0.0) * per, 3),
+        "assemble_ms_per_rg": round(busy.get("assemble", 0.0) * per, 3),
+        "io_ms_per_rg": round(busy.get("io", 0.0) * per, 3),
+        "stage_sum_ms_per_rg": round(stage_sum * per, 3),
+        "pipelined_wall_ms_per_rg": round(best_wall * per, 3),
+        "hidden_ms_per_rg": round(max(0.0, stage_sum - best_wall) * per, 3),
+    }
+    hideable = stage_sum - max(busy.values()) if busy else 0.0
+    if hideable > 0:
+        out["overlap_efficiency"] = round(
+            max(0.0, stage_sum - best_wall) / hideable, 3)
+    return out
 
 
 def _hostasm_subprocess(timeout_s: int = 900) -> dict | None:
@@ -918,6 +1017,22 @@ def _hostasm_subprocess(timeout_s: int = 900) -> dict | None:
         return None
     line = out.stdout.strip().splitlines()[-1] if out.stdout.strip() else "null"
     return json.loads(line)
+
+
+def _host_leg_ms(host1: float, host2: float | None, k: int) -> float:
+    """ONE definition of the projection model's host leg at k cores,
+    shared by the best-of and median-composed blocks so they cannot
+    desynchronize.  k >= 2: the writer's split pipeline gives the
+    assembly thread its own core, so the leg is the BETTER of measured
+    2-thread column-parallel assembly and measured 1-thread assembly
+    overlapped on a dedicated core (a 2-core host can always choose
+    encoder_threads=1 + the assembly stage).  No thread scaling is
+    claimed beyond the measured point — k=4 projects the same measured
+    leg.  Without a 2-core measurement, linear scaling (the labeled
+    'extrapolated' assumption)."""
+    if host2 is not None:
+        return host1 if k == 1 else min(host2, host1)
+    return host1 / k
 
 
 def _projected_system(out: dict, t_base: float, rows: int) -> dict | None:
@@ -953,12 +1068,23 @@ def _projected_system(out: dict, t_base: float, rows: int) -> dict | None:
         "pcie_ms_per_step": round(pcie_ms, 3),
         "baseline_rows_per_sec_measured": round(base_rows_per_sec, 1),
         "model": "steady-state pipelined rows/s = 64Ki / max(device_ms, "
-                 "pcie_ms, host_assembly_ms / k_cores); host assembly "
+                 "pcie_ms, host_assembly_ms at k cores); host assembly "
                  "threads per column (GIL-releasing native primitives, "
-                 "TpuChunkEncoder.encode_many), measured at 1 core",
+                 "TpuChunkEncoder.assemble_many); the 2-core leg is the "
+                 "MEASURED host_assembly_ms_2core when present, divided "
+                 "linearly (extrapolated) otherwise",
     }
+    host2 = out.get("host_assembly_ms_2core")
+    if host2:
+        proj["host_assembly_ms_2core_measured"] = host2
+    proj["host_scaling"] = out.get(
+        "host_scaling", "measured" if host2 else "extrapolated")
+
+    def host_leg(k: int) -> float:
+        return _host_leg_ms(host_ms, host2, k)
+
     for k in (1, 2, 4):
-        bottleneck = max(dev_ms, pcie_ms, host_ms / k)
+        bottleneck = max(dev_ms, pcie_ms, host_leg(k))
         rps = N / bottleneck * 1e3
         proj[f"projected_rows_per_sec_{k}core"] = round(rps, 1)
         proj[f"projected_vs_baseline_{k}core"] = round(
@@ -970,7 +1096,7 @@ def _projected_system(out: dict, t_base: float, rows: int) -> dict | None:
     sens = {}
     for gbps in (4.0, 8.0, 16.0):
         p_ms = (up_mb + down_mb) / 1e3 / gbps * 1e3
-        rps = N / max(dev_ms, p_ms, host_ms / 2) * 1e3
+        rps = N / max(dev_ms, p_ms, host_leg(2)) * 1e3
         sens[f"{gbps:g}_gbps"] = {
             "projected_rows_per_sec_2core": round(rps, 1),
             "projected_vs_baseline_2core": round(rps / base_rows_per_sec, 2),
@@ -983,7 +1109,7 @@ def _projected_system(out: dict, t_base: float, rows: int) -> dict | None:
         # cfg2 schema); the same pipeline model, PCIe becomes the
         # bottleneck once the host keeps up
         for k in (2, 4):
-            bottleneck = max(aff_ms, pcie_ms, host_ms / k)
+            bottleneck = max(aff_ms, pcie_ms, host_leg(k))
             rps = N / bottleneck * 1e3
             proj[f"projected_affine_rows_per_sec_{k}core"] = round(rps, 1)
             proj[f"projected_affine_vs_baseline_{k}core"] = round(
@@ -1661,6 +1787,7 @@ def _derive_median_projection(c2: dict | None) -> None:
     if not base_rps:
         return
     pcie_ms = proj.get("pcie_ms_per_step", 0.0)
+    host2 = c2.get("host_assembly_ms_2core")
     N = 1 << 16
     med = {
         "device_ms_median": dev_ms,
@@ -1668,11 +1795,19 @@ def _derive_median_projection(c2: dict | None) -> None:
         "host_assembly_ms_median": round(host_ms, 3),
         "host_history_n": len(ha_hist),
         "baseline_rows_per_sec_median": round(base_rps, 1),
+        # the host-scaling assumption is a labeled input, not prose
+        # (VERDICT r5 next #3): "measured" only when a 2-core assembly
+        # leg was actually timed this sweep
+        "host_scaling": "measured" if host2 else "extrapolated",
         "model": "same pipeline model as the parent block, every leg at "
                  "its history median instead of best-of",
     }
+    if host2:
+        med["host_assembly_ms_2core_measured"] = host2
+
     for k in (1, 2, 4):
-        rps = N / max(dev_ms, pcie_ms, host_ms / k) * 1e3
+        # _host_leg_ms: the one shared definition of the k-core host leg
+        rps = N / max(dev_ms, pcie_ms, _host_leg_ms(host_ms, host2, k)) * 1e3
         med[f"projected_rows_per_sec_{k}core"] = round(rps, 1)
         med[f"projected_vs_baseline_{k}core"] = round(rps / base_rps, 2)
     proj["median"] = med
@@ -1994,7 +2129,10 @@ def main() -> None:
                     or k == "tpu_platform"]
 
         def _host_keys(r):
-            return [k for k in r if k.startswith("host_")]
+            # hostasm_overlap rides the host group: its breakdown must
+            # stay self-consistent with the winning run's host numbers
+            return [k for k in r
+                    if k.startswith("host_") or k == "hostasm_overlap"]
 
         def _proj_keys(r):
             return ["projected_system"] if "projected_system" in r else []

@@ -321,14 +321,31 @@ class RowGroup:
     file_offset: int | None = None
     total_compressed_size: int | None = None
     ordinal: int | None = None
+    # serialized ColumnChunk fragments, precomputed at commit time by the
+    # pipelined writer so close() only splices bytes (None = serialize in
+    # write(), the non-pipelined path)
+    _cc_bytes: list | None = field(default=None, repr=False, compare=False)
+
+    def precompute_column_bytes(self, pool=None) -> None:
+        """Serialize every column chunk's footer fragment NOW — called by
+        the writer right after the row group's offsets are final, so the
+        per-column thrift composition rides the overlapped assembly window
+        instead of the close() critical path.  ``pool`` (optional
+        concurrent.futures executor) shards the composition per column.
+        Must not be called before the metas' file offsets are absolute."""
+        if pool is not None and len(self.columns) > 1:
+            self._cc_bytes = list(pool.map(fast_column_chunk, self.columns))
+        else:
+            self._cc_bytes = [fast_column_chunk(c) for c in self.columns]
 
     def write(self, w: CompactWriter) -> None:
         w.struct_begin()
         w.field_list_begin(1, CT_STRUCT, len(self.columns))
-        for c in self.columns:
-            # complete nested struct: its field-delta state is confined,
-            # so the direct composer's bytes splice in verbatim
-            w._buf += fast_column_chunk(c)
+        # complete nested structs: their field-delta state is confined,
+        # so the direct composer's bytes splice in verbatim
+        for b in (self._cc_bytes if self._cc_bytes is not None
+                  else map(fast_column_chunk, self.columns)):
+            w.append_raw(b)
         w.field_i64(2, self.total_byte_size)
         w.field_i64(3, self.num_rows)
         if self.file_offset is not None:

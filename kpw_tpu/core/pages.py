@@ -9,6 +9,8 @@ the encoder can be numpy (this module) or vmapped TPU kernels
 
 from __future__ import annotations
 
+import os
+import threading
 import zlib
 from dataclasses import dataclass, field
 
@@ -23,6 +25,7 @@ from .metadata import (
     DataPageHeader,
     DictionaryPageHeader,
     Statistics,
+    fast_data_page_header,
     write_page_header,
 )
 from .schema import Codec, ColumnDescriptor, Encoding, PageType, PhysicalType
@@ -92,13 +95,73 @@ def _min_max_bytes(values, physical_type: int):
     return lo, hi
 
 
-@dataclass
 class EncodedChunk:
-    """Serialized pages for one column chunk + footer metadata ingredients."""
+    """Serialized pages for one column chunk + footer metadata ingredients.
 
-    blob: bytes  # all pages back to back (dict page first if any)
-    meta: ColumnMetaData
-    dictionary_page_len: int  # 0 if none
+    ``parts`` is a writev-style gather list of page buffers (bytes /
+    memoryview) in file order, dict page first if any: the writer hands
+    the parts straight to the sink so the chunk's pages are never
+    concatenated into one intermediate blob (the copy measured as the
+    largest host-assembly slice at the 64-column uncompressed shape).
+    ``blob`` joins lazily for callers that still want one buffer."""
+
+    __slots__ = ("parts", "length", "meta", "dictionary_page_len", "_blob")
+
+    def __init__(self, parts, meta: ColumnMetaData,
+                 dictionary_page_len: int, length: int | None = None) -> None:
+        if isinstance(parts, (bytes, bytearray, memoryview)):
+            parts = [parts]  # compat: single pre-joined blob
+        self.parts = parts
+        self.length = (sum(len(p) for p in parts)
+                       if length is None else length)
+        self.meta = meta
+        self.dictionary_page_len = dictionary_page_len  # 0 if none
+        self._blob: bytes | None = None
+
+    @property
+    def blob(self) -> bytes:
+        """All pages back to back as one buffer (joined on first access)."""
+        if self._blob is None:
+            if len(self.parts) == 1 and isinstance(self.parts[0], bytes):
+                self._blob = self.parts[0]
+            else:
+                self._blob = b"".join(self.parts)
+        return self._blob
+
+
+_POOL = None
+_POOL_LOCK = threading.Lock()
+
+
+def shared_assembly_pool():
+    """One process-wide host-assembly pool (column-parallel page building,
+    native encode calls, column-chunk serialization): encoders are
+    constructed per rotated file by the streaming writer, so a per-encoder
+    pool would leak threads on every rotation.  Sized to the core count;
+    callers gate on their own ``encoder_threads`` before using it."""
+    global _POOL
+    with _POOL_LOCK:
+        if _POOL is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            _POOL = ThreadPoolExecutor(
+                max_workers=max(2, os.cpu_count() or 1),
+                thread_name_prefix="kpw-encode")
+        return _POOL
+
+
+class PreparedRowGroup:
+    """Opaque handle between :meth:`CpuChunkEncoder.launch_many` and
+    :meth:`CpuChunkEncoder.assemble_many` — carries whatever the launch
+    phase dispatched (device handles, resolved page plans) so the two
+    halves can run on different pipeline threads for different row groups
+    without colliding on encoder instance state."""
+
+    __slots__ = ("pres", "state")
+
+    def __init__(self, pres: list, state=None) -> None:
+        self.pres = pres  # per-chunk prepare() results, encode()'s ``pre``
+        self.state = state  # backend-private (e.g. the TPU planner's plans)
 
 
 @dataclass
@@ -251,18 +314,84 @@ class CpuChunkEncoder:
         or None to fall through to the synchronous ``_dictionary_build``."""
         return pre
 
+    # -- split row-group encode (launch || assemble) -----------------------
+    # The writer's overlapped pipeline drives these two halves from
+    # different threads: row group N+1's launch_many (device dispatch in
+    # the TPU backend) runs while row group N is still in assemble_many
+    # (pure host page building).  encode_many composes them inline, so the
+    # sync path and every backend stay byte-identical by construction.
+
+    # Whether launch_many performs real asynchronous work worth its own
+    # pipeline stage.  False here (and for the native backend): prepare()
+    # is a no-op, so a split stage would only DEEPEN the pipe — one more
+    # detached-but-unencoded row group estimated at the unlearned size
+    # ratio, which measurably skews the first file's size-based rotation.
+    # The TPU backend overrides to True: its launch dispatches the
+    # planner's device programs, the thing the assembly stage overlaps.
+    split_launch_overlaps = False
+
+    def launch_many(self, chunks: list[ColumnChunkData]) -> PreparedRowGroup:
+        """Phase 1: dispatch whatever can run asynchronously for a whole
+        row group (device programs in the TPU backend; nothing here).
+        Returns the handle :meth:`assemble_many` consumes."""
+        return PreparedRowGroup([self.prepare(c) for c in chunks])
+
+    def _parallel_assembly_ok(self) -> bool:
+        """Whether assemble_many may shard columns across the shared pool.
+        The pure-numpy oracle stays sequential (its primitives hold the
+        GIL; threading adds overhead, not parallelism) — the native/TPU
+        backends override to True when their GIL-releasing primitives are
+        loaded."""
+        return False
+
+    def _assembly_workers(self, n_chunks: int) -> int:
+        workers = self.options.encoder_threads or (os.cpu_count() or 1)
+        return min(workers, n_chunks)
+
+    def assemble_many(self, chunks: list[ColumnChunkData],
+                      prepared: PreparedRowGroup,
+                      base_offset: int) -> list["EncodedChunk"]:
+        """Phase 2: pure host assembly of every column's pages.  Shards
+        columns across the shared pool when the backend's primitives
+        release the GIL (``encoder_threads`` sizes it; 1 pins serial):
+        each chunk encodes at offset 0 (page bytes never embed offsets),
+        then footer offsets shift by the running base — byte-identical to
+        the sequential path."""
+        workers = self._assembly_workers(len(chunks))
+        if workers > 1 and self._parallel_assembly_ok():
+            out = list(shared_assembly_pool().map(
+                lambda cp: self.encode(cp[0], 0, pre=cp[1]),
+                zip(chunks, prepared.pres)))
+            return self._shift_offsets(out, base_offset)
+        out = []
+        offset = base_offset
+        for chunk, pre in zip(chunks, prepared.pres):
+            e = self.encode(chunk, offset, pre=pre)
+            offset += e.length
+            out.append(e)
+        return out
+
     def encode_many(self, chunks: list[ColumnChunkData], base_offset: int) -> list["EncodedChunk"]:
         """Encode several chunks laid out back to back.  Launches all device
         work first (async dispatch), then assembles in order so host assembly
         of column i overlaps device compute of columns i+1.."""
-        pres = [self.prepare(c) for c in chunks]
-        out = []
+        return self.assemble_many(chunks, self.launch_many(chunks),
+                                  base_offset)
+
+    @staticmethod
+    def _shift_offsets(encoded: list["EncodedChunk"],
+                       base_offset: int) -> list["EncodedChunk"]:
+        """Footer-offset fixup for chunks encoded at offset 0 in parallel:
+        the ONE definition of which meta fields carry file offsets, shared
+        by every backend — a new offset field added here reaches all."""
         offset = base_offset
-        for chunk, pre in zip(chunks, pres):
-            e = self.encode(chunk, offset, pre=pre)
-            offset += len(e.blob)
-            out.append(e)
-        return out
+        for e in encoded:
+            m = e.meta
+            if m.dictionary_page_offset is not None:
+                m.dictionary_page_offset += offset
+            m.data_page_offset += offset
+            offset += e.length
+        return encoded
 
     # -- helpers -----------------------------------------------------------
     def _dictionary_viable(self, chunk: ColumnChunkData) -> bool:
@@ -330,10 +459,11 @@ class CpuChunkEncoder:
                     if len(dict_plain) <= opts.dictionary_page_size_limit:
                         use_dict = True
 
-        # Pages accumulate as a PARTS LIST joined once at the end: one
-        # exact-size allocation and copy, instead of bytearray doubling
-        # plus a bytes() bounce (measured ~2x the output volume in pure
-        # memcpy on the 64-col uncompressed shape).
+        # Pages accumulate as a PARTS LIST handed to the writer verbatim
+        # (EncodedChunk.parts): no bytearray doubling, no bytes() bounce,
+        # and since the writer gathers parts straight into the sink, no
+        # join either — the page buffers are copied exactly once, by the
+        # sink write itself.
         blob_parts: list = []
         blob_len = 0
         encodings = set()
@@ -378,45 +508,75 @@ class CpuChunkEncoder:
         if def_levels is not None:
             present = np.asarray(def_levels) == col.max_def
             value_offsets = np.concatenate([[0], np.cumsum(present)])
-        for a, b in self._slot_ranges(chunk):
-            if def_levels is not None:
-                va, vb = int(value_offsets[a]), int(value_offsets[b])
-            else:
-                va, vb = a, b
-            levels_blob = self._levels_page_blob(chunk, a, b)
-            if use_dict:
-                parts = [self._indices_body(indices, va, vb,
-                                            len(dict_values))]
-            else:
-                parts = self._values_page_parts(chunk, va, vb, pt,
-                                                value_encoding)
-            if levels_blob:
-                parts.insert(0, levels_blob)
-            body_len = sum(len(p) for p in parts)
-            comp_buf, comp_len = self._compress_parts(parts, body_len)
-            header = write_page_header(
-                PageType.DATA_PAGE,
-                body_len,
-                comp_len,
-                data_header=DataPageHeader(
-                    num_values=b - a,
-                    encoding=value_encoding,
-                    definition_level_encoding=Encoding.RLE,
-                    repetition_level_encoding=Encoding.RLE,
-                ),
-                crc=self._page_crc(parts if comp_buf is None
-                                   else [comp_buf]),
-            )
-            if data_page_offset is None:
-                data_page_offset = base_offset + blob_len
-            blob_parts.append(header)
-            if comp_buf is None:
-                blob_parts.extend(parts)  # uncompressed: verbatim, no concat
-            else:
-                blob_parts.append(bytes(comp_buf))  # scratch: see dict page
-            blob_len += len(header) + comp_len
-            total_uncompressed += len(header) + body_len
-            total_compressed += len(header) + comp_len
+        if (opts.codec == Codec.UNCOMPRESSED and not opts.page_checksums
+                and col.max_def == 0 and col.max_rep == 0):
+            # Tight loop for the hot shape (flat required column,
+            # uncompressed, no CRC — the cfg2 headline): no level blob, no
+            # compress/crc dispatch, header straight through the direct
+            # composer.  Byte-identical to the generic loop below by
+            # construction (same body bytes, same fast header).
+            nd = len(dict_values) if use_dict else 0
+            for a, b in self._slot_ranges(chunk):
+                if use_dict:
+                    body = self._indices_body(indices, a, b, nd)
+                    # planner bodies may arrive as a parts LIST
+                    # (zero-copy prefix + packed view)
+                    parts = body if type(body) is list else [body]
+                else:
+                    parts = self._values_page_parts(chunk, a, b, pt,
+                                                    value_encoding)
+                body_len = sum(map(len, parts))
+                header = fast_data_page_header(body_len, body_len, b - a,
+                                               value_encoding)
+                if data_page_offset is None:
+                    data_page_offset = base_offset + blob_len
+                blob_parts.append(header)
+                blob_parts.extend(parts)
+                hl = len(header)
+                blob_len += hl + body_len
+                total_uncompressed += hl + body_len
+                total_compressed += hl + body_len
+        else:
+            for a, b in self._slot_ranges(chunk):
+                if def_levels is not None:
+                    va, vb = int(value_offsets[a]), int(value_offsets[b])
+                else:
+                    va, vb = a, b
+                levels_blob = self._levels_page_blob(chunk, a, b)
+                if use_dict:
+                    body = self._indices_body(indices, va, vb,
+                                              len(dict_values))
+                    parts = body if type(body) is list else [body]
+                else:
+                    parts = self._values_page_parts(chunk, va, vb, pt,
+                                                    value_encoding)
+                if levels_blob:
+                    parts.insert(0, levels_blob)
+                body_len = sum(len(p) for p in parts)
+                comp_buf, comp_len = self._compress_parts(parts, body_len)
+                header = write_page_header(
+                    PageType.DATA_PAGE,
+                    body_len,
+                    comp_len,
+                    data_header=DataPageHeader(
+                        num_values=b - a,
+                        encoding=value_encoding,
+                        definition_level_encoding=Encoding.RLE,
+                        repetition_level_encoding=Encoding.RLE,
+                    ),
+                    crc=self._page_crc(parts if comp_buf is None
+                                       else [comp_buf]),
+                )
+                if data_page_offset is None:
+                    data_page_offset = base_offset + blob_len
+                blob_parts.append(header)
+                if comp_buf is None:
+                    blob_parts.extend(parts)  # uncompressed: verbatim
+                else:
+                    blob_parts.append(bytes(comp_buf))  # scratch: see above
+                blob_len += len(header) + comp_len
+                total_uncompressed += len(header) + body_len
+                total_compressed += len(header) + comp_len
 
         stats = None
         if opts.write_statistics:
@@ -444,4 +604,7 @@ class CpuChunkEncoder:
             dictionary_page_offset=dictionary_page_offset,
             statistics=stats,
         )
-        return EncodedChunk(b"".join(blob_parts), meta, dict_page_len)
+        # No join: the parts list IS the output (writev-style gather all
+        # the way to the sink) — the last whole-output-volume memcpy on
+        # the assembly hot path, gone.
+        return EncodedChunk(blob_parts, meta, dict_page_len, length=blob_len)

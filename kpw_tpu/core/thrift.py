@@ -151,6 +151,16 @@ class CompactWriter:
         self._varint(len(value))
         self._buf += value
 
+    def append_raw(self, data: bytes) -> None:
+        """Splice pre-serialized thrift bytes into the stream verbatim.
+
+        For COMPLETE nested structs composed out-of-band (the direct
+        composers in core.metadata): a finished struct confines its
+        field-delta state, so its bytes are position-independent and the
+        writer's own delta tracking is unaffected.  Public so callers never
+        have to reach into the private buffer."""
+        self._buf += data
+
     def getvalue(self) -> bytes:
         return bytes(self._buf)
 

@@ -11,6 +11,7 @@ KafkaProtoParquetWriter.java:473).
 from __future__ import annotations
 
 import io
+import os
 import queue
 import threading
 import time
@@ -102,20 +103,68 @@ class ParquetFileWriter:
         self._size_ratio = 1.0  # EWMA of on-disk bytes / raw-estimate bytes
         self._num_rows = 0
         self._closed = False
-        # 3-stage pipeline (SURVEY.md §2.4): caller accumulates batch N+2
-        # while the encode thread encodes row group N+1 and the IO thread
-        # writes row group N.  Bounded queues (depth 1 each) cap in-flight
-        # memory at ~3 row groups and backpressure the producer naturally.
+        # Overlapped pipeline (SURVEY.md §2.4): caller accumulates batch
+        # N+3 while the dispatch thread launches row group N+2's encode
+        # (device programs + readbacks in the TPU backend), the assembly
+        # thread page-assembles/serializes row group N+1 on the host, and
+        # the IO thread writes row group N.  Bounded queues (depth 1 each)
+        # cap in-flight memory at ~4 row groups and backpressure the
+        # producer naturally.  The assembly stage only exists when the
+        # encoder supports the launch||assemble split AND a second core is
+        # available to overlap onto (auto-inlined into the dispatch thread
+        # otherwise — the classic 3-stage shape).
         self._pipeline = pipeline
         self._enc_q: queue.Queue | None = None
+        self._asm_q: queue.Queue | None = None
         self._io_q: queue.Queue | None = None
         self._enc_thread: threading.Thread | None = None
+        self._asm_thread: threading.Thread | None = None
         self._io_thread: threading.Thread | None = None
-        self._inflight_bytes = 0  # detached but not yet durable (estimate)
-        self._inflight_lock = threading.Lock()  # += / -= from two threads
+        # detached but not yet ENCODED (raw estimate, ratio-scaled by
+        # estimated_size); once a stage finishes encoding, the row group
+        # moves to _encoded_inflight at its EXACT byte size — the deeper
+        # 4-stage pipe holds more in-flight groups, and scaling known
+        # sizes by the EWMA ratio would skew size-based rotation
+        self._inflight_bytes = 0
+        self._encoded_inflight = 0  # encoded but not yet durable (exact)
+        self._inflight_lock = threading.Lock()  # += / -= across stage threads
         self._pipe_error: BaseException | None = None
         self._abandoned = threading.Event()
+        self._used_assembly_stage = False
+        # per-stage busy seconds of the pipeline threads (zeros on the
+        # sync path): each key is written by exactly one stage thread and
+        # read approximately — the overlap evidence the bench breakdown
+        # and the runtime metrics surface without a global tracer
+        self.stage_busy_s = {"dispatch": 0.0, "assemble": 0.0, "io": 0.0}
         self._write(MAGIC)
+
+    def _split_assembly_capable(self) -> bool:
+        """True when the encoder can split a row group into launch_many
+        (device dispatch) + assemble_many (host page building) halves that
+        are safe to run on different threads for different row groups, AND
+        its launch actually overlaps real asynchronous work
+        (``split_launch_overlaps`` — a no-op launch would only deepen the
+        pipe and skew first-file rotation estimates, see pages.py).
+        Conservative by construction: an encoder that overrode encode_many
+        itself (a custom backend, a test double) keeps its override on the
+        single encode stage — the split path would silently bypass it."""
+        from .pages import CpuChunkEncoder
+
+        cls = type(self.encoder)
+        return (getattr(cls, "split_launch_overlaps", False)
+                and getattr(cls, "encode_many", None)
+                is CpuChunkEncoder.encode_many
+                and hasattr(cls, "launch_many")
+                and hasattr(cls, "assemble_many"))
+
+    @staticmethod
+    def _available_cores() -> int:
+        """Cores this process may actually use (affinity mask respects
+        cgroup/taskset limits; same rule as the Builder's pipeline auto)."""
+        try:
+            return len(os.sched_getaffinity(0))
+        except (AttributeError, OSError):
+            return os.cpu_count() or 1
 
     # -- low level ---------------------------------------------------------
     def _write(self, data: bytes) -> None:
@@ -133,15 +182,24 @@ class ParquetFileWriter:
                 self.sink.seek(self._pos)
             except (OSError, io.UnsupportedOperation):
                 pass
-        written = 0
         # NOTE (measured): do NOT pre-size the sink with a seek-ahead
         # end-marker — BytesIO's growth is already amortized-efficient,
         # and the marker write measured ~1.5x SLOWER than plain appends
         # at the 20 MB row-group shape; the profile cost attributed to
         # sink writes is cache-cold source traffic, not reallocation.
-        for p in parts:
-            self.sink.write(p)
-            written += len(p)
+        if len(parts) > 8 and hasattr(self.sink, "writelines"):
+            # writev-style gather: the parts list is now per PAGE BUFFER
+            # (EncodedChunk.parts), thousands of entries per row group —
+            # writelines loops in C, one Python call for the lot.  Raises
+            # partway => _pos unmoved, the retry seeks back (same contract
+            # as the per-part loop).
+            written = sum(map(len, parts))
+            self.sink.writelines(parts)
+        else:
+            written = 0
+            for p in parts:
+                self.sink.write(p)
+                written += len(p)
         self._pos += written
         return written
 
@@ -151,9 +209,19 @@ class ParquetFileWriter:
         return self._pos
 
     @property
+    def has_assembly_stage(self) -> bool:
+        """Whether the overlapped host-assembly stage ran on its own
+        thread (False until the first pipelined flush, on the sync path,
+        and when auto-inlined — split-incapable encoder or single core).
+        Sticky across close() so post-run stats stay readable."""
+        return self._asm_thread is not None or self._used_assembly_stage
+
+    @property
     def size_ratio(self) -> float:
-        """Measured on-disk/raw-estimate byte ratio of committed row groups
-        (1.0 until the first commit)."""
+        """Measured on-disk/raw-estimate byte ratio of encoded row groups
+        (1.0 until the first row group finishes encoding — the pipelined
+        paths fold the exact encoded size in as soon as it is known,
+        before the IO commit)."""
         return self._size_ratio
 
     def estimated_size(self) -> int:
@@ -164,8 +232,9 @@ class ParquetFileWriter:
         the measured encoded/raw ratio of already-committed row groups so
         size-based rotation tracks what will actually land on disk
         (dictionary/RLE/compression can shrink — or stats can grow — the
-        raw columnar estimate substantially)."""
-        return self._pos + int(
+        raw columnar estimate substantially).  Row groups already through
+        the encode stage count at their exact encoded size."""
+        return self._pos + self._encoded_inflight + int(
             self._size_ratio * (self._pending_bytes + self._inflight_bytes))
 
     def append_batch(self, batch: ColumnBatch) -> None:
@@ -209,6 +278,17 @@ class ParquetFileWriter:
             return
         self._enc_q = queue.Queue(maxsize=1)
         self._io_q = queue.Queue(maxsize=1)
+        # the assembly stage earns its thread only when the encoder can
+        # split AND there is a second core to overlap onto; otherwise it
+        # auto-inlines into the dispatch thread (3-stage shape, identical
+        # behavior to the pre-split pipeline)
+        if self._split_assembly_capable() and self._available_cores() > 1:
+            self._asm_q = queue.Queue(maxsize=1)
+            self._asm_thread = threading.Thread(
+                target=self._assembly_loop, name="kpw-rg-assemble",
+                daemon=True)
+            self._used_assembly_stage = True
+            self._asm_thread.start()
         self._enc_thread = threading.Thread(
             target=self._encode_loop, name="kpw-rg-encode", daemon=True)
         self._io_thread = threading.Thread(
@@ -241,43 +321,94 @@ class ParquetFileWriter:
             encoded, off = [], 0
             for chunk in chunks:
                 e = self.encoder.encode(chunk, off)
-                off += len(e.blob)
+                off += e.length
                 encoded.append(e)
             return encoded
 
-    def _relay_io_sentinel(self) -> None:
-        """Tell the IO thread to exit; never blocks forever (the IO thread
-        may already be gone after an abandon)."""
+    def _relay_sentinel(self, q: queue.Queue) -> None:
+        """Tell the next stage's thread to exit; never blocks forever (the
+        downstream thread may already be gone after an abandon)."""
         while True:
             try:
-                self._io_q.put(None, timeout=0.2)
+                q.put(None, timeout=0.2)
                 return
             except queue.Full:
                 if self._abandoned.is_set():
-                    return  # IO thread drains or exits on its own timeout
+                    return  # downstream drains or exits on its own timeout
+
+    def _next_stage_q(self) -> queue.Queue:
+        """The queue the dispatch stage feeds: the assembly stage when it
+        exists, else straight to IO."""
+        return self._asm_q if self._asm_q is not None else self._io_q
 
     def _encode_loop(self) -> None:
-        """Stage B: merge + encode one row group at a time, at base offset 0
-        (absolute offsets are assigned by the IO stage — the native encoder
-        does the same shift for its column-parallel path)."""
+        """Stage B (dispatch): merge one row group at a time and either
+        launch its encode through the split API — so the device leg of row
+        group N+1 runs while the assembly thread still owns row group N's
+        host leg — or, without an assembly stage, encode it whole.  Either
+        way the encode is at base offset 0 (absolute offsets are assigned
+        by the IO stage — the native encoder does the same shift for its
+        column-parallel path)."""
         while True:
             try:
                 item = self._enc_q.get(timeout=0.2)
             except queue.Empty:
                 if self._abandoned.is_set():
-                    self._relay_io_sentinel()
+                    self._relay_sentinel(self._next_stage_q())
                     return
                 continue
             if item is None:
-                self._relay_io_sentinel()
+                self._relay_sentinel(self._next_stage_q())
                 return
             if self._abandoned.is_set() or self._pipe_error is not None:
                 continue  # drain without work (abandoned or poisoned)
             parts, rows, est = item
             try:
-                encoded = self._encode_chunks(
-                    [self._merge_chunks(p) for p in parts])
-                self._io_q.put((encoded, rows, est))
+                t0 = time.perf_counter()
+                chunks = [self._merge_chunks(p) for p in parts]
+                if self._asm_q is not None:
+                    with stage("rowgroup.launch"):
+                        prepared = self.encoder.launch_many(chunks)
+                    self.stage_busy_s["dispatch"] += time.perf_counter() - t0
+                    self._asm_q.put((chunks, prepared, rows, est))
+                else:
+                    encoded = self._encode_chunks(chunks)
+                    enc_len = self._mark_encoded(encoded, est)
+                    self.stage_busy_s["dispatch"] += time.perf_counter() - t0
+                    self._io_q.put((encoded, rows, enc_len))
+            except BaseException as e:  # noqa: BLE001 - poisons the writer
+                self._pipe_error = e
+                with self._inflight_lock:
+                    self._inflight_bytes -= est
+
+    def _assembly_loop(self) -> None:
+        """Stage B': column-parallel host assembly (page building, blob
+        serialization, stats) of one row group at a time, overlapped with
+        the NEXT row group's dispatch in stage B.  Owns its own queue and
+        the same poison protocol as the other stages: an assembly failure
+        after detach is unrecoverable (the rows left the pending buffer),
+        so it poisons the writer instead of dying silently."""
+        while True:
+            try:
+                item = self._asm_q.get(timeout=0.2)
+            except queue.Empty:
+                if self._abandoned.is_set():
+                    self._relay_sentinel(self._io_q)
+                    return
+                continue
+            if item is None:
+                self._relay_sentinel(self._io_q)
+                return
+            if self._abandoned.is_set() or self._pipe_error is not None:
+                continue  # drain without work (abandoned or poisoned)
+            chunks, prepared, rows, est = item
+            try:
+                t0 = time.perf_counter()
+                with stage("rowgroup.assemble"):
+                    encoded = self.encoder.assemble_many(chunks, prepared, 0)
+                enc_len = self._mark_encoded(encoded, est)
+                self.stage_busy_s["assemble"] += time.perf_counter() - t0
+                self._io_q.put((encoded, rows, enc_len))
             except BaseException as e:  # noqa: BLE001 - poisons the writer
                 self._pipe_error = e
                 with self._inflight_lock:
@@ -299,17 +430,40 @@ class ParquetFileWriter:
                 return
             if self._abandoned.is_set():
                 continue
-            encoded, rows, est = item
+            encoded, rows, enc_len = item
             while not self._abandoned.is_set() and self._pipe_error is None:
                 try:
-                    self._commit_encoded(encoded, rows, raw_estimate=est)
+                    t0 = time.perf_counter()
+                    # raw_estimate=0: _mark_encoded already folded this
+                    # row group's exact encoded size into the ratio EWMA
+                    # (one stage earlier than a commit-time update — the
+                    # deeper pipeline must not delay ratio learning)
+                    self._commit_encoded(encoded, rows)
+                    self.stage_busy_s["io"] += time.perf_counter() - t0
                     break
                 except OSError:
                     time.sleep(0.1)
                 except BaseException as e:  # noqa: BLE001 - poison, don't die
                     self._pipe_error = e
             with self._inflight_lock:
-                self._inflight_bytes -= est
+                self._encoded_inflight -= enc_len
+
+    def _mark_encoded(self, encoded_chunks, raw_estimate: int) -> int:
+        """Account one row group the moment its encode finishes: fold the
+        EXACT encoded size into the encoded/raw ratio EWMA (the pipelined
+        commit happens a queue hop — or two, with the assembly stage —
+        later, and size-based rotation must not keep estimating with a
+        stale ratio) and move the group from the ratio-scaled raw-estimate
+        pool to the exact encoded-inflight pool.  Returns the encoded
+        size, which replaces the raw estimate on the IO queue."""
+        actual = sum(e.length for e in encoded_chunks)
+        if raw_estimate > 0 and actual > 0:
+            self._size_ratio += 0.5 * (actual / raw_estimate
+                                       - self._size_ratio)
+        with self._inflight_lock:
+            self._inflight_bytes -= raw_estimate
+            self._encoded_inflight += actual
+        return actual
 
     def _commit_encoded(self, encoded_chunks, num_rows: int,
                         raw_estimate: int = 0) -> None:
@@ -319,20 +473,22 @@ class ParquetFileWriter:
         pre-encode pending-bytes estimate for this row group; it feeds the
         encoded/raw size-ratio EWMA behind :meth:`estimated_size`."""
         rg_start = self._pos
-        blobs = []
+        parts: list = []
         columns: list[ColumnChunk] = []
         total_byte_size = 0
         total_compressed = 0
         for e in encoded_chunks:
             m = e.meta
-            blobs.append(e.blob)
+            parts.extend(e.parts)
             total_byte_size += m.total_uncompressed_size
             total_compressed += m.total_compressed_size
         with stage("rowgroup.io_write"):
-            # one seek, then per-chunk writes: no b"".join bounce copy of
-            # the whole row group (tens of MB at default block size);
+            # one seek, then a writev-style gather of every chunk's page
+            # buffers: the page bytes go from the encoder's parts straight
+            # into the sink — no per-chunk blob join, no whole-row-group
+            # b"".join bounce (tens of MB at default block size);
             # raises => nothing mutated yet (_pos only advances at the end)
-            actual = self._write_parts(blobs)
+            actual = self._write_parts(parts)
         if raw_estimate > 0 and actual > 0:
             self._size_ratio += 0.5 * (actual / raw_estimate
                                        - self._size_ratio)
@@ -345,24 +501,32 @@ class ParquetFileWriter:
             m.data_page_offset += rg_start
             columns.append(ColumnChunk(file_offset=m.data_page_offset,
                                        meta_data=m))
-        self._row_groups.append(RowGroup(
+        rg = RowGroup(
             columns=columns,
             total_byte_size=total_byte_size,
             num_rows=num_rows,
             file_offset=rg_start,
             total_compressed_size=total_compressed,
             ordinal=len(self._row_groups),
-        ))
+        )
+        # offsets are absolute now: serialize the footer fragments here —
+        # on the pipelined path this runs in the IO thread, overlapped
+        # with later row groups' encode, so close() only splices bytes
+        rg.precompute_column_bytes()
+        self._row_groups.append(rg)
         self._num_rows += num_rows
 
     def _drain_pipe(self) -> None:
-        """Flush the tail through the pipeline and join both threads."""
+        """Flush the tail through the pipeline and join every stage thread
+        (the sentinel relays stage to stage, in order)."""
         if self._enc_thread is None:
             return
         self._enc_q.put(None)
         self._enc_thread.join()
+        if self._asm_thread is not None:
+            self._asm_thread.join()
         self._io_thread.join()
-        self._enc_thread = self._io_thread = None
+        self._enc_thread = self._asm_thread = self._io_thread = None
         self._check_pipe_error()
 
     def abandon(self) -> None:
@@ -370,18 +534,17 @@ class ParquetFileWriter:
         abandons the open tmp on close — KPW.java:381-398)."""
         self._abandoned.set()
         if self._enc_thread is not None:
-            try:
-                self._enc_q.put_nowait(None)
-            except queue.Full:
-                pass
-            self._enc_thread.join(timeout=10)
-            if self._io_thread is not None:
+            for q, t in ((self._enc_q, self._enc_thread),
+                         (self._asm_q, self._asm_thread),
+                         (self._io_q, self._io_thread)):
+                if t is None:
+                    continue
                 try:
-                    self._io_q.put_nowait(None)
+                    q.put_nowait(None)
                 except queue.Full:
                     pass
-                self._io_thread.join(timeout=10)
-            self._enc_thread = self._io_thread = None
+                t.join(timeout=10)
+            self._enc_thread = self._asm_thread = self._io_thread = None
         self._closed = True
 
     def write_batch(self, batch: ColumnBatch) -> None:

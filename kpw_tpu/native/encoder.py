@@ -14,7 +14,6 @@ slow to pay for offload (kpw_tpu/runtime/writer.py).
 
 from __future__ import annotations
 
-import os
 import struct
 import threading
 
@@ -22,67 +21,33 @@ import numpy as np
 
 from ..core import encodings as enc
 from ..core.bytecol import ByteColumn
-from ..core.pages import CpuChunkEncoder, EncoderOptions
-from ..core.schema import PhysicalType
+from ..core.bytecol import lens_and_payload
+from ..core.pages import CpuChunkEncoder, EncoderOptions, shared_assembly_pool
+from ..core.schema import Codec, Encoding, PhysicalType
 from . import lib
 
-_POOL = None
-_POOL_LOCK = threading.Lock()
-
-
-def _shared_pool():
-    """One process-wide encode pool: encoders are constructed per rotated
-    file by the streaming writer, so a per-encoder pool would leak threads
-    on every rotation.  Sized to the core count; callers gate on their own
-    encoder_threads before using it."""
-    global _POOL
-    with _POOL_LOCK:
-        if _POOL is None:
-            from concurrent.futures import ThreadPoolExecutor
-
-            _POOL = ThreadPoolExecutor(
-                max_workers=max(2, os.cpu_count() or 1),
-                thread_name_prefix="kpw-encode")
-        return _POOL
+# compat alias: the shared host-assembly pool moved to core.pages so the
+# split launch||assemble pipeline can use it without importing native
+_shared_pool = shared_assembly_pool
 
 
 class NativeChunkEncoder(CpuChunkEncoder):
-    """Byte-identical C++ implementation of the chunk encoder primitives."""
+    """Byte-identical C++ implementation of the chunk encoder primitives.
+
+    encode_many / the launch||assemble split ride the superclass; this
+    backend's hot primitives (dictionary build, RLE/bit-pack, delta,
+    codecs) are GIL-releasing native calls, so _parallel_assembly_ok
+    unlocks column-parallel page assembly across the shared pool — the
+    intra-file counterpart of the reference's thread-per-file data
+    parallelism (KafkaProtoParquetWriter.java:40-41)."""
 
     def __init__(self, options: EncoderOptions) -> None:
         super().__init__(options)
         self._lib = lib()
         self._tl = threading.local()  # per-thread compression scratch
 
-    def encode_many(self, chunks, base_offset: int):
-        """Column-parallel encode: the hot primitives (dictionary build,
-        RLE/bit-pack, delta, codecs) are GIL-releasing native calls, so
-        columns encode concurrently — the intra-file counterpart of the
-        reference's thread-per-file data parallelism
-        (KafkaProtoParquetWriter.java:40-41).  Each chunk encodes at offset
-        0 (page bytes never embed offsets), then footer offsets shift by
-        the running base — byte-identical to the sequential path."""
-        workers = self.options.encoder_threads or (os.cpu_count() or 1)
-        workers = min(workers, len(chunks))
-        if self._lib is None or workers <= 1:
-            return super().encode_many(chunks, base_offset)
-        encoded = list(_shared_pool().map(lambda c: self.encode(c, 0), chunks))
-        return self._shift_offsets(encoded, base_offset)
-
-    @staticmethod
-    def _shift_offsets(encoded, base_offset: int):
-        """Footer-offset fixup for chunks encoded at offset 0 in parallel:
-        the ONE definition of which meta fields carry file offsets, shared
-        by this backend and TpuChunkEncoder.encode_many — a new offset
-        field added here reaches both."""
-        offset = base_offset
-        for e in encoded:
-            m = e.meta
-            if m.dictionary_page_offset is not None:
-                m.dictionary_page_offset += offset
-            m.data_page_offset += offset
-            offset += len(e.blob)
-        return encoded
+    def _parallel_assembly_ok(self) -> bool:
+        return self._lib is not None
 
     @staticmethod
     def _fixed_width_ok(values, pt: int) -> bool:
@@ -160,15 +125,11 @@ class NativeChunkEncoder(CpuChunkEncoder):
         return d.view(values.dtype), idx
 
     def _values_body(self, values, pt: int, encoding: int) -> bytes:
-        from ..core.schema import Encoding
-
         L = self._lib
         if L is not None and encoding == Encoding.DELTA_BINARY_PACKED:
             bit_size = 32 if pt == PhysicalType.INT32 else 64
             return L.delta_binary_packed(np.asarray(values), bit_size)
         if L is not None and encoding == Encoding.DELTA_LENGTH_BYTE_ARRAY:
-            from ..core.bytecol import lens_and_payload
-
             lens, payload = lens_and_payload(values)
             return L.delta_binary_packed(lens, 32) + payload
         return super()._values_body(values, pt, encoding)
@@ -178,8 +139,6 @@ class NativeChunkEncoder(CpuChunkEncoder):
         """DELTA_LENGTH_BYTE_ARRAY without materializing the concatenation:
         [tiny delta-of-lengths header, zero-copy payload view] — the codec
         streams the parts (page bytes unchanged)."""
-        from ..core.schema import Encoding
-
         v = chunk.values
         if (self._lib is not None
                 and encoding == Encoding.DELTA_LENGTH_BYTE_ARRAY
@@ -196,8 +155,6 @@ class NativeChunkEncoder(CpuChunkEncoder):
         per-thread scratch (no Python-side body concatenation, no zeroed
         bounce buffers, no compressed-bytes copy); other codecs take the
         base path."""
-        from ..core.schema import Codec
-
         opts = self.options
         if (self._lib is not None and opts.codec == Codec.ZSTD
                 and self._lib.has_zstd):

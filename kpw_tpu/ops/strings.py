@@ -154,4 +154,9 @@ def device_string_dictionary(col: ByteColumn, max_k: int | None = None,
         timings["prefix_ms"] = round((t1 - t0) * 1e3, 3)
         timings["device_ms"] = round((t2 - t1) * 1e3, 3)
         timings["tiebreak_ms"] = round((t3 - t_tie0) * 1e3, 3)
+        # how much of the column fell to the per-row host tie-break loop
+        # (ADVICE r5 #3): rows with len >= 8 pay Python-level work in two
+        # passes, so a mostly-long column degenerates toward a full host
+        # loop — the probe's reader needs that denominator, not just the ms
+        timings["tiebreak_row_fraction"] = round(long_rows.size / n, 4)
     return dict_values, out_idx

@@ -95,6 +95,21 @@ class MeshChunkEncoder(NativeChunkEncoder):
                 else:  # byte/column counters sum
                     self.ici_stats[k] = self.ici_stats.get(k, 0) + v
 
+    def _merge_string_stats(self, col_stats: dict) -> None:
+        """string_stats counterpart of :meth:`_merge_stats` (ADVICE r5 #1):
+        per-call locals merge under the lock — a shared multi-worker
+        encoder must never read-modify-write the shared dict unlocked, or
+        concurrent BYTE_ARRAY encodes drop counter updates."""
+        with self._stats_lock:
+            for k, v in col_stats.items():
+                if k in ("k_global_max", "k_local_max"):
+                    self.string_stats[k] = max(self.string_stats.get(k, 0), v)
+                elif k == "merge_ms":
+                    self.string_stats[k] = round(
+                        self.string_stats.get(k, 0.0) + v, 3)
+                else:  # column/byte counters sum
+                    self.string_stats[k] = self.string_stats.get(k, 0) + v
+
     def _mesh_string_dictionary(self, values, max_k: int | None):
         """Byte-array dictionary built the way a real multi-host mesh
         would: each shard hashes ITS rows locally (the GIL-releasing C++
@@ -163,20 +178,20 @@ class MeshChunkEncoder(NativeChunkEncoder):
                         overflow = True
                         break
         gk = len(merged)
-        self.string_stats["columns"] = self.string_stats.get("columns", 0) + 1
-        self.string_stats["exchanged_payload_bytes"] = (
-            self.string_stats.get("exchanged_payload_bytes", 0) + exchanged)
-        self.string_stats["k_global_max"] = max(
-            self.string_stats.get("k_global_max", 0), gk)
-        self.string_stats["k_local_max"] = max(
-            [self.string_stats.get("k_local_max", 0)]
-            + [len(u) for u in shard_uniqs])
+        # per-call local accumulation, merged under the stats lock at the
+        # exits (ADVICE r5 #1) — the same protocol as the numeric routes'
+        # _merge_stats, so a shared multi-worker encoder stays exact
+        col_stats = {
+            "columns": 1,
+            "exchanged_payload_bytes": exchanged,
+            "k_global_max": gk,
+            "k_local_max": max([0] + [len(u) for u in shard_uniqs]),
+        }
         if overflow:
-            self.string_stats["overflow_columns"] = (
-                self.string_stats.get("overflow_columns", 0) + 1)
-            self.string_stats["merge_ms"] = round(
-                self.string_stats.get("merge_ms", 0.0)
-                + (_time.perf_counter() - t0) * 1e3, 3)
+            col_stats["overflow_columns"] = 1
+            col_stats["merge_ms"] = round(
+                (_time.perf_counter() - t0) * 1e3, 3)
+            self._merge_string_stats(col_stats)
             return None  # ratio abort: encode() falls back like the oracle
         slot = {v: i for i, v in enumerate(merged)}
         out_idx = np.empty(n, np.uint32)
@@ -186,20 +201,18 @@ class MeshChunkEncoder(NativeChunkEncoder):
             lut = np.fromiter((slot[v] for v in shard_uniqs[s]), np.uint32,
                               len(shard_uniqs[s]))
             out_idx[a:b] = lut[shard_idx[s][: b - a]]
-        self.string_stats["merge_ms"] = round(
-            self.string_stats.get("merge_ms", 0.0)
-            + (_time.perf_counter() - t0) * 1e3, 3)
+        col_stats["merge_ms"] = round((_time.perf_counter() - t0) * 1e3, 3)
+        self._merge_string_stats(col_stats)
         return merged, out_idx
 
-    def encode_many(self, chunks, base_offset: int):
-        """Sequential: each eligible column launches a multi-device SPMD
-        collective program, and concurrent multi-device dispatch from a
-        host thread pool adds contention without parallelism (device work
-        serializes on the same chips anyway) — so the native backend's
-        column-threaded encode_many is deliberately bypassed."""
-        from ..core.pages import CpuChunkEncoder
-
-        return CpuChunkEncoder.encode_many(self, chunks, base_offset)
+    def _parallel_assembly_ok(self) -> bool:
+        """Sequential page assembly, always: each eligible column launches
+        a multi-device SPMD collective program from inside encode(), and
+        concurrent multi-device dispatch from a host thread pool adds
+        contention without parallelism (device work serializes on the same
+        chips anyway) — so the native backend's column-threaded assembly
+        is deliberately disabled."""
+        return False
 
     def _try_dictionary(self, chunk):
         from ..core.bytecol import ByteColumn

@@ -151,6 +151,15 @@ class ParquetFile:
     def get_num_written_records(self) -> int:
         return self._num_records
 
+    def writer_overlap_stats(self) -> dict:
+        """Per-stage busy seconds of the underlying writer's overlapped
+        row-group pipeline (dispatch / assemble / io, zeros on the sync
+        path) plus whether the host-assembly stage is split onto its own
+        thread — the evidence the bench's ``hostasm_overlap`` breakdown
+        and the runtime metrics read, without installing a tracer."""
+        w = self._writer
+        return {"split_assembly": w.has_assembly_stage, **w.stage_busy_s}
+
     # -- internals ---------------------------------------------------------
     def _flush_batch(self) -> None:
         if not self._batch:

@@ -417,17 +417,20 @@ def test_mesh_backend_multi_worker_threads():
 
 def test_shared_encoder_stats_exact_under_threads(mesh8):
     """Workers can SHARE one MeshChunkEncoder (runtime/writer.py hands the
-    same backend object to every worker): ici_stats counters and route_log
-    must come out EXACT under concurrent encodes — per-call local dicts
-    merged under the stats lock, never unlocked read-modify-writes on the
-    shared dicts (review finding, round 5)."""
+    same backend object to every worker): ici_stats counters, string_stats
+    counters and route_log must come out EXACT under concurrent encodes —
+    per-call local dicts merged under the stats lock, never unlocked
+    read-modify-writes on the shared dicts (review finding round 5;
+    string_stats: ADVICE r5 #1)."""
     import threading
 
     from kpw_tpu.core import Schema, WriterProperties, leaf
+    from kpw_tpu.core.bytecol import ByteColumn
     from kpw_tpu.core.pages import ColumnChunkData
     from kpw_tpu.parallel.mesh_encoder import MeshChunkEncoder
 
-    schema = Schema([leaf("b", "int64"), leaf("w", "int64")])
+    schema = Schema([leaf("b", "int64"), leaf("w", "int64"),
+                     leaf("s", "string")])
     enc_opts = WriterProperties().encoder_options()
     menc = MeshChunkEncoder(enc_opts, mesh=mesh8)
     PER_THREAD, THREADS = 4, 4
@@ -444,8 +447,11 @@ def test_shared_encoder_stats_exact_under_threads(mesh8):
             for _ in range(PER_THREAD):
                 bounded = r.integers(0, 1500, 4096).astype(np.int64)
                 wide = r.integers(-700, 700, 4096).astype(np.int64)
+                strs = ByteColumn.from_list(
+                    [b"v%d" % k for k in r.integers(0, 500, 4096)])
                 assert menc._try_dictionary(chunk_for(0, bounded)) is not None
                 assert menc._try_dictionary(chunk_for(1, wide)) is not None
+                assert menc._try_dictionary(chunk_for(2, strs)) is not None
         except Exception as e:  # pragma: no cover - failure reporting
             errs.append(e)
 
@@ -458,10 +464,66 @@ def test_shared_encoder_stats_exact_under_threads(mesh8):
     total = THREADS * PER_THREAD
     assert menc.ici_stats["bounded_columns"] == total
     assert menc.ici_stats["columns"] == total  # gather-side counter
+    # BYTE_ARRAY columns ride _mesh_string_dictionary: its counters merge
+    # under the same lock, so the totals are exact, not approximate
+    assert menc.string_stats["columns"] == total
+    assert menc.string_stats["k_global_max"] == 500
+    assert menc.string_stats.get("overflow_columns", 0) == 0
     routes = [e["route"] for e in menc.route_log]
     assert routes.count("bounded-psum") == total
     assert routes.count("two-phase-gather") == total
     assert all(e["accepted"] for e in menc.route_log)
+
+
+def test_shared_encoder_string_stats_exact_under_threads(mesh8):
+    """Host-only variant of the shared-encoder stats test: BYTE_ARRAY
+    columns never touch the collective path (per-shard C++ hash + k-way
+    union), so string_stats exactness must hold even where the numeric
+    shard_map routes can't run — this is the direct regression test for
+    the unlocked read-modify-write on self.string_stats (ADVICE r5 #1)."""
+    import threading
+
+    from kpw_tpu.core import Schema, WriterProperties, leaf
+    from kpw_tpu.core.bytecol import ByteColumn
+    from kpw_tpu.core.pages import ColumnChunkData
+    from kpw_tpu.parallel.mesh_encoder import MeshChunkEncoder
+
+    schema = Schema([leaf("s", "string")])
+    menc = MeshChunkEncoder(WriterProperties().encoder_options(), mesh=mesh8)
+    if menc._lib is None:
+        pytest.skip("native library unavailable")
+    PER_THREAD, THREADS = 6, 4
+    errs: list = []
+
+    def worker(seed):
+        try:
+            r = np.random.default_rng(seed)
+            for _ in range(PER_THREAD):
+                col = ByteColumn.from_list(
+                    [b"k%05d" % k for k in r.integers(0, 700, 4096)])
+                chunk = ColumnChunkData(schema.columns[0], col,
+                                        num_rows=len(col))
+                built = menc._try_dictionary(chunk)
+                assert built is not None
+                d, idx = built
+                # identity with the single-hash oracle per call
+                assert d == sorted(set(col))
+                assert [d[i] for i in idx[:64]] == list(col)[:64]
+        except Exception as e:  # pragma: no cover - failure reporting
+            errs.append(e)
+
+    ts = [threading.Thread(target=worker, args=(s,)) for s in range(THREADS)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert not errs, errs
+    total = THREADS * PER_THREAD
+    assert menc.string_stats["columns"] == total
+    assert menc.string_stats["k_global_max"] == 700
+    assert menc.string_stats["k_local_max"] <= 700
+    assert menc.string_stats["exchanged_payload_bytes"] > 0
+    assert menc.string_stats.get("overflow_columns", 0) == 0
 
 
 def test_dispatch_lock_covers_only_device_section(mesh8, monkeypatch):

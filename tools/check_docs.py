@@ -47,13 +47,14 @@ CHECKS = [
      ["config3.vs_dist.median", "config3.vs_dist.p90", "config3.vs_dist.best"]),
     ("PARITY.md", r"statistical parity \(([\d.]+)x median\)",
      ["config3.vs_dist.median"]),
-    ("PARITY.md", r"records \*\*([\d.]+)x at 2 host cores\*\* \(the core count",
-     ["config2.projected_system.median.projected_vs_baseline_2core"]),
-    ("PARITY.md", r"and ([\d.]+)x at one core",
+    ("PARITY.md", r"records \*\*([\d.]+)x at one host core\*\* \(the ≥8x bar",
      ["config2.projected_system.median.projected_vs_baseline_1core"]),
-    ("PARITY.md", r"single-run composition records ([\d.]+)x at one core /\s+\*\*([\d.]+)x at 2 cores\*\*",
+    ("PARITY.md", r"single-run composition records\s+\*\*([\d.]+)x\*\* \(host-bound, ([\d.]+)M rows/s/chip",
      ["config2.projected_system.projected_vs_baseline_1core",
-      "config2.projected_system.projected_vs_baseline_2core"]),
+      ("config2.projected_system.projected_rows_per_sec_1core", 1e6)]),
+    ("PARITY.md", r"\*\* best, ([\d.]+) ms\s+median over n=(\d+)",
+     ["config2.projected_system.median.host_assembly_ms_median",
+      "config2.projected_system.median.host_history_n"]),
     ("PARITY.md", r"\*\*affine shape\*\*[^|]*\| \*\*([\d.]+)\*\* \| \*\*([\d.]+)M\*\*",
      ["config2.tpu_rowgroup_affine_ms_per_step",
       ("config2.tpu_rowgroup_affine_rows_per_sec_per_chip", 1e6)]),
@@ -61,25 +62,85 @@ CHECKS = [
      ["config2.rowgroup_ms_dist.median", "config2.rowgroup_ms_dist.best"]),
     ("README.md", r"measures ([\d.]+) ms best \(7",
      ["config2.tpu_rowgroup_nullable_ms_per_step"]),
-    ("README.md", r"median-composed\s+projection records ([\d.]+)× at 2 host cores\*\* \(([\d.]+)× at one\)",
-     ["config2.projected_system.median.projected_vs_baseline_2core",
-      "config2.projected_system.median.projected_vs_baseline_1core"]),
-    ("README.md", r"best\s+single-run composition ([\d.]+)×/([\d.]+)×",
+    ("README.md", r"median-composed\s+projection records ([\d.]+)× at one host core\*\*",
+     ["config2.projected_system.median.projected_vs_baseline_1core"]),
+    ("README.md", r"host leg to a\s+([\d.]+) ms median \(n=(\d+)\)",
+     ["config2.projected_system.median.host_assembly_ms_median",
+      "config2.projected_system.median.host_history_n"]),
+    ("README.md", r"best\s+single-run composition ([\d.]+)× \(host-bound at a ([\d.]+) ms host leg",
      ["config2.projected_system.projected_vs_baseline_1core",
-      "config2.projected_system.projected_vs_baseline_2core"]),
+      "config2.projected_system.host_assembly_ms_1core"]),
     ("README.md", r"the device phase drops to \*\*([\d.]+) ms = ([\d.]+)M",
      ["config2.tpu_rowgroup_affine_ms_per_step",
       ("config2.tpu_rowgroup_affine_rows_per_sec_per_chip", 1e6)]),
 ]
 
 
+# --- cited-artifact-key reconciliation (VERDICT r5 ask #2) -----------------
+# Round 5's docs cited three keys (`encode_side_vs_baseline`,
+# `string_device_probe`, `writer_route`) that no committed sweep contains —
+# present-tense "recorded as `key`" prose for artifacts that were never
+# written.  This pass extracts every backtick-quoted snake_case token whose
+# surrounding sentence claims artifact provenance (recorded/reported/
+# tracked/metric/artifact/block) and fails unless the key actually exists
+# somewhere in the committed sweep JSON.  A claim explicitly labeled
+# "pending"/"next sweep" is exempt: promising a key is honest, asserting a
+# nonexistent one is drift.
+
+KEY_DOCS = ("PARITY.md", "README.md", "BASELINE.md")
+_KEY_TOKEN = re.compile(r"`([a-z][a-z0-9]*(?:_[a-z0-9]+)+)`")
+# provenance cue, looked for in a TIGHT window right before/after the
+# token: a doc only "cites an artifact key" when it claims the number is
+# recorded/reported/tracked there (or names a per-config block/metric) —
+# a cue two sentences away must not turn a code identifier into a claim
+_CITE_CUE = re.compile(
+    r"\brecorded\b|\breported\b|\btracked\b|\bartifact\b|\bmetric\b", re.I)
+_PENDING_CUE = re.compile(r"\bpending\b|\bnext sweep\b|\bwill be\b", re.I)
+_WINDOW_BEFORE, _WINDOW_AFTER = 90, 50
+
+
+def _artifact_key_set(obj, out: set) -> set:
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            out.add(k)
+            _artifact_key_set(v, out)
+    elif isinstance(obj, list):
+        for v in obj:
+            _artifact_key_set(v, out)
+    return out
+
+
+def check_cited_keys(full_record: dict, docs: dict) -> list[str]:
+    keys = _artifact_key_set(full_record, set())
+    failures = []
+    for fname in KEY_DOCS:
+        text = docs[fname]
+        seen = set()
+        for m in _KEY_TOKEN.finditer(text):
+            tok = m.group(1)
+            if tok in keys or (fname, tok) in seen:
+                continue
+            if tok.startswith("test_"):
+                continue  # pytest names, never artifact keys
+            window = text[max(0, m.start() - _WINDOW_BEFORE):
+                          m.end() + _WINDOW_AFTER]
+            if not _CITE_CUE.search(window) or _PENDING_CUE.search(window):
+                continue
+            seen.add((fname, tok))
+            failures.append(
+                f"{fname}: cites artifact key `{tok}` absent from the "
+                f"committed sweep JSON")
+    return failures
+
+
 def main() -> int:
     sweep_path = os.environ.get("KPW_BENCH_SWEEP_PATH",
                                 os.path.join(ROOT, "BENCH_SWEEP_r05.json"))
-    rec = json.load(open(sweep_path))["configs"]
+    full_record = json.load(open(sweep_path))
+    rec = full_record["configs"]
     docs = {f: open(os.path.join(ROOT, f)).read()
-            for f in {c[0] for c in CHECKS}}
-    failures = []
+            for f in ({c[0] for c in CHECKS} | set(KEY_DOCS))}
+    failures = check_cited_keys(full_record, docs)
     for fname, pattern, paths in CHECKS:
         m = re.search(pattern, docs[fname])
         if not m:
