@@ -12,6 +12,9 @@ encode (NYC-taxi-shaped replay, one chip), printed as ONE JSON line
                the cfg2 shape (headline) and the nullable shape
   --hostasm    measure the TPU path's host-side assembly per row group
                (always CPU jax; feeds the projected_system block)
+  --obs        run a short streaming replay under FULL instrumentation
+               (span timeline + gauges + ack lag) and write the Chrome
+               trace + stats snapshot to BENCH_OBS_r06.json
   --cpu        force the virtual CPU platform (local smoke)
 
 Baseline for configs 1/2/3/5 is pyarrow's C++ parquet writer with matched
@@ -855,7 +858,8 @@ def host_assembly_probe(repeats: int = 3) -> dict | None:
     from kpw_tpu.core import ParquetFileWriter, Schema, WriterProperties, \
         columns_from_arrays, leaf
     from kpw_tpu.ops.backend import TpuChunkEncoder
-    from kpw_tpu.utils.tracing import StageTimer, set_tracer
+    from kpw_tpu.utils.tracing import (SpanRecorder, StageTimer,
+                                       set_span_recorder, set_tracer)
 
     rows = 1 << 16
     arrays = make_taxi_like(rows)
@@ -877,9 +881,11 @@ def host_assembly_probe(repeats: int = 3) -> dict | None:
         w.close()
         return buf.tell()
 
-    def timed_stages(o) -> tuple[dict, float]:
+    def timed_stages(o, with_spans: bool = False) -> tuple[dict, float]:
         tracer = StageTimer()
         set_tracer(tracer)
+        if with_spans:
+            set_span_recorder(SpanRecorder())
         try:
             t0 = time.perf_counter()
             for _ in range(repeats):
@@ -887,6 +893,7 @@ def host_assembly_probe(repeats: int = 3) -> dict | None:
             wall = time.perf_counter() - t0
         finally:
             set_tracer(None)
+            set_span_recorder(None)
         return tracer.summary(), wall
 
     run()  # warmup: CPU-jax compiles outside the timing
@@ -914,6 +921,26 @@ def host_assembly_probe(repeats: int = 3) -> dict | None:
         "host_encoder_threads": opts.encoder_threads,
         "host_scaling": "extrapolated",
     }
+    # span-recording overhead A/B (observability PR acceptance: <3% on
+    # the 1-thread assembly leg): the SAME leg with the span ring buffer
+    # ALSO installed.  Interleaved pairs + medians — the per-span cost is
+    # a lock round and a deque append per ~ms-scale row group, far below
+    # this shared box's run-to-run drift, so single mean-of-3 arms swing
+    # ±20% and only pair-wise interleaving isolates the real delta.
+    base_ms, span_ms = [], []
+    for _ in range(7):
+        s_off, _ = timed_stages(opts)
+        base_ms.append(ms("encode.bodies", s_off)
+                       + ms("encode.assemble", s_off))
+        s_on, _ = timed_stages(opts, with_spans=True)
+        span_ms.append(ms("encode.bodies", s_on)
+                       + ms("encode.assemble", s_on))
+    base_med, span_med = _median(base_ms), _median(span_ms)
+    out["host_assembly_ms_spans_off_median"] = round(base_med, 3)
+    out["host_assembly_ms_spans_on_median"] = round(span_med, 3)
+    if base_med > 0:
+        out["tracing_overhead_pct"] = round(
+            (span_med - base_med) / base_med * 100, 2)
     if cores >= 2:
         # measured 2-core assembly (the tentpole ask: host_measured_cores
         # was 1, every *_2core projection extrapolated): same writer, the
@@ -1684,6 +1711,147 @@ def bench_config6() -> dict:
 
 
 # ---------------------------------------------------------------------------
+# --obs: instrumented streaming replay (observability artifact)
+# ---------------------------------------------------------------------------
+
+# stage -> span names that evidence it.  ``dispatch``/``assembly`` cover
+# both the row-group pipeline's split threads (rowgroup.launch /
+# rowgroup.assemble) and the encoder-internal phases (encode.launch /
+# encode.assemble) that also appear when the split is auto-inlined on a
+# single core — either way each pipeline leg leaves >= 1 span.
+OBS_STAGE_SPANS = {
+    "consumer": ("consumer.fetch", "consumer.track"),
+    "dispatch": ("rowgroup.encode", "rowgroup.launch", "encode.launch"),
+    "assembly": ("rowgroup.assemble", "encode.assemble", "encode.bodies"),
+    "io": ("rowgroup.io_write",),
+}
+
+
+def obs_probe(rows: int = 30_000) -> dict:
+    """``--obs`` mode: the observability layer's committed evidence.  Runs
+    a short flat streaming replay (cfg6 shape, scaled down) through the
+    FULL writer with tracing + a metric registry enabled, waits until
+    every produced record is durably published and acked (small
+    max_file_size rotates by size; a 1 s max_file_open_duration rotates
+    the tail by time, so the final ack-lag must reach 0), then records:
+
+    - the span timeline as Chrome-trace JSON (``chrome_trace`` — load it
+      in chrome://tracing / ui.perfetto.dev),
+    - the unified ``writer.stats()`` snapshot (queue high-watermarks,
+      stall seconds, rotation causes, ack lag, stage timers),
+    - per-pipeline-stage span counts (``stage_span_counts``) and the
+      Prometheus rendering of the registry.
+
+    Runs on CPU (the instrumentation, not the encoder, is what's
+    measured); the TpuChunkEncoder backend is used when importable so the
+    dispatch/assembly split stages appear in the timeline."""
+    from kpw_tpu import Builder, FakeBroker, MemoryFileSystem, MetricRegistry
+    from kpw_tpu.runtime.export import registry_to_prometheus
+
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                    "tests"))
+    from proto_helpers import build_classes, _field, _F
+
+    fields = ([_field(f"i{k}", k + 1, _F.TYPE_INT64, _F.LABEL_REQUIRED)
+               for k in range(8)]
+              + [_field(f"s{k}", k + 9, _F.TYPE_STRING, _F.LABEL_REQUIRED)
+                 for k in range(4)])
+    Msg = build_classes("obsbench", {"Replay": fields})["Replay"]
+
+    rng = np.random.default_rng(8)
+    ints = rng.integers(0, 1_000_000, (rows, 8))
+    sidx = rng.integers(0, 100, (rows, 4))
+    pool = [f"cat_{j:03d}" for j in range(100)]
+    broker = FakeBroker()
+    parts = 4
+    broker.create_topic("obs", parts)
+    for r in range(rows):
+        m = Msg()
+        for k in range(8):
+            setattr(m, f"i{k}", int(ints[r, k]))
+        for k in range(4):
+            setattr(m, f"s{k}", pool[sidx[r, k]])
+        broker.produce("obs", m.SerializeToString(), partition=r % parts)
+
+    backend = "cpu"
+    try:
+        from kpw_tpu.ops import backend as _ops_backend  # noqa: F401
+
+        backend = "tpu"  # TpuChunkEncoder on CPU jax: split stages appear
+    except ImportError:
+        print("[bench:obs] TPU encoder backend unavailable; cpu encoder "
+              "(no split assembly stage in the timeline)", file=sys.stderr)
+
+    fs = MemoryFileSystem()
+    reg = MetricRegistry()
+    w = (Builder().broker(broker).topic("obs").proto_class(Msg)
+         .target_dir("/obs").filesystem(fs).instance_name("obsbench")
+         .group_id("obs-run").metric_registry(reg)
+         .encoder_backend(backend)
+         .tracing(True, span_capacity=16384)
+         # several size rotations inside the run; the tail publishes by
+         # TIME so the final ack-lag must drain to zero before close
+         .max_file_size(512 * 1024).block_size(256 * 1024)
+         .max_file_open_duration_seconds(1.0)
+         .build())
+    t0 = time.perf_counter()
+    w.start()
+    deadline = time.time() + 120
+    while w.total_written_records < rows:
+        if time.time() > deadline:
+            raise RuntimeError("obs replay stalled before full write")
+        time.sleep(0.002)
+    t_written = time.perf_counter() - t0
+    while (w.total_flushed_records < rows
+           or w.ack_lag()["unacked_records"] > 0):
+        if time.time() > deadline:
+            raise RuntimeError(
+                f"obs replay never drained: flushed "
+                f"{w.total_flushed_records}/{rows}, lag {w.ack_lag()}")
+        time.sleep(0.01)
+    stats = w.stats()
+    trace = w.span_recorder.to_chrome_trace()
+    prom = registry_to_prometheus(reg)
+    w.close()
+
+    span_names = [e["name"] for e in trace["traceEvents"]
+                  if e.get("ph") == "X"]
+    counts = {leg: sum(span_names.count(n) for n in names)
+              for leg, names in OBS_STAGE_SPANS.items()}
+    missing = [leg for leg, c in counts.items() if c == 0]
+    hwms = {
+        "consumer": stats["consumer"]["queue"]["high_watermark"],
+        **{f"worker0.{q}": qs["high_watermark"]
+           for q, qs in stats["workers"][0]["pipeline"]["queues"].items()},
+    }
+    out = {
+        "metric": "obs_streaming_replay",
+        "value": round(rows / t_written, 1),
+        "unit": "rows/s",
+        "rows": rows,
+        "encoder_backend": backend,
+        "stage_span_counts": counts,
+        "stage_spans_complete": not missing,
+        "queue_high_watermarks": hwms,
+        "final_ack_lag": stats["ack"],
+        "rotations": stats["rotations"],
+        "spans_buffered": stats["spans"]["buffered"],
+        "spans_dropped": stats["spans"]["dropped"],
+        "stats": stats,
+        "chrome_trace": trace,
+        "prometheus_sample": prom.splitlines()[:40],
+    }
+    if missing:
+        print(f"[bench:obs] WARNING: no spans for stages {missing}",
+              file=sys.stderr)
+    print(f"[bench:obs] {rows} rows in {t_written:.2f}s, "
+          f"{len(span_names)} spans, stage counts {counts}, "
+          f"rotations {stats['rotations']}, final lag {stats['ack']}",
+          file=sys.stderr)
+    return out
+
+
+# ---------------------------------------------------------------------------
 # config 7: nested streaming replay (cfg5 shape through the FULL writer)
 # ---------------------------------------------------------------------------
 
@@ -1968,7 +2136,8 @@ def _graded_main() -> None:
 
 def main() -> None:
     if not any(f in sys.argv
-               for f in ("--all", "--rowgroup", "--hostasm", "--config")):
+               for f in ("--all", "--rowgroup", "--hostasm", "--config",
+                         "--obs")):
         # default graded path: jax-free orchestrator (see _graded_main)
         _graded_main()
         return
@@ -1984,9 +2153,9 @@ def main() -> None:
             print("[bench] --all aborted: backend probe hung/failed",
                   file=sys.stderr)
             sys.exit(3)
-    if "--cpu" in sys.argv or "--hostasm" in sys.argv:
-        # --hostasm measures HOST work only and must never grab the real
-        # chip; the switch must precede the first device use below
+    if "--cpu" in sys.argv or "--hostasm" in sys.argv or "--obs" in sys.argv:
+        # --hostasm/--obs measure HOST work only and must never grab the
+        # real chip; the switch must precede the first device use below
         import jax
 
         jax.config.update("jax_platforms", "cpu")
@@ -2129,10 +2298,12 @@ def main() -> None:
                     or k == "tpu_platform"]
 
         def _host_keys(r):
-            # hostasm_overlap rides the host group: its breakdown must
-            # stay self-consistent with the winning run's host numbers
+            # hostasm_overlap + the tracing-overhead A/B ride the host
+            # group: their breakdowns must stay self-consistent with the
+            # winning run's host numbers
             return [k for k in r
-                    if k.startswith("host_") or k == "hostasm_overlap"]
+                    if k.startswith("host_")
+                    or k in ("hostasm_overlap", "tracing_overhead_pct")]
 
         def _proj_keys(r):
             return ["projected_system"] if "projected_system" in r else []
@@ -2258,6 +2429,21 @@ def main() -> None:
         return
     if "--hostasm" in sys.argv:
         print(json.dumps(host_assembly_probe()))
+        return
+    if "--obs" in sys.argv:
+        out = obs_probe()
+        path = os.environ.get(
+            "KPW_OBS_PATH",
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "BENCH_OBS_r06.json"))
+        with open(path, "w") as f:
+            json.dump(out, f, indent=1)
+        print(f"[bench:obs] artifact written to {path}", file=sys.stderr)
+        # stdout line stays small: the full stats/trace live in the artifact
+        summary = {k: v for k, v in out.items()
+                   if k not in ("stats", "chrome_trace", "prometheus_sample")}
+        summary["artifact"] = os.path.basename(path)
+        print(json.dumps(summary))
         return
     if "--config" in sys.argv:
         n = int(sys.argv[sys.argv.index("--config") + 1])

@@ -10,6 +10,13 @@ EncoderBackend (CPU numpy reference vs vmapped TPU kernels).
 
 __version__ = "0.1.0"
 
-from .runtime import Builder, KafkaProtoParquetWriter, MetricRegistry  # noqa: E402,F401
+from .runtime import (  # noqa: E402,F401
+    Builder,
+    Gauge,
+    KafkaProtoParquetWriter,
+    MetricRegistry,
+    registry_to_json,
+    registry_to_prometheus,
+)
 from .ingest import FakeBroker, KafkaBrokerClient, PartitionOffset, SmartCommitConsumer  # noqa: E402,F401
 from .io import HdfsFileSystem, LocalFileSystem, MemoryFileSystem  # noqa: E402,F401

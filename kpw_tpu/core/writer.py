@@ -36,6 +36,72 @@ class PipelineError(RuntimeError):
     dies un-acked and the records are redelivered (at-least-once)."""
 
 
+class StatQueue(queue.Queue):
+    """Bounded stage queue with backpressure instrumentation: live depth,
+    high watermark, and cumulative blocked-on-put / blocked-on-get stall
+    seconds.  Put stall = the producer stage waiting on a full queue (the
+    downstream stage is the bottleneck); get stall = the consumer stage
+    starved (the upstream stage is).  The non-blocking fast path costs one
+    extra try per operation and only the SLOW path (already sleeping on
+    the queue's condition) takes the stats lock around a timer read — the
+    un-contended hot path's overhead is a counter bump."""
+
+    def __init__(self, maxsize: int = 0) -> None:
+        super().__init__(maxsize)
+        self._stat_lock = threading.Lock()
+        self.high_watermark = 0
+        self.put_stall_s = 0.0
+        self.get_stall_s = 0.0
+        self.puts = 0
+        self.gets = 0
+
+    def put(self, item, block: bool = True, timeout=None) -> None:
+        try:
+            super().put(item, block=False)
+        except queue.Full:
+            if not block:
+                raise
+            t0 = time.perf_counter()
+            try:
+                super().put(item, block=True, timeout=timeout)
+            finally:
+                # a timed-out Full still stalled the producer: count it
+                with self._stat_lock:
+                    self.put_stall_s += time.perf_counter() - t0
+        depth = self.qsize()
+        with self._stat_lock:
+            self.puts += 1
+            if depth > self.high_watermark:
+                self.high_watermark = depth
+
+    def get(self, block: bool = True, timeout=None):
+        try:
+            item = super().get(block=False)
+        except queue.Empty:
+            if not block:
+                raise
+            t0 = time.perf_counter()
+            try:
+                item = super().get(block=True, timeout=timeout)
+            finally:
+                with self._stat_lock:
+                    self.get_stall_s += time.perf_counter() - t0
+        with self._stat_lock:
+            self.gets += 1
+        return item
+
+    def stats(self) -> dict:
+        with self._stat_lock:
+            return {
+                "depth": self.qsize(),
+                "high_watermark": self.high_watermark,
+                "put_stall_s": round(self.put_stall_s, 6),
+                "get_stall_s": round(self.get_stall_s, 6),
+                "puts": self.puts,
+                "gets": self.gets,
+            }
+
+
 @dataclass
 class WriterProperties:
     """Mirrors the reference's ParquetProperties (ParquetFile.java:105-122):
@@ -216,6 +282,27 @@ class ParquetFileWriter:
         Sticky across close() so post-run stats stay readable."""
         return self._asm_thread is not None or self._used_assembly_stage
 
+    def pipeline_stats(self) -> dict:
+        """Pull-based pipeline observability snapshot: per-stage busy
+        seconds plus each stage queue's depth / high-watermark / stall
+        accounting (the queue is named for the stage that CONSUMES it:
+        ``dispatch`` feeds the encode-dispatch thread, ``assembly`` the
+        host-assembly thread when split, ``io`` the IO thread).  Queues
+        survive :meth:`close`/:meth:`abandon`, so post-run stats stay
+        readable; empty ``queues`` means the sync (non-pipelined) path."""
+        out: dict = {
+            "split_assembly": self.has_assembly_stage,
+            "stage_busy_s": {k: round(v, 6)
+                             for k, v in self.stage_busy_s.items()},
+            "queues": {},
+        }
+        for name, q in (("dispatch", self._enc_q),
+                        ("assembly", self._asm_q),
+                        ("io", self._io_q)):
+            if q is not None:
+                out["queues"][name] = q.stats()
+        return out
+
     @property
     def size_ratio(self) -> float:
         """Measured on-disk/raw-estimate byte ratio of encoded row groups
@@ -276,14 +363,14 @@ class ParquetFileWriter:
     def _ensure_pipe(self) -> None:
         if self._enc_thread is not None:
             return
-        self._enc_q = queue.Queue(maxsize=1)
-        self._io_q = queue.Queue(maxsize=1)
+        self._enc_q = StatQueue(maxsize=1)
+        self._io_q = StatQueue(maxsize=1)
         # the assembly stage earns its thread only when the encoder can
         # split AND there is a second core to overlap onto; otherwise it
         # auto-inlines into the dispatch thread (3-stage shape, identical
         # behavior to the pre-split pipeline)
         if self._split_assembly_capable() and self._available_cores() > 1:
-            self._asm_q = queue.Queue(maxsize=1)
+            self._asm_q = StatQueue(maxsize=1)
             self._asm_thread = threading.Thread(
                 target=self._assembly_loop, name="kpw-rg-assemble",
                 daemon=True)
@@ -315,7 +402,8 @@ class ParquetFileWriter:
     def _encode_chunks(self, chunks: list[ColumnChunkData]):
         """Encode merged chunks at base offset 0 (absolute offsets are
         assigned at commit time) — shared by the sync and pipelined paths."""
-        with stage("rowgroup.encode"):
+        with stage("rowgroup.encode",
+                   rows=chunks[0].num_rows if chunks else 0):
             if hasattr(self.encoder, "encode_many"):
                 return self.encoder.encode_many(chunks, 0)
             encoded, off = [], 0
@@ -367,7 +455,7 @@ class ParquetFileWriter:
                 t0 = time.perf_counter()
                 chunks = [self._merge_chunks(p) for p in parts]
                 if self._asm_q is not None:
-                    with stage("rowgroup.launch"):
+                    with stage("rowgroup.launch", rows=rows):
                         prepared = self.encoder.launch_many(chunks)
                     self.stage_busy_s["dispatch"] += time.perf_counter() - t0
                     self._asm_q.put((chunks, prepared, rows, est))
@@ -404,7 +492,7 @@ class ParquetFileWriter:
             chunks, prepared, rows, est = item
             try:
                 t0 = time.perf_counter()
-                with stage("rowgroup.assemble"):
+                with stage("rowgroup.assemble", rows=rows):
                     encoded = self.encoder.assemble_many(chunks, prepared, 0)
                 enc_len = self._mark_encoded(encoded, est)
                 self.stage_busy_s["assemble"] += time.perf_counter() - t0
@@ -482,7 +570,8 @@ class ParquetFileWriter:
             parts.extend(e.parts)
             total_byte_size += m.total_uncompressed_size
             total_compressed += m.total_compressed_size
-        with stage("rowgroup.io_write"):
+        with stage("rowgroup.io_write", rowgroup=len(self._row_groups),
+                   rows=num_rows):
             # one seek, then a writev-style gather of every chunk's page
             # buffers: the page bytes go from the encoder's parts straight
             # into the sink — no per-chunk blob join, no whole-row-group

@@ -13,6 +13,7 @@ bounded queue (``maxQueuedRecordsInConsumer``, KPW.java:468).
 from __future__ import annotations
 
 import threading
+import time
 import uuid
 from collections import deque
 
@@ -46,6 +47,19 @@ class SmartCommitConsumer:
         self._buf_count = 0
         self._buf_max = max_queued_records
         self._buf_cond = threading.Condition()
+        # queue observability (all mutated under _buf_cond, so a stats()
+        # reader sees a consistent snapshot): live depth is _buf_count;
+        # high watermark + cumulative fetcher blocked-on-put / worker
+        # blocked-on-get stall seconds are the backpressure evidence
+        self._buf_hwm = 0
+        self._put_stall_s = 0.0
+        self._get_stall_s = 0.0
+        self._records_in = 0
+        self._records_out = 0
+        # fetch-loop skips because a partition hit the open-page bound
+        # (reference offsetTrackerMaxOpenPagesPerPartition backpressure);
+        # only the fetcher thread writes it
+        self._backpressure_skips = 0
         self._fetch_max = fetch_max_records
         self._topic: str | None = None
         self._thread: threading.Thread | None = None
@@ -88,7 +102,9 @@ class SmartCommitConsumer:
         (wait_for: no check-then-wait race, no spurious early None)."""
         with self._buf_cond:
             if timeout is not None and not self._buf:
+                t0 = time.perf_counter()
                 self._buf_cond.wait_for(lambda: bool(self._buf), timeout)
+                self._get_stall_s += time.perf_counter() - t0
             got = self._drain_locked(1)
         return got[0] if got else None
 
@@ -128,6 +144,7 @@ class SmartCommitConsumer:
                 self._head_pos += take
                 self._buf_count -= take
             out.extend(chunk)
+            self._records_out += len(chunk)
             if runs is not None and chunk:
                 first, last = chunk[0], chunk[-1]
                 if last.offset - first.offset == len(chunk) - 1:
@@ -154,14 +171,46 @@ class SmartCommitConsumer:
                 if space <= 0:
                     if not self._running:
                         return False
+                    t0 = time.perf_counter()
                     self._buf_cond.wait(0.05)
+                    self._put_stall_s += time.perf_counter() - t0
                     continue
                 part = records[pos: pos + space] if (pos or space < len(records) - pos) else records
                 self._buf.append(part)
                 self._buf_count += len(part)
+                self._records_in += len(part)
+                if self._buf_count > self._buf_hwm:
+                    self._buf_hwm = self._buf_count
                 pos += len(part)
                 self._buf_cond.notify_all()
         return True
+
+    def queue_depth(self) -> int:
+        """Live record count in the shared bounded buffer."""
+        with self._buf_cond:
+            return self._buf_count
+
+    def stats(self) -> dict:
+        """Pull-based consumer observability snapshot: the shared queue's
+        depth / high-watermark / stall accounting, the fetcher's
+        open-page-backpressure skip count, and the offset tracker's
+        per-partition ack frontier (the delivered-but-uncommitted records
+        behind the at-least-once commit)."""
+        with self._buf_cond:
+            q = {
+                "depth": self._buf_count,
+                "capacity": self._buf_max,
+                "high_watermark": self._buf_hwm,
+                "put_stall_s": round(self._put_stall_s, 6),
+                "get_stall_s": round(self._get_stall_s, 6),
+                "records_in": self._records_in,
+                "records_out": self._records_out,
+            }
+        return {
+            "queue": q,
+            "backpressure_skips": self._backpressure_skips,
+            "tracker": self.tracker.snapshot(),
+        }
 
     def ack(self, po: PartitionOffset) -> None:
         new_commit = self.tracker.ack(po)
@@ -254,7 +303,11 @@ class SmartCommitConsumer:
                 if not self._running:
                     break
                 if self.tracker.is_backpressured(p):
-                    continue  # open-page backpressure (KPW.java:596-611)
+                    # open-page backpressure (KPW.java:596-611): counted so
+                    # a stalled partition is visible from stats(), not just
+                    # inferred from a flat-lining consumer rate
+                    self._backpressure_skips += 1
+                    continue
                 pos = self._positions.get(p, 0)
                 with stage("consumer.fetch"):
                     records = self.broker.fetch(self._topic, p, pos,

@@ -196,3 +196,28 @@ class PagedOffsetTracker:
         with self._lock:
             t = self._parts.get(partition)
             return 0 if t is None else t.delivered - t.committed
+
+    def snapshot(self) -> dict:
+        """All-partition ack-frontier snapshot, one lock round: per
+        partition the committed / delivered frontiers, the pending
+        (delivered-but-uncommitted) gap, and the open-page count that
+        drives backpressure — plus pre-summed totals.  ``pending`` is the
+        tracker-level ack lag: records the consumer delivered whose
+        offsets have not all been acked past the commit frontier yet."""
+        with self._lock:
+            parts = {
+                p: {
+                    "committed": t.committed,
+                    "delivered": t.delivered,
+                    "pending": t.delivered - t.committed,
+                    "open_pages": t.open_pages(),
+                }
+                for p, t in sorted(self._parts.items())
+            }
+        return {
+            "partitions": parts,
+            "pending_total": sum(v["pending"] for v in parts.values()),
+            "open_pages_total": sum(v["open_pages"] for v in parts.values()),
+            "max_open_pages_per_partition": self.max_open_pages,
+            "page_size": self.page_size,
+        }

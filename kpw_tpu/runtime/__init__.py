@@ -2,6 +2,7 @@
 retry, metrics — the reference's L3-L5 layers rebuilt (SURVEY.md §1)."""
 
 from .builder import Builder  # noqa: F401
-from .metrics import MetricRegistry  # noqa: F401
+from .export import registry_to_json, registry_to_prometheus  # noqa: F401
+from .metrics import Gauge, MetricRegistry  # noqa: F401
 from .parquet_file import ParquetFile  # noqa: F401
 from .writer import KafkaProtoParquetWriter  # noqa: F401

@@ -67,6 +67,11 @@ class Builder:
         self._batch_size = 4096
         self._on_parse_error = "raise"  # parity: poison pill kills the worker
         self._clean_abandoned_tmp = False  # opt-in tmp GC at start()
+        # observability: span-timeline tracing (utils/tracing.py).  Off by
+        # default — the disabled stage() path is a true no-op
+        self._tracing = False
+        self._trace_span_capacity = 65536
+        self._trace_path: str | None = None
 
     # -- required ----------------------------------------------------------
     def broker(self, broker) -> "Builder":
@@ -257,6 +262,30 @@ class Builder:
         leftovers the reference never GCs, SURVEY.md §3.5).  Off by default:
         only safe when at most one live writer uses this instance name."""
         self._clean_abandoned_tmp = flag
+        return self
+
+    def tracing(self, flag: bool = True,
+                span_capacity: int = 65536) -> "Builder":
+        """Record per-stage spans while the writer runs: start() installs a
+        process-wide StageTimer + SpanRecorder (a bounded ring buffer of
+        ``span_capacity`` spans, oldest evicted first) that every
+        ``stage(...)`` site feeds; close() uninstalls them.  Read the
+        results via ``writer.stats()`` (cumulative stage timers) and
+        ``writer.write_trace(path)`` (Chrome/Perfetto timeline JSON).
+        Process-wide: two concurrently-started tracing writers would share
+        one recorder — enable it on the writer under investigation."""
+        self._tracing = flag
+        if span_capacity <= 0:
+            raise ValueError("span_capacity must be positive")
+        self._trace_span_capacity = span_capacity
+        return self
+
+    def trace_path(self, path: str | None) -> "Builder":
+        """Write the span timeline as Chrome-trace JSON to ``path`` at
+        close().  Implies :meth:`tracing`."""
+        self._trace_path = path
+        if path:
+            self._tracing = True
         return self
 
     def on_parse_error(self, policy: str) -> "Builder":

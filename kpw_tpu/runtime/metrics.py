@@ -10,6 +10,7 @@ written≠flushed distinction (buffered vs durable) is load-bearing and kept.
 from __future__ import annotations
 
 import math
+import random
 import threading
 import time
 
@@ -85,7 +86,12 @@ class Meter:
 
     @property
     def count(self) -> int:
-        return self._count
+        # locked like the rate getters: a bare int read is atomic in
+        # CPython, but a reader racing mark() could otherwise observe the
+        # count before the EWMA update it belongs with — take the same
+        # lock so concurrent readers see a consistent counter
+        with self._lock:
+            return self._count
 
     def _rate(self, ewma: _EWMA) -> float:
         with self._lock:
@@ -109,6 +115,21 @@ class Meter:
         with self._lock:
             elapsed = self._clock() - self._start
             return self._count / elapsed if elapsed > 0 else 0.0
+
+    def snapshot(self) -> dict:
+        """Count + all rates in one lock round (a stats() scrape reading
+        the four properties separately would tick four times and could
+        interleave with a concurrent mark)."""
+        with self._lock:
+            self._tick_if_necessary()
+            elapsed = self._clock() - self._start
+            return {
+                "count": self._count,
+                "mean_rate": self._count / elapsed if elapsed > 0 else 0.0,
+                "m1_rate": self._m1.rate,
+                "m5_rate": self._m5.rate,
+                "m15_rate": self._m15.rate,
+            }
 
 
 _RESCALE_SECONDS = 3600.0  # Dropwizard ExponentiallyDecayingReservoir
@@ -150,8 +171,6 @@ class Histogram:
         }
 
     def update(self, value: float) -> None:
-        import random
-
         with self._lock:
             now = self._clock()
             self._rescale_if_needed(now)
@@ -178,8 +197,10 @@ class Histogram:
         with self._lock:
             self._rescale_if_needed(self._clock())
             entries = sorted(self._samples.values())  # by value
+            count = self._count  # same lock round: count matches quantiles
         if not entries:
-            return {"min": 0, "max": 0, "mean": 0, "p50": 0, "p95": 0}
+            return {"min": 0, "max": 0, "mean": 0, "p50": 0, "p95": 0,
+                    "p99": 0, "count": count}
         total_w = sum(w for _, w in entries)
 
         def q(p: float) -> float:
@@ -198,7 +219,47 @@ class Histogram:
             "mean": sum(v * w for v, w in entries) / total_w,
             "p50": q(0.5),
             "p95": q(0.95),
+            # file-size tails: rotation-band verification needs the p99
+            # (one oversized file in a hundred is exactly what the ~1%
+            # overshoot bound is about)
+            "p99": q(0.99),
+            "count": count,
         }
+
+
+class Gauge:
+    """Point-in-time value: either set explicitly (``set``) or backed by a
+    callable sampled at read time (``set_function`` — the pull-based shape:
+    the live structure is read only when something scrapes the registry).
+    Dropwizard registers gauges the same two ways."""
+
+    def __init__(self, fn=None) -> None:
+        self._fn = fn
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._fn = None
+            self._value = float(value)
+
+    def set_function(self, fn) -> None:
+        """Back the gauge with a zero-arg callable, sampled on read."""
+        with self._lock:
+            self._fn = fn
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            fn = self._fn
+            if fn is None:
+                return self._value
+        try:
+            return float(fn())
+        except Exception:
+            # a dying provider (e.g. a closed writer's structures) must
+            # never take the scrape down with it
+            return float("nan")
 
 
 class MetricRegistry:
@@ -224,6 +285,23 @@ class MetricRegistry:
                 self._metrics[name] = h
             return h
 
+    def gauge(self, name: str, fn=None) -> Gauge:
+        """Get-or-create a gauge; ``fn`` (optional zero-arg callable)
+        installs/replaces the read-time provider."""
+        with self._lock:
+            g = self._metrics.get(name)
+            if g is None:
+                g = Gauge()
+                self._metrics[name] = g
+            elif not isinstance(g, Gauge):
+                # fail intelligibly, not with an AttributeError later
+                raise TypeError(
+                    f"metric {name!r} already registered as "
+                    f"{type(g).__name__}, not Gauge")
+        if fn is not None:
+            g.set_function(fn)
+        return g
+
     def get(self, name: str):
         return self._metrics.get(name)
 
@@ -237,3 +315,27 @@ FLUSHED_RECORDS_METER = "parquet.writer.flushed.records"
 WRITTEN_BYTES_METER = "parquet.writer.written.bytes"
 FLUSHED_BYTES_METER = "parquet.writer.flushed.bytes"
 FILE_SIZE_HISTOGRAM = "parquet.writer.file.size"
+# observability layer (beyond the reference, which has no gauges):
+# at-least-once ack lag — records accepted (written) but not yet durably
+# acked, and the age of the oldest unacked offset — plus rotation-cause
+# meters and the shared consumer queue's live depth
+ACK_LAG_GAUGE = "parquet.writer.ack.lag.records"
+ACK_AGE_GAUGE = "parquet.writer.ack.oldest.age.seconds"
+ROTATED_SIZE_METER = "parquet.writer.rotated.size"
+ROTATED_TIME_METER = "parquet.writer.rotated.time"
+CONSUMER_QUEUE_DEPTH_GAUGE = "consumer.queue.depth"
+
+# the canonical registry docs cite from (tools/check_docs.py verifies
+# every doc-cited metric name is listed here)
+METRIC_NAMES = (
+    WRITTEN_RECORDS_METER,
+    FLUSHED_RECORDS_METER,
+    WRITTEN_BYTES_METER,
+    FLUSHED_BYTES_METER,
+    FILE_SIZE_HISTOGRAM,
+    ACK_LAG_GAUGE,
+    ACK_AGE_GAUGE,
+    ROTATED_SIZE_METER,
+    ROTATED_TIME_METER,
+    CONSUMER_QUEUE_DEPTH_GAUGE,
+)

@@ -47,6 +47,12 @@ class ParquetFile:
         self._est_record_bytes = float(est_record_bytes)
         self._creation_time = time.time()
         self._closed = False
+        # why this file left service: "size" (crossed max_file_size),
+        # "time" (max_file_open_duration), "close" (writer shutdown
+        # abandoned the open tmp), "error" (worker died), or None while
+        # still open.  Set by the worker at the rotation decision point;
+        # feeds the rotation-cause meters and per-file observability
+        self.rotation_reason: str | None = None
 
     # -- reference API -----------------------------------------------------
     def write(self, record) -> None:
@@ -159,6 +165,17 @@ class ParquetFile:
         and the runtime metrics read, without installing a tracer."""
         w = self._writer
         return {"split_assembly": w.has_assembly_stage, **w.stage_busy_s}
+
+    def pipeline_stats(self) -> dict:
+        """Full pipeline observability snapshot of the underlying writer:
+        per-stage busy seconds plus each stage queue's depth /
+        high-watermark / blocked-on-put / blocked-on-get stall accounting
+        (core.writer.StatQueue).  Readable after close/abandon — the
+        worker folds rotated-away files' stats into its running totals."""
+        out = self._writer.pipeline_stats()
+        out["rotation_reason"] = self.rotation_reason
+        out["records"] = self._num_records
+        return out
 
     # -- internals ---------------------------------------------------------
     def _flush_batch(self) -> None:

@@ -21,6 +21,7 @@ from datetime import datetime
 from ..ingest.consumer import SmartCommitConsumer
 from ..ingest.offsets import PartitionOffset
 from ..models.proto_bridge import ProtoColumnarizer
+from ..utils import tracing
 from . import metrics as M
 from .parquet_file import ParquetFile
 from .retry import RetryInterrupted, try_until_succeeds
@@ -80,6 +81,22 @@ class KafkaProtoParquetWriter:
         self._flushed_bytes = reg.meter(M.FLUSHED_BYTES_METER) if reg else M.Meter()
         self._file_size_histogram = (reg.histogram(M.FILE_SIZE_HISTOGRAM)
                                      if reg else M.Histogram())
+        # rotation-cause meters + pull-sampled gauges (observability layer;
+        # the reference has neither — its only rotation evidence is the
+        # published file names).  The gauges are function-backed: the live
+        # structures are read only when the registry is scraped.
+        self._rotated_size = reg.meter(M.ROTATED_SIZE_METER) if reg else M.Meter()
+        self._rotated_time = reg.meter(M.ROTATED_TIME_METER) if reg else M.Meter()
+        if reg:
+            reg.gauge(M.ACK_LAG_GAUGE,
+                      lambda: self.ack_lag()["unacked_records"])
+            reg.gauge(M.ACK_AGE_GAUGE,
+                      lambda: self.ack_lag()["oldest_unacked_age_s"])
+            reg.gauge(M.CONSUMER_QUEUE_DEPTH_GAUGE, self.consumer.queue_depth)
+        # tracing owned by this writer when the Builder asked for it
+        # (installed at start(), uninstalled at close() iff still ours)
+        self.stage_timer: tracing.StageTimer | None = None
+        self.span_recorder: tracing.SpanRecorder | None = None
 
     def _make_encoder_factory(self, backend):
         if backend == "cpu" or backend is None:
@@ -112,6 +129,15 @@ class KafkaProtoParquetWriter:
             raise ValueError("already started")
         self._started = True
         logger.info("Starting tpu parquet writer '%s'", self._b._instance_name)
+        if self._b._tracing:
+            # process-wide install (the stage() seam is global); the writer
+            # owns these instances and removes them at close() unless
+            # something else replaced them first
+            self.stage_timer = tracing.StageTimer()
+            self.span_recorder = tracing.SpanRecorder(
+                capacity=self._b._trace_span_capacity)
+            tracing.set_tracer(self.stage_timer)
+            tracing.set_span_recorder(self.span_recorder)
         if self._b._clean_abandoned_tmp:
             self._gc_abandoned_tmp()
         self.consumer.start()
@@ -154,6 +180,21 @@ class KafkaProtoParquetWriter:
         for w in self._workers:
             w.close()
         self.consumer.close()
+        if self.span_recorder is not None:
+            if self._b._trace_path:
+                try:
+                    self.span_recorder.write_chrome_trace(self._b._trace_path)
+                    logger.info("Wrote span timeline to %s",
+                                self._b._trace_path)
+                except OSError:
+                    logger.exception("Could not write trace to %s",
+                                     self._b._trace_path)
+            # uninstall only what is still ours: a second writer (or the
+            # user) may have installed its own tracer meanwhile
+            if tracing.get_span_recorder() is self.span_recorder:
+                tracing.set_span_recorder(None)
+            if tracing.get_tracer() is self.stage_timer:
+                tracing.set_tracer(None)
         logger.info("Writer '%s' closed", self._b._instance_name)
 
     def __enter__(self):
@@ -162,6 +203,76 @@ class KafkaProtoParquetWriter:
 
     def __exit__(self, *exc):
         self.close()
+
+    # -- observability (beyond the reference: SURVEY.md §5 had only
+    # lifecycle logging) ----------------------------------------------------
+    def ack_lag(self) -> dict:
+        """The load-bearing at-least-once observable: records accepted
+        (written into an open file) whose offsets have NOT been durably
+        acked yet — they would be redelivered on a crash right now — and
+        the age of the oldest such record's first write.  Zero lag means
+        every accepted record's file has been published and its offsets
+        committed."""
+        now = time.time()
+        lag = 0
+        oldest: float | None = None
+        for w in self._workers:
+            lag += w._unacked_count
+            ts = w._oldest_unacked_ts
+            if ts is not None and (oldest is None or ts < oldest):
+                oldest = ts
+        return {
+            "unacked_records": lag,
+            "oldest_unacked_age_s": (round(now - oldest, 6)
+                                     if oldest is not None else 0.0),
+        }
+
+    def stats(self) -> dict:
+        """One pull-based snapshot of the whole pipeline, JSON-serializable
+        by construction: meters (keyed by their canonical metric names),
+        the file-size histogram, rotation-cause counts, ack lag, the
+        consumer's queue/tracker state, per-worker row-group pipeline
+        gauges (stage busy seconds + queue depth / high-watermark / stall),
+        and — when tracing is installed — the cumulative stage timers and
+        span-buffer occupancy.  written ≠ flushed ≠ acked: written counts
+        records buffered into an open file, flushed counts records in
+        published files, acked means the offsets are committed."""
+        out: dict = {
+            "meters": {
+                M.WRITTEN_RECORDS_METER: self._written_records.snapshot(),
+                M.WRITTEN_BYTES_METER: self._written_bytes.snapshot(),
+                M.FLUSHED_RECORDS_METER: self._flushed_records.snapshot(),
+                M.FLUSHED_BYTES_METER: self._flushed_bytes.snapshot(),
+            },
+            "file_size": self._file_size_histogram.snapshot(),
+            "rotations": {
+                "size": self._rotated_size.count,
+                "time": self._rotated_time.count,
+            },
+            "ack": self.ack_lag(),
+            "consumer": self.consumer.stats(),
+            "workers": [w.observability() for w in self._workers],
+        }
+        # writer-OWNED tracing only: the process-global seam may hold a
+        # different writer's (or the user's) instruments, and attributing
+        # their timings to this writer would be misdirection — users who
+        # installed their own tracer already hold its handle
+        if self.stage_timer is not None:
+            out["stages"] = self.stage_timer.summary()
+        if self.span_recorder is not None:
+            out["spans"] = {"buffered": len(self.span_recorder),
+                            "dropped": self.span_recorder.dropped,
+                            "capacity": self.span_recorder.capacity}
+        return out
+
+    def write_trace(self, path: str) -> None:
+        """Dump the span timeline recorded so far as Chrome-trace JSON
+        (requires Builder.tracing; close() also writes it when a
+        trace_path was configured)."""
+        if self.span_recorder is None:
+            raise ValueError("tracing not enabled on this writer "
+                             "(Builder.tracing)")
+        self.span_recorder.write_chrome_trace(path)
 
     # -- programmatic metrics (KPW.java:201-210) ---------------------------
     @property
@@ -203,6 +314,17 @@ class _Worker:
         # encoded-bytes/record estimate carried across rotations so every
         # file (not just the first's successors) rotates tightly
         self._carry_est = 64.0
+        # ack-lag accounting: records in _written_runs (written, not yet
+        # acked) and when the oldest of them was first written.  Written by
+        # this worker thread only; the parent's ack_lag() reads them
+        # lock-free (a slightly stale int is fine for a gauge)
+        self._unacked_count = 0
+        self._oldest_unacked_ts: float | None = None
+        # cumulative pipeline stats of rotated-away files, folded at each
+        # finalize/abandon so high watermarks and stall time survive
+        # rotation (a per-file snapshot alone would reset every ~1 GiB)
+        self._pipe_totals: dict = {"files": 0, "split_assembly": False,
+                                   "stage_busy_s": {}, "queues": {}}
 
     def start(self) -> None:
         self._thread.start()
@@ -215,7 +337,9 @@ class _Worker:
         self._stop.set()
         self._thread.join(timeout=30)
         if self.current_file is not None:
+            self.current_file.rotation_reason = "close"
             self.current_file.abandon()
+            self._fold_pipe_stats(self.current_file)
             self.current_file = None
 
     # -- loop (KPW.java:253-292) -------------------------------------------
@@ -237,7 +361,7 @@ class _Worker:
             while not self._stop.is_set():
                 if (self.current_file is not None
                         and self._is_file_timed_out()):
-                    self._finalize_current_file()
+                    self._finalize_current_file("time")
                 # batch granularity follows the LIVE bytes/record estimate,
                 # not the static 64 B guess: small-record streams (nested
                 # cfg7-shaped, ~10 B/record encoded) were capped at 1/16 of
@@ -311,8 +435,10 @@ class _Worker:
             # its offsets were never acked)
             if self.current_file is not None:
                 try:
+                    self.current_file.rotation_reason = "error"
                     self.current_file.abandon()
                 finally:
+                    self._fold_pipe_stats(self.current_file)
                     self.current_file = None
 
     def _try_wire_batch(self, recs, runs) -> bool:
@@ -355,24 +481,39 @@ class _Worker:
         batches are fetch-batch slices)."""
         runs = self._written_runs
         run = runs[-1] if runs else None
+        n = 0
         for r in records:
+            n += 1
             if run is not None and run[0] == r.partition and run[2] == r.offset:
                 run[2] += 1
             else:
                 run = [r.partition, r.offset, r.offset + 1]
                 runs.append(run)
+        self._note_unacked(n)
 
     def _note_written_runs(self, polled_runs) -> None:
         """Fold (partition, start, count) runs from poll_many_runs into the
         held ack runs — O(runs), not O(records)."""
         runs = self._written_runs
         last = runs[-1] if runs else None
+        n = 0
         for part, start, count in polled_runs:
+            n += count
             if last is not None and last[0] == part and last[2] == start:
                 last[2] = start + count
             else:
                 last = [part, start, start + count]
                 runs.append(last)
+        self._note_unacked(n)
+
+    def _note_unacked(self, n: int) -> None:
+        """Ack-lag bookkeeping: n more records written but not yet acked;
+        stamp the oldest-unacked clock on the 0 -> n transition."""
+        if n <= 0:
+            return
+        if self._oldest_unacked_ts is None:
+            self._oldest_unacked_ts = time.time()
+        self._unacked_count += n
 
     def _poll_cap(self, base: int) -> int:
         """Shrink the poll batch as the open file nears its size threshold:
@@ -412,6 +553,62 @@ class _Worker:
         with self.p.fs.open_append(path) as f:
             f.write(frame)
 
+    # -- observability -----------------------------------------------------
+    def _fold_pipe_stats(self, f: ParquetFile) -> None:
+        """Fold a finished file's pipeline stats into the worker's running
+        totals (stall seconds and put/get counts sum; high watermarks
+        max).  Never raises: observability must not take down the
+        rotation path."""
+        try:
+            self._fold_into(self._pipe_totals, f.pipeline_stats())
+        except Exception:
+            logger.exception("pipeline-stat fold failed (ignored)")
+
+    @staticmethod
+    def _fold_into(tot: dict, ps: dict) -> None:
+        tot["files"] += 1
+        tot["split_assembly"] = (tot["split_assembly"]
+                                 or ps.get("split_assembly", False))
+        busy = tot["stage_busy_s"]
+        for k, v in ps.get("stage_busy_s", {}).items():
+            busy[k] = round(busy.get(k, 0.0) + v, 6)
+        for qname, qs in ps.get("queues", {}).items():
+            agg = tot["queues"].setdefault(
+                qname, {"high_watermark": 0, "put_stall_s": 0.0,
+                        "get_stall_s": 0.0, "puts": 0, "gets": 0})
+            agg["high_watermark"] = max(agg["high_watermark"],
+                                        qs.get("high_watermark", 0))
+            for k in ("put_stall_s", "get_stall_s"):
+                agg[k] = round(agg[k] + qs.get(k, 0.0), 6)
+            for k in ("puts", "gets"):
+                agg[k] += qs.get(k, 0)
+
+    def observability(self) -> dict:
+        """This worker's pull-based snapshot: ack-lag contribution plus
+        pipeline totals (rotated-away files folded + the live file's
+        stats merged in)."""
+        tot = {
+            "files": self._pipe_totals["files"],
+            "split_assembly": self._pipe_totals["split_assembly"],
+            "stage_busy_s": dict(self._pipe_totals["stage_busy_s"]),
+            "queues": {q: dict(v)
+                       for q, v in self._pipe_totals["queues"].items()},
+        }
+        f = self.current_file
+        if f is not None:
+            try:
+                self._fold_into(tot, f.pipeline_stats())
+            except Exception:
+                pass  # file may be rotating away under us
+        ts = self._oldest_unacked_ts
+        return {
+            "worker": self.index,
+            "unacked_records": self._unacked_count,
+            "oldest_unacked_age_s": (round(time.time() - ts, 6)
+                                     if ts is not None else 0.0),
+            "pipeline": tot,
+        }
+
     # -- file management ---------------------------------------------------
     def _tmp_path(self) -> str:
         # targetDir/tmp/{instance}_{idx}_{rand}.tmp (KPW.java:236-239)
@@ -448,18 +645,22 @@ class _Worker:
         ts = _format_now(self.p._b._file_date_time_pattern)
         return f"{ts}_{self.p._b._instance_name}_{self.index}{self.p._b._file_extension}"
 
-    def _finalize_current_file(self) -> None:
+    def _finalize_current_file(self, reason: str = "size") -> None:
         """Close (flush+footer) -> rename/publish -> ack.  Order is the
-        correctness protocol (KPW.java:325-351)."""
+        correctness protocol (KPW.java:325-351).  ``reason`` records why
+        the file rotated ("size" | "time") for the rotation-cause
+        meters."""
         f = self.current_file
         if f is None:
             return
+        f.rotation_reason = reason
         self._carry_est = f.est_record_bytes
         if f.get_num_written_records() == 0:
             # never publish empty files; just drop the tmp
             try_until_succeeds(f.close, stop_event=self._stop)
             try_until_succeeds(lambda: self.p.fs.delete(f.path),
                                stop_event=self._stop)
+            self._fold_pipe_stats(f)
             self.current_file = None
             return
         try_until_succeeds(f.close, stop_event=self._stop)
@@ -467,12 +668,17 @@ class _Worker:
         self.p._flushed_records.mark(self._file_records)
         self.p._flushed_bytes.mark(size)
         self.p._file_size_histogram.update(size)
+        (self.p._rotated_time if reason == "time"
+         else self.p._rotated_size).mark()
         self._rename_and_move(f.path)
+        self._fold_pipe_stats(f)
         self.current_file = None
         # ack strictly after durable publish (KPW.java:347-350)
         for partition, start, end in self._written_runs:
             self.p.consumer.ack_run(partition, start, end - start)
         self._written_runs.clear()
+        self._unacked_count = 0
+        self._oldest_unacked_ts = None
 
     def _rename_and_move(self, tmp_path: str) -> None:
         # (KPW.java:359-378)

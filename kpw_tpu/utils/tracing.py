@@ -5,11 +5,16 @@ KafkaProtoParquetWriter.java:172-197).  The TPU rebuild needs real stage
 attribution because the pipeline is host ingest / device encode / host
 flush: a slowdown can hide in device dispatch, host assembly, or IO.
 
-Two layers, both zero-cost when disabled:
+Three layers, all zero-cost when disabled:
 
-- :class:`StageTimer` — cumulative wall-clock + call counts per stage,
-  queryable programmatically (the metrics analog of the reference's
+- :class:`StageTimer` — cumulative wall-clock + call counts + min/max per
+  stage, queryable programmatically (the metrics analog of the reference's
   written/flushed meters, KPW.java:144-151, but for time).
+- :class:`SpanRecorder` — a bounded, thread-safe ring buffer of individual
+  spans (name, thread, start, duration, optional attrs like row-group
+  ordinal or file path), exportable as Chrome/Perfetto ``trace_event``
+  JSON so dispatch-vs-assembly-vs-IO overlap is visually inspectable on
+  a timeline instead of inferred from cumulative sums.
 - ``jax.profiler.TraceAnnotation`` — when a JAX profiler trace is being
   captured, the same ``stage(...)`` spans show up on the TensorBoard/Perfetto
   timeline against the device activity.
@@ -17,9 +22,33 @@ Two layers, both zero-cost when disabled:
 
 from __future__ import annotations
 
+import json
 import threading
 import time
+from collections import deque
 from contextlib import contextmanager
+
+# Canonical stage-name registry: every name ``stage(...)`` is called with
+# anywhere in the codebase.  Docs cite these names; tools/check_docs.py
+# verifies each cited name exists here so a rename cannot silently orphan
+# a doc claim.  Grouped by pipeline leg:
+#   consumer.* — the smart-commit fetcher thread (ingest/consumer.py)
+#   worker.*   — the per-worker poll loop (runtime/writer.py)
+#   rowgroup.* — the row-group pipeline stages (core/writer.py)
+#   encode.*   — the encoder's internal phases (ops/backend.py)
+STAGE_NAMES = (
+    "consumer.fetch",
+    "consumer.track",
+    "worker.shred",
+    "worker.append",
+    "rowgroup.encode",
+    "rowgroup.launch",
+    "rowgroup.assemble",
+    "rowgroup.io_write",
+    "encode.launch",
+    "encode.bodies",
+    "encode.assemble",
+)
 
 
 class StageTimer:
@@ -29,16 +58,25 @@ class StageTimer:
         self._lock = threading.Lock()
         self._total: dict[str, float] = {}
         self._count: dict[str, int] = {}
+        self._min: dict[str, float] = {}
+        self._max: dict[str, float] = {}
 
     def record(self, name: str, seconds: float) -> None:
         with self._lock:
             self._total[name] = self._total.get(name, 0.0) + seconds
             self._count[name] = self._count.get(name, 0) + 1
+            if seconds < self._min.get(name, float("inf")):
+                self._min[name] = seconds
+            if seconds > self._max.get(name, float("-inf")):
+                self._max[name] = seconds
 
     def summary(self) -> dict[str, dict[str, float]]:
         with self._lock:
             return {
-                name: {"seconds": self._total[name], "calls": self._count[name]}
+                name: {"seconds": self._total[name],
+                       "calls": self._count[name],
+                       "min": self._min[name],
+                       "max": self._max[name]}
                 for name in sorted(self._total)
             }
 
@@ -46,9 +84,111 @@ class StageTimer:
         with self._lock:
             self._total.clear()
             self._count.clear()
+            self._min.clear()
+            self._max.clear()
+
+
+class SpanRecorder:
+    """Bounded thread-safe ring buffer of per-event spans.
+
+    Each span is (name, thread_name, thread_id, start_s, duration_s,
+    attrs) with ``start_s`` relative to the recorder's creation.  The
+    buffer is a ``deque(maxlen=capacity)``: at capacity the OLDEST spans
+    are evicted, so a long run keeps the most recent window — the part a
+    live investigation actually wants — at O(capacity) memory.  Append is
+    one lock round per span; spans here are stage-granular (row groups,
+    fetch batches), not per record, so the hot path never sees more than
+    a few thousand appends per second."""
+
+    def __init__(self, capacity: int = 65536) -> None:
+        if capacity <= 0:
+            raise ValueError("capacity must be positive")
+        self.capacity = capacity
+        self._lock = threading.Lock()
+        self._spans: deque = deque(maxlen=capacity)
+        self._dropped = 0
+        # wall-clock anchor + monotonic epoch: spans are timed with
+        # perf_counter (monotonic, ns resolution) but anchored to an
+        # absolute wall time so multiple recorders/processes can be lined up
+        self.epoch_wall = time.time()
+        self._epoch = time.perf_counter()
+
+    def now(self) -> float:
+        """Seconds since the recorder's epoch (span clock)."""
+        return time.perf_counter() - self._epoch
+
+    def record(self, name: str, thread_name: str, thread_id: int,
+               start_s: float, duration_s: float,
+               attrs: dict | None = None) -> None:
+        with self._lock:
+            if len(self._spans) == self._spans.maxlen:
+                self._dropped += 1
+            self._spans.append(
+                (name, thread_name, thread_id, start_s, duration_s, attrs))
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._spans)
+
+    @property
+    def dropped(self) -> int:
+        """Spans evicted by the ring bound (oldest-first)."""
+        with self._lock:
+            return self._dropped
+
+    def snapshot(self) -> list[tuple]:
+        """Consistent copy of the buffered spans, oldest first."""
+        with self._lock:
+            return list(self._spans)
+
+    def to_chrome_trace(self) -> dict:
+        """Chrome/Perfetto ``trace_event`` JSON (the ``chrome://tracing``
+        / https://ui.perfetto.dev object format): one complete event
+        (``ph: "X"``) per span, microsecond ``ts``/``dur``, ``tid`` =
+        recording thread.  Thread names ride ``thread_name`` metadata
+        events so the timeline rows are labeled kpw-rg-encode /
+        kpw-rg-assemble / kpw-rg-io / worker threads."""
+        spans = self.snapshot()
+        events = []
+        thread_names: dict[int, str] = {}
+        for name, tname, tid, start_s, dur_s, attrs in spans:
+            thread_names.setdefault(tid, tname)
+            ev = {
+                "name": name,
+                "ph": "X",
+                "ts": round(start_s * 1e6, 3),
+                "dur": round(dur_s * 1e6, 3),
+                "pid": 1,
+                "tid": tid,
+                "cat": name.split(".", 1)[0],
+            }
+            if attrs:
+                ev["args"] = attrs
+            events.append(ev)
+        for tid, tname in thread_names.items():
+            events.append({
+                "name": "thread_name", "ph": "M", "pid": 1, "tid": tid,
+                "args": {"name": tname},
+            })
+        return {
+            "traceEvents": events,
+            "displayTimeUnit": "ms",
+            "otherData": {
+                "recorder_epoch_unix_s": self.epoch_wall,
+                "spans_dropped": self.dropped,
+                "span_capacity": self.capacity,
+            },
+        }
+
+    def write_chrome_trace(self, path: str) -> None:
+        """Serialize :meth:`to_chrome_trace` to ``path`` (open the file in
+        chrome://tracing or ui.perfetto.dev)."""
+        with open(path, "w") as f:
+            json.dump(self.to_chrome_trace(), f)
 
 
 _tracer: StageTimer | None = None
+_recorder: SpanRecorder | None = None
 
 
 def set_tracer(tracer: StageTimer | None) -> None:
@@ -61,13 +201,27 @@ def get_tracer() -> StageTimer | None:
     return _tracer
 
 
+def set_span_recorder(recorder: SpanRecorder | None) -> None:
+    """Install (or remove) the process-wide span ring buffer.  Orthogonal
+    to :func:`set_tracer`: either, both, or neither may be installed."""
+    global _recorder
+    _recorder = recorder
+
+
+def get_span_recorder() -> SpanRecorder | None:
+    return _recorder
+
+
 @contextmanager
-def stage(name: str):
-    """Span a pipeline stage: feeds the installed StageTimer and annotates
-    the JAX profiler timeline.  A true no-op (just a yield) when no tracer is
-    installed, so the hot path pays nothing by default."""
+def stage(name: str, **attrs):
+    """Span a pipeline stage: feeds the installed StageTimer and/or
+    SpanRecorder and annotates the JAX profiler timeline.  A true no-op
+    (just a yield) when neither is installed, so the hot path pays nothing
+    by default.  ``attrs`` (row-group ordinal, file path, batch rows, ...)
+    are only consumed when a SpanRecorder is installed."""
     tracer = _tracer
-    if tracer is None:
+    recorder = _recorder
+    if tracer is None and recorder is None:
         yield
         return
     annotation = None
@@ -84,4 +238,10 @@ def stage(name: str):
     finally:
         if annotation is not None:
             annotation.__exit__(None, None, None)
-        tracer.record(name, time.perf_counter() - t0)
+        dt = time.perf_counter() - t0
+        if tracer is not None:
+            tracer.record(name, dt)
+        if recorder is not None:
+            t = threading.current_thread()
+            recorder.record(name, t.name, t.ident or 0,
+                            t0 - recorder._epoch, dt, attrs or None)

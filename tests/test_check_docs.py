@@ -43,6 +43,56 @@ def test_neutral_code_identifier_not_a_claim():
     assert _failures("tune `encoder_threads` to size the pool.") == []
 
 
+# --- cited stage/metric-name reconciliation (observability PR) -------------
+
+NAMES = {"rowgroup.encode", "rowgroup.assemble",
+         "parquet.writer.file.size", "parquet.writer.ack.lag.records"}
+
+
+def _name_failures(text: str) -> list:
+    docs = {f: "" for f in check_docs.NAME_DOCS}
+    docs["PARITY.md"] = text
+    return check_docs.check_cited_names(docs, names=NAMES)
+
+
+def test_unknown_stage_name_flagged():
+    out = _name_failures("host work hides in the `rowgroup.asemble` stage.")
+    assert len(out) == 1 and "rowgroup.asemble" in out[0]
+
+
+def test_unknown_metric_name_flagged():
+    out = _name_failures("watch `parquet.writer.ack.lag.seconds` climb.")
+    assert len(out) == 1 and "parquet.writer.ack.lag.seconds" in out[0]
+
+
+def test_known_names_pass():
+    assert _name_failures(
+        "`rowgroup.encode` feeds `parquet.writer.file.size`; the "
+        "`parquet.writer.ack.lag.records` gauge drains to 0.") == []
+
+
+def test_foreign_prefix_ignored():
+    # dotted tokens outside the registry's prefixes are file names / API
+    # references, not stage citations
+    assert _name_failures("see `bench.py` and `jax.lax.sort` for details.") == []
+
+
+def test_duplicate_citation_reported_once():
+    out = _name_failures("`rowgroup.bogus` here and `rowgroup.bogus` there.")
+    assert len(out) == 1
+
+
+def test_canonical_registry_importable():
+    """The real registries back the checker: every name used by a stage()
+    call site must be present (spot-check the pipeline's load-bearing
+    ones)."""
+    names = check_docs._canonical_names()
+    assert {"consumer.fetch", "worker.shred", "rowgroup.launch",
+            "rowgroup.assemble", "rowgroup.io_write", "encode.assemble",
+            "parquet.writer.written.records",
+            "parquet.writer.ack.lag.records"} <= names
+
+
 def test_committed_docs_reconcile():
     """The repo's own docs + sweep artifact must pass the full checker."""
     assert check_docs.main() == 0

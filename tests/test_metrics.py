@@ -4,8 +4,14 @@ java:111-119 registers Dropwizard Meters): 1/5/15-minute EWMAs ticked every
 a fake clock so the assertions are exact."""
 
 import math
+import threading
 
-from kpw_tpu.runtime.metrics import Histogram, Meter, MetricRegistry
+from kpw_tpu.runtime.export import (
+    prometheus_name,
+    registry_to_json,
+    registry_to_prometheus,
+)
+from kpw_tpu.runtime.metrics import Gauge, Histogram, Meter, MetricRegistry
 
 
 class FakeClock:
@@ -75,11 +81,60 @@ def test_steady_state_converges_to_true_rate():
     assert m.mean_rate == 1000 * 120 / 600.0
 
 
+def test_meter_count_exact_under_threads():
+    """Meter.count now takes the lock like the rate getters: concurrent
+    marks never lose an increment and readers see consistent counts."""
+    m = Meter()
+    n_threads, n_marks = 8, 500
+
+    def work() -> None:
+        for _ in range(n_marks):
+            m.mark(2)
+            m.count  # interleaved reads must not disturb the counter
+
+    threads = [threading.Thread(target=work) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert m.count == 2 * n_threads * n_marks
+
+
+def test_meter_snapshot_consistent():
+    clk = FakeClock()
+    m = Meter(clock=clk)
+    m.mark(500)
+    clk.t += 5.0
+    s = m.snapshot()
+    assert s["count"] == 500
+    assert s["m1_rate"] == 100.0
+    assert s["mean_rate"] == 100.0
+
+
 def test_registry_returns_same_instance():
     r = MetricRegistry()
     assert r.meter("x") is r.meter("x")
     assert r.histogram("h") is r.histogram("h")
-    assert "h" in r.names() and "x" in r.names()
+    assert r.gauge("g") is r.gauge("g")
+    assert {"g", "h", "x"} <= set(r.names())
+
+
+def test_gauge_set_and_function():
+    g = Gauge()
+    assert g.value == 0.0
+    g.set(7)
+    assert g.value == 7.0
+    box = {"v": 1}
+    g.set_function(lambda: box["v"])
+    box["v"] = 42
+    assert g.value == 42.0
+    g.set(3.5)  # explicit set replaces the provider
+    assert g.value == 3.5
+
+
+def test_gauge_raising_provider_yields_nan():
+    g = Gauge(fn=lambda: 1 / 0)
+    assert math.isnan(g.value)  # a dead provider must not break a scrape
 
 
 def test_histogram_snapshot():
@@ -90,6 +145,62 @@ def test_histogram_snapshot():
     assert s["min"] == 1.0 and s["max"] == 100.0
     assert h.count == 100
     assert 45 <= s["p50"] <= 55
+
+
+def test_histogram_weighted_quantiles_exact():
+    """Weighted-snapshot quantile path (Dropwizard WeightedSnapshot): with
+    a frozen clock every sample carries weight 1, the reservoir holds all
+    of them, and each quantile is exactly the first value whose cumulative
+    normalized weight crosses p — deterministic, including the new p99."""
+    clk = FakeClock()
+    h = Histogram(reservoir=256, clock=clk)
+    for v in range(1, 101):
+        h.update(float(v))
+    s = h.snapshot()
+    assert s["p50"] == 50.0
+    assert s["p95"] == 95.0
+    assert s["p99"] == 99.0
+    assert s["mean"] == sum(range(1, 101)) / 100
+
+
+def test_histogram_p99_tail_dominates():
+    """p99 is the rotation-band tail observable: one oversized file in ~50
+    must move p99 while leaving p50/p95 put."""
+    clk = FakeClock()
+    h = Histogram(reservoir=256, clock=clk)
+    for _ in range(98):
+        h.update(100.0)
+    h.update(900.0)
+    h.update(900.0)
+    s = h.snapshot()
+    assert s["p50"] == 100.0 and s["p95"] == 100.0
+    assert s["p99"] == 900.0
+    assert s["max"] == 900.0
+
+
+def test_empty_histogram_snapshot_has_p99():
+    s = Histogram().snapshot()
+    assert s == {"min": 0, "max": 0, "mean": 0, "p50": 0, "p95": 0,
+                 "p99": 0, "count": 0}
+
+
+def test_registry_gauge_name_collision_raises():
+    import pytest
+
+    r = MetricRegistry()
+    r.meter("m")
+    with pytest.raises(TypeError):
+        r.gauge("m")
+
+
+def test_dead_gauge_renders_null_json():
+    r = MetricRegistry()
+    r.gauge("dead", fn=lambda: 1 / 0)
+    doc = registry_to_json(r)
+    assert doc["dead"]["value"] is None  # NaN would be RFC-invalid JSON
+    import json
+
+    json.loads(json.dumps(doc))
 
 
 def test_histogram_decays_toward_recent_data():
@@ -109,6 +220,44 @@ def test_histogram_decays_toward_recent_data():
     assert s["p95"] == 900.0
     assert s["mean"] > 850.0
     assert h.count == 1200
+
+
+def test_prometheus_name_sanitization():
+    assert (prometheus_name("parquet.writer.written.records")
+            == "parquet_writer_written_records")
+    assert prometheus_name("9bad") .startswith("_")
+
+
+def test_registry_prometheus_rendering():
+    r = MetricRegistry()
+    r.meter("parquet.writer.written.records").mark(7)
+    for v in (10.0, 20.0, 900.0):
+        r.histogram("parquet.writer.file.size").update(v)
+    r.gauge("parquet.writer.ack.lag.records").set(3)
+    text = registry_to_prometheus(r)
+    assert "# TYPE parquet_writer_written_records_total counter" in text
+    assert "parquet_writer_written_records_total 7" in text
+    assert 'parquet_writer_written_records_rate{window="1m"}' in text
+    assert 'parquet_writer_file_size{quantile="0.99"} 900' in text
+    assert "parquet_writer_file_size_count 3" in text
+    assert "parquet_writer_ack_lag_records 3" in text
+    # exposition format: every non-comment line is "name[{labels}] value"
+    for line in text.strip().splitlines():
+        if not line.startswith("#"):
+            assert len(line.rsplit(" ", 1)) == 2
+
+
+def test_registry_json_rendering():
+    import json
+
+    r = MetricRegistry()
+    r.meter("m").mark(2)
+    r.histogram("h").update(5.0)
+    r.gauge("g", fn=lambda: 11)
+    doc = json.loads(json.dumps(registry_to_json(r)))
+    assert doc["m"]["type"] == "meter" and doc["m"]["count"] == 2
+    assert doc["h"]["type"] == "histogram" and doc["h"]["p99"] == 5.0
+    assert doc["g"] == {"type": "gauge", "value": 11.0}
 
 
 def test_histogram_rescale_preserves_snapshot():

@@ -1,16 +1,27 @@
 """Stage tracing (SURVEY.md §5: the rebuild's tracing/profiling subsystem)."""
 
 import io
+import json
+import threading
+import time
 
 import numpy as np
 
 from kpw_tpu.core import ParquetFileWriter, Schema, WriterProperties, columns_from_arrays, leaf
 from kpw_tpu.ops import TpuChunkEncoder
-from kpw_tpu.utils import StageTimer, set_tracer, stage
+from kpw_tpu.utils import (
+    STAGE_NAMES,
+    SpanRecorder,
+    StageTimer,
+    set_span_recorder,
+    set_tracer,
+    stage,
+)
 
 
 def test_stage_noop_without_tracer():
     set_tracer(None)
+    set_span_recorder(None)
     with stage("anything"):
         pass  # must not raise or record
 
@@ -34,3 +45,112 @@ def test_stage_timing_pipeline():
     assert {"rowgroup.encode", "rowgroup.io_write",
             "encode.launch", "encode.assemble"} <= set(s)
     assert all(v["calls"] >= 1 and v["seconds"] >= 0 for v in s.values())
+    # every stage name observed anywhere must be in the canonical registry
+    assert set(s) <= set(STAGE_NAMES)
+
+
+def test_stage_timer_min_max():
+    t = StageTimer()
+    t.record("x", 0.25)
+    t.record("x", 0.05)
+    t.record("x", 0.10)
+    s = t.summary()["x"]
+    assert s["calls"] == 3
+    assert s["min"] == 0.05 and s["max"] == 0.25
+    assert abs(s["seconds"] - 0.40) < 1e-12
+    t.reset()
+    assert t.summary() == {}
+
+
+def test_stage_timer_threaded_exact_counts():
+    """Concurrent recorders through the stage() seam: exact call counts,
+    consistent totals/min/max under contention."""
+    timer = StageTimer()
+    recorder = SpanRecorder(capacity=10_000)
+    set_tracer(timer)
+    set_span_recorder(recorder)
+    n_threads, n_calls = 8, 200
+
+    def work(i: int) -> None:
+        for k in range(n_calls):
+            with stage("mt.shared"):
+                pass
+            with stage(f"mt.only{i}"):
+                pass
+
+    try:
+        threads = [threading.Thread(target=work, args=(i,))
+                   for i in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    finally:
+        set_tracer(None)
+        set_span_recorder(None)
+    s = timer.summary()
+    assert s["mt.shared"]["calls"] == n_threads * n_calls
+    for i in range(n_threads):
+        assert s[f"mt.only{i}"]["calls"] == n_calls
+    for v in s.values():
+        assert 0 <= v["min"] <= v["max"] <= v["seconds"] + 1e-12
+    # the span ring saw every call too (capacity was not exceeded)
+    assert len(recorder) == 2 * n_threads * n_calls
+    assert recorder.dropped == 0
+
+
+def test_disabled_tracing_records_nothing():
+    """The disabled hot path must leave the ring buffer empty: a recorder
+    that exists but is not installed sees zero entries."""
+    recorder = SpanRecorder()
+    set_tracer(None)
+    set_span_recorder(None)
+    for _ in range(50):
+        with stage("never.recorded", attr=1):
+            pass
+    assert len(recorder) == 0
+    assert recorder.dropped == 0
+
+
+def test_span_ring_bound_evicts_oldest():
+    r = SpanRecorder(capacity=4)
+    set_span_recorder(r)
+    try:
+        for i in range(10):
+            with stage("ring.span", i=i):
+                pass
+    finally:
+        set_span_recorder(None)
+    assert len(r) == 4
+    assert r.dropped == 6
+    # the surviving spans are the MOST RECENT four
+    kept = [s[5]["i"] for s in r.snapshot()]
+    assert kept == [6, 7, 8, 9]
+
+
+def test_chrome_trace_roundtrip():
+    """Export -> json round trip with well-formed ph/ts/dur fields, thread
+    labeling metadata, and attrs riding args."""
+    r = SpanRecorder(capacity=64)
+    set_span_recorder(r)
+    try:
+        with stage("trace.outer", rowgroup=3, rows=100):
+            time.sleep(0.002)
+        with stage("trace.inner"):
+            pass
+    finally:
+        set_span_recorder(None)
+    doc = json.loads(json.dumps(r.to_chrome_trace()))
+    events = doc["traceEvents"]
+    xs = [e for e in events if e["ph"] == "X"]
+    assert {e["name"] for e in xs} == {"trace.outer", "trace.inner"}
+    for e in xs:
+        assert isinstance(e["ts"], (int, float)) and e["ts"] >= 0
+        assert isinstance(e["dur"], (int, float)) and e["dur"] >= 0
+        assert e["pid"] == 1 and isinstance(e["tid"], int)
+    outer = next(e for e in xs if e["name"] == "trace.outer")
+    assert outer["args"] == {"rowgroup": 3, "rows": 100}
+    assert outer["dur"] >= 2000  # slept 2 ms; dur is microseconds
+    meta = [e for e in events if e["ph"] == "M"]
+    assert meta and all(e["name"] == "thread_name" for e in meta)
+    assert doc["otherData"]["spans_dropped"] == 0

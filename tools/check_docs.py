@@ -110,6 +110,47 @@ def _artifact_key_set(obj, out: set) -> set:
     return out
 
 
+# --- cited stage/metric-name reconciliation (observability PR) -------------
+# Docs cite pipeline stage names (`rowgroup.assemble`) and metric names
+# (`parquet.writer.ack.lag.records`).  Both live in canonical in-code
+# registries — tracing.STAGE_NAMES and metrics.METRIC_NAMES — so a rename
+# there would silently orphan every doc claim built on the old name.  This
+# pass extracts every backtick-quoted dotted lowercase token whose first
+# segment matches a registry prefix (consumer/worker/rowgroup/encode/
+# parquet) and fails unless the full name exists in a registry.
+
+NAME_DOCS = ("PARITY.md", "README.md")
+_DOTTED_TOKEN = re.compile(r"`([a-z][a-z0-9_]*(?:\.[a-z0-9_]+)+)`")
+
+
+def _canonical_names() -> set:
+    sys.path.insert(0, ROOT)
+    from kpw_tpu.runtime.metrics import METRIC_NAMES
+    from kpw_tpu.utils.tracing import STAGE_NAMES
+
+    return set(METRIC_NAMES) | set(STAGE_NAMES)
+
+
+def check_cited_names(docs: dict, names: set | None = None) -> list[str]:
+    if names is None:
+        names = _canonical_names()
+    prefixes = {n.split(".", 1)[0] for n in names}
+    failures = []
+    for fname in NAME_DOCS:
+        seen = set()
+        for m in _DOTTED_TOKEN.finditer(docs[fname]):
+            tok = m.group(1)
+            if (tok.split(".", 1)[0] not in prefixes or tok in names
+                    or tok in seen):
+                continue
+            seen.add(tok)
+            failures.append(
+                f"{fname}: cites stage/metric name `{tok}` absent from the "
+                f"canonical registry (tracing.STAGE_NAMES / "
+                f"metrics.METRIC_NAMES)")
+    return failures
+
+
 def check_cited_keys(full_record: dict, docs: dict) -> list[str]:
     keys = _artifact_key_set(full_record, set())
     failures = []
@@ -138,9 +179,18 @@ def main() -> int:
                                 os.path.join(ROOT, "BENCH_SWEEP_r05.json"))
     full_record = json.load(open(sweep_path))
     rec = full_record["configs"]
+    # the observability artifact (bench.py --obs) is a second committed
+    # key source: docs citing its keys reconcile against it the same way
+    obs_path = os.environ.get("KPW_OBS_PATH",
+                              os.path.join(ROOT, "BENCH_OBS_r06.json"))
+    key_record: dict = {"sweep": full_record}
+    if os.path.exists(obs_path):
+        key_record["obs"] = json.load(open(obs_path))
     docs = {f: open(os.path.join(ROOT, f)).read()
-            for f in ({c[0] for c in CHECKS} | set(KEY_DOCS))}
-    failures = check_cited_keys(full_record, docs)
+            for f in ({c[0] for c in CHECKS} | set(KEY_DOCS)
+                      | set(NAME_DOCS))}
+    failures = check_cited_keys(key_record, docs)
+    failures += check_cited_names(docs)
     for fname, pattern, paths in CHECKS:
         m = re.search(pattern, docs[fname])
         if not m:
