@@ -15,6 +15,11 @@ encode (NYC-taxi-shaped replay, one chip), printed as ONE JSON line
   --obs        run a short streaming replay under FULL instrumentation
                (span timeline + gauges + ack lag) and write the Chrome
                trace + stats snapshot to BENCH_OBS_r06.json
+  --chaos      run a seeded fault-injection replay (IO faults, worker
+               kills, rename failures, rebalance) through the full writer
+               with supervision, check the at-least-once invariant
+               mechanically, A/B the disabled overhead, and write
+               BENCH_CHAOS_r07.json
   --cpu        force the virtual CPU platform (local smoke)
 
 Baseline for configs 1/2/3/5 is pyarrow's C++ parquet writer with matched
@@ -1852,6 +1857,275 @@ def obs_probe(rows: int = 30_000) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# --chaos: seeded fault-injection replay (robustness artifact)
+# ---------------------------------------------------------------------------
+
+def _chaos_messages(rows: int, pad: int = 100):
+    """Pre-serialized indexed payloads: timestamp = global index is the
+    record identity the invariant check resolves acked offsets through."""
+    return _chaos_messages_range(0, rows, pad)
+
+
+def _chaos_messages_range(start: int, end: int, pad: int = 100):
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                    "tests"))
+    from proto_helpers import sample_message_class
+
+    cls = sample_message_class()
+    filler = "x" * pad
+    return [cls(query=f"q-{i}-{filler}", timestamp=i).SerializeToString()
+            for i in range(start, end)]
+
+
+def _chaos_writer(broker, fs, parts, supervise: bool, group: str,
+                  threads: int = 1):
+    from kpw_tpu import Builder, RetryPolicy
+
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                    "tests"))
+    from proto_helpers import sample_message_class
+
+    b = (Builder().broker(broker).topic("chaos")
+         .proto_class(sample_message_class()).target_dir("/chaos")
+         .filesystem(fs).instance_name("chaosbench").group_id(group)
+         .thread_count(threads).batch_size(256)
+         .retry_policy(RetryPolicy(base_sleep=0.005, max_sleep=0.05))
+         .max_file_size(256 * 1024).block_size(32 * 1024)
+         .max_file_open_duration_seconds(0.5))
+    if supervise:
+        b.supervise(True, max_restarts=6, restart_backoff_seconds=0.01)
+    return b.build()
+
+
+def _chaos_drain(w, broker, parts, rows, group: str, deadline_s: float,
+                 expected_deaths: int = 0,
+                 sched=None) -> tuple[float, float]:
+    """Run to full drain; returns (seconds to every record written,
+    seconds to every record acked with ack-lag 0).  The written time is
+    the hot-path figure the overhead A/B compares — the drain time is
+    quantized by the time-rotation tail (up to max_file_open_duration)
+    and only proves recovery, not speed.  With a schedule: phase 1 runs
+    under fire until all records were written AND the scheduled kills
+    landed, then disarms."""
+    t0 = time.perf_counter()
+    w.start()
+    deadline = time.time() + deadline_s
+    t_written = None
+    while time.time() < deadline:
+        if (w.total_written_records >= rows
+                and (sched is None or w._failed.count >= expected_deaths)):
+            t_written = time.perf_counter() - t0
+            break
+        # 1 ms poll: the A/B compares ~100-300 ms written-times, so a
+        # coarser poll would quantize the very deltas it measures
+        time.sleep(0.001)
+    if sched is not None:
+        sched.stop()
+    while time.time() < deadline:
+        if (sum(broker.committed(group, "chaos", p) for p in range(parts))
+                >= rows and w.ack_lag()["unacked_records"] == 0):
+            if t_written is None:
+                t_written = time.perf_counter() - t0
+            return t_written, time.perf_counter() - t0
+        time.sleep(0.01)
+    raise RuntimeError(
+        f"chaos replay never drained: committed "
+        f"{[broker.committed(group, 'chaos', p) for p in range(parts)]}, "
+        f"lag {w.ack_lag()}")
+
+
+def chaos_probe(rows: int = 20_000, seed: int = 7,
+                ab_pairs: int = 9) -> dict:
+    """``--chaos`` mode: the robustness layer's committed evidence.
+
+    Part 1 — seeded chaos replay: a fixed fault schedule (transient EIO on
+    write/rename/fetch/commit, a torn write, latency stalls, one fatal
+    ENOSPC worker kill, one forced rebalance) drives the FULL writer with
+    supervision on; after the faults stop the run must drain, and the
+    at-least-once invariant is checked mechanically: every acked offset's
+    record appears in a published (renamed) file, no tmp file is counted
+    as published, ack-lag reaches exactly 0.
+
+    Part 2 — disabled-overhead A/B: interleaved pairs of the same clean
+    replay with the robustness layer absent (arm A: bare filesystem/broker,
+    no supervision) vs installed-but-idle (arm B: empty fault schedule
+    wrappers + supervision enabled).  Pairwise medians, same methodology as
+    the PR-2 tracing A/B: single-shot arms swing +-20% on this shared box.
+    """
+    import errno as _errno
+
+    from kpw_tpu import (FakeBroker, FaultInjectingBroker,
+                         FaultInjectingFileSystem, FaultSchedule,
+                         MemoryFileSystem)
+    import pyarrow.parquet as pq
+
+    parts = 2
+    payloads = _chaos_messages(rows)
+
+    def fresh_broker():
+        b = FakeBroker()
+        b.create_topic("chaos", parts)
+        for i, p in enumerate(payloads):
+            b.produce("chaos", p, partition=i % parts)
+        return b
+
+    # -- part 1: the chaos run --------------------------------------------
+    sched = (FaultSchedule(seed=seed)
+             .fail_nth("write", 24, err=_errno.ENOSPC)   # fatal: worker kill
+             .fail_random("write", 8, 120)               # scattered EIO
+             .fail_nth("write", 15, partial=0.5)         # torn write
+             .fail_nth("rename", 2, count=2)             # publish faults
+             .fail_random("fetch", 4, 100)
+             .fail_nth("commit", 2)
+             .delay_nth("write", 30, 0.05, count=2))     # latency injection
+    plan = sched.plan()
+    broker = fresh_broker()
+    fb = FaultInjectingBroker(broker, sched, rebalance_on_fetch=(8,))
+    fs = FaultInjectingFileSystem(MemoryFileSystem(), sched)
+    w = _chaos_writer(fb, fs, parts, supervise=True, group="chaos-run")
+    _, drain_s = _chaos_drain(w, broker, parts, rows, "chaos-run", 120,
+                              expected_deaths=1, sched=sched)
+    stats = w.stats()
+
+    all_parquet = fs.list_files("/chaos", extension=".parquet")
+    # a published file must live OUTSIDE the tmp dir: a .parquet inside
+    # /chaos/tmp (or any .tmp-suffixed survivor of the listing) is a
+    # protocol violation and is COUNTED, not silently filtered away
+    tmp_published = sum(1 for f in all_parquet
+                        if "/chaos/tmp/" in f or f.endswith(".tmp"))
+    files = [f for f in all_parquet
+             if "/chaos/tmp/" not in f and not f.endswith(".tmp")]
+    got: dict = {}
+    for f in files:
+        for r in pq.read_table(fs.open_read(f)).to_pylist():
+            got[r["timestamp"]] = got.get(r["timestamp"], 0) + 1
+    missing_acked = 0
+    committed_total = 0
+    for p in range(parts):
+        committed = broker.committed("chaos-run", "chaos", p)
+        committed_total += committed
+        for off in range(committed):
+            if got.get(off * parts + p, 0) < 1:
+                missing_acked += 1
+    # identity: record i went to partition i%parts at offset i//parts, so
+    # (p, off) -> i = off*parts + p  (round-robin produce above)
+    published_total = sum(got.values())
+    duplicates = published_total - len(got)
+    invariant = (missing_acked == 0 and tmp_published == 0
+                 and stats["ack"]["unacked_records"] == 0
+                 and committed_total >= rows)
+    w.close()
+
+    outcome = {
+        "rows": rows,
+        "drain_seconds": round(drain_s, 3),
+        "faults_fired": len([e for e in sched.fired()
+                             if e["errno"] is not None]),
+        "fired_by_op": {},
+        "worker_deaths": stats["meters"]["parquet.writer.failed"]["count"],
+        "worker_restarts": stats["supervision"]["restarts_total"],
+        "worker_retries": stats["meters"]["parquet.writer.retries"]["count"],
+        "broker_retries": stats["consumer"]["broker_retries"],
+        "redelivered_records": stats["consumer"]["redelivered_records"],
+        "published_files": len(files),
+        "published_records": published_total,
+        "duplicate_records": duplicates,
+        "tmp_published": tmp_published,
+        "acked_offsets_checked": committed_total,
+        "acked_but_missing": missing_acked,
+        "final_ack_lag": stats["ack"],
+        "invariant_holds": invariant,
+    }
+    for e in sched.fired():
+        op = e["op"] if e["errno"] is not None else f"{e['op']}(event)"
+        outcome["fired_by_op"][op] = outcome["fired_by_op"].get(op, 0) + 1
+    print(f"[bench:chaos] {rows} rows drained in {drain_s:.2f}s under "
+          f"{outcome['faults_fired']} faults; deaths "
+          f"{outcome['worker_deaths']}, restarts "
+          f"{outcome['worker_restarts']}, duplicates {duplicates}, "
+          f"invariant_holds={invariant}", file=sys.stderr)
+
+    # -- part 2: disabled-overhead A/B ------------------------------------
+    # longer arms than the chaos run: written-time on this box carries
+    # ±10-30 ms of thread-handoff jitter regardless of run length, so the
+    # arm must be long enough (~0.6 s) to keep that under the 3% bar's
+    # resolution
+    ab_rows = 60_000
+    ab_payloads = payloads + _chaos_messages_range(rows, ab_rows)
+
+    def arm(enabled: bool, i: int) -> float:
+        b = FakeBroker()
+        b.create_topic("chaos", parts)
+        for j, p in enumerate(ab_payloads):
+            b.produce("chaos", p, partition=j % parts)
+        if enabled:
+            empty = FaultSchedule(seed=0)  # installed but idle
+            fsx = FaultInjectingFileSystem(MemoryFileSystem(), empty)
+            brx = FaultInjectingBroker(b, empty)
+        else:
+            fsx = MemoryFileSystem()
+            brx = b
+        wx = _chaos_writer(brx, fsx, parts, supervise=enabled,
+                           group=f"ab-{int(enabled)}-{i}")
+        # the WRITTEN time is the comparison: drain time is quantized by
+        # the tail's time-based rotation (0..0.5 s), pure noise here
+        t_written, _ = _chaos_drain(wx, b, parts, ab_rows,
+                                    f"ab-{int(enabled)}-{i}", 60)
+        wx.close()
+        return t_written
+
+    arm(False, 98)  # warm BOTH arms: first-run allocator/heap growth must
+    arm(True, 99)   # not land inside either arm's measured window
+    t_off, t_on, deltas = [], [], []
+    for i in range(ab_pairs):
+        # min-of-3 per arm (the uncontended cost on this noisy shared
+        # 2-core box; single reps carry +10-30% scheduling outliers),
+        # order alternating per pair so slow drift cancels
+        order = (False, True) if i % 2 == 0 else (True, False)
+        pair = {}
+        for enabled in order:
+            pair[enabled] = min(arm(enabled, 3 * i + r) for r in range(3))
+        t_off.append(pair[False])
+        t_on.append(pair[True])
+        deltas.append((pair[True] - pair[False]) / pair[False] * 100)
+    off_med, on_med = _median(t_off), _median(t_on)
+    # PR-2 methodology: overhead = delta of the two arm MEDIANS (each arm
+    # entry already min-of-3).  The per-pair deltas are recorded alongside
+    # for variance visibility — their median is outlier-tenderer on this
+    # box (a single +30% scheduling event lands in one pair's ratio but
+    # washes out of an arm median).
+    overhead = ((on_med - off_med) / off_med * 100) if off_med > 0 else 0.0
+    out = {
+        "metric": "chaos_at_least_once",
+        "value": outcome["worker_restarts"],
+        "unit": "supervised restarts",
+        "seed": seed,
+        "fault_schedule": plan,
+        "rebalance_on_fetch": [8],
+        "fault_log": sched.fired(),
+        "outcome": outcome,
+        "disabled_overhead_pct": round(overhead, 2),
+        "ab_rows": ab_rows,
+        "ab_pairs": ab_pairs,
+        "ab_seconds_off": [round(t, 3) for t in t_off],
+        "ab_seconds_on": [round(t, 3) for t in t_on],
+        "ab_pair_deltas_pct": [round(d, 2) for d in deltas],
+        "ab_policy": ("interleaved pairs (order alternating), min-of-3 per "
+                      "arm per pair, overhead = delta of arm medians (PR-2 "
+                      "tracing-A/B methodology): arm A = bare fs/broker + "
+                      "no supervision, arm B = empty-schedule fault "
+                      "wrappers + supervision enabled (zero faults fire); "
+                      "compared on time-to-all-written (the hot path) — "
+                      "drain time is quantized by the tail's time "
+                      "rotation"),
+    }
+    print(f"[bench:chaos] disabled-overhead A/B: off {off_med:.3f}s vs on "
+          f"{on_med:.3f}s median over {ab_pairs} pairs -> "
+          f"{overhead:+.2f}%", file=sys.stderr)
+    return out
+
+
+# ---------------------------------------------------------------------------
 # config 7: nested streaming replay (cfg5 shape through the FULL writer)
 # ---------------------------------------------------------------------------
 
@@ -2137,7 +2411,7 @@ def _graded_main() -> None:
 def main() -> None:
     if not any(f in sys.argv
                for f in ("--all", "--rowgroup", "--hostasm", "--config",
-                         "--obs")):
+                         "--obs", "--chaos")):
         # default graded path: jax-free orchestrator (see _graded_main)
         _graded_main()
         return
@@ -2153,9 +2427,11 @@ def main() -> None:
             print("[bench] --all aborted: backend probe hung/failed",
                   file=sys.stderr)
             sys.exit(3)
-    if "--cpu" in sys.argv or "--hostasm" in sys.argv or "--obs" in sys.argv:
-        # --hostasm/--obs measure HOST work only and must never grab the
-        # real chip; the switch must precede the first device use below
+    if ("--cpu" in sys.argv or "--hostasm" in sys.argv
+            or "--obs" in sys.argv or "--chaos" in sys.argv):
+        # --hostasm/--obs/--chaos measure HOST work only and must never
+        # grab the real chip; the switch must precede the first device use
+        # below
         import jax
 
         jax.config.update("jax_platforms", "cpu")
@@ -2442,6 +2718,21 @@ def main() -> None:
         # stdout line stays small: the full stats/trace live in the artifact
         summary = {k: v for k, v in out.items()
                    if k not in ("stats", "chrome_trace", "prometheus_sample")}
+        summary["artifact"] = os.path.basename(path)
+        print(json.dumps(summary))
+        return
+    if "--chaos" in sys.argv:
+        out = chaos_probe()
+        path = os.environ.get(
+            "KPW_CHAOS_PATH",
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "BENCH_CHAOS_r07.json"))
+        with open(path, "w") as f:
+            json.dump(out, f, indent=1)
+        print(f"[bench:chaos] artifact written to {path}", file=sys.stderr)
+        # stdout line stays small: the full fault log lives in the artifact
+        summary = {k: v for k, v in out.items()
+                   if k not in ("fault_log", "fault_schedule")}
         summary["artifact"] = os.path.basename(path)
         print(json.dumps(summary))
         return

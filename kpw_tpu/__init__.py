@@ -15,8 +15,24 @@ from .runtime import (  # noqa: E402,F401
     Gauge,
     KafkaProtoParquetWriter,
     MetricRegistry,
+    RetryBudgetExceeded,
+    RetryPolicy,
+    WriterFailedError,
     registry_to_json,
     registry_to_prometheus,
 )
-from .ingest import FakeBroker, KafkaBrokerClient, PartitionOffset, SmartCommitConsumer  # noqa: E402,F401
-from .io import HdfsFileSystem, LocalFileSystem, MemoryFileSystem  # noqa: E402,F401
+from .ingest import (  # noqa: E402,F401
+    FakeBroker,
+    FaultInjectingBroker,
+    KafkaBrokerClient,
+    PartitionOffset,
+    SmartCommitConsumer,
+)
+from .io import (  # noqa: E402,F401
+    FaultInjectingFileSystem,
+    FaultSchedule,
+    HdfsFileSystem,
+    InjectedFault,
+    LocalFileSystem,
+    MemoryFileSystem,
+)

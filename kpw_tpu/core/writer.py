@@ -156,11 +156,16 @@ class ParquetFileWriter:
     """
 
     def __init__(self, sink, schema: Schema, properties: WriterProperties | None = None,
-                 encoder=None, pipeline: bool = False) -> None:
+                 encoder=None, pipeline: bool = False,
+                 retry_policy=None) -> None:
         self.sink = sink
         self.schema = schema
         self.properties = properties or WriterProperties()
         self.encoder = encoder or CpuChunkEncoder(self.properties.encoder_options())
+        # IO-retry classification for the pipelined IO thread (duck-typed
+        # runtime.retry.RetryPolicy: is_fatal + next_sleep).  None keeps the
+        # historical fixed-100ms retry-every-OSError loop.
+        self._retry_policy = retry_policy
         self._pos = 0
         self._row_groups: list[RowGroup] = []
         self._pending: list[ColumnChunkData] | None = None
@@ -519,8 +524,12 @@ class ParquetFileWriter:
             if self._abandoned.is_set():
                 continue
             encoded, rows, enc_len = item
+            sleep = None
+            attempt = 0
+            started = time.monotonic()
             while not self._abandoned.is_set() and self._pipe_error is None:
                 try:
+                    attempt += 1
                     t0 = time.perf_counter()
                     # raw_estimate=0: _mark_encoded already folded this
                     # row group's exact encoded size into the ratio EWMA
@@ -529,8 +538,30 @@ class ParquetFileWriter:
                     self._commit_encoded(encoded, rows)
                     self.stage_busy_s["io"] += time.perf_counter() - t0
                     break
-                except OSError:
-                    time.sleep(0.1)
+                except OSError as e:
+                    pol = self._retry_policy
+                    if pol is None:
+                        sleep = 0.1  # historical fixed retry-everything
+                    elif pol.is_fatal(e):
+                        # non-transient errno (ENOSPC/EROFS/...): retrying
+                        # in place cannot heal it — poison the writer so
+                        # the owning worker dies un-acked and the records
+                        # are redelivered instead of spinning forever
+                        self._pipe_error = e
+                        break
+                    else:
+                        sleep = pol.next_sleep(sleep)
+                        # honor the policy's attempt/deadline budget: a
+                        # bounded policy must cap this seam too, not spin
+                        if ((pol.max_attempts is not None
+                             and attempt >= pol.max_attempts)
+                                or (pol.deadline is not None
+                                    and time.monotonic() + sleep - started
+                                    > pol.deadline)):
+                            self._pipe_error = e
+                            break
+                    if self._abandoned.wait(sleep):
+                        break
                 except BaseException as e:  # noqa: BLE001 - poison, don't die
                     self._pipe_error = e
             with self._inflight_lock:

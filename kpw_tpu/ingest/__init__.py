@@ -13,3 +13,4 @@ from .broker import FakeBroker, Record  # noqa: F401
 from .offsets import PagedOffsetTracker, PartitionOffset  # noqa: F401
 from .consumer import SmartCommitConsumer  # noqa: F401
 from .kafka_client import KafkaBrokerClient  # noqa: F401  (needs kafka-python at construction)
+from .faults import FaultInjectingBroker  # noqa: F401

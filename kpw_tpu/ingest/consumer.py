@@ -17,6 +17,7 @@ import time
 import uuid
 from collections import deque
 
+from ..runtime.retry import RetryInterrupted, RetryPolicy
 from .broker import FakeBroker, Record
 from .offsets import PagedOffsetTracker, PartitionOffset
 
@@ -31,6 +32,7 @@ class SmartCommitConsumer:
         max_queued_records: int = 100_000,
         fetch_max_records: int = 2000,
         member_id: str | None = None,
+        retry_policy: RetryPolicy | None = None,
     ) -> None:
         self.broker = broker
         self.group_id = group_id
@@ -68,6 +70,15 @@ class SmartCommitConsumer:
         self._assigned: list[int] = []
         self._generation = -1
         self._commit_lock = threading.Lock()
+        # broker-IO retry: transient fetch/commit failures (a sick broker,
+        # an injected chaos fault) back off and retry instead of killing the
+        # fetcher thread / the acking worker.  Default policy = infinite
+        # attempts with backoff (reference delivery semantics).
+        self._retry = retry_policy or RetryPolicy()
+        self._stop_event = threading.Event()
+        self._broker_retries = 0   # fetch+commit retry count (stats)
+        self._redelivered = 0      # records re-injected by redeliver_run
+        self._fetcher_error: str | None = None
 
     # -- lifecycle ---------------------------------------------------------
     def subscribe(self, topic: str) -> None:
@@ -89,6 +100,7 @@ class SmartCommitConsumer:
 
     def close(self) -> None:
         self._running = False
+        self._stop_event.set()
         if self._thread is not None:
             self._thread.join(timeout=10)
             self._thread = None
@@ -155,21 +167,25 @@ class SmartCommitConsumer:
             self._buf_cond.notify_all()
         return out
 
-    def _put_batch(self, records: list[Record]) -> bool:
+    def _put_batch(self, records: list[Record],
+                   stop_event: threading.Event | None = None) -> bool:
         """Fetcher side: enqueue one tracked batch, blocking while the
         record-count bound is reached.  The bound is HARD (the reference's
         maxQueuedRecordsInConsumer is a BlockingQueue capacity): an
         oversized batch is admitted in slices as space opens, never
         overshooting ``max_queued_records``.  Returns False when shut down
-        before everything was admitted (caller must not advance its fetch
-        position; already-admitted slices may be redelivered — at-least-once
-        allows the duplicates)."""
+        (or ``stop_event`` fires — the supervisor's redelivery must not
+        stay wedged on a full queue through close) before everything was
+        admitted (caller must not advance its fetch position;
+        already-admitted slices may be redelivered — at-least-once allows
+        the duplicates)."""
         pos = 0
         with self._buf_cond:
             while pos < len(records):
                 space = self._buf_max - self._buf_count
                 if space <= 0:
-                    if not self._running:
+                    if not self._running or (stop_event is not None
+                                             and stop_event.is_set()):
                         return False
                     t0 = time.perf_counter()
                     self._buf_cond.wait(0.05)
@@ -190,6 +206,46 @@ class SmartCommitConsumer:
         with self._buf_cond:
             return self._buf_count
 
+    def fetcher_alive(self) -> bool:
+        """True while the fetcher thread is running and has not died to an
+        unretryable broker error — the consumer half of writer.healthy()."""
+        return (self._thread is not None and self._thread.is_alive()
+                and self._fetcher_error is None)
+
+    def redeliver_run(self, partition: int, start: int, count: int,
+                      stop_event: threading.Event | None = None) -> int:
+        """Re-inject the already-tracked offset run [start, start+count)
+        into the shared buffer by re-fetching it from the broker.
+
+        The supervised-restart redelivery path: a dead worker's held
+        (written-but-unacked and polled-but-unwritten) offsets were consumed
+        from the queue and will never be acked by anyone — without
+        re-injection the commit frontier stalls behind them forever.  The
+        run is NOT tracked again (its pages are already open in the
+        tracker); duplicates with a survivor's output are allowed by the
+        at-least-once contract.  ``stop_event`` (e.g. the supervisor's
+        close signal) aborts promptly — the consumer's own stop is honored
+        too.  Returns the number of records re-injected."""
+        stop = stop_event or self._stop_event
+        end = start + count
+        off = start
+        while (off < end and not stop.is_set()
+               and not self._stop_event.is_set()):
+            recs = self._retry.call(
+                lambda off=off: self.broker.fetch(
+                    self._topic, partition, off,
+                    min(self._fetch_max, end - off)),
+                stop_event=stop,
+                on_retry=self._count_retry, label="broker.refetch")
+            recs = [r for r in recs if r.offset < end]
+            if not recs:
+                break  # run no longer materializable (compacted away)
+            if not self._put_batch(recs, stop_event=stop):
+                break  # shutting down
+            self._redelivered += len(recs)
+            off = recs[-1].offset + 1
+        return off - start
+
     def stats(self) -> dict:
         """Pull-based consumer observability snapshot: the shared queue's
         depth / high-watermark / stall accounting, the fetcher's
@@ -209,15 +265,17 @@ class SmartCommitConsumer:
         return {
             "queue": q,
             "backpressure_skips": self._backpressure_skips,
+            "fetcher_alive": self.fetcher_alive(),
+            "fetcher_error": self._fetcher_error,
+            "broker_retries": self._broker_retries,
+            "redelivered_records": self._redelivered,
             "tracker": self.tracker.snapshot(),
         }
 
     def ack(self, po: PartitionOffset) -> None:
         new_commit = self.tracker.ack(po)
         if new_commit is not None:
-            with self._commit_lock:
-                self.broker.commit(self.group_id, self._topic, po.partition,
-                                   new_commit)
+            self._commit_with_retry(po.partition, new_commit)
 
     def ack_run(self, partition: int, start: int, count: int) -> None:
         """Batch ack of a contiguous offset run — one tracker round and at
@@ -227,9 +285,27 @@ class SmartCommitConsumer:
             return
         new_commit = self.tracker.ack_run(partition, start, count)
         if new_commit is not None:
+            self._commit_with_retry(partition, new_commit)
+
+    def _commit_with_retry(self, partition: int, offset: int) -> None:
+        """Commit the advanced frontier, retrying transient broker errors.
+        Safe to retry indefinitely: commit is idempotent and the records it
+        covers are already durably published — losing the commit only costs
+        redelivery (at-least-once), never data.  Each attempt re-reads the
+        tracker's (monotonic) frontier: a retry that backed off for seconds
+        must not push a stale lower offset over a newer commit another
+        worker made meanwhile (FakeBroker guards monotonicity; a real
+        Kafka commit does not)."""
+        def do() -> None:
             with self._commit_lock:
+                cur = self.tracker.committed(partition)
                 self.broker.commit(self.group_id, self._topic, partition,
-                                   new_commit)
+                                   max(offset, cur))
+        self._retry.call(do, stop_event=self._stop_event,
+                         on_retry=self._count_retry, label="broker.commit")
+
+    def _count_retry(self, attempt, exc, sleep_s) -> None:
+        self._broker_retries += 1
 
     # -- internals ---------------------------------------------------------
     def _track_batch(self, partition: int, records: list[Record]) -> list[Record]:
@@ -282,11 +358,13 @@ class SmartCommitConsumer:
 
     def _fetch_loop(self) -> None:
         import logging
-        import time
 
         try:
             self._fetch_loop_inner()
-        except Exception:
+        except RetryInterrupted:
+            pass  # close() during a fetch retry: clean shutdown
+        except Exception as e:
+            self._fetcher_error = repr(e)
             logging.getLogger(__name__).exception(
                 "consumer fetcher thread died; poll() will starve")
             raise
@@ -310,8 +388,14 @@ class SmartCommitConsumer:
                     continue
                 pos = self._positions.get(p, 0)
                 with stage("consumer.fetch"):
-                    records = self.broker.fetch(self._topic, p, pos,
-                                                self._fetch_max)
+                    # transient poll errors back off and retry in place;
+                    # only a fatal-classified error (or retry-budget
+                    # exhaustion on a bounded policy) kills the fetcher
+                    records = self._retry.call(
+                        lambda: self.broker.fetch(self._topic, p, pos,
+                                                  self._fetch_max),
+                        stop_event=self._stop_event,
+                        on_retry=self._count_retry, label="broker.fetch")
                 with stage("consumer.track"):
                     accepted = self._track_batch(p, records)
                 if not accepted:

@@ -4,3 +4,8 @@ analog), with the atomic tmp→rename publish the correctness protocol needs
 
 from .fs import FileSystem, LocalFileSystem, MemoryFileSystem  # noqa: F401
 from .hdfs import HdfsFileSystem  # noqa: F401  (needs libhdfs at construction)
+from .faults import (  # noqa: F401
+    FaultInjectingFileSystem,
+    FaultSchedule,
+    InjectedFault,
+)

@@ -1,0 +1,273 @@
+"""Deterministic fault injection for the write path.
+
+The at-least-once contract (tmp→rename publish, ack strictly after rename —
+KafkaProtoParquetWriter.java:38-62) is only worth anything if it holds while
+the filesystem misbehaves.  This module makes misbehavior *reproducible*:
+:class:`FaultSchedule` is a seeded, schedule-driven plan of which operation
+ordinals fail (or stall), and :class:`FaultInjectingFileSystem` is a wrapper
+over any :class:`~kpw_tpu.io.fs.FileSystem` that consults the plan on every
+IO call.  Injection is strictly opt-in at the seam where a filesystem (or
+broker) is handed to the Builder: unless a wrapper is installed there,
+no write-path code ever consults a schedule, so the disabled hot-path
+cost is zero (the module itself is exported from the package for
+discoverability, but constructing a writer never touches it).
+
+Operation names checked by the filesystem wrapper:
+
+``open`` (open_write/open_append/open_read), ``write`` (write/writelines),
+``flush``, ``close``, ``rename``, ``delete``, ``mkdirs``, ``list``.
+
+The broker-side counterpart (``fetch`` / ``commit`` / forced ``rebalance``)
+lives in :mod:`kpw_tpu.ingest.faults` and shares the same schedule object,
+so one seed drives the whole chaos run.
+"""
+
+from __future__ import annotations
+
+import errno as _errno
+import random
+import threading
+import time
+
+from .fs import FileSystem
+
+
+class InjectedFault(OSError):
+    """The injected error type: an OSError with a configurable errno, so
+    retry classification sees exactly what a real failure would carry."""
+
+
+class _Rule:
+    __slots__ = ("op", "ordinals", "errno", "latency_s", "partial")
+
+    def __init__(self, op: str, ordinals: set, errno: int | None,
+                 latency_s: float, partial: float) -> None:
+        self.op = op
+        self.ordinals = ordinals  # 1-based call numbers this rule covers
+        self.errno = errno        # None = latency-only rule
+        self.latency_s = latency_s
+        self.partial = partial    # fraction of a write to land before failing
+
+
+class FaultSchedule:
+    """Seeded plan: which call ordinals of which operations fail/stall.
+
+    Deterministic by construction — random placement (:meth:`fail_random`)
+    draws ordinals from the seeded RNG at *schedule-build* time, so the
+    fired set depends only on the seed and per-op call counts, never on
+    thread interleaving across different operations.  Every fired fault is
+    recorded (op, ordinal, errno) for the chaos artifact.
+    """
+
+    def __init__(self, seed: int = 0) -> None:
+        self.seed = seed
+        self._rng = random.Random(seed)
+        self._rules: dict[str, list[_Rule]] = {}
+        self._counts: dict[str, int] = {}
+        self._fired: list[dict] = []
+        self._lock = threading.Lock()
+        self._active = True
+
+    # -- building ------------------------------------------------------------
+    def fail_nth(self, op: str, nth: int, *, count: int = 1,
+                 err: int = _errno.EIO, latency_s: float = 0.0,
+                 partial: float = 0.0) -> "FaultSchedule":
+        """Fail calls ``nth .. nth+count-1`` (1-based) of ``op`` with an
+        :class:`InjectedFault` carrying ``err``.  ``partial`` (0..1, write
+        ops only) lands that fraction of the payload before raising — a torn
+        write the retry protocol must overwrite, not append after."""
+        if nth < 1 or count < 1:
+            raise ValueError("nth and count must be >= 1")
+        ordinals = set(range(nth, nth + count))
+        self._rules.setdefault(op, []).append(
+            _Rule(op, ordinals, err, latency_s, partial))
+        return self
+
+    def fail_forever_from(self, op: str, nth: int, *,
+                          err: int = _errno.EIO) -> "FaultSchedule":
+        """Every call of ``op`` from ordinal ``nth`` on fails — the
+        persistent-failure shape (dead disk) that exhausts restart budgets.
+        (Encoded as a negative sentinel ordinal: ``n >= nth`` matches.)"""
+        if nth < 1:
+            raise ValueError("nth must be >= 1")
+        self._rules.setdefault(op, []).append(
+            _Rule(op, {-nth}, err, 0.0, 0.0))
+        return self
+
+    def delay_nth(self, op: str, nth: int, latency_s: float,
+                  count: int = 1) -> "FaultSchedule":
+        """Stall (but do not fail) calls ``nth .. nth+count-1`` of ``op``."""
+        if nth < 1 or count < 1:
+            raise ValueError("nth and count must be >= 1")
+        self._rules.setdefault(op, []).append(
+            _Rule(op, set(range(nth, nth + count)), None, latency_s, 0.0))
+        return self
+
+    def fail_random(self, op: str, n_faults: int, window: int, *,
+                    err: int = _errno.EIO,
+                    latency_s: float = 0.0) -> "FaultSchedule":
+        """Place ``n_faults`` failures uniformly (seeded RNG) over the first
+        ``window`` calls of ``op`` — schedule-time draw, so the plan is
+        fixed before the run starts."""
+        if n_faults > window:
+            raise ValueError("n_faults must be <= window")
+        picked = set(self._rng.sample(range(1, window + 1), n_faults))
+        self._rules.setdefault(op, []).append(
+            _Rule(op, picked, err, latency_s, 0.0))
+        return self
+
+    def stop(self) -> None:
+        """Disarm the schedule: no further faults fire (chaos runs call this
+        to let the system drain and prove recovery)."""
+        with self._lock:
+            self._active = False
+
+    # -- plan/evidence --------------------------------------------------------
+    def plan(self) -> list[dict]:
+        """The full schedule as data (for the committed chaos artifact)."""
+        out = []
+        for op, rules in sorted(self._rules.items()):
+            for r in rules:
+                open_ended = any(o < 0 for o in r.ordinals)
+                out.append({
+                    "op": op,
+                    "ordinals": ("open-ended" if open_ended
+                                 else sorted(r.ordinals)),
+                    "from": (-min(r.ordinals) if open_ended else None),
+                    "errno": r.errno,
+                    "latency_s": r.latency_s,
+                    "partial": r.partial,
+                })
+        return out
+
+    def note(self, op: str, ordinal: int) -> None:
+        """Record a non-error chaos event (e.g. a forced rebalance) in the
+        fired log so the artifact carries the full timeline."""
+        with self._lock:
+            self._fired.append({"op": op, "ordinal": ordinal, "errno": None})
+
+    def fired(self) -> list[dict]:
+        with self._lock:
+            return list(self._fired)
+
+    def counts(self) -> dict:
+        with self._lock:
+            return dict(self._counts)
+
+    # -- runtime check --------------------------------------------------------
+    def check(self, op: str, payload_writer=None) -> None:
+        """Advance ``op``'s call count; stall and/or raise when a rule
+        covers this ordinal.  ``payload_writer`` (write ops) is a callable
+        ``fraction -> None`` that lands a torn prefix before the raise."""
+        rule = None
+        with self._lock:
+            n = self._counts.get(op, 0) + 1
+            self._counts[op] = n
+            if self._active:
+                for r in self._rules.get(op, ()):
+                    hit = (n in r.ordinals
+                           or any(o < 0 and n >= -o for o in r.ordinals))
+                    if hit:
+                        rule = r
+                        break
+            if rule is not None and rule.errno is not None:
+                self._fired.append({"op": op, "ordinal": n,
+                                    "errno": rule.errno})
+        if rule is None:
+            return
+        if rule.latency_s > 0.0:
+            time.sleep(rule.latency_s)
+        if rule.errno is None:
+            return  # latency-only rule
+        if rule.partial > 0.0 and payload_writer is not None:
+            payload_writer(rule.partial)
+        raise InjectedFault(rule.errno, f"injected fault: {op} call #{n}")
+
+
+class _FaultFile:
+    """File wrapper consulting the schedule on write/flush/close.  A torn
+    write (``partial``) lands a prefix through the inner file before
+    raising, so retry protocols are tested against garbage-on-disk, not
+    just clean no-ops."""
+
+    def __init__(self, inner, schedule: FaultSchedule) -> None:
+        self._inner = inner
+        self._schedule = schedule
+
+    def write(self, data) -> int:
+        def torn(fraction: float) -> None:
+            self._inner.write(data[: int(len(data) * fraction)])
+        self._schedule.check("write", torn)
+        return self._inner.write(data)
+
+    def writelines(self, parts) -> None:
+        parts = list(parts)
+
+        def torn(fraction: float) -> None:
+            self._inner.writelines(parts[: int(len(parts) * fraction)])
+        self._schedule.check("write", torn)
+        self._inner.writelines(parts)
+
+    def flush(self) -> None:
+        self._schedule.check("flush")
+        self._inner.flush()
+
+    def close(self) -> None:
+        self._schedule.check("close")
+        self._inner.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def __getattr__(self, name):  # seek/tell/read/… pass through
+        return getattr(self._inner, name)
+
+
+class FaultInjectingFileSystem(FileSystem):
+    """Schedule-consulting wrapper over any FileSystem.  Read-only probes
+    (``exists``/``size``) pass through unchecked — they are rotation/ack
+    bookkeeping, and failing them tests nothing the write-path ops don't."""
+
+    def __init__(self, inner: FileSystem, schedule: FaultSchedule) -> None:
+        self.inner = inner
+        self.schedule = schedule
+
+    def mkdirs(self, path: str) -> None:
+        self.schedule.check("mkdirs")
+        self.inner.mkdirs(path)
+
+    def open_write(self, path: str):
+        self.schedule.check("open")
+        return _FaultFile(self.inner.open_write(path), self.schedule)
+
+    def open_append(self, path: str):
+        self.schedule.check("open")
+        return _FaultFile(self.inner.open_append(path), self.schedule)
+
+    def open_read(self, path: str):
+        self.schedule.check("open")
+        return self.inner.open_read(path)
+
+    def rename(self, src: str, dst: str) -> None:
+        self.schedule.check("rename")
+        self.inner.rename(src, dst)
+
+    def exists(self, path: str) -> bool:
+        return self.inner.exists(path)
+
+    def delete(self, path: str) -> None:
+        self.schedule.check("delete")
+        self.inner.delete(path)
+
+    def size(self, path: str) -> int:
+        return self.inner.size(path)
+
+    def list_files(self, path: str, extension: str | None = None,
+                   recursive: bool = True) -> list[str]:
+        self.schedule.check("list")
+        return self.inner.list_files(path, extension=extension,
+                                     recursive=recursive)

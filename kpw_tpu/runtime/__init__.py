@@ -5,4 +5,9 @@ from .builder import Builder  # noqa: F401
 from .export import registry_to_json, registry_to_prometheus  # noqa: F401
 from .metrics import Gauge, MetricRegistry  # noqa: F401
 from .parquet_file import ParquetFile  # noqa: F401
-from .writer import KafkaProtoParquetWriter  # noqa: F401
+from .retry import (  # noqa: F401
+    RetryBudgetExceeded,
+    RetryInterrupted,
+    RetryPolicy,
+)
+from .writer import KafkaProtoParquetWriter, WriterFailedError  # noqa: F401

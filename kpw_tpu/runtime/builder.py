@@ -67,6 +67,13 @@ class Builder:
         self._batch_size = 4096
         self._on_parse_error = "raise"  # parity: poison pill kills the worker
         self._clean_abandoned_tmp = False  # opt-in tmp GC at start()
+        # robustness: IO retry policy (None = default RetryPolicy — infinite
+        # attempts, backoff+jitter, fatal-errno classification) and opt-in
+        # worker supervision (the reference never restarts a dead worker)
+        self._retry_policy = None
+        self._supervise = False
+        self._max_worker_restarts = 5
+        self._restart_backoff = 0.1  # seconds; doubles per restart, cap 5 s
         # observability: span-timeline tracing (utils/tracing.py).  Off by
         # default — the disabled stage() path is a true no-op
         self._tracing = False
@@ -255,6 +262,43 @@ class Builder:
         contend for the one core instead of overlapping).  Set explicitly
         to pin either mode."""
         self._pipeline = flag
+        return self
+
+    def retry_policy(self, policy) -> "Builder":
+        """IO retry policy for every write-path seam (worker flush/close/
+        publish/dead-letter, consumer fetch/commit).  Default: infinite
+        attempts with exponential backoff + decorrelated jitter and
+        fatal-by-default classification of non-transient errnos (ENOSPC /
+        EROFS / EDQUOT kill the worker instead of spinning).  Pass
+        ``RetryPolicy.reference()`` to restore the reference's pure
+        fixed-100ms retry-everything loop, or a bounded policy
+        (``max_attempts`` / ``deadline``) to cap the spin."""
+        from .retry import RetryPolicy
+
+        if policy is not None and not isinstance(policy, RetryPolicy):
+            raise TypeError("retry_policy expects a RetryPolicy instance")
+        self._retry_policy = policy
+        return self
+
+    def supervise(self, flag: bool = True, max_restarts: int = 5,
+                  restart_backoff_seconds: float = 0.1) -> "Builder":
+        """Supervised worker recovery: detect a dead worker, re-inject its
+        never-acked offsets into the shared queue, and restart it — up to
+        ``max_restarts`` times per worker slot with exponential backoff
+        starting at ``restart_backoff_seconds``.  Redelivery-by-restart
+        preserves at-least-once (the dead worker's records were never
+        acked).  When every worker is dead with its budget exhausted the
+        writer is terminally failed and ``close()`` raises
+        ``WriterFailedError``.  Off by default (reference parity: a dead
+        worker stays dead until process restart — but death is still
+        visible via ``healthy()`` / ``stats()`` / the failed meter)."""
+        if max_restarts < 0:
+            raise ValueError("max_restarts must be >= 0")
+        if restart_backoff_seconds < 0:
+            raise ValueError("restart_backoff_seconds must be >= 0")
+        self._supervise = flag
+        self._max_worker_restarts = max_restarts
+        self._restart_backoff = restart_backoff_seconds
         return self
 
     def clean_abandoned_tmp(self, flag: bool) -> "Builder":

@@ -324,6 +324,14 @@ ACK_AGE_GAUGE = "parquet.writer.ack.oldest.age.seconds"
 ROTATED_SIZE_METER = "parquet.writer.rotated.size"
 ROTATED_TIME_METER = "parquet.writer.rotated.time"
 CONSUMER_QUEUE_DEPTH_GAUGE = "consumer.queue.depth"
+# robustness layer: retry/backoff accounting, worker deaths + supervised
+# restarts, live-worker gauge, and the startup recovery sweep's GC count
+RETRIES_METER = "parquet.writer.retries"
+RETRY_BACKOFF_MS_METER = "parquet.writer.retry.backoff.ms"
+FAILED_METER = "parquet.writer.failed"
+RESTARTS_METER = "parquet.writer.worker.restarts"
+WORKERS_ALIVE_GAUGE = "parquet.writer.workers.alive"
+TMP_SWEPT_METER = "parquet.writer.tmp.swept"
 
 # the canonical registry docs cite from (tools/check_docs.py verifies
 # every doc-cited metric name is listed here)
@@ -338,4 +346,10 @@ METRIC_NAMES = (
     ROTATED_SIZE_METER,
     ROTATED_TIME_METER,
     CONSUMER_QUEUE_DEPTH_GAUGE,
+    RETRIES_METER,
+    RETRY_BACKOFF_MS_METER,
+    FAILED_METER,
+    RESTARTS_METER,
+    WORKERS_ALIVE_GAUGE,
+    TMP_SWEPT_METER,
 )

@@ -30,13 +30,15 @@ class ParquetFile:
         encoder=None,
         pipeline: bool = False,
         est_record_bytes: float = 64.0,
+        retry_policy=None,
     ) -> None:
         self.path = path
         self._fs = fs
         self._sink = fs.open_write(path)
         self._writer = ParquetFileWriter(self._sink, columnarizer.schema,
                                          properties, encoder=encoder,
-                                         pipeline=pipeline)
+                                         pipeline=pipeline,
+                                         retry_policy=retry_policy)
         self._columnarizer = columnarizer
         self._batch: list = []
         self._batch_size = batch_size

@@ -4,9 +4,17 @@
 (KafkaProtoParquetWriter.java:63-214); each worker runs the poll → parse →
 write → rotate → publish → ack loop (:253-292) with size/time rotation
 (:297-308), tmp→rename atomic publish (:359-378), deferred acks strictly
-after publish (:347-350 — the at-least-once anchor), infinite IO retry
-(:410-443), and close semantics that abandon the open tmp file so unacked
-records are redelivered (:381-398).
+after publish (:347-350 — the at-least-once anchor), policy-driven IO
+retry (runtime/retry.py — reference :410-443 semantics by default, plus
+fatal-errno classification), and close semantics that abandon the open tmp
+file so unacked records are redelivered (:381-398).
+
+Beyond the reference (robustness PR): worker death is observable
+(``healthy()``, the failed meter, per-worker exit reasons in ``stats()``),
+and ``Builder.supervise`` adds a supervisor that re-injects a dead
+worker's never-acked offsets into the shared queue and restarts the slot
+with capped, backed-off restarts — terminal exhaustion raises
+``WriterFailedError`` at ``close()``.
 """
 
 from __future__ import annotations
@@ -24,9 +32,17 @@ from ..models.proto_bridge import ProtoColumnarizer
 from ..utils import tracing
 from . import metrics as M
 from .parquet_file import ParquetFile
-from .retry import RetryInterrupted, try_until_succeeds
+from .retry import RetryInterrupted, RetryPolicy
 
 logger = logging.getLogger(__name__)
+
+
+class WriterFailedError(Exception):
+    """Terminal writer failure: every worker died and (with supervision
+    enabled) the restart budget is exhausted.  Raised by ``close()`` so a
+    writer that silently stopped making progress cannot masquerade as a
+    clean shutdown; the unacked records are redelivered to the next
+    instance (at-least-once)."""
 
 
 def _format_now(pattern: str) -> str:
@@ -61,17 +77,30 @@ class KafkaProtoParquetWriter:
         self.columnarizer = ProtoColumnarizer(b._proto_class)
         self.properties = b.writer_properties()
         self._encoder_factory = self._make_encoder_factory(b._backend)
+        # one retry policy instance for the writer's IO seams (workers +
+        # consumer broker IO): infinite-attempt backoff with fatal-errno
+        # classification by default; Builder.retry_policy overrides
+        self.retry_policy = b._retry_policy or RetryPolicy()
         self.consumer = SmartCommitConsumer(
             broker=b._broker,
             group_id=b._group_id,
             page_size=b._offset_tracker_page_size,
             max_open_pages_per_partition=b._offset_tracker_max_open_pages,
             max_queued_records=b._max_queued_records,
+            retry_policy=self.retry_policy,
         )
         self.consumer.subscribe(b._topic)
         self._workers: list[_Worker] = []
         self._started = False
         self._closed = False
+        # supervision state: restart counts per worker index (kept across
+        # replacements), the death-notice the supervisor sleeps on, and the
+        # terminal verdict once every restart budget is exhausted
+        self._restart_counts: list[int] = [0] * b._thread_count
+        self._dead_notice = threading.Event()
+        self._close_event = threading.Event()
+        self._supervisor: threading.Thread | None = None
+        self._terminal: WriterFailedError | None = None
         # metrics (registered iff a registry is supplied — KPW.java:144-151 —
         # but always counted for the programmatic getters :201-210)
         reg = b._metric_registry
@@ -87,12 +116,22 @@ class KafkaProtoParquetWriter:
         # structures are read only when the registry is scraped.
         self._rotated_size = reg.meter(M.ROTATED_SIZE_METER) if reg else M.Meter()
         self._rotated_time = reg.meter(M.ROTATED_TIME_METER) if reg else M.Meter()
+        # robustness meters — always counted (satellite: worker death must
+        # be visible even without supervision enabled)
+        self._retries = reg.meter(M.RETRIES_METER) if reg else M.Meter()
+        self._retry_backoff_ms = (reg.meter(M.RETRY_BACKOFF_MS_METER)
+                                  if reg else M.Meter())
+        self._failed = reg.meter(M.FAILED_METER) if reg else M.Meter()
+        self._restarts = reg.meter(M.RESTARTS_METER) if reg else M.Meter()
+        self._tmp_swept = reg.meter(M.TMP_SWEPT_METER) if reg else M.Meter()
         if reg:
             reg.gauge(M.ACK_LAG_GAUGE,
                       lambda: self.ack_lag()["unacked_records"])
             reg.gauge(M.ACK_AGE_GAUGE,
                       lambda: self.ack_lag()["oldest_unacked_age_s"])
             reg.gauge(M.CONSUMER_QUEUE_DEPTH_GAUGE, self.consumer.queue_depth)
+            reg.gauge(M.WORKERS_ALIVE_GAUGE,
+                      lambda: sum(1 for w in self._workers if w.alive()))
         # tracing owned by this writer when the Builder asked for it
         # (installed at start(), uninstalled at close() iff still ours)
         self.stage_timer: tracing.StageTimer | None = None
@@ -145,6 +184,12 @@ class KafkaProtoParquetWriter:
             w = _Worker(self, i)
             self._workers.append(w)
             w.start()
+        if self._b._supervise:
+            self._supervisor = threading.Thread(
+                target=self._supervise_loop,
+                name=f"KPW-supervisor-{self._b._instance_name}",
+                daemon=True)
+            self._supervisor.start()
 
     def _gc_abandoned_tmp(self) -> None:
         """Remove .tmp files left by a previous run of THIS instance name
@@ -169,14 +214,107 @@ class KafkaProtoParquetWriter:
         for p in stale:
             try:
                 self.fs.delete(p)
+                self._tmp_swept.mark()
                 logger.info("Removed abandoned tmp file %s", p)
             except OSError:
                 logger.warning("Could not remove abandoned tmp file %s", p)
+
+    # -- supervision (beyond the reference: a dead reference worker is a
+    # silent log line until process restart) ---------------------------------
+    def _notify_worker_death(self) -> None:
+        self._dead_notice.set()
+
+    def _supervise_loop(self) -> None:
+        """Detect dead workers and restart them with capped restarts +
+        exponential backoff.  A restarted worker's held (unacked) offsets
+        are re-injected into the shared queue first — the records were never
+        acked, so redelivery-by-restart preserves at-least-once.  When every
+        worker is dead with its budget exhausted, the writer is terminally
+        failed: close() raises WriterFailedError."""
+        try:
+            self._supervise_loop_inner()
+        except RetryInterrupted:
+            pass  # close() interrupted a redelivery retry
+        except Exception:
+            logger.exception("supervisor thread died; no further restarts")
+
+    def _supervise_loop_inner(self) -> None:
+        b = self._b
+        while not self._close_event.is_set():
+            if not self._dead_notice.wait(0.2):
+                continue
+            self._dead_notice.clear()
+            for i in range(len(self._workers)):
+                if self._close_event.is_set():
+                    return
+                w = self._workers[i]
+                if not w.failed:
+                    continue
+                if self._restart_counts[i] >= b._max_worker_restarts:
+                    self._check_terminal()
+                    continue
+                # let the dying thread finish its cleanup (file abandon)
+                # before reading its held runs
+                w._thread.join(timeout=10)
+                delay = min(b._restart_backoff
+                            * (2 ** self._restart_counts[i]), 5.0)
+                if self._close_event.wait(delay):
+                    return
+                self._restart_counts[i] += 1
+                self._restarts.mark()
+                # replacement FIRST, then redelivery: re-injection blocks
+                # on the bounded queue when it is full, and with
+                # thread_count=1 the replacement is the only consumer that
+                # can make space — the reverse order deadlocks
+                nw = _Worker(self, i)
+                self._workers[i] = nw
+                nw.start()
+                try:
+                    for part, start, end in w.held_runs():
+                        self.consumer.redeliver_run(
+                            part, start, end - start,
+                            stop_event=self._close_event)
+                except RetryInterrupted:
+                    return  # close() during redelivery: clean exit
+                logger.warning(
+                    "supervisor: restarted worker %d (restart %d/%d) after "
+                    "%s", i, self._restart_counts[i], b._max_worker_restarts,
+                    w.exit_reason)
+                # re-arm: another worker may have died while we restarted
+                self._dead_notice.set()
+
+    def _check_terminal(self) -> None:
+        b = self._b
+        exhausted = all(
+            w.failed and self._restart_counts[i] >= b._max_worker_restarts
+            for i, w in enumerate(self._workers))
+        if exhausted and self._terminal is None:
+            self._terminal = WriterFailedError(
+                f"writer '{b._instance_name}': all {len(self._workers)} "
+                f"worker(s) dead, restart budget "
+                f"({b._max_worker_restarts}) exhausted; last errors: "
+                f"{[w.exit_reason for w in self._workers]}")
+            logger.error("%s", self._terminal)
+
+    def healthy(self) -> bool:
+        """Liveness verdict for callers that never read stats(): True while
+        the writer is started, not closed, not terminally failed, every
+        worker thread is alive, and the consumer's fetcher is running.
+        False during a supervised restart window (a worker is down until
+        its replacement starts) and permanently once anything died for
+        good."""
+        if not self._started or self._closed or self._terminal is not None:
+            return False
+        return (all(w.alive() for w in self._workers)
+                and self.consumer.fetcher_alive())
 
     def close(self) -> None:
         if self._closed:
             return
         self._closed = True
+        self._close_event.set()
+        if self._supervisor is not None:
+            self._supervisor.join(timeout=30)
         for w in self._workers:
             w.close()
         self.consumer.close()
@@ -196,6 +334,11 @@ class KafkaProtoParquetWriter:
             if tracing.get_tracer() is self.stage_timer:
                 tracing.set_tracer(None)
         logger.info("Writer '%s' closed", self._b._instance_name)
+        if self._terminal is not None:
+            # a writer whose every worker died with the restart budget
+            # exhausted must not report a clean shutdown — the caller is
+            # the only one left who can act (alert, restart the process)
+            raise self._terminal
 
     def __enter__(self):
         self.start()
@@ -231,18 +374,27 @@ class KafkaProtoParquetWriter:
         """One pull-based snapshot of the whole pipeline, JSON-serializable
         by construction: meters (keyed by their canonical metric names),
         the file-size histogram, rotation-cause counts, ack lag, the
+        health verdict + supervision block (worker liveness, death and
+        restart counts, terminal failure), the recovery sweep count, the
         consumer's queue/tracker state, per-worker row-group pipeline
-        gauges (stage busy seconds + queue depth / high-watermark / stall),
-        and — when tracing is installed — the cumulative stage timers and
-        span-buffer occupancy.  written ≠ flushed ≠ acked: written counts
-        records buffered into an open file, flushed counts records in
-        published files, acked means the offsets are committed."""
+        gauges (stage busy seconds + queue depth / high-watermark / stall)
+        plus per-worker retry/last-error accounting, and — when tracing is
+        installed — the cumulative stage timers and span-buffer occupancy.
+        written ≠ flushed ≠ acked: written counts records buffered into an
+        open file, flushed counts records in published files, acked means
+        the offsets are committed."""
+        b = self._b
         out: dict = {
             "meters": {
                 M.WRITTEN_RECORDS_METER: self._written_records.snapshot(),
                 M.WRITTEN_BYTES_METER: self._written_bytes.snapshot(),
                 M.FLUSHED_RECORDS_METER: self._flushed_records.snapshot(),
                 M.FLUSHED_BYTES_METER: self._flushed_bytes.snapshot(),
+                M.RETRIES_METER: self._retries.snapshot(),
+                M.RETRY_BACKOFF_MS_METER: self._retry_backoff_ms.snapshot(),
+                M.FAILED_METER: self._failed.snapshot(),
+                M.RESTARTS_METER: self._restarts.snapshot(),
+                M.TMP_SWEPT_METER: self._tmp_swept.snapshot(),
             },
             "file_size": self._file_size_histogram.snapshot(),
             "rotations": {
@@ -250,6 +402,18 @@ class KafkaProtoParquetWriter:
                 "time": self._rotated_time.count,
             },
             "ack": self.ack_lag(),
+            "healthy": self.healthy(),
+            "supervision": {
+                "enabled": b._supervise,
+                "max_restarts": b._max_worker_restarts,
+                "workers_alive": sum(1 for w in self._workers if w.alive()),
+                "workers_dead": sum(1 for w in self._workers if w.failed),
+                "restart_counts": list(self._restart_counts),
+                "restarts_total": sum(self._restart_counts),
+                "terminal_failure": (str(self._terminal)
+                                     if self._terminal is not None else None),
+            },
+            "recovery": {"tmp_swept": self._tmp_swept.count},
             "consumer": self.consumer.stats(),
             "workers": [w.observability() for w in self._workers],
         }
@@ -306,10 +470,24 @@ class _Worker:
             daemon=True,
         )
         self.current_file: ParquetFile | None = None
+        # death visibility (satellite: a dead worker must be observable
+        # even without supervision): set in the _run except path before the
+        # thread exits, read by healthy()/stats()/the supervisor
+        self.failed = False
+        self.exit_reason: str | None = None
+        # per-worker retry accounting fed by the policy's on_retry hook
+        self.retries = 0
+        self.backoff_s = 0.0
+        self.last_error: str | None = None
         # acks held until publish, as contiguous runs [partition, start, end)
         # — poll batches arrive as runs, and per-record PartitionOffset
         # bookkeeping was a measurable slice of the hot loop
         self._written_runs: list[list[int]] = []
+        # the poll batch currently being processed, as (partition, start,
+        # count) runs: consumed from the queue but not yet folded into
+        # _written_runs — on death these must be redelivered too, or the
+        # commit frontier stalls behind them forever
+        self._inflight_runs: list = []
         self._file_records = 0
         # encoded-bytes/record estimate carried across rotations so every
         # file (not just the first's successors) rotates tightly
@@ -328,6 +506,33 @@ class _Worker:
 
     def start(self) -> None:
         self._thread.start()
+
+    def alive(self) -> bool:
+        return self._thread.is_alive()
+
+    def held_runs(self) -> list[tuple[int, int, int]]:
+        """Every offset run this worker consumed but never acked, as
+        (partition, start, end) — written-but-unpublished runs plus the
+        in-flight poll batch.  Read by the supervisor AFTER joining the
+        dead thread (single-writer discipline: only the worker thread
+        mutates these)."""
+        runs = [(p, s, e) for p, s, e in self._written_runs]
+        runs.extend((p, s, s + c) for p, s, c in self._inflight_runs)
+        return runs
+
+    def _retry(self, fn, label: str = ""):
+        """Policy-driven retry for this worker's IO: stop-aware, metered
+        (retry count, backoff time, last error) via the on_retry hook."""
+        return self.p.retry_policy.call(fn, stop_event=self._stop,
+                                        on_retry=self._on_retry, label=label)
+
+    def _on_retry(self, attempt: int, exc: BaseException,
+                  sleep_s: float) -> None:
+        self.retries += 1
+        self.backoff_s += sleep_s
+        self.last_error = repr(exc)
+        self.p._retries.mark()
+        self.p._retry_backoff_ms.mark(max(1, int(sleep_s * 1000)))
 
     def close(self) -> None:
         """Stop; the open tmp file is abandoned, its offsets never acked —
@@ -375,7 +580,12 @@ class _Worker:
                 if not recs:
                     time.sleep(0.001)
                     continue
+                # consumed from the queue: from here until these runs are
+                # folded into _written_runs (or individually acked) they
+                # are redeliverable only through held_runs()
+                self._inflight_runs = runs
                 if use_wire and self._try_wire_batch(recs, runs):
+                    self._inflight_runs = []
                     if self._is_file_full():
                         self._finalize_current_file()
                     continue
@@ -394,9 +604,8 @@ class _Worker:
                                 rec.partition, rec.offset)
                             # durability first, like the main path: the raw
                             # payload lands in the dead-letter file before ack
-                            try_until_succeeds(
-                                lambda: self._dead_letter(rec),
-                                stop_event=self._stop)
+                            self._retry(lambda: self._dead_letter(rec),
+                                        "dead_letter")
                             self.p.consumer.ack(
                                 PartitionOffset(rec.partition, rec.offset))
                         elif b._on_parse_error == "skip":
@@ -413,14 +622,15 @@ class _Worker:
                                 "KPW.java:271-275)", self.index)
                             raise
                 if not parsed:
+                    self._inflight_runs = []  # every record was acked above
                     continue
                 if self.current_file is None:
                     self._open_file()
                 # append is pure memory; only the (idempotent) flush retries
                 self.current_file.append_records([m for _, m in parsed])
-                try_until_succeeds(self.current_file.flush_if_full,
-                                   stop_event=self._stop)
+                self._retry(self.current_file.flush_if_full, "flush")
                 self._note_written(r for r, _ in parsed)
+                self._inflight_runs = []
                 self.p._written_records.mark(len(parsed))
                 self.p._written_bytes.mark(nbytes)
                 self._file_records += len(parsed)
@@ -428,18 +638,26 @@ class _Worker:
                     self._finalize_current_file()
         except RetryInterrupted:
             pass
-        except Exception:
+        except Exception as e:
+            self.exit_reason = repr(e)
             logger.exception("worker %d terminated", self.index)
             # a dying worker must not leak its open file's pipeline threads
             # or sink; the tmp stays on disk un-published (at-least-once:
             # its offsets were never acked)
-            if self.current_file is not None:
-                try:
-                    self.current_file.rotation_reason = "error"
-                    self.current_file.abandon()
-                finally:
-                    self._fold_pipe_stats(self.current_file)
-                    self.current_file = None
+            try:
+                if self.current_file is not None:
+                    try:
+                        self.current_file.rotation_reason = "error"
+                        self.current_file.abandon()
+                    finally:
+                        self._fold_pipe_stats(self.current_file)
+                        self.current_file = None
+            finally:
+                # visibility LAST: `failed` flips only after cleanup, so
+                # the supervisor's join-then-read of held_runs() is safe
+                self.p._failed.mark()
+                self.failed = True
+                self.p._notify_worker_death()
 
     def _try_wire_batch(self, recs, runs) -> bool:
         """Shred a poll batch through the native wire decoder and append it
@@ -461,12 +679,10 @@ class _Worker:
             self._open_file()
         # row order: records a fallback batch left in the file's record
         # buffer are OLDER than this batch — hand them to the writer first
-        try_until_succeeds(self.current_file.flush_buffered,
-                           stop_event=self._stop)
+        self._retry(self.current_file.flush_buffered, "flush_buffered")
         with stage("worker.append"):
             self.current_file.append_batch(batch)  # pure memory
-        try_until_succeeds(self.current_file.maybe_flush_row_group,
-                           stop_event=self._stop)
+        self._retry(self.current_file.maybe_flush_row_group, "flush")
         self._note_written_runs(runs)
         self.p._written_records.mark(len(recs))
         self.p._written_bytes.mark(batch.wire_bytes
@@ -603,6 +819,13 @@ class _Worker:
         ts = self._oldest_unacked_ts
         return {
             "worker": self.index,
+            "alive": self.alive(),
+            "failed": self.failed,
+            "exit_reason": self.exit_reason,
+            "restarts": self.p._restart_counts[self.index],
+            "retries": self.retries,
+            "retry_backoff_s": round(self.backoff_s, 6),
+            "last_error": self.last_error,
             "unacked_records": self._unacked_count,
             "oldest_unacked_age_s": (round(time.time() - ts, 6)
                                      if ts is not None else 0.0),
@@ -635,9 +858,10 @@ class _Worker:
                 encoder=self.p._encoder_factory(),
                 pipeline=self.p._b._pipeline,
                 est_record_bytes=self._carry_est,
+                retry_policy=self.p.retry_policy,
             )
 
-        self.current_file = try_until_succeeds(make, stop_event=self._stop)
+        self.current_file = self._retry(make, "open")
         self._file_records = 0
 
     def _new_file_name(self) -> str:
@@ -657,13 +881,12 @@ class _Worker:
         self._carry_est = f.est_record_bytes
         if f.get_num_written_records() == 0:
             # never publish empty files; just drop the tmp
-            try_until_succeeds(f.close, stop_event=self._stop)
-            try_until_succeeds(lambda: self.p.fs.delete(f.path),
-                               stop_event=self._stop)
+            self._retry(f.close, "close")
+            self._retry(lambda: self.p.fs.delete(f.path), "delete")
             self._fold_pipe_stats(f)
             self.current_file = None
             return
-        try_until_succeeds(f.close, stop_event=self._stop)
+        self._retry(f.close, "close")
         size = self.p.fs.size(f.path)
         self.p._flushed_records.mark(self._file_records)
         self.p._flushed_bytes.mark(size)
@@ -704,4 +927,4 @@ class _Worker:
             self.p.fs.rename(tmp_path, dest)
             logger.info("Published %s", dest)
 
-        try_until_succeeds(do, stop_event=self._stop)
+        self._retry(do, "publish")

@@ -22,3 +22,11 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def pytest_configure(config):
+    # tier-1 runs `-m 'not slow'`; register the marker so the chaos torture
+    # test is deselectable without a PytestUnknownMarkWarning
+    config.addinivalue_line(
+        "markers", "slow: long-running chaos/torture tests excluded from "
+        "the tier-1 fast suite")

@@ -1,0 +1,316 @@
+"""Chaos tests: the at-least-once invariant under injected failure.
+
+The reference's correctness protocol (write tmp -> close -> atomic rename ->
+ack, KafkaProtoParquetWriter.java:325-351) promises that a record's offset
+is acked only after the record is durably published.  These tests drive the
+FULL writer through a seeded fault schedule — transient IO errors
+mid-row-group, torn writes, rename failures on the publish step, broker
+fetch/commit errors, forced rebalances, and fatal faults that kill workers —
+and then assert the invariant *mechanically*:
+
+* every acked offset's record appears in a published (renamed) file,
+* no tmp file is ever counted as published,
+* ack-lag drains to exactly 0 after faults stop.
+
+A short seeded smoke variant runs in tier-1; the full torture run is marked
+``slow``.
+"""
+
+import collections
+import errno
+import time
+
+import pyarrow.parquet as pq
+import pytest
+
+from kpw_tpu import (
+    Builder,
+    FakeBroker,
+    FaultInjectingBroker,
+    FaultInjectingFileSystem,
+    FaultSchedule,
+    MemoryFileSystem,
+    MetricRegistry,
+    RetryPolicy,
+    WriterFailedError,
+)
+
+from proto_helpers import sample_message_class
+
+TOPIC = "chaos"
+
+
+def produce_indexed(broker, cls, rows, parts, pad=0):
+    """Produce ``rows`` records round-robin over ``parts`` partitions;
+    returns {(partition, offset): timestamp} — the identity map the
+    invariant check resolves acked offsets through.  ``pad`` fattens each
+    record so chaos runs produce enough row-group write ops for the
+    schedule's fault ordinals to actually fire."""
+    identity = {}
+    filler = "x" * pad
+    for i in range(rows):
+        m = cls(query=f"q-{i}-{filler}", timestamp=i)
+        p, off = broker.produce(TOPIC, m.SerializeToString(),
+                                partition=i % parts)
+        identity[(p, off)] = i
+    return identity
+
+
+def published_timestamps(fs, target="/out"):
+    """Multiset of record timestamps across PUBLISHED files only, plus the
+    file list; asserts no tmp leaks into the published set — a .parquet
+    living under the tmp dir (or a .tmp-suffixed listing survivor) is a
+    publish-protocol violation, counted rather than silently filtered."""
+    all_parquet = fs.list_files(target, extension=".parquet")
+    violations = [f for f in all_parquet
+                  if f"{target}/tmp/" in f or f.endswith(".tmp")]
+    assert violations == [], f"tmp counted as published: {violations}"
+    got = collections.Counter()
+    for f in all_parquet:
+        for r in pq.read_table(fs.open_read(f)).to_pylist():
+            got[r["timestamp"]] += 1
+    return got, all_parquet
+
+
+def assert_at_least_once_invariant(w, broker, fs, identity, parts,
+                                   group="g"):
+    """The mechanical invariant: acked offsets ⊆ published records, zero
+    published tmp files, ack-lag drained to 0."""
+    got, files = published_timestamps(fs)
+    total_committed = 0
+    for p in range(parts):
+        committed = broker.committed(group, TOPIC, p)
+        total_committed += committed
+        for off in range(committed):
+            ts = identity[(p, off)]
+            assert got[ts] >= 1, (
+                f"offset {p}/{off} acked but record {ts} not published")
+    lag = w.ack_lag()
+    assert lag["unacked_records"] == 0 and lag["oldest_unacked_age_s"] == 0.0
+    return got, files, total_committed
+
+
+def run_chaos(rows, parts, threads, build_schedule, max_restarts=6,
+              deadline_s=60, registry=None, expected_deaths=0):
+    """Produce -> run the writer under the schedule -> stop faults ->
+    drain -> return everything the invariant check needs."""
+    broker = FakeBroker()
+    broker.create_topic(TOPIC, parts)
+    cls = sample_message_class()
+    identity = produce_indexed(broker, cls, rows, parts, pad=150)
+
+    sched = FaultSchedule(seed=7)
+    rebalance_at = build_schedule(sched)
+    inner = MemoryFileSystem()
+    fs = FaultInjectingFileSystem(inner, sched)
+    fb = FaultInjectingBroker(broker, sched,
+                              rebalance_on_fetch=rebalance_at or ())
+
+    b = (Builder().broker(fb).topic(TOPIC).proto_class(cls)
+         .target_dir("/out").filesystem(fs).instance_name("chaos")
+         .group_id("g").thread_count(threads).batch_size(64)
+         .retry_policy(RetryPolicy(base_sleep=0.005, max_sleep=0.05))
+         .supervise(True, max_restarts=max_restarts,
+                    restart_backoff_seconds=0.01)
+         # small row groups + files: many write/rename ops per run, so the
+         # schedule's ordinals land mid-row-group and mid-publish
+         .max_file_size(128 * 1024).block_size(16 * 1024)
+         .max_file_open_duration_seconds(0.5))
+    if registry is not None:
+        b.metric_registry(registry)
+    w = b.build()
+    w.start()
+    deadline = time.time() + deadline_s
+    # phase 1: run under fire until everything has at least been written
+    # AND the scheduled worker kills actually landed (the write-op faults
+    # fire in the IO leg, which lags the written counter — disarming on
+    # written-alone would skip the late ordinals)
+    while time.time() < deadline:
+        if (w.total_written_records >= rows
+                and w._failed.count >= expected_deaths):
+            break
+        time.sleep(0.01)
+    # phase 2: faults stop; the system must fully drain
+    sched.stop()
+    while time.time() < deadline:
+        if (sum(broker.committed("g", TOPIC, p) for p in range(parts)) >= rows
+                and w.ack_lag()["unacked_records"] == 0):
+            break
+        time.sleep(0.02)
+    return w, broker, fs, sched, identity
+
+
+def test_chaos_smoke_at_least_once():
+    """Tier-1 seeded smoke: transient write/rename/fetch faults, one torn
+    write, one forced rebalance, and one fatal ENOSPC worker kill — the
+    invariant must hold and the supervisor must have restarted the
+    worker."""
+    rows, parts = 3000, 2
+    reg = MetricRegistry()
+
+    def schedule(s):
+        # fatal rule FIRST: rules match in registration order, so a later
+        # overlapping transient rule can never mask the kill
+        s.fail_nth("write", 14, err=errno.ENOSPC)         # fatal: worker kill
+        s.fail_nth("write", 5, count=2)                   # mid-row-group EIO
+        s.fail_nth("write", 9, partial=0.5)               # torn write
+        s.fail_nth("rename", 1)                           # publish fault
+        s.fail_nth("fetch", 3, count=2)                   # poll errors
+        s.fail_nth("commit", 1)                           # ack-path fault
+        return (6,)                                       # rebalance mid-run
+
+    w, broker, fs, sched, identity = run_chaos(rows, parts, 1, schedule,
+                                               registry=reg,
+                                               expected_deaths=1)
+    try:
+        got, files, committed = assert_at_least_once_invariant(
+            w, broker, fs, identity, parts)
+        assert committed >= rows  # everything eventually acked
+        # nothing lost: every produced record is present (>=1 occurrences)
+        assert set(got) == set(range(rows))
+        stats = w.stats()
+        assert stats["supervision"]["restarts_total"] >= 1  # the kill healed
+        assert stats["meters"]["parquet.writer.failed"]["count"] >= 1
+        assert stats["meters"]["parquet.writer.retries"]["count"] >= 1
+        assert stats["healthy"] is True
+        assert reg.get("parquet.writer.worker.restarts").count >= 1
+        assert sched.fired()  # the schedule actually fired
+    finally:
+        w.close()
+
+
+@pytest.mark.slow
+def test_chaos_torture_at_least_once():
+    """Full torture: two workers, heavier randomized (seeded) fault load —
+    many transient IO faults, repeated rename failures, torn writes,
+    broker errors, two worker kills, two rebalances, latency injection."""
+    rows, parts = 40_000, 4
+
+    def schedule(s):
+        # fatal rules first (registration order = match priority)
+        s.fail_nth("write", 70, err=errno.ENOSPC)         # worker kill 1
+        s.fail_nth("write", 150, err=errno.ENOSPC)        # worker kill 2
+        s.fail_random("write", 12, 400)                   # scattered EIO
+        s.fail_nth("write", 31, partial=0.3)              # torn writes
+        s.fail_nth("write", 57, partial=0.7)
+        s.fail_nth("rename", 2, count=2)
+        s.fail_nth("rename", 7)
+        s.fail_random("fetch", 5, 200)
+        s.fail_nth("commit", 2, count=2)
+        s.delay_nth("write", 40, 0.05, count=3)           # latency injection
+        s.delay_nth("fetch", 11, 0.05)
+        return (10, 60)                                   # two rebalances
+
+    w, broker, fs, sched, identity = run_chaos(rows, parts, 2, schedule,
+                                               deadline_s=120,
+                                               expected_deaths=2)
+    try:
+        got, files, committed = assert_at_least_once_invariant(
+            w, broker, fs, identity, parts)
+        assert committed >= rows
+        assert set(got) == set(range(rows))
+        stats = w.stats()
+        assert stats["supervision"]["restarts_total"] >= 1
+        assert len(files) >= 4  # rotation kept happening under fire
+        # the schedule did real damage: faults fired across multiple ops
+        ops_fired = {e["op"] for e in sched.fired()}
+        assert {"write", "rename", "fetch"} <= ops_fired
+    finally:
+        w.close()
+
+
+def test_worker_death_visible_without_supervision():
+    """Satellite: a dead worker must be observable even when supervision
+    was never enabled — healthy() flips false, the failed meter marks,
+    stats carry the exit reason — and close() still succeeds (reference
+    parity: no restart, no terminal error)."""
+    broker = FakeBroker()
+    broker.create_topic(TOPIC, 1)
+    cls = sample_message_class()
+    produce_indexed(broker, cls, 500, 1)
+    sched = FaultSchedule(seed=1).fail_nth("write", 2, err=errno.EROFS)
+    fs = FaultInjectingFileSystem(MemoryFileSystem(), sched)
+    reg = MetricRegistry()
+    w = (Builder().broker(broker).topic(TOPIC).proto_class(cls)
+         .target_dir("/out").filesystem(fs).instance_name("nosup")
+         .group_id("g").batch_size(32).metric_registry(reg)
+         .retry_policy(RetryPolicy(base_sleep=0.005, max_sleep=0.02))
+         .max_file_open_duration_seconds(0.2)
+         .build())
+    w.start()
+    deadline = time.time() + 10
+    while reg.get("parquet.writer.failed").count < 1 and time.time() < deadline:
+        time.sleep(0.01)
+    assert reg.get("parquet.writer.failed").count == 1
+    assert w.healthy() is False
+    s = w.stats()
+    assert s["supervision"]["enabled"] is False
+    assert s["supervision"]["workers_dead"] == 1
+    assert s["supervision"]["workers_alive"] == 0
+    assert "EROFS" in s["workers"][0]["exit_reason"] \
+        or "30" in s["workers"][0]["exit_reason"]  # errno.EROFS == 30
+    assert reg.get("parquet.writer.workers.alive").value == 0.0
+    w.close()  # must NOT raise without supervision
+
+
+def test_restart_budget_exhausted_raises_on_close():
+    """Satellite: with supervision on and a persistently failing sink, the
+    restart budget runs out, healthy() goes false, and close() raises a
+    terminal WriterFailedError instead of silently succeeding."""
+    broker = FakeBroker()
+    broker.create_topic(TOPIC, 1)
+    cls = sample_message_class()
+    produce_indexed(broker, cls, 100, 1)
+    sched = FaultSchedule(seed=2).fail_forever_from("write", 1,
+                                                    err=errno.ENOSPC)
+    fs = FaultInjectingFileSystem(MemoryFileSystem(), sched)
+    w = (Builder().broker(broker).topic(TOPIC).proto_class(cls)
+         .target_dir("/out").filesystem(fs).instance_name("term")
+         .group_id("g").batch_size(32)
+         .retry_policy(RetryPolicy(base_sleep=0.005, max_sleep=0.01))
+         .supervise(True, max_restarts=2, restart_backoff_seconds=0.01)
+         .max_file_open_duration_seconds(0.2)
+         .build())
+    w.start()
+    deadline = time.time() + 15
+    while w._terminal is None and time.time() < deadline:
+        time.sleep(0.02)
+    assert w.healthy() is False
+    s = w.stats()
+    assert s["supervision"]["terminal_failure"] is not None
+    assert s["supervision"]["restart_counts"] == [2]
+    with pytest.raises(WriterFailedError, match="restart budget"):
+        w.close()
+    # nothing was ever acked: the records are intact for the next instance
+    assert broker.committed("g", TOPIC, 0) == 0
+
+
+def test_recovery_sweep_meters_swept_tmp():
+    """Satellite: the startup recovery sweep counts what it GC'd — the
+    swept-tmp meter and the stats recovery block agree with the planted
+    leftovers."""
+    broker = FakeBroker()
+    broker.create_topic(TOPIC, 1)
+    cls = sample_message_class()
+    produce_indexed(broker, cls, 50, 1)
+    fs = MemoryFileSystem()
+    fs.mkdirs("/out/tmp")
+    for p in ("/out/tmp/sweep_0_11.tmp", "/out/tmp/sweep_0_22.tmp",
+              "/out/tmp/other_0_33.tmp"):
+        with fs.open_write(p) as f:
+            f.write(b"leftover")
+    reg = MetricRegistry()
+    w = (Builder().broker(broker).topic(TOPIC).proto_class(cls)
+         .target_dir("/out").filesystem(fs).instance_name("sweep")
+         .group_id("g").metric_registry(reg)
+         .clean_abandoned_tmp(True)
+         .max_file_open_duration_seconds(0.2)
+         .build())
+    with w:
+        deadline = time.time() + 10
+        while w.total_flushed_records < 50 and time.time() < deadline:
+            time.sleep(0.01)
+    assert reg.get("parquet.writer.tmp.swept").count == 2
+    assert w.stats()["recovery"]["tmp_swept"] == 2
+    # the foreign instance's tmp survived
+    assert fs.exists("/out/tmp/other_0_33.tmp")

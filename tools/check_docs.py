@@ -180,12 +180,17 @@ def main() -> int:
     full_record = json.load(open(sweep_path))
     rec = full_record["configs"]
     # the observability artifact (bench.py --obs) is a second committed
-    # key source: docs citing its keys reconcile against it the same way
+    # key source: docs citing its keys reconcile against it the same way;
+    # the chaos artifact (bench.py --chaos) is the third
     obs_path = os.environ.get("KPW_OBS_PATH",
                               os.path.join(ROOT, "BENCH_OBS_r06.json"))
     key_record: dict = {"sweep": full_record}
     if os.path.exists(obs_path):
         key_record["obs"] = json.load(open(obs_path))
+    chaos_path = os.environ.get("KPW_CHAOS_PATH",
+                                os.path.join(ROOT, "BENCH_CHAOS_r07.json"))
+    if os.path.exists(chaos_path):
+        key_record["chaos"] = json.load(open(chaos_path))
     docs = {f: open(os.path.join(ROOT, f)).read()
             for f in ({c[0] for c in CHECKS} | set(KEY_DOCS)
                       | set(NAME_DOCS))}
