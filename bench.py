@@ -20,6 +20,11 @@ encode (NYC-taxi-shaped replay, one chip), printed as ONE JSON line
                with supervision, check the at-least-once invariant
                mechanically, A/B the disabled overhead, and write
                BENCH_CHAOS_r07.json
+  --crash      SIGKILL a real child writer process mid-run, recover over
+               the same directory, verify every acked offset landed in a
+               structurally-valid published file (independent verifier),
+               A/B the fsync-publish overhead, and write
+               BENCH_CRASH_r08.json
   --cpu        force the virtual CPU platform (local smoke)
 
 Baseline for configs 1/2/3/5 is pyarrow's C++ parquet writer with matched
@@ -2126,6 +2131,196 @@ def chaos_probe(rows: int = 20_000, seed: int = 7,
 
 
 # ---------------------------------------------------------------------------
+# --crash: kill -9 a real child writer process, verify the wreckage
+# ---------------------------------------------------------------------------
+
+def crash_probe(rows: int = 12_000, seed: int = 8,
+                ab_pairs: int = 7) -> dict:
+    """``--crash`` mode: the durability layer's committed evidence.
+
+    Part 1 — process-level crash replay (tests/crash_child.py): a child
+    writer streams over a REAL local filesystem with the durability
+    discipline on (fsync-before-rename publish, page CRCs, fsync'd offset
+    commit log); the parent SIGKILLs it after ``kill_after_files``
+    publishes (seed-derived), plants the torn-final + stale-tmp debris a
+    power cut would leave, restarts a fresh process over the same
+    directory with verify-on-startup recovery, and checks the invariant
+    from disk alone: every logged (acked) offset's record lives in a
+    structurally-VERIFIED published file, nothing unverifiable stayed
+    published (the torn final was quarantined, not deleted), tmps swept,
+    ack-lag drained to 0.
+
+    Part 2 — fsync-overhead A/B: interleaved pairs of the same clean
+    local-disk replay with durability off (arm A: plain rename publish)
+    vs on (arm B: fsync + rename + dir-fsync per publish).  Pairwise
+    min-of-3 arms, overhead = delta of arm medians (the PR-2/PR-3
+    methodology; single-shot arms swing ±20% on this shared box).
+    """
+    import json as _json
+    import shutil
+    import signal
+    import tempfile
+
+    tests_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "tests")
+    sys.path.insert(0, tests_dir)
+    import crash_child
+
+    child_py = os.path.join(tests_dir, "crash_child.py")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    kill_after_files = 1 + seed % 3  # the seeded kill point
+
+    # -- part 1: kill -9 + recovery ---------------------------------------
+    target = tempfile.mkdtemp(prefix="kpw_crash_")
+    try:
+        victim = subprocess.Popen(
+            [sys.executable, child_py, target, str(rows), "victim"],
+            env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        deadline = time.time() + 180
+        in_window = False
+        while time.time() < deadline:
+            if victim.poll() is not None:
+                raise RuntimeError(
+                    f"victim exited rc={victim.returncode} before the kill")
+            if (len(crash_child.published_files(target)) >= kill_after_files
+                    and crash_child.read_commit_frontiers(target)):
+                in_window = True
+                break
+            time.sleep(0.02)
+        if not in_window:
+            victim.kill()
+            raise RuntimeError(
+                f"crash probe kill window missed: child published "
+                f"{len(crash_child.published_files(target))} file(s) "
+                f"(< {kill_after_files}) in 180 s — box too contended")
+        os.kill(victim.pid, signal.SIGKILL)
+        victim.wait(timeout=30)
+        frontiers = crash_child.read_commit_frontiers(target)
+
+        # power-cut debris a process kill cannot produce (page cache
+        # survives process death): one torn published final + a stale tmp
+        files = crash_child.published_files(target)
+        whole = open(files[0], "rb").read()
+        torn_name = "19990101-000000000_crash_0.parquet"
+        with open(os.path.join(target, torn_name), "wb") as f:
+            f.write(whole[: max(8, len(whole) // 3)])
+        os.makedirs(os.path.join(target, "tmp"), exist_ok=True)
+        with open(os.path.join(target, "tmp", "crash_0_77.tmp"), "wb") as f:
+            f.write(b"half a row group")
+
+        rc = subprocess.run(
+            [sys.executable, child_py, target, str(rows), "recover"],
+            env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL, timeout=300).returncode
+        verdict = crash_child.check_crash_invariant(target)
+        rec_stats = _json.load(
+            open(os.path.join(target, crash_child.RECOVER_STATS)))
+        outcome = {
+            "rows": rows,
+            "kill_after_files": kill_after_files,
+            "victim_killed_with": "SIGKILL",
+            "acked_frontiers_at_kill": {str(p): f
+                                        for p, f in frontiers.items()},
+            "recover_rc": rc,
+            "planted_torn_final": torn_name,
+            "torn_final_quarantined":
+                torn_name in verdict["quarantined_files"],
+            "recovered_ack_lag": rec_stats["ack"]["unacked_records"],
+            "recovery_stats": rec_stats["recovery"],
+            **{k: (v if not isinstance(v, list) else len(v))
+               for k, v in verdict.items()
+               if k not in ("quarantined_files", "tmp_files_left")},
+            "quarantined_count": len(verdict["quarantined_files"]),
+            "tmp_files_left": len(verdict["tmp_files_left"]),
+            "invariant_holds": (verdict["invariant_holds"] and rc == 0
+                                and torn_name in
+                                verdict["quarantined_files"]),
+        }
+    finally:
+        shutil.rmtree(target, ignore_errors=True)
+    print(f"[bench:crash] kill -9 after {kill_after_files} publish(es): "
+          f"{outcome['acked_offsets_checked']} acked offsets checked, "
+          f"{outcome['verified_ok']} files verified, "
+          f"{outcome['quarantined_count']} quarantined, "
+          f"invariant_holds={outcome['invariant_holds']}", file=sys.stderr)
+
+    # -- part 2: fsync-overhead A/B ---------------------------------------
+    from kpw_tpu import Builder, FakeBroker, LocalFileSystem, RetryPolicy
+
+    from proto_helpers import sample_message_class
+
+    ab_rows = 40_000
+    parts = 2
+    payloads = _chaos_messages(ab_rows)
+    cls = sample_message_class()
+
+    def arm(durable: bool, i: int) -> float:
+        b = FakeBroker()
+        b.create_topic("chaos", parts)
+        for j, p in enumerate(payloads):
+            b.produce("chaos", p, partition=j % parts)
+        tdir = tempfile.mkdtemp(prefix="kpw_fsync_ab_")
+        try:
+            bb = (Builder().broker(b).topic("chaos").proto_class(cls)
+                  .target_dir(tdir).filesystem(LocalFileSystem())
+                  .instance_name(f"ab{i}").group_id(f"fsync-ab-{i}")
+                  .batch_size(256)
+                  .retry_policy(RetryPolicy(base_sleep=0.005,
+                                            max_sleep=0.05))
+                  .max_file_size(256 * 1024).block_size(32 * 1024)
+                  .max_file_open_duration_seconds(0.5))
+            if durable:
+                bb.durability(True)
+            wx = bb.build()
+            t_written, _ = _chaos_drain(wx, b, parts, ab_rows,
+                                        f"fsync-ab-{i}", 60)
+            wx.close()
+        finally:
+            shutil.rmtree(tdir, ignore_errors=True)
+        return t_written
+
+    arm(False, 98)  # warm both arms outside the measured window
+    arm(True, 99)
+    t_off, t_on, deltas = [], [], []
+    for i in range(ab_pairs):
+        order = (False, True) if i % 2 == 0 else (True, False)
+        pair = {}
+        for durable in order:
+            pair[durable] = min(arm(durable, 3 * i + r) for r in range(3))
+        t_off.append(pair[False])
+        t_on.append(pair[True])
+        deltas.append((pair[True] - pair[False]) / pair[False] * 100)
+    off_med, on_med = _median(t_off), _median(t_on)
+    overhead = ((on_med - off_med) / off_med * 100) if off_med > 0 else 0.0
+    out = {
+        "metric": "crash_kill9_at_least_once",
+        "value": outcome["acked_offsets_checked"],
+        "unit": "acked offsets verified in valid published files",
+        "seed": seed,
+        "outcome": outcome,
+        "fsync_overhead_pct": round(overhead, 2),
+        "ab_rows": ab_rows,
+        "ab_pairs": ab_pairs,
+        "ab_seconds_off": [round(t, 3) for t in t_off],
+        "ab_seconds_on": [round(t, 3) for t in t_on],
+        "ab_pair_deltas_pct": [round(d, 2) for d in deltas],
+        "ab_policy": ("interleaved pairs (order alternating), min-of-3 per "
+                      "arm per pair, overhead = delta of arm medians (same "
+                      "methodology as the PR-2 tracing and PR-3 chaos "
+                      "A/Bs): arm A = plain rename publish, arm B = "
+                      "durable publish (fsync tmp + atomic rename + dir "
+                      "fsync), both on the real local filesystem; "
+                      "compared on time-to-all-written — the tail file's "
+                      "publish lands outside the window, every earlier "
+                      "rotation's fsync inside it"),
+    }
+    print(f"[bench:crash] fsync-overhead A/B: off {off_med:.3f}s vs on "
+          f"{on_med:.3f}s median over {ab_pairs} pairs -> "
+          f"{overhead:+.2f}%", file=sys.stderr)
+    return out
+
+
+# ---------------------------------------------------------------------------
 # config 7: nested streaming replay (cfg5 shape through the FULL writer)
 # ---------------------------------------------------------------------------
 
@@ -2411,7 +2606,7 @@ def _graded_main() -> None:
 def main() -> None:
     if not any(f in sys.argv
                for f in ("--all", "--rowgroup", "--hostasm", "--config",
-                         "--obs", "--chaos")):
+                         "--obs", "--chaos", "--crash")):
         # default graded path: jax-free orchestrator (see _graded_main)
         _graded_main()
         return
@@ -2428,8 +2623,9 @@ def main() -> None:
                   file=sys.stderr)
             sys.exit(3)
     if ("--cpu" in sys.argv or "--hostasm" in sys.argv
-            or "--obs" in sys.argv or "--chaos" in sys.argv):
-        # --hostasm/--obs/--chaos measure HOST work only and must never
+            or "--obs" in sys.argv or "--chaos" in sys.argv
+            or "--crash" in sys.argv):
+        # --hostasm/--obs/--chaos/--crash measure HOST work only and must never
         # grab the real chip; the switch must precede the first device use
         # below
         import jax
@@ -2733,6 +2929,21 @@ def main() -> None:
         # stdout line stays small: the full fault log lives in the artifact
         summary = {k: v for k, v in out.items()
                    if k not in ("fault_log", "fault_schedule")}
+        summary["artifact"] = os.path.basename(path)
+        print(json.dumps(summary))
+        return
+    if "--crash" in sys.argv:
+        out = crash_probe()
+        path = os.environ.get(
+            "KPW_CRASH_PATH",
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "BENCH_CRASH_r08.json"))
+        with open(path, "w") as f:
+            json.dump(out, f, indent=1)
+        print(f"[bench:crash] artifact written to {path}", file=sys.stderr)
+        summary = {k: v for k, v in out.items()
+                   if k not in ("outcome",)}
+        summary["invariant_holds"] = out["outcome"]["invariant_holds"]
         summary["artifact"] = os.path.basename(path)
         print(json.dumps(summary))
         return

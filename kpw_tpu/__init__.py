@@ -15,6 +15,7 @@ from .runtime import (  # noqa: E402,F401
     Gauge,
     KafkaProtoParquetWriter,
     MetricRegistry,
+    PublishVerificationError,
     RetryBudgetExceeded,
     RetryPolicy,
     WriterFailedError,

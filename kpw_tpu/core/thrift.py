@@ -168,20 +168,42 @@ class CompactWriter:
         return len(self._buf)
 
 
+class ThriftDecodeError(ValueError):
+    """Malformed or truncated thrift-compact bytes.  The read side's ONE
+    error type: the independent file verifier (io/verify.py) decodes
+    footers and page headers from possibly-torn files, and corruption must
+    surface as a diagnosable failure — never a bare IndexError, an
+    unbounded varint, or a RecursionError from garbage nesting."""
+
+
+# nesting deeper than any parquet metadata struct (schema trees are flat
+# lists here; the deepest real chain is FileMetaData>RowGroup>ColumnChunk>
+# ColumnMetaData>Statistics = 5) — garbage bytes decoding as ever-nested
+# structs fail loudly instead of exhausting the Python stack
+_MAX_DEPTH = 32
+
+
 class CompactReader:
-    """Minimal generic compact-protocol decoder (for tests/debugging).
+    """Generic compact-protocol decoder, bounds-checked end to end.
 
     Decodes a struct into ``{field_id: value}``; nested structs become dicts,
     lists become Python lists.  Element types are mapped to Python scalars;
     i16/i32/i64 are indistinguishable after decode, which is fine for
-    verification purposes.
+    verification purposes.  Every read is bounds-checked against ``data``
+    (and the optional ``limit``) so a truncated or bit-flipped input raises
+    :class:`ThriftDecodeError` with the failing byte position.
     """
 
-    def __init__(self, data: bytes, pos: int = 0) -> None:
+    def __init__(self, data: bytes, pos: int = 0,
+                 limit: int | None = None) -> None:
         self.data = data
         self.pos = pos
+        self.limit = len(data) if limit is None else limit
 
     def _byte(self) -> int:
+        if self.pos >= self.limit:
+            raise ThriftDecodeError(
+                f"truncated thrift: read past byte {self.limit}")
         b = self.data[self.pos]
         self.pos += 1
         return b
@@ -195,11 +217,14 @@ class CompactReader:
             if not b & 0x80:
                 return out
             shift += 7
+            if shift > 63:
+                raise ThriftDecodeError(
+                    f"varint wider than 64 bits at byte {self.pos}")
 
     def _zigzag_varint(self) -> int:
         return unzigzag(self._varint())
 
-    def read_value(self, ctype: int):
+    def read_value(self, ctype: int, depth: int = 0):
         if ctype in (CT_TRUE, CT_FALSE):
             return ctype == CT_TRUE
         if ctype == CT_BYTE:
@@ -207,11 +232,17 @@ class CompactReader:
         if ctype in (CT_I16, CT_I32, CT_I64):
             return self._zigzag_varint()
         if ctype == CT_DOUBLE:
+            if self.pos + 8 > self.limit:
+                raise ThriftDecodeError(
+                    f"truncated double at byte {self.pos}")
             v = struct.unpack_from("<d", self.data, self.pos)[0]
             self.pos += 8
             return v
         if ctype == CT_BINARY:
             n = self._varint()
+            if n < 0 or self.pos + n > self.limit:
+                raise ThriftDecodeError(
+                    f"binary of {n} bytes overruns input at byte {self.pos}")
             v = self.data[self.pos : self.pos + n]
             self.pos += n
             return v
@@ -221,15 +252,24 @@ class CompactReader:
             elem = head & 0x0F
             if size == 15:
                 size = self._varint()
+            if size > self.limit - self.pos:
+                # every element consumes >= 1 byte; a size past the input's
+                # remainder can only be corruption — fail before looping
+                raise ThriftDecodeError(
+                    f"list of {size} elements overruns input at byte "
+                    f"{self.pos}")
             if elem in (CT_TRUE, CT_FALSE):
                 # bools inside lists are encoded as the type byte itself
                 return [self._byte() == CT_TRUE for _ in range(size)]
-            return [self.read_value(elem) for _ in range(size)]
+            return [self.read_value(elem, depth) for _ in range(size)]
         if ctype == CT_STRUCT:
-            return self.read_struct()
-        raise ValueError(f"unsupported compact type {ctype}")
+            return self.read_struct(depth + 1)
+        raise ThriftDecodeError(f"unsupported compact type {ctype}")
 
-    def read_struct(self) -> dict:
+    def read_struct(self, depth: int = 0) -> dict:
+        if depth > _MAX_DEPTH:
+            raise ThriftDecodeError(
+                f"struct nesting deeper than {_MAX_DEPTH}")
         out: dict[int, object] = {}
         last_fid = 0
         while True:
@@ -243,4 +283,4 @@ class CompactReader:
             else:
                 fid = last_fid + delta
             last_fid = fid
-            out[fid] = self.read_value(ctype)
+            out[fid] = self.read_value(ctype, depth)

@@ -15,7 +15,8 @@ discoverability, but constructing a writer never touches it).
 Operation names checked by the filesystem wrapper:
 
 ``open`` (open_write/open_append/open_read), ``write`` (write/writelines),
-``flush``, ``close``, ``rename``, ``delete``, ``mkdirs``, ``list``.
+``flush``, ``close``, ``rename``, ``sync`` (sync/sync_dir — the legs of a
+durable publish), ``delete``, ``mkdirs``, ``list``.
 
 The broker-side counterpart (``fetch`` / ``commit`` / forced ``rebalance``)
 lives in :mod:`kpw_tpu.ingest.faults` and shares the same schedule object,
@@ -38,15 +39,17 @@ class InjectedFault(OSError):
 
 
 class _Rule:
-    __slots__ = ("op", "ordinals", "errno", "latency_s", "partial")
+    __slots__ = ("op", "ordinals", "errno", "latency_s", "partial", "drop")
 
     def __init__(self, op: str, ordinals: set, errno: int | None,
-                 latency_s: float, partial: float) -> None:
+                 latency_s: float, partial: float,
+                 drop: bool = False) -> None:
         self.op = op
         self.ordinals = ordinals  # 1-based call numbers this rule covers
-        self.errno = errno        # None = latency-only rule
+        self.errno = errno        # None = latency-only (or drop) rule
         self.latency_s = latency_s
         self.partial = partial    # fraction of a write to land before failing
+        self.drop = drop          # crash window: swallow the op, no error
 
 
 class FaultSchedule:
@@ -94,6 +97,21 @@ class FaultSchedule:
             _Rule(op, {-nth}, err, 0.0, 0.0))
         return self
 
+    def drop_writes_from(self, nth: int) -> "FaultSchedule":
+        """Crash window: every ``write`` op from ordinal ``nth`` on is
+        silently SWALLOWED — the caller is told it succeeded, but nothing
+        lands in the file.  This is the kill -9 / power-cut shape (bytes the
+        process believed written never reached the disk) made reproducible
+        in-process: the writer happily finalizes and publishes a file whose
+        tail was never written, producing exactly the torn PUBLISHED state
+        the recovery verifier must catch and quarantine.  Open-ended, like
+        :meth:`fail_forever_from`."""
+        if nth < 1:
+            raise ValueError("nth must be >= 1")
+        self._rules.setdefault("write", []).append(
+            _Rule("write", {-nth}, None, 0.0, 0.0, drop=True))
+        return self
+
     def delay_nth(self, op: str, nth: int, latency_s: float,
                   count: int = 1) -> "FaultSchedule":
         """Stall (but do not fail) calls ``nth .. nth+count-1`` of ``op``."""
@@ -137,6 +155,7 @@ class FaultSchedule:
                     "errno": r.errno,
                     "latency_s": r.latency_s,
                     "partial": r.partial,
+                    "drop": r.drop,
                 })
         return out
 
@@ -155,10 +174,13 @@ class FaultSchedule:
             return dict(self._counts)
 
     # -- runtime check --------------------------------------------------------
-    def check(self, op: str, payload_writer=None) -> None:
+    def check(self, op: str, payload_writer=None) -> str | None:
         """Advance ``op``'s call count; stall and/or raise when a rule
         covers this ordinal.  ``payload_writer`` (write ops) is a callable
-        ``fraction -> None`` that lands a torn prefix before the raise."""
+        ``fraction -> None`` that lands a torn prefix before the raise.
+        Returns ``"drop"`` when a crash-window rule covers this ordinal —
+        the caller must then swallow the operation (report success, write
+        nothing); returns None otherwise."""
         rule = None
         with self._lock:
             n = self._counts.get(op, 0) + 1
@@ -170,15 +192,19 @@ class FaultSchedule:
                     if hit:
                         rule = r
                         break
-            if rule is not None and rule.errno is not None:
-                self._fired.append({"op": op, "ordinal": n,
-                                    "errno": rule.errno})
+            if rule is not None and (rule.errno is not None or rule.drop):
+                entry = {"op": op, "ordinal": n, "errno": rule.errno}
+                if rule.drop:
+                    entry["drop"] = True
+                self._fired.append(entry)
         if rule is None:
-            return
+            return None
         if rule.latency_s > 0.0:
             time.sleep(rule.latency_s)
+        if rule.drop:
+            return "drop"
         if rule.errno is None:
-            return  # latency-only rule
+            return None  # latency-only rule
         if rule.partial > 0.0 and payload_writer is not None:
             payload_writer(rule.partial)
         raise InjectedFault(rule.errno, f"injected fault: {op} call #{n}")
@@ -197,7 +223,8 @@ class _FaultFile:
     def write(self, data) -> int:
         def torn(fraction: float) -> None:
             self._inner.write(data[: int(len(data) * fraction)])
-        self._schedule.check("write", torn)
+        if self._schedule.check("write", torn) == "drop":
+            return len(data)  # crash window: lie like a lost page cache
         return self._inner.write(data)
 
     def writelines(self, parts) -> None:
@@ -205,7 +232,8 @@ class _FaultFile:
 
         def torn(fraction: float) -> None:
             self._inner.writelines(parts[: int(len(parts) * fraction)])
-        self._schedule.check("write", torn)
+        if self._schedule.check("write", torn) == "drop":
+            return  # crash window: swallowed
         self._inner.writelines(parts)
 
     def flush(self) -> None:
@@ -255,6 +283,19 @@ class FaultInjectingFileSystem(FileSystem):
     def rename(self, src: str, dst: str) -> None:
         self.schedule.check("rename")
         self.inner.rename(src, dst)
+
+    def sync(self, path: str) -> None:
+        self.schedule.check("sync")
+        self.inner.sync(path)
+
+    def sync_dir(self, path: str) -> None:
+        self.schedule.check("sync")
+        self.inner.sync_dir(path)
+
+    # durable_rename deliberately NOT forwarded to inner: the base-class
+    # composition (sync -> rename -> sync_dir) runs HERE, so each leg
+    # consults the schedule — an fsync-failure rule fires inside the
+    # durable publish exactly where a real fsync would fail
 
     def exists(self, path: str) -> bool:
         return self.inner.exists(path)

@@ -37,6 +37,41 @@ class FileSystem:
         """Atomic move; parent of dst must exist."""
         raise NotImplementedError
 
+    def sync(self, path: str) -> None:
+        """Force ``path``'s contents to stable storage (fsync).  A plain
+        close() only hands the bytes to the OS page cache — they survive a
+        process kill but NOT a machine crash/power cut.  Implementations
+        whose close IS durable (MemoryFileSystem's atomic store publish,
+        HDFS pipeline close) no-op."""
+        raise NotImplementedError
+
+    def sync_dir(self, path: str) -> None:
+        """Force the DIRECTORY ENTRY updates under ``path`` (a rename's new
+        name, a create) to stable storage.  POSIX makes this a separate
+        fsync on the directory fd; filesystems without that distinction
+        no-op."""
+        raise NotImplementedError
+
+    def durable_rename(self, src: str, dst: str) -> None:
+        """Crash-consistent publish: fsync the file, atomically rename it,
+        then fsync the destination's parent directory — the full
+        fsync-before-rename + dir-fsync discipline, so after this returns
+        the published file survives kill -9 AND power loss.  One default
+        composition over the three primitives; wrappers that intercept
+        sync/rename (fault injection) inherit the decomposed ops.
+
+        Retry-safe for the SAME (src, dst) pair: unlike a bare rename, this
+        can fail AFTER the rename landed (the trailing dir fsync), so a
+        retried call finds src gone and dst present — it resumes at the
+        pending dir fsync instead of raising ENOENT on the fsync of a file
+        that was already published."""
+        if self.exists(src):
+            self.sync(src)
+            self.rename(src, dst)
+        elif not self.exists(dst):
+            raise FileNotFoundError(src)
+        self.sync_dir(dst.rsplit("/", 1)[0] if "/" in dst else ".")
+
     def exists(self, path: str) -> bool:
         raise NotImplementedError
 
@@ -66,6 +101,21 @@ class LocalFileSystem(FileSystem):
 
     def rename(self, src: str, dst: str) -> None:
         os.replace(src, dst)
+
+    def sync(self, path: str) -> None:
+        # O_RDONLY is enough to fsync file DATA on linux; no O_RDWR needed
+        fd = os.open(path, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+
+    def sync_dir(self, path: str) -> None:
+        fd = os.open(path, os.O_RDONLY | getattr(os, "O_DIRECTORY", 0))
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
 
     def exists(self, path: str) -> bool:
         return os.path.exists(path)
@@ -159,6 +209,19 @@ class MemoryFileSystem(FileSystem):
             if os.path.dirname(d) not in self._dirs:
                 raise FileNotFoundError(f"parent dir missing: {dst}")
             self._files[d] = self._files.pop(s)
+
+    def sync(self, path: str) -> None:
+        # the store IS stable storage here; still raise on a missing file so
+        # durability bugs (sync before close, wrong path) surface in tests
+        with self._lock:
+            if self._norm(path) not in self._files:
+                raise FileNotFoundError(path)
+
+    def sync_dir(self, path: str) -> None:
+        with self._lock:
+            p = self._norm(path)
+            if p not in self._dirs:
+                raise FileNotFoundError(path)
 
     def exists(self, path: str) -> bool:
         with self._lock:

@@ -65,6 +65,17 @@ class HdfsFileSystem(FileSystem):
     def rename(self, src: str, dst: str) -> None:
         self._fs.move(src, dst)  # HDFS NameNode rename: atomic
 
+    def sync(self, path: str) -> None:
+        # HDFS close() already waits for the write pipeline's replica acks
+        # (the durability POSIX fsync provides locally); there is no
+        # path-level fsync in the libhdfs surface, so sync is a no-op — but
+        # keep the Local/Memory contract of raising on a lost file
+        if not self.exists(path):
+            raise FileNotFoundError(path)
+
+    def sync_dir(self, path: str) -> None:
+        pass  # namespace edits are journaled by the NameNode at rename time
+
     def exists(self, path: str) -> bool:
         return self._fs.get_file_info(path).type != self._FileType.NotFound
 
@@ -90,7 +101,15 @@ class HdfsFileSystem(FileSystem):
         sel = self._FileSelector(path, recursive=recursive,
                                  allow_not_found=True)
         out = []
-        for info in self._fs.get_file_info(sel):
+        try:
+            infos = self._fs.get_file_info(sel)
+        except FileNotFoundError:
+            # despite allow_not_found, pyarrow can raise when the
+            # directory is being CREATED concurrently (observed racing a
+            # recursive create_dir) — Local/Memory parity is an empty
+            # listing for a dir that isn't fully there yet
+            return out
+        for info in infos:
             if info.type != self._FileType.File:
                 continue
             if extension is None or info.path.endswith(extension):

@@ -1,0 +1,356 @@
+"""Independent structural verifier for parquet files — no pyarrow, no
+shared write-path code beyond the thrift decoder.
+
+The write side (core/writer.py, core/pages.py) emits page CRCs and a
+thrift footer, but until this module nothing in the repo could *check* a
+published file: a torn final (kill -9 between a page-cache write and the
+fsync that never happened) or a bit-flipped page body was invisible until
+some downstream reader choked.  This verifier walks the physical layout
+from the bytes alone:
+
+* ``PAR1`` magic at both ends,
+* footer-length sanity (the 4-byte little-endian length must frame a
+  region inside the file),
+* thrift-compact footer parse (bounds-checked ``core.thrift.CompactReader``
+  — corruption raises ``ThriftDecodeError``, never an IndexError),
+* row-group / column-chunk offsets and sizes in-bounds and non-overlapping
+  with the footer,
+* a full page-header walk of every column chunk (header parse, body
+  in-bounds, page-type sanity, per-chunk byte accounting),
+* CRC-32 (gzip polynomial, PARQUET-1539) check of every page body that
+  carries the optional crc field — the write side's
+  ``Builder.page_checksums(True)`` checksums verified on read,
+* row/value-count consistency (row-group rows sum to the footer's
+  ``num_rows``; each chunk's data-page values sum to its meta's
+  ``num_values``).
+
+It deliberately does NOT decode values: the contract is "structurally
+valid parquet whose every byte is where the footer says it is", which is
+what the recovery pass (runtime/writer.py ``recover``) needs to decide
+publish-vs-quarantine, and what the crash harness (tests/test_crash.py,
+``bench.py --crash``) asserts for every acked offset's file.
+
+CLI: ``python -m kpw_tpu.io.verify <file-or-dir> [...]`` — exit 0 iff
+every file verifies; ``--json`` dumps the reports as one JSON array.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import zlib
+from dataclasses import dataclass, field
+
+from ..core.schema import Codec, PageType
+from ..core.thrift import CompactReader, ThriftDecodeError
+from .fs import FileSystem, LocalFileSystem
+
+MAGIC = b"PAR1"
+# trailing frame: 4-byte little-endian footer length + magic
+_TAIL = 8
+# FileMetaData field ids (parquet.thrift; mirrors core/metadata.py's writer)
+_FMD_VERSION, _FMD_SCHEMA, _FMD_NUM_ROWS, _FMD_ROW_GROUPS = 1, 2, 3, 4
+# RowGroup
+_RG_COLUMNS, _RG_NUM_ROWS = 1, 3
+# ColumnChunk / ColumnMetaData
+_CC_META = 3
+_CM_CODEC, _CM_NUM_VALUES = 4, 5
+_CM_TOTAL_COMPRESSED = 7
+_CM_DATA_PAGE_OFFSET, _CM_DICT_PAGE_OFFSET = 9, 11
+# PageHeader
+_PH_TYPE, _PH_UNCOMPRESSED, _PH_COMPRESSED, _PH_CRC = 1, 2, 3, 4
+_PH_DATA_HEADER, _PH_DICT_HEADER, _PH_V2_HEADER = 5, 7, 8
+_DPH_NUM_VALUES = 1  # in both v1 and v2 data-page headers
+
+
+@dataclass
+class FileReport:
+    """Structured verdict for one file.  ``ok`` iff ``errors`` is empty;
+    every failed check appends one human-readable entry (the walk keeps
+    going where it safely can, so one report carries every independent
+    defect it could reach)."""
+
+    path: str
+    size: int = 0
+    ok: bool = False
+    errors: list = field(default_factory=list)
+    num_rows: int | None = None
+    row_groups: int = 0
+    columns: int = 0
+    pages: int = 0
+    pages_crc_checked: int = 0
+    footer_bytes: int = 0
+
+    def to_dict(self) -> dict:
+        return {
+            "path": self.path,
+            "size": self.size,
+            "ok": self.ok,
+            "errors": list(self.errors),
+            "num_rows": self.num_rows,
+            "row_groups": self.row_groups,
+            "columns": self.columns,
+            "pages": self.pages,
+            "pages_crc_checked": self.pages_crc_checked,
+            "footer_bytes": self.footer_bytes,
+        }
+
+
+def _require_int(report: FileReport, container: dict, fid: int,
+                 what: str) -> int | None:
+    v = container.get(fid)
+    if not isinstance(v, int) or isinstance(v, bool):
+        report.errors.append(f"{what} missing or not an integer")
+        return None
+    return v
+
+
+def _walk_chunk(data: bytes, report: FileReport, rg_i: int, col_i: int,
+                meta: dict, footer_start: int) -> None:
+    """Page-header walk of one column chunk: every page header must parse,
+    every body must lie inside the chunk, the bytes must account exactly
+    for total_compressed_size, data-page values must sum to num_values,
+    and any page carrying a crc field must match its body's CRC-32."""
+    where = f"row group {rg_i} column {col_i}"
+    num_values = _require_int(report, meta, _CM_NUM_VALUES,
+                              f"{where}: num_values")
+    total = _require_int(report, meta, _CM_TOTAL_COMPRESSED,
+                         f"{where}: total_compressed_size")
+    data_off = _require_int(report, meta, _CM_DATA_PAGE_OFFSET,
+                            f"{where}: data_page_offset")
+    if num_values is None or total is None or data_off is None:
+        return
+    dict_off = meta.get(_CM_DICT_PAGE_OFFSET)
+    if dict_off is not None and (not isinstance(dict_off, int)
+                                 or isinstance(dict_off, bool)):
+        # same int discipline as the required fields: a corrupt footer can
+        # flip field 11's type nibble, and the verifier must diagnose that,
+        # not crash computing offsets with bytes
+        report.errors.append(
+            f"{where}: dictionary_page_offset is not an integer")
+        return
+    start = dict_off if dict_off is not None else data_off
+    end = start + total
+    if start < len(MAGIC) or total < 0 or end > footer_start:
+        report.errors.append(
+            f"{where}: chunk [{start}, {end}) outside data region "
+            f"[{len(MAGIC)}, {footer_start})")
+        return
+    if not start <= data_off < end:
+        report.errors.append(
+            f"{where}: data_page_offset {data_off} outside chunk "
+            f"[{start}, {end})")
+        return
+    codec = meta.get(_CM_CODEC, Codec.UNCOMPRESSED)
+    pos = start
+    values_seen = 0
+    first = True
+    first_data_pos = None
+    while pos < end:
+        r = CompactReader(data, pos, limit=end)
+        try:
+            ph = r.read_struct()
+        except ThriftDecodeError as e:
+            report.errors.append(
+                f"{where}: page header at byte {pos} unreadable: {e}")
+            return
+        ptype = ph.get(_PH_TYPE)
+        comp = ph.get(_PH_COMPRESSED)
+        uncomp = ph.get(_PH_UNCOMPRESSED)
+        if not isinstance(comp, int) or not isinstance(uncomp, int) \
+                or comp < 0 or uncomp < 0:
+            report.errors.append(
+                f"{where}: page at byte {pos} has invalid sizes "
+                f"(compressed={comp!r}, uncompressed={uncomp!r})")
+            return
+        body_start = r.pos
+        body_end = body_start + comp
+        if body_end > end:
+            report.errors.append(
+                f"{where}: page body [{body_start}, {body_end}) overruns "
+                f"chunk end {end} — torn page")
+            return
+        if ptype == PageType.DICTIONARY_PAGE:
+            if not first or dict_off != pos:
+                report.errors.append(
+                    f"{where}: dictionary page at byte {pos} not the "
+                    f"chunk's first page at dictionary_page_offset")
+        elif ptype in (PageType.DATA_PAGE, PageType.DATA_PAGE_V2):
+            if first_data_pos is None:
+                first_data_pos = pos
+            hdr_fid = (_PH_DATA_HEADER if ptype == PageType.DATA_PAGE
+                       else _PH_V2_HEADER)
+            hdr = ph.get(hdr_fid)
+            nv = hdr.get(_DPH_NUM_VALUES) if isinstance(hdr, dict) else None
+            if not isinstance(nv, int):
+                report.errors.append(
+                    f"{where}: data page at byte {pos} missing its "
+                    f"num_values header")
+                return
+            values_seen += nv
+        else:
+            report.errors.append(
+                f"{where}: page at byte {pos} has unknown type {ptype!r}")
+            return
+        if codec == Codec.UNCOMPRESSED and comp != uncomp:
+            report.errors.append(
+                f"{where}: uncompressed page at byte {pos} has "
+                f"compressed={comp} != uncompressed={uncomp}")
+        crc = ph.get(_PH_CRC)
+        if isinstance(crc, int):
+            got = zlib.crc32(data[body_start:body_end])
+            if got != crc & 0xFFFFFFFF:
+                report.errors.append(
+                    f"{where}: page at byte {pos} CRC mismatch "
+                    f"(header {crc & 0xFFFFFFFF:#010x}, body {got:#010x})")
+            report.pages_crc_checked += 1
+        report.pages += 1
+        first = False
+        pos = body_end
+    if pos != end:
+        report.errors.append(
+            f"{where}: pages account for {pos - start} bytes, footer says "
+            f"{total}")
+    if first_data_pos is not None and first_data_pos != data_off:
+        report.errors.append(
+            f"{where}: first data page at byte {first_data_pos}, footer "
+            f"says {data_off}")
+    if values_seen != num_values:
+        report.errors.append(
+            f"{where}: data pages carry {values_seen} values, footer says "
+            f"{num_values}")
+
+
+def verify_bytes(data: bytes, path: str = "<bytes>") -> FileReport:
+    """Structurally verify one parquet file given its full contents."""
+    report = FileReport(path=path, size=len(data))
+    if len(data) < len(MAGIC) * 2 + 4:
+        report.errors.append(
+            f"file of {len(data)} bytes cannot frame magic + footer")
+        return report
+    if data[: len(MAGIC)] != MAGIC:
+        report.errors.append("leading PAR1 magic missing")
+    if data[-len(MAGIC):] != MAGIC:
+        report.errors.append("trailing PAR1 magic missing — torn tail")
+        return report  # without the tail frame nothing below is anchored
+    footer_len = int.from_bytes(data[-_TAIL:-len(MAGIC)], "little")
+    report.footer_bytes = footer_len
+    footer_start = len(data) - _TAIL - footer_len
+    if footer_len <= 0 or footer_start < len(MAGIC):
+        report.errors.append(
+            f"footer length {footer_len} does not fit the file "
+            f"({len(data)} bytes)")
+        return report
+    r = CompactReader(data, footer_start, limit=len(data) - _TAIL)
+    try:
+        fmd = r.read_struct()
+    except ThriftDecodeError as e:
+        report.errors.append(f"footer thrift parse failed: {e}")
+        return report
+    if r.pos != len(data) - _TAIL:
+        report.errors.append(
+            f"footer parse consumed {r.pos - footer_start} bytes, "
+            f"frame says {footer_len}")
+    if not isinstance(fmd.get(_FMD_SCHEMA), list) or not fmd.get(_FMD_SCHEMA):
+        report.errors.append("footer has no schema elements")
+    num_rows = _require_int(report, fmd, _FMD_NUM_ROWS, "footer num_rows")
+    report.num_rows = num_rows
+    rgs = fmd.get(_FMD_ROW_GROUPS)
+    if not isinstance(rgs, list):
+        report.errors.append("footer has no row-group list")
+        return report
+    report.row_groups = len(rgs)
+    rows_sum = 0
+    for rg_i, rg in enumerate(rgs):
+        if not isinstance(rg, dict):
+            report.errors.append(f"row group {rg_i} is not a struct")
+            continue
+        rg_rows = _require_int(report, rg, _RG_NUM_ROWS,
+                               f"row group {rg_i} num_rows")
+        if rg_rows is not None:
+            rows_sum += rg_rows
+        cols = rg.get(_RG_COLUMNS)
+        if not isinstance(cols, list) or not cols:
+            report.errors.append(f"row group {rg_i} has no column chunks")
+            continue
+        for col_i, cc in enumerate(cols):
+            meta = cc.get(_CC_META) if isinstance(cc, dict) else None
+            if not isinstance(meta, dict):
+                report.errors.append(
+                    f"row group {rg_i} column {col_i} has no metadata")
+                continue
+            report.columns += 1
+            _walk_chunk(data, report, rg_i, col_i, meta, footer_start)
+    if num_rows is not None and rows_sum != num_rows:
+        report.errors.append(
+            f"row groups sum to {rows_sum} rows, footer says {num_rows}")
+    report.ok = not report.errors
+    return report
+
+
+def verify_file(fs: FileSystem, path: str) -> FileReport:
+    """Read ``path`` through ``fs`` and structurally verify it.  A file
+    that cannot even be read reports that as its (only) error."""
+    try:
+        with fs.open_read(path) as f:
+            data = f.read()
+    except (OSError, KeyError) as e:  # KeyError: MemoryFileSystem miss
+        report = FileReport(path=path)
+        report.errors.append(f"unreadable: {e!r}")
+        return report
+    return verify_bytes(data, path)
+
+
+def verify_dir(fs: FileSystem, target_dir: str,
+               extension: str = ".parquet",
+               exclude_dirs: tuple = ("tmp", "quarantine")) -> list[FileReport]:
+    """Verify every published ``extension`` file under ``target_dir``,
+    excluding the writer's working subtrees (``tmp/`` holds open files
+    that are legitimately incomplete; ``quarantine/`` holds files already
+    condemned)."""
+    target = target_dir.rstrip("/")
+    skips = tuple(f"{target}/{d}/" for d in exclude_dirs)
+    out = []
+    for p in fs.list_files(target, extension=extension):
+        if any(p.startswith(s) for s in skips):
+            continue
+        out.append(verify_file(fs, p))
+    return out
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    as_json = "--json" in argv
+    paths = [a for a in argv if a != "--json"]
+    if not paths:
+        print("usage: python -m kpw_tpu.io.verify [--json] "
+              "<file-or-dir> [...]", file=sys.stderr)
+        return 2
+    fs = LocalFileSystem()
+    reports: list[FileReport] = []
+    for p in paths:
+        if os.path.isdir(p):
+            reports.extend(verify_dir(fs, p))
+        else:
+            reports.append(verify_file(fs, p))
+    if as_json:
+        print(json.dumps([r.to_dict() for r in reports], indent=1))
+    else:
+        for r in reports:
+            if r.ok:
+                print(f"OK   {r.path}  rows={r.num_rows} "
+                      f"row_groups={r.row_groups} pages={r.pages} "
+                      f"crc_checked={r.pages_crc_checked}")
+            else:
+                print(f"FAIL {r.path}")
+                for e in r.errors:
+                    print(f"     - {e}")
+    bad = sum(1 for r in reports if not r.ok)
+    print(f"{len(reports) - bad}/{len(reports)} file(s) structurally valid",
+          file=sys.stderr)
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

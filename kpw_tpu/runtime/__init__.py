@@ -10,4 +10,8 @@ from .retry import (  # noqa: F401
     RetryInterrupted,
     RetryPolicy,
 )
-from .writer import KafkaProtoParquetWriter, WriterFailedError  # noqa: F401
+from .writer import (  # noqa: F401
+    KafkaProtoParquetWriter,
+    PublishVerificationError,
+    WriterFailedError,
+)

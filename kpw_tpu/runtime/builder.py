@@ -74,6 +74,13 @@ class Builder:
         self._supervise = False
         self._max_worker_restarts = 5
         self._restart_backoff = 0.1  # seconds; doubles per restart, cap 5 s
+        # durability: crash-consistent publish (fsync-before-rename +
+        # dir-fsync) and independent structural verification.  All off by
+        # default — fsync costs real milliseconds per publish (measured in
+        # bench.py --crash) and the reference never fsyncs
+        self._durable_publish = False
+        self._verify_on_publish = False
+        self._verify_on_startup = False
         # observability: span-timeline tracing (utils/tracing.py).  Off by
         # default — the disabled stage() path is a true no-op
         self._tracing = False
@@ -299,6 +306,36 @@ class Builder:
         self._supervise = flag
         self._max_worker_restarts = max_restarts
         self._restart_backoff = restart_backoff_seconds
+        return self
+
+    def durability(self, fsync: bool = True, *,
+                   verify_on_publish: bool = False,
+                   verify_on_startup: bool = False) -> "Builder":
+        """Crash-consistency discipline for the publish protocol, three
+        independent opt-ins (all default off — each costs time on the hot
+        rotation path, measured by ``bench.py --crash``):
+
+        * ``fsync`` — publish via durable rename: fsync the tmp file
+          BEFORE the atomic rename, fsync the destination directory AFTER
+          (``FileSystem.durable_rename``).  Without it a published-then-
+          acked file can vanish in a power cut (the rename lived only in
+          the page cache) — a plain process ``kill -9`` is already safe
+          either way, because the page cache survives process death and
+          the ack happens after rename returns.
+        * ``verify_on_publish`` — run the independent structural verifier
+          (``kpw_tpu.io.verify``) over the closed tmp file before the
+          rename.  A file that fails is moved to
+          ``{target_dir}/quarantine/`` (never published, never deleted)
+          and the worker dies un-acked, so the records are redelivered —
+          a corrupt encode can then never be acked.
+        * ``verify_on_startup`` — ``start()`` verifies every published
+          ``.parquet`` under the target dir and quarantines structural
+          failures (torn finals from a previous crash) before new work
+          begins; the sweep's manifest lands in ``stats()['recovery']``.
+        """
+        self._durable_publish = fsync
+        self._verify_on_publish = verify_on_publish
+        self._verify_on_startup = verify_on_startup
         return self
 
     def clean_abandoned_tmp(self, flag: bool) -> "Builder":

@@ -332,6 +332,14 @@ FAILED_METER = "parquet.writer.failed"
 RESTARTS_METER = "parquet.writer.worker.restarts"
 WORKERS_ALIVE_GAUGE = "parquet.writer.workers.alive"
 TMP_SWEPT_METER = "parquet.writer.tmp.swept"
+# durability layer: independent structural verification (io/verify.py) of
+# published files — verified counts clean passes (startup recovery +
+# publish-time), verify.failed counts files the verifier condemned, and
+# quarantined counts condemned finals moved to {target_dir}/quarantine/
+# (moved, never deleted)
+VERIFIED_METER = "parquet.writer.verified"
+VERIFY_FAILED_METER = "parquet.writer.verify.failed"
+QUARANTINED_METER = "parquet.writer.quarantined"
 
 # the canonical registry docs cite from (tools/check_docs.py verifies
 # every doc-cited metric name is listed here)
@@ -352,4 +360,7 @@ METRIC_NAMES = (
     RESTARTS_METER,
     WORKERS_ALIVE_GAUGE,
     TMP_SWEPT_METER,
+    VERIFIED_METER,
+    VERIFY_FAILED_METER,
+    QUARANTINED_METER,
 )

@@ -45,6 +45,14 @@ class WriterFailedError(Exception):
     instance (at-least-once)."""
 
 
+class PublishVerificationError(Exception):
+    """A closed tmp file failed the independent structural verifier at
+    publish time (``Builder.durability(verify_on_publish=True)``).  The
+    file was quarantined, never published; deliberately NOT an OSError —
+    the bytes are wrong, so the IO retry loop must not spin on it.  The
+    worker dies un-acked and the records are redelivered."""
+
+
 def _format_now(pattern: str) -> str:
     """strftime of now, plus ``%3f`` = zero-padded milliseconds — the
     reference's file-name pattern is yyyyMMdd-HHmmssSSS (KPW.java:486-487)
@@ -124,6 +132,15 @@ class KafkaProtoParquetWriter:
         self._failed = reg.meter(M.FAILED_METER) if reg else M.Meter()
         self._restarts = reg.meter(M.RESTARTS_METER) if reg else M.Meter()
         self._tmp_swept = reg.meter(M.TMP_SWEPT_METER) if reg else M.Meter()
+        # durability meters + the recovery manifest (what the startup pass
+        # verified/quarantined, surfaced verbatim in stats()["recovery"])
+        self._verified = reg.meter(M.VERIFIED_METER) if reg else M.Meter()
+        self._verify_failed = (reg.meter(M.VERIFY_FAILED_METER)
+                               if reg else M.Meter())
+        self._quarantined = (reg.meter(M.QUARANTINED_METER)
+                             if reg else M.Meter())
+        self._recovery_manifest: dict = {"verified_files": 0,
+                                         "quarantined_files": []}
         if reg:
             reg.gauge(M.ACK_LAG_GAUGE,
                       lambda: self.ack_lag()["unacked_records"])
@@ -179,6 +196,8 @@ class KafkaProtoParquetWriter:
             tracing.set_span_recorder(self.span_recorder)
         if self._b._clean_abandoned_tmp:
             self._gc_abandoned_tmp()
+        if self._b._verify_on_startup:
+            self._verify_published()
         self.consumer.start()
         for i in range(self._b._thread_count):
             w = _Worker(self, i)
@@ -218,6 +237,54 @@ class KafkaProtoParquetWriter:
                 logger.info("Removed abandoned tmp file %s", p)
             except OSError:
                 logger.warning("Could not remove abandoned tmp file %s", p)
+
+    def _verify_published(self) -> None:
+        """Startup recovery, the read-back half of the durability story:
+        structurally verify every published ``.parquet`` under the target
+        dir (``tmp/`` and ``quarantine/`` excluded) with the independent
+        verifier and move every failure to ``{target_dir}/quarantine/`` —
+        moved, NEVER deleted: a torn final may still hold recoverable row
+        groups, and deleting data on a heuristic is how recovery tools
+        destroy evidence.  A verify failure here is expected exactly once
+        per torn publish (power cut mid-rename with durability off, a
+        crash-window tear); the quarantined records were by construction
+        never acked OR are redelivered duplicates, so removing the file
+        from the published set preserves at-least-once.  The manifest of
+        what happened lands in ``stats()['recovery']``."""
+        from ..io.verify import verify_dir
+
+        reports = verify_dir(self.fs, self.target_dir)
+        for rep in reports:
+            if rep.ok:
+                self._verified.mark()
+            else:
+                self._verify_failed.mark()
+                qpath = self._quarantine(rep.path)
+                self._recovery_manifest["quarantined_files"].append({
+                    "path": rep.path,
+                    "quarantined_to": qpath,
+                    "errors": list(rep.errors[:5]),
+                })
+        self._recovery_manifest["verified_files"] = sum(
+            1 for r in reports if r.ok)
+
+    def _quarantine(self, path: str) -> str:
+        """Move a condemned file to ``{target_dir}/quarantine/`` (same
+        filesystem, atomic rename; name collisions get a numeric suffix).
+        Returns the quarantine path."""
+        qdir = f"{self.target_dir}/quarantine"
+        self.fs.mkdirs(qdir)
+        name = path.rsplit("/", 1)[-1]
+        dest = f"{qdir}/{name}"
+        seq = 0
+        while self.fs.exists(dest):
+            seq += 1
+            dest = f"{qdir}/{name}.{seq}"
+        self.fs.rename(path, dest)
+        self._quarantined.mark()
+        logger.warning("Quarantined structurally-invalid file %s -> %s",
+                       path, dest)
+        return dest
 
     # -- supervision (beyond the reference: a dead reference worker is a
     # silent log line until process restart) ---------------------------------
@@ -395,6 +462,9 @@ class KafkaProtoParquetWriter:
                 M.FAILED_METER: self._failed.snapshot(),
                 M.RESTARTS_METER: self._restarts.snapshot(),
                 M.TMP_SWEPT_METER: self._tmp_swept.snapshot(),
+                M.VERIFIED_METER: self._verified.snapshot(),
+                M.VERIFY_FAILED_METER: self._verify_failed.snapshot(),
+                M.QUARANTINED_METER: self._quarantined.snapshot(),
             },
             "file_size": self._file_size_histogram.snapshot(),
             "rotations": {
@@ -413,7 +483,19 @@ class KafkaProtoParquetWriter:
                 "terminal_failure": (str(self._terminal)
                                      if self._terminal is not None else None),
             },
-            "recovery": {"tmp_swept": self._tmp_swept.count},
+            "recovery": {
+                "tmp_swept": self._tmp_swept.count,
+                "verified": self._verified.count,
+                "verify_failed": self._verify_failed.count,
+                "quarantined": self._quarantined.count,
+                "manifest": {
+                    "verified_files":
+                        self._recovery_manifest["verified_files"],
+                    "quarantined_files": [
+                        dict(q) for q in
+                        self._recovery_manifest["quarantined_files"]],
+                },
+            },
             "consumer": self.consumer.stats(),
             "workers": [w.observability() for w in self._workers],
         }
@@ -905,26 +987,59 @@ class _Worker:
 
     def _rename_and_move(self, tmp_path: str) -> None:
         # (KPW.java:359-378)
+        if self.p._b._verify_on_publish:
+            # independent read-back BEFORE the rename: a structurally
+            # invalid tmp (bad encode, torn write a retry never healed)
+            # must never become a published file.  Verify failure is a
+            # data error, not an IO error — quarantine the tmp and die
+            # un-acked (redelivery), instead of retrying a rename that
+            # would publish garbage
+            from ..io.verify import verify_file
+
+            rep = verify_file(self.p.fs, tmp_path)
+            if rep.ok:
+                self.p._verified.mark()
+            else:
+                self.p._verify_failed.mark()
+                qpath = self.p._quarantine(tmp_path)
+                raise PublishVerificationError(
+                    f"tmp file failed structural verification and was "
+                    f"quarantined to {qpath}: {rep.errors[:3]}")
+
+        # the destination is computed ONCE, outside the retried closure: a
+        # durable publish can fail AFTER its rename landed (the trailing
+        # dir fsync), and the retry must resume the SAME (src, dst) pair —
+        # recomputing a fresh timestamped name would orphan the renamed
+        # file and spin on the vanished tmp
+        dest_dir = self.p.target_dir
+        pattern = self.p._b._directory_date_time_pattern
+        if pattern:
+            dest_dir = f"{dest_dir}/{_format_now(pattern)}"
+            self._retry(lambda: self.p.fs.mkdirs(dest_dir), "publish")
+        name = self._new_file_name()
+        dest = f"{dest_dir}/{name}"
+        # millisecond timestamps can collide when one worker finalizes
+        # twice in the same tick; rename here overwrites (os.replace /
+        # HDFS-adapter replace), which would silently destroy an
+        # already-acked published file — disambiguate instead (the
+        # suffix only ever appears under collision)
+        seq = 0
+        while self.p.fs.exists(dest):
+            seq += 1
+            stem, ext = (name.rsplit(".", 1) + [""])[:2]
+            dest = (f"{dest_dir}/{stem}-{seq}.{ext}" if ext
+                    else f"{dest_dir}/{stem}-{seq}")
+
         def do() -> None:
-            dest_dir = self.p.target_dir
-            pattern = self.p._b._directory_date_time_pattern
-            if pattern:
-                dest_dir = f"{dest_dir}/{_format_now(pattern)}"
-                self.p.fs.mkdirs(dest_dir)
-            name = self._new_file_name()
-            dest = f"{dest_dir}/{name}"
-            # millisecond timestamps can collide when one worker finalizes
-            # twice in the same tick; rename here overwrites (os.replace /
-            # HDFS-adapter replace), which would silently destroy an
-            # already-acked published file — disambiguate instead (the
-            # suffix only ever appears under collision)
-            seq = 0
-            while self.p.fs.exists(dest):
-                seq += 1
-                stem, ext = (name.rsplit(".", 1) + [""])[:2]
-                dest = (f"{dest_dir}/{stem}-{seq}.{ext}" if ext
-                        else f"{dest_dir}/{stem}-{seq}")
-            self.p.fs.rename(tmp_path, dest)
+            if self.p._b._durable_publish:
+                # fsync tmp -> atomic rename -> fsync dest dir: after this
+                # the publish survives power loss, so the ack that follows
+                # can never point at a file the disk forgot.  Retry-safe:
+                # durable_rename resumes at the dir fsync when the rename
+                # already landed on a previous attempt
+                self.p.fs.durable_rename(tmp_path, dest)
+            else:
+                self.p.fs.rename(tmp_path, dest)
             logger.info("Published %s", dest)
 
         self._retry(do, "publish")

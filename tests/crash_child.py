@@ -1,0 +1,244 @@
+"""Child process + parent-side helpers for the kill -9 crash harness.
+
+The process-level leg of the durability story (tests/test_crash.py and
+``bench.py --crash``): a REAL writer process is SIGKILLed mid-run and the
+at-least-once invariant is then checked from the bytes the dead process
+left on disk.  The child runs a full writer over a LocalFileSystem with
+the durability discipline on; its broker is a :class:`DurableCommitBroker`
+whose offset commits are fsync'd to an on-disk commit log BEFORE they
+become visible — so the log that survives the kill is exactly the set of
+acks the invariant must account for (the writer acks only after publish,
+so every logged offset's record must live in a published file).
+
+Run as a script (the parent spawns it with subprocess):
+
+    python crash_child.py <target_dir> <rows> victim   # killed by parent
+    python crash_child.py <target_dir> <rows> recover  # heals + drains
+
+``victim`` produces ``rows`` records and streams until the parent
+SIGKILLs it (it exits 0 if it somehow finishes first — the parent treats
+that as a missed kill window and asserts on it).  ``recover`` re-produces
+the SAME records (redelivery-by-restart: none of the dead run's unacked
+records were lost, and duplicates are allowed), starts over the same
+directory with ``verify_on_startup`` + tmp sweep, drains to ack-lag 0,
+and dumps its stats to ``recover_stats.json``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+PARTS = 2
+PAD = 150
+INSTANCE = "crash"
+GROUP = "crash-g"
+COMMIT_LOG = "commits.log"
+RECOVER_STATS = "recover_stats.json"
+
+
+def make_broker_class():
+    from kpw_tpu import FakeBroker
+
+    class DurableCommitBroker(FakeBroker):
+        """FakeBroker whose commits are fsync'd to ``log_path`` before
+        they become visible.  Durability order matters: log-then-commit
+        means a kill between the two leaves a logged offset that was
+        never re-readable from the broker — but the writer only commits
+        AFTER publish, so the logged offset's record is published either
+        way and the invariant check stays sound (strictly harder, never
+        weaker)."""
+
+        def __init__(self, log_path: str) -> None:
+            super().__init__()
+            self._log_fd = os.open(log_path,
+                                   os.O_WRONLY | os.O_APPEND | os.O_CREAT,
+                                   0o644)
+
+        def commit(self, group, topic, partition, offset) -> None:
+            os.write(self._log_fd, f"{partition} {offset}\n".encode())
+            os.fsync(self._log_fd)
+            super().commit(group, topic, partition, offset)
+
+    return DurableCommitBroker
+
+
+def identity(partition: int, offset: int) -> int:
+    """(partition, offset) -> record timestamp under round-robin produce."""
+    return offset * PARTS + partition
+
+
+def produce_all(broker, cls, rows: int) -> None:
+    filler = "x" * PAD
+    for i in range(rows):
+        broker.produce("crash", cls(query=f"q-{i}-{filler}",
+                                    timestamp=i).SerializeToString(),
+                       partition=i % PARTS)
+
+
+def build_writer(target_dir: str, broker, durability: bool = True):
+    from kpw_tpu import Builder, LocalFileSystem, RetryPolicy
+
+    from proto_helpers import sample_message_class
+
+    b = (Builder().broker(broker).topic("crash")
+         .proto_class(sample_message_class()).target_dir(target_dir)
+         .filesystem(LocalFileSystem())
+         .instance_name(INSTANCE).group_id(GROUP)
+         .batch_size(128).page_checksums(True)
+         .retry_policy(RetryPolicy(base_sleep=0.005, max_sleep=0.05))
+         .clean_abandoned_tmp(True)
+         .max_file_size(128 * 1024).block_size(16 * 1024)
+         .max_file_open_duration_seconds(0.5))
+    if durability:
+        b.durability(True, verify_on_publish=False, verify_on_startup=True)
+    return b.build()
+
+
+# -- parent-side helpers (imported by test_crash.py and bench.py) -----------
+
+def read_commit_frontiers(target_dir: str,
+                          log_name: str = COMMIT_LOG) -> dict[int, int]:
+    """Parse the durable commit log into {partition: max committed
+    frontier} — the set of acks the invariant must account for."""
+    path = os.path.join(target_dir, log_name)
+    frontiers: dict[int, int] = {}
+    if not os.path.exists(path):
+        return frontiers
+    for line in open(path):
+        try:
+            p, off = line.split()
+            p, off = int(p), int(off)
+        except ValueError:
+            continue  # torn tail line: the kill landed mid-write
+        frontiers[p] = max(frontiers.get(p, 0), off)
+    return frontiers
+
+
+def published_files(target_dir: str) -> list[str]:
+    """Published .parquet paths — tmp/ and quarantine/ excluded."""
+    target = target_dir.rstrip("/")
+    out = []
+    for root, _dirs, files in os.walk(target):
+        if (root.startswith(os.path.join(target, "tmp"))
+                or root.startswith(os.path.join(target, "quarantine"))):
+            continue
+        out.extend(os.path.join(root, f) for f in files
+                   if f.endswith(".parquet"))
+    return sorted(out)
+
+
+def check_crash_invariant(target_dir: str) -> dict:
+    """The mechanical post-crash verdict, computed from disk alone:
+    every logged (acked) offset's record lives in a structurally-VERIFIED
+    published file, no unverifiable file remains published, no tmp file
+    survived recovery.  Returns a dict of evidence (raises nothing — the
+    caller asserts on the fields)."""
+    import pyarrow.parquet as pq
+
+    from kpw_tpu.io.fs import LocalFileSystem
+    from kpw_tpu.io.verify import verify_dir
+
+    reports = verify_dir(LocalFileSystem(), target_dir)
+    bad = [r for r in reports if not r.ok]
+    got: dict[int, int] = {}
+    for r in reports:
+        if not r.ok:
+            continue  # unverified files must not vouch for acked offsets
+        for row in pq.read_table(r.path).to_pylist():
+            got[row["timestamp"]] = got.get(row["timestamp"], 0) + 1
+    frontiers = read_commit_frontiers(target_dir)
+    missing = []
+    acked = 0
+    for p, frontier in frontiers.items():
+        for off in range(frontier):
+            acked += 1
+            if got.get(identity(p, off), 0) < 1:
+                missing.append((p, off))
+    tmp_dir = os.path.join(target_dir, "tmp")
+    tmps = (os.listdir(tmp_dir) if os.path.isdir(tmp_dir) else [])
+    qdir = os.path.join(target_dir, "quarantine")
+    quarantined = sorted(os.listdir(qdir)) if os.path.isdir(qdir) else []
+    return {
+        "published_files": len(reports),
+        "verified_ok": len(reports) - len(bad),
+        "unverifiable_published": [r.path for r in bad],
+        "acked_offsets_checked": acked,
+        "acked_but_missing": missing,
+        "published_records": sum(got.values()),
+        "distinct_records": len(got),
+        "pages_crc_checked": sum(r.pages_crc_checked for r in reports),
+        "tmp_files_left": tmps,
+        "quarantined_files": quarantined,
+        "invariant_holds": (not missing and not bad and acked > 0),
+    }
+
+
+# -- child entry points ------------------------------------------------------
+
+def run_victim(target_dir: str, rows: int) -> int:
+    from proto_helpers import sample_message_class
+
+    cls = sample_message_class()
+    broker = make_broker_class()(os.path.join(target_dir, COMMIT_LOG))
+    broker.create_topic("crash", PARTS)
+    produce_all(broker, cls, rows)
+    w = build_writer(target_dir, broker)
+    w.start()
+    deadline = time.time() + 300
+    while time.time() < deadline:  # run until SIGKILLed (or drained)
+        if (sum(broker.committed(GROUP, "crash", p) for p in range(PARTS))
+                >= rows and w.ack_lag()["unacked_records"] == 0):
+            break
+        time.sleep(0.01)
+    w.close()
+    return 0
+
+
+def run_recover(target_dir: str, rows: int) -> int:
+    from proto_helpers import sample_message_class
+
+    cls = sample_message_class()
+    # redelivery-by-restart: the healed instance re-serves the FULL topic
+    # (its own commit log goes to a separate file so the parent's run-1
+    # frontier read stays pristine)
+    broker = make_broker_class()(
+        os.path.join(target_dir, "commits_recover.log"))
+    broker.create_topic("crash", PARTS)
+    produce_all(broker, cls, rows)
+    w = build_writer(target_dir, broker)
+    w.start()
+    deadline = time.time() + 300
+    drained = False
+    while time.time() < deadline:
+        if (sum(broker.committed(GROUP, "crash", p) for p in range(PARTS))
+                >= rows and w.ack_lag()["unacked_records"] == 0):
+            drained = True
+            break
+        time.sleep(0.01)
+    stats = w.stats()
+    w.close()
+    stats["drained"] = drained
+    with open(os.path.join(target_dir, RECOVER_STATS), "w") as f:
+        json.dump(stats, f, indent=1, default=repr)
+    return 0 if drained else 3
+
+
+def main(argv: list[str]) -> int:
+    target_dir, rows, mode = argv[0], int(argv[1]), argv[2]
+    os.makedirs(target_dir, exist_ok=True)
+    if mode == "victim":
+        return run_victim(target_dir, rows)
+    if mode == "recover":
+        return run_recover(target_dir, rows)
+    print(f"unknown mode {mode!r}", file=sys.stderr)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -34,6 +34,7 @@ from kpw_tpu import (
     RetryPolicy,
     WriterFailedError,
 )
+from kpw_tpu.io.verify import verify_file
 
 from proto_helpers import sample_message_class
 
@@ -60,13 +61,21 @@ def published_timestamps(fs, target="/out"):
     """Multiset of record timestamps across PUBLISHED files only, plus the
     file list; asserts no tmp leaks into the published set — a .parquet
     living under the tmp dir (or a .tmp-suffixed listing survivor) is a
-    publish-protocol violation, counted rather than silently filtered."""
+    publish-protocol violation, counted rather than silently filtered.
+    Every published file must ALSO pass the independent structural
+    verifier (magic, footer, page walk, CRCs) before its records may
+    vouch for acked offsets: the invariant is "offsets present in VALID
+    parquet", not merely "offsets present"."""
     all_parquet = fs.list_files(target, extension=".parquet")
     violations = [f for f in all_parquet
                   if f"{target}/tmp/" in f or f.endswith(".tmp")]
     assert violations == [], f"tmp counted as published: {violations}"
     got = collections.Counter()
     for f in all_parquet:
+        rep = verify_file(fs, f)
+        assert rep.ok, (
+            f"published file fails structural verification: {f}: "
+            f"{rep.errors}")
         for r in pq.read_table(fs.open_read(f)).to_pylist():
             got[r["timestamp"]] += 1
     return got, all_parquet

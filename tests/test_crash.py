@@ -1,0 +1,161 @@
+"""Process-level crash harness: SIGKILL a real writer process mid-run and
+prove, via the INDEPENDENT structural verifier, that the at-least-once
+contract survived the process boundary.
+
+PR 3's chaos tests injected faults inside one process; everything here
+crosses it.  A child writer process (tests/crash_child.py) streams records
+over a LocalFileSystem with the durability discipline on, fsync'ing every
+offset commit to an on-disk log before it becomes visible.  The parent
+SIGKILLs the child at a seeded point mid-run, plants the torn-final /
+stale-tmp debris a power cut would leave, restarts a fresh process over
+the same directory, and then asserts mechanically from the bytes on disk:
+
+* every logged (acked) offset's record lives in a structurally-VERIFIED
+  published file (``kpw_tpu.io.verify`` — magic, footer, page walk, CRCs),
+* no unverifiable file remains published (torn finals were quarantined,
+  not deleted and not left published),
+* abandoned tmp files were swept,
+* the healed run drained to ack-lag 0.
+
+The short smoke runs in tier-1; the multi-kill torture is ``slow``.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from crash_child import (
+    COMMIT_LOG,
+    RECOVER_STATS,
+    check_crash_invariant,
+    published_files,
+    read_commit_frontiers,
+)
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+CHILD = os.path.join(HERE, "crash_child.py")
+
+
+def _spawn(target_dir: str, rows: int, mode: str) -> subprocess.Popen:
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.Popen([sys.executable, CHILD, target_dir,
+                             str(rows), mode],
+                            env=env, stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL)
+
+
+def _kill_after_publishes(proc: subprocess.Popen, target_dir: str,
+                          n_files: int, timeout_s: float = 120) -> None:
+    """SIGKILL the child once >= n_files are published AND at least one
+    offset commit hit the durable log — the seeded kill point: mid-run,
+    after real acks exist to check, before the stream drains."""
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        if proc.poll() is not None:
+            pytest.fail("victim exited before the kill window "
+                        f"(rc={proc.returncode}) — raise rows")
+        if (len(published_files(target_dir)) >= n_files
+                and read_commit_frontiers(target_dir)):
+            break
+        time.sleep(0.02)
+    else:
+        proc.kill()
+        pytest.fail("victim never published within the kill window")
+    os.kill(proc.pid, signal.SIGKILL)
+    proc.wait(timeout=30)
+    assert proc.returncode == -signal.SIGKILL
+
+
+def _plant_debris(target_dir: str) -> tuple[str, str]:
+    """The states a power cut can leave that a plain process SIGKILL
+    cannot (the page cache survives process death): a TORN published
+    final (its tail never reached the disk) and a stale tmp.  Returns
+    (torn_final_name, stale_tmp_name)."""
+    files = published_files(target_dir)
+    assert files, "need at least one published file to tear"
+    whole = open(files[0], "rb").read()
+    torn_name = "19990101-000000000_crash_0.parquet"
+    with open(os.path.join(target_dir, torn_name), "wb") as f:
+        f.write(whole[: max(8, len(whole) // 3)])
+        f.flush()
+        os.fsync(f.fileno())
+    tmp_dir = os.path.join(target_dir, "tmp")
+    os.makedirs(tmp_dir, exist_ok=True)
+    stale_tmp = "crash_0_424242.tmp"
+    with open(os.path.join(tmp_dir, stale_tmp), "wb") as f:
+        f.write(b"half a row group")
+    return torn_name, stale_tmp
+
+
+def _recover_and_check(tmp_path, rows: int, torn_name: str,
+                       stale_tmp: str) -> dict:
+    target = str(tmp_path)
+    rc = _spawn(target, rows, "recover").wait(timeout=300)
+    assert rc == 0, f"recover run failed rc={rc}"
+
+    verdict = check_crash_invariant(target)
+    # the tentpole invariant: every acked offset in a verified published
+    # file; nothing unverifiable left published; tmps swept
+    assert verdict["acked_but_missing"] == [], verdict
+    assert verdict["unverifiable_published"] == [], verdict
+    assert verdict["acked_offsets_checked"] > 0
+    assert verdict["tmp_files_left"] == []
+    assert verdict["invariant_holds"] is True
+    # the torn final was quarantined — moved, never deleted, not published
+    assert torn_name in verdict["quarantined_files"]
+    assert not os.path.exists(os.path.join(target, torn_name))
+    # the stale tmp was swept by recovery, not published
+    assert not os.path.exists(os.path.join(target, "tmp", stale_tmp))
+    # page CRCs were actually exercised (page_checksums on in the child)
+    assert verdict["pages_crc_checked"] > 0
+
+    stats = json.load(open(os.path.join(target, RECOVER_STATS)))
+    assert stats["drained"] is True
+    assert stats["ack"]["unacked_records"] == 0
+    assert stats["recovery"]["quarantined"] >= 1
+    assert stats["recovery"]["tmp_swept"] >= 1
+    quarantined_paths = [q["path"] for q in
+                         stats["recovery"]["manifest"]["quarantined_files"]]
+    assert any(torn_name in p for p in quarantined_paths)
+    # the healed run republished everything: every produced record present
+    assert verdict["distinct_records"] == rows
+    return verdict
+
+
+def test_crash_smoke_kill9_at_least_once(tmp_path):
+    """Tier-1: one SIGKILL after the first publish, planted power-cut
+    debris, one recovery run — invariant checked from disk."""
+    rows = 4000
+    target = str(tmp_path)
+    victim = _spawn(target, rows, "victim")
+    _kill_after_publishes(victim, target, n_files=1)
+    torn, stale = _plant_debris(target)
+    _recover_and_check(tmp_path, rows, torn, stale)
+
+
+@pytest.mark.slow
+def test_crash_torture_double_kill(tmp_path):
+    """Slow torture: kill a victim, start another victim over the same
+    directory and kill IT too (crash during recovery), then heal — the
+    invariant must hold across stacked crashes, with the commit log
+    accumulating acks from both dead runs."""
+    rows = 20_000
+    target = str(tmp_path)
+    victim = _spawn(target, rows, "victim")
+    _kill_after_publishes(victim, target, n_files=2)
+    frontier_1 = read_commit_frontiers(target)
+
+    victim2 = _spawn(target, rows, "victim")
+    _kill_after_publishes(victim2, target, n_files=4)
+    frontier_2 = read_commit_frontiers(target)
+    # the second run made progress past the first run's acks
+    assert sum(frontier_2.values()) >= sum(frontier_1.values())
+
+    torn, stale = _plant_debris(target)
+    verdict = _recover_and_check(tmp_path, rows, torn, stale)
+    assert verdict["acked_offsets_checked"] >= sum(frontier_2.values())

@@ -400,3 +400,190 @@ def test_dead_letter_memory_vs_local_parity(tmp_path):
     mem = run(MemoryFileSystem(), "/out")
     loc = run(LocalFileSystem(), str(tmp_path / "out"))
     assert sorted(mem) == sorted(loc) == sorted(poisons)
+
+
+# ---------------------------------------------------------------------------
+# durability seam: sync faults, durable rename, crash-window drops
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("make_fs", [
+    lambda tmp: (MemoryFileSystem(), "/p"),
+    lambda tmp: (LocalFileSystem(), str(tmp)),
+], ids=["memory", "local"])
+def test_durable_rename_parity(make_fs, tmp_path):
+    """durable_rename (fsync -> rename -> dir fsync) behaves identically
+    over Memory and Local filesystems, and sync on a missing path raises
+    FileNotFoundError on both."""
+    inner, root = make_fs(tmp_path)
+    inner.mkdirs(f"{root}/d")
+    with inner.open_write(f"{root}/d/t.tmp") as f:
+        f.write(b"payload")
+    inner.durable_rename(f"{root}/d/t.tmp", f"{root}/d/final")
+    assert not inner.exists(f"{root}/d/t.tmp")
+    with inner.open_read(f"{root}/d/final") as rf:
+        assert rf.read() == b"payload"
+    with pytest.raises(FileNotFoundError):
+        inner.sync(f"{root}/d/nope")
+
+
+def test_fsync_fault_injection_fires_inside_durable_rename():
+    """An fsync-failure rule fires inside the decomposed durable publish:
+    the wrapper's durable_rename consults the schedule on each leg (sync,
+    rename, dir sync), so a single retry re-runs the whole composition."""
+    sched = FaultSchedule(seed=0).fail_nth("sync", 1, err=errno.EIO)
+    inner = MemoryFileSystem()
+    fs = FaultInjectingFileSystem(inner, sched)
+    fs.mkdirs("/d")
+    with fs.open_write("/d/t.tmp") as f:
+        f.write(b"x")
+    with pytest.raises(InjectedFault):
+        fs.durable_rename("/d/t.tmp", "/d/final")
+    assert inner.exists("/d/t.tmp")  # first leg failed: nothing renamed
+    fs.durable_rename("/d/t.tmp", "/d/final")  # retry heals
+    assert inner.exists("/d/final")
+    assert [e["op"] for e in sched.fired()] == ["sync"]
+    # three sync checks total: the failed first leg, then retry's file +
+    # dir fsyncs (rename leg counted separately)
+    assert sched.counts()["sync"] == 3
+    assert sched.counts()["rename"] == 1
+
+
+def test_crash_window_drops_writes_silently():
+    """drop_writes_from: writes after the Nth op report success but land
+    nothing — the reproducible kill -9 / power-cut tear."""
+    sched = FaultSchedule(seed=0).drop_writes_from(2)
+    inner = MemoryFileSystem()
+    fs = FaultInjectingFileSystem(inner, sched)
+    f = fs.open_write("/t")
+    assert f.write(b"AAAA") == 4      # op 1: lands
+    assert f.write(b"BBBB") == 4      # op 2: swallowed, reports success
+    f.writelines([b"CC", b"DD"])      # op 3: swallowed
+    f.close()
+    assert inner.open_read("/t").read() == b"AAAA"
+    fired = sched.fired()
+    assert all(e.get("drop") for e in fired) and len(fired) == 2
+    assert all(e["errno"] is None for e in fired)
+
+
+def test_crash_window_torn_publish_quarantined():
+    """The in-process torn-publish reproduction (no subprocess needed):
+    a crash window swallows mid-file writes, so the worker publishes a
+    structurally-torn file BELIEVING it succeeded — with
+    durability(verify_on_publish=True) the independent verifier catches
+    it before the rename, quarantines the tmp, and the worker dies
+    un-acked; after the window closes, supervision redelivers and every
+    record still lands exactly-verified (at-least-once held)."""
+    from kpw_tpu.io.verify import verify_dir, verify_file
+
+    broker = FakeBroker()
+    broker.create_topic(TOPIC, 1)
+    cls = sample_message_class()
+    rows = 3000
+    for i in range(rows):
+        broker.produce(TOPIC, cls(query="q" + "x" * 150,
+                                  timestamp=i).SerializeToString(),
+                       partition=0)
+    sched = FaultSchedule(seed=11).drop_writes_from(6)
+    inner = MemoryFileSystem()
+    fs = FaultInjectingFileSystem(inner, sched)
+    w = (Builder().broker(broker).topic(TOPIC).proto_class(cls)
+         .target_dir("/out").filesystem(fs).instance_name("cw")
+         .group_id("g").batch_size(64).page_checksums(True)
+         .retry_policy(RetryPolicy(base_sleep=0.005, max_sleep=0.02))
+         .supervise(True, max_restarts=8, restart_backoff_seconds=0.01)
+         .durability(False, verify_on_publish=True)
+         .max_file_size(128 * 1024).block_size(16 * 1024)
+         .max_file_open_duration_seconds(0.3)
+         .build())
+    w.start()
+    deadline = time.time() + 30
+    # phase 1: run inside the crash window until a torn tmp was condemned
+    while time.time() < deadline and w._verify_failed.count < 1:
+        time.sleep(0.01)
+    sched.stop()  # window over; the healed worker re-runs the records
+    while time.time() < deadline:
+        if (broker.committed("g", TOPIC, 0) >= rows
+                and w.ack_lag()["unacked_records"] == 0):
+            break
+        time.sleep(0.02)
+    stats = w.stats()
+    w.close()
+    assert stats["recovery"]["verify_failed"] >= 1
+    assert stats["recovery"]["quarantined"] >= 1
+    # torn files live in quarantine, never in the published set
+    quarantined = inner.list_files("/out/quarantine")
+    assert quarantined
+    assert not verify_file(inner, quarantined[0]).ok
+    published = verify_dir(inner, "/out")
+    assert published and all(r.ok for r in published)
+    assert broker.committed("g", TOPIC, 0) >= rows
+    assert stats["supervision"]["restarts_total"] >= 1
+
+
+def test_durable_rename_resumes_after_post_rename_fsync_failure():
+    """The dir fsync comes AFTER the rename, so a durable publish can fail
+    with the rename already landed; the retried call (same src/dst pair)
+    must resume at the pending dir fsync — not raise ENOENT fsyncing the
+    tmp that was already published (which the default policy would retry
+    forever)."""
+    sched = FaultSchedule(seed=0).fail_nth("sync", 2, err=errno.EIO)
+    inner = MemoryFileSystem()
+    fs = FaultInjectingFileSystem(inner, sched)
+    fs.mkdirs("/d")
+    with fs.open_write("/d/t.tmp") as f:
+        f.write(b"x")
+    with pytest.raises(InjectedFault):
+        fs.durable_rename("/d/t.tmp", "/d/final")
+    # the rename leg landed before the failing dir fsync
+    assert inner.exists("/d/final") and not inner.exists("/d/t.tmp")
+    fs.durable_rename("/d/t.tmp", "/d/final")  # retry: resumes, no ENOENT
+    assert inner.exists("/d/final")
+
+
+def test_writer_publish_survives_post_rename_fsync_failure():
+    """Writer-level version: with durability on and the dir-fsync leg of
+    one publish failing transiently, the run still drains to ack-lag 0
+    with every record published exactly once (the retried publish resumed
+    the same destination instead of wedging on the vanished tmp)."""
+    from kpw_tpu.io.verify import verify_dir
+
+    broker = FakeBroker()
+    broker.create_topic(TOPIC, 1)
+    cls = sample_message_class()
+    rows = 1500
+    for i in range(rows):
+        broker.produce(TOPIC, cls(query="q" + "x" * 120,
+                                  timestamp=i).SerializeToString(),
+                       partition=0)
+    # ordinal 2 = the FIRST publish's dir fsync (1 = its file fsync)
+    sched = FaultSchedule(seed=4).fail_nth("sync", 2, err=errno.EIO)
+    inner = MemoryFileSystem()
+    fs = FaultInjectingFileSystem(inner, sched)
+    w = (Builder().broker(broker).topic(TOPIC).proto_class(cls)
+         .target_dir("/out").filesystem(fs).instance_name("dsync")
+         .group_id("g").batch_size(64)
+         .retry_policy(RetryPolicy(base_sleep=0.005, max_sleep=0.02))
+         .durability(True)
+         .max_file_size(128 * 1024).block_size(16 * 1024)
+         .max_file_open_duration_seconds(0.3)
+         .build())
+    w.start()
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        if (broker.committed("g", TOPIC, 0) >= rows
+                and w.ack_lag()["unacked_records"] == 0):
+            break
+        time.sleep(0.02)
+    w.close()
+    assert broker.committed("g", TOPIC, 0) >= rows
+    assert any(e["op"] == "sync" for e in sched.fired())
+    reports = verify_dir(inner, "/out")
+    assert reports and all(r.ok for r in reports)
+    import collections
+    got = collections.Counter()
+    import pyarrow.parquet as pq
+    for r in reports:
+        for row in pq.read_table(inner.open_read(r.path)).to_pylist():
+            got[row["timestamp"]] += 1
+    # exactly once: the resumed publish must not duplicate the file
+    assert got == collections.Counter({i: 1 for i in range(rows)})

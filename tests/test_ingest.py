@@ -191,8 +191,12 @@ def test_kafka_client_surface_matches_fake_broker():
 
 
 def test_hdfs_adapter_surface():
-    """HdfsFileSystem implements the full FileSystem surface and gates its
-    connection errors with actionable guidance (no cluster in the image)."""
+    """HdfsFileSystem implements the full ABSTRACT FileSystem surface and
+    gates its connection errors with actionable guidance (no cluster in
+    the image).  Concrete base templates (durable_rename — the fsync ->
+    rename -> dir-fsync composition over the three primitives) are
+    deliberately inherited: overriding them would fork the publish
+    discipline per filesystem."""
     import inspect
 
     from kpw_tpu.io.fs import FileSystem
@@ -201,6 +205,8 @@ def test_hdfs_adapter_surface():
     for name, member in inspect.getmembers(FileSystem, inspect.isfunction):
         if name.startswith("_"):
             continue
+        if "NotImplementedError" not in inspect.getsource(member):
+            continue  # concrete template, meant to be inherited
         assert getattr(HdfsFileSystem, name) is not member, f"{name} not overridden"
     with pytest.raises((RuntimeError, ImportError)):
         HdfsFileSystem(host="localhost", port=1)

@@ -73,6 +73,12 @@ CHECKS = [
     ("README.md", r"the device phase drops to \*\*([\d.]+) ms = ([\d.]+)M",
      ["config2.tpu_rowgroup_affine_ms_per_step",
       ("config2.tpu_rowgroup_affine_rows_per_sec_per_chip", 1e6)]),
+    # durability PR: fsync-overhead quotes reconcile against the crash
+    # artifact (the `crash:` prefix routes the lookup there)
+    ("README.md", r"committed fsync A/B:\s+\*\*\+([\d.]+)%\*\*",
+     ["crash:fsync_overhead_pct"]),
+    ("PARITY.md", r"records `fsync_overhead_pct` \*\*\+([\d.]+)%\*\*",
+     ["crash:fsync_overhead_pct"]),
 ]
 
 
@@ -121,6 +127,85 @@ def _artifact_key_set(obj, out: set) -> set:
 
 NAME_DOCS = ("PARITY.md", "README.md")
 _DOTTED_TOKEN = re.compile(r"`([a-z][a-z0-9_]*(?:\.[a-z0-9_]+)+)`")
+
+
+# --- cited-test reconciliation (durability PR) ------------------------------
+# Docs cite pytest names as evidence ("quarantine semantics — `test_...`").
+# A citation of a test that does not exist is the worst kind of drift: a
+# guarantee with imaginary proof.  Every backticked `test_*` token in the
+# docs must match a real `def test_*` under tests/ (a trailing `*` makes it
+# a prefix pattern, e.g. `test_page_checksums_*`).  On top of that,
+# quarantine/verify claims specifically must be BACKED: a doc that talks
+# about quarantining or the structural verifier must cite at least one
+# existing test whose name exercises that path.
+
+_TEST_TOKEN = re.compile(r"`(test_[a-z0-9_]+\*?)`")
+# what counts as a durability CLAIM: quarantine prose, the durability
+# knobs, or "structurally/independently verified" guarantees — but NOT
+# every use of the word "verified" ("verified by pyarrow" in neutral
+# feature prose is a statement about a test, not a recovery guarantee)
+_DURABILITY_CLAIM = re.compile(
+    r"quarantin|verify_on_(?:publish|startup)"
+    r"|structural(?:ly)?[ -]verif|independent(?:ly)? verif", re.I)
+_DURABILITY_TEST = re.compile(r"quarantine|verif|crash|corrupt|torn")
+
+
+def _test_function_names() -> set:
+    names = set()
+    tdir = os.path.join(ROOT, "tests")
+    for fn in os.listdir(tdir):
+        if not fn.endswith(".py"):
+            continue
+        with open(os.path.join(tdir, fn)) as f:
+            names.update(re.findall(r"^def (test_[a-zA-Z0-9_]+)",
+                                    f.read(), re.M))
+    return names
+
+
+def _token_exists(tok: str, test_names: set) -> bool:
+    if tok.endswith("*"):
+        return any(n.startswith(tok[:-1]) for n in test_names)
+    return tok in test_names
+
+
+def check_cited_tests(docs: dict, test_names: set | None = None) -> list[str]:
+    if test_names is None:
+        test_names = _test_function_names()
+    failures = []
+    for fname in sorted(set(KEY_DOCS) | set(NAME_DOCS)):
+        seen = set()
+        for m in _TEST_TOKEN.finditer(docs[fname]):
+            tok = m.group(1)
+            if tok in seen:
+                continue
+            seen.add(tok)
+            if not _token_exists(tok, test_names):
+                failures.append(
+                    f"{fname}: cites test `{tok}` that does not exist "
+                    f"under tests/")
+    return failures
+
+
+def check_durability_claims(docs: dict,
+                            test_names: set | None = None) -> list[str]:
+    """A doc making quarantine/verify claims with no matching cited test
+    fails: the durability guarantees are exactly the kind of prose that
+    outlives the code that enforced it."""
+    if test_names is None:
+        test_names = _test_function_names()
+    failures = []
+    for fname in NAME_DOCS:
+        text = docs[fname]
+        if not _DURABILITY_CLAIM.search(text):
+            continue
+        backed = [m.group(1) for m in _TEST_TOKEN.finditer(text)
+                  if _DURABILITY_TEST.search(m.group(1))
+                  and _token_exists(m.group(1), test_names)]
+        if not backed:
+            failures.append(
+                f"{fname}: makes quarantine/verify claims but cites no "
+                f"existing quarantine/verify/crash test as evidence")
+    return failures
 
 
 def _canonical_names() -> set:
@@ -191,11 +276,18 @@ def main() -> int:
                                 os.path.join(ROOT, "BENCH_CHAOS_r07.json"))
     if os.path.exists(chaos_path):
         key_record["chaos"] = json.load(open(chaos_path))
+    # the crash/durability artifact (bench.py --crash) is the fourth
+    crash_path = os.environ.get("KPW_CRASH_PATH",
+                                os.path.join(ROOT, "BENCH_CRASH_r08.json"))
+    if os.path.exists(crash_path):
+        key_record["crash"] = json.load(open(crash_path))
     docs = {f: open(os.path.join(ROOT, f)).read()
             for f in ({c[0] for c in CHECKS} | set(KEY_DOCS)
                       | set(NAME_DOCS))}
     failures = check_cited_keys(key_record, docs)
     failures += check_cited_names(docs)
+    failures += check_cited_tests(docs)
+    failures += check_durability_claims(docs)
     for fname, pattern, paths in CHECKS:
         m = re.search(pattern, docs[fname])
         if not m:
@@ -205,8 +297,11 @@ def main() -> int:
             scale = 1.0
             if isinstance(spec, tuple):
                 spec, scale = spec
+            root = rec
+            if spec.startswith("crash:"):
+                root, spec = key_record.get("crash", {}), spec[6:]
             try:
-                expect = float(art(rec, spec)) / scale
+                expect = float(art(root, spec)) / scale
             except (KeyError, TypeError):
                 failures.append(f"{fname}: artifact key missing: {spec}")
                 continue
