@@ -30,6 +30,7 @@ from .ingest import (  # noqa: E402,F401
     SmartCommitConsumer,
 )
 from .io import (  # noqa: E402,F401
+    FailoverFileSystem,
     FaultInjectingFileSystem,
     FaultSchedule,
     HdfsFileSystem,

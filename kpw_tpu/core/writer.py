@@ -157,7 +157,7 @@ class ParquetFileWriter:
 
     def __init__(self, sink, schema: Schema, properties: WriterProperties | None = None,
                  encoder=None, pipeline: bool = False,
-                 retry_policy=None) -> None:
+                 retry_policy=None, heartbeat=None) -> None:
         self.sink = sink
         self.schema = schema
         self.properties = properties or WriterProperties()
@@ -166,6 +166,11 @@ class ParquetFileWriter:
         # runtime.retry.RetryPolicy: is_fatal + next_sleep).  None keeps the
         # historical fixed-100ms retry-every-OSError loop.
         self._retry_policy = retry_policy
+        # IO-progress publisher (duck-typed runtime.watchdog.Heartbeat:
+        # io_started/io_finished/beat).  The pipelined IO thread stalls
+        # *off* the worker thread, so the hung-IO watchdog can only see it
+        # through this seam; None (the default) publishes nothing.
+        self._heartbeat = heartbeat
         self._pos = 0
         self._row_groups: list[RowGroup] = []
         self._pending: list[ColumnChunkData] | None = None
@@ -527,6 +532,14 @@ class ParquetFileWriter:
             sleep = None
             attempt = 0
             started = time.monotonic()
+            # heartbeat around the whole commit-with-retry: a write that
+            # never returns parks this thread here, and the pending
+            # "rowgroup.io_write" op is what the hung-IO watchdog ages.  Each
+            # retry attempt that RETURNS re-stamps it (beat) — a live
+            # backoff loop is the retry policy's business, not a hang.
+            hb = self._heartbeat
+            hb_token = (hb.io_started("rowgroup.io_write")
+                        if hb is not None else None)
             while not self._abandoned.is_set() and self._pipe_error is None:
                 try:
                     attempt += 1
@@ -539,6 +552,8 @@ class ParquetFileWriter:
                     self.stage_busy_s["io"] += time.perf_counter() - t0
                     break
                 except OSError as e:
+                    if hb is not None:
+                        hb.beat()
                     pol = self._retry_policy
                     if pol is None:
                         sleep = 0.1  # historical fixed retry-everything
@@ -564,6 +579,8 @@ class ParquetFileWriter:
                         break
                 except BaseException as e:  # noqa: BLE001 - poison, don't die
                     self._pipe_error = e
+            if hb is not None:
+                hb.io_finished(hb_token)
             with self._inflight_lock:
                 self._encoded_inflight -= enc_len
 

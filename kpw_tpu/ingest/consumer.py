@@ -98,11 +98,18 @@ class SmartCommitConsumer:
                                         daemon=True)
         self._thread.start()
 
-    def close(self) -> None:
+    def close(self, timeout: float = 10.0) -> None:
         self._running = False
         self._stop_event.set()
+        # wake a fetcher blocked in a put-stall NOW (full buffer, worker
+        # gone or slow): _put_batch re-checks _running on wake and bails,
+        # so close never deadlocks behind a wedged producer — without the
+        # notify it still exits, but only at the next 50 ms wait tick
+        # (pinned by test_consumer_close_releases_blocked_put)
+        with self._buf_cond:
+            self._buf_cond.notify_all()
         if self._thread is not None:
-            self._thread.join(timeout=10)
+            self._thread.join(timeout=timeout)
             self._thread = None
         if self._topic is not None:
             self.broker.leave_group(self.group_id, self._topic, self.member_id)

@@ -9,6 +9,7 @@ from .faults import (  # noqa: F401
     FaultSchedule,
     InjectedFault,
 )
+from .failover import FailoverFileSystem  # noqa: F401
 # NOTE: .verify is deliberately NOT imported here — it is a runnable module
 # (`python -m kpw_tpu.io.verify <file-or-dir>`), and a package-level import
 # would make runpy warn about the double import.  Import it directly:

@@ -39,17 +39,30 @@ class InjectedFault(OSError):
 
 
 class _Rule:
-    __slots__ = ("op", "ordinals", "errno", "latency_s", "partial", "drop")
+    __slots__ = ("op", "ordinals", "errno", "latency_s", "partial", "drop",
+                 "hang", "hang_timeout_s", "heal_after", "healable",
+                 "healed", "fired_count")
 
     def __init__(self, op: str, ordinals: set, errno: int | None,
                  latency_s: float, partial: float,
-                 drop: bool = False) -> None:
+                 drop: bool = False, hang: bool = False,
+                 hang_timeout_s: float | None = None,
+                 heal_after: int | None = None,
+                 healable: bool = False) -> None:
         self.op = op
         self.ordinals = ordinals  # 1-based call numbers this rule covers
-        self.errno = errno        # None = latency-only (or drop) rule
+        self.errno = errno        # None = latency-only (or drop/hang) rule
         self.latency_s = latency_s
         self.partial = partial    # fraction of a write to land before failing
         self.drop = drop          # crash window: swallow the op, no error
+        self.hang = hang          # block until released (or hang_timeout_s)
+        self.hang_timeout_s = hang_timeout_s
+        # recover_after bookkeeping: a healable rule stops firing once it
+        # fired heal_after times (None = only an explicit heal() heals it)
+        self.heal_after = heal_after
+        self.healable = healable
+        self.healed = False
+        self.fired_count = 0
 
 
 class FaultSchedule:
@@ -70,6 +83,9 @@ class FaultSchedule:
         self._fired: list[dict] = []
         self._lock = threading.Lock()
         self._active = True
+        # hung ops park on this event (hang_nth); release_hangs()/stop()
+        # set it, letting every parked caller proceed
+        self._hang_release = threading.Event()
 
     # -- building ------------------------------------------------------------
     def fail_nth(self, op: str, nth: int, *, count: int = 1,
@@ -112,6 +128,60 @@ class FaultSchedule:
             _Rule("write", {-nth}, None, 0.0, 0.0, drop=True))
         return self
 
+    def hang_nth(self, op: str, nth: int, *, count: int = 1,
+                 timeout_s: float | None = None) -> "FaultSchedule":
+        """HANG calls ``nth .. nth+count-1`` of ``op``: the call blocks —
+        it never returns and never raises — until :meth:`release_hangs`
+        (or :meth:`stop`) fires, after which the operation proceeds
+        normally.  This is the storage failure shape a finite ``latency``
+        stall cannot model: a wedged NFS/HDFS pipeline that neither
+        errors nor completes, invisible to errno-classified retry and
+        curable only by a watchdog or a bounded ``close(deadline=...)``.
+        ``timeout_s`` bounds the park (the op then proceeds) so tests
+        can't wedge forever on a missed release."""
+        if nth < 1 or count < 1:
+            raise ValueError("nth and count must be >= 1")
+        self._rules.setdefault(op, []).append(
+            _Rule(op, set(range(nth, nth + count)), None, 0.0, 0.0,
+                  hang=True, hang_timeout_s=timeout_s))
+        return self
+
+    def release_hangs(self) -> None:
+        """Release every op parked (and any future op that would park) on
+        a ``hang`` rule; the released operations proceed normally."""
+        self._hang_release.set()
+
+    def recover_after(self, op: str, nth: int = 1, *,
+                      err: int = _errno.ENOSPC,
+                      heal_after_ops: int | None = None) -> "FaultSchedule":
+        """Dead-disk-that-heals: every call of ``op`` from ordinal ``nth``
+        fails with ``err`` until the rule HEALS — after it has fired
+        ``heal_after_ops`` times, or when :meth:`heal` is called
+        (``heal_after_ops=None`` = only the explicit call heals).  Unlike
+        ``fail_forever_from`` this models ENOSPC/EROFS conditions that an
+        operator (or time) fixes: the disk fills, spills divert, the disk
+        is cleared, and the same filesystem starts working again — the
+        deterministic schedule behind pause/resume and failover
+        reconciliation tests."""
+        if nth < 1:
+            raise ValueError("nth must be >= 1")
+        if heal_after_ops is not None and heal_after_ops < 1:
+            raise ValueError("heal_after_ops must be >= 1")
+        self._rules.setdefault(op, []).append(
+            _Rule(op, {-nth}, err, 0.0, 0.0,
+                  heal_after=heal_after_ops, healable=True))
+        return self
+
+    def heal(self) -> None:
+        """Heal every :meth:`recover_after` rule now: the dead disk is
+        back.  Chaos/degrade runs call this at the scripted recovery
+        moment; rules with ``heal_after_ops`` also heal on their own."""
+        with self._lock:
+            for rules in self._rules.values():
+                for r in rules:
+                    if r.healable:
+                        r.healed = True
+
     def delay_nth(self, op: str, nth: int, latency_s: float,
                   count: int = 1) -> "FaultSchedule":
         """Stall (but do not fail) calls ``nth .. nth+count-1`` of ``op``."""
@@ -136,9 +206,11 @@ class FaultSchedule:
 
     def stop(self) -> None:
         """Disarm the schedule: no further faults fire (chaos runs call this
-        to let the system drain and prove recovery)."""
+        to let the system drain and prove recovery).  Also releases every
+        parked ``hang`` — a drained system must not hold hostages."""
         with self._lock:
             self._active = False
+        self._hang_release.set()
 
     # -- plan/evidence --------------------------------------------------------
     def plan(self) -> list[dict]:
@@ -156,6 +228,9 @@ class FaultSchedule:
                     "latency_s": r.latency_s,
                     "partial": r.partial,
                     "drop": r.drop,
+                    "hang": r.hang,
+                    "heal_after_ops": r.heal_after,
+                    "healable": r.healable,
                 })
         return out
 
@@ -187,18 +262,33 @@ class FaultSchedule:
             self._counts[op] = n
             if self._active:
                 for r in self._rules.get(op, ()):
+                    if r.healed:
+                        continue
                     hit = (n in r.ordinals
                            or any(o < 0 and n >= -o for o in r.ordinals))
                     if hit:
                         rule = r
                         break
-            if rule is not None and (rule.errno is not None or rule.drop):
+            if rule is not None:
+                rule.fired_count += 1
+                if (rule.heal_after is not None
+                        and rule.fired_count >= rule.heal_after):
+                    rule.healed = True  # this firing is the rule's last
+            if rule is not None and (rule.errno is not None or rule.drop
+                                     or rule.hang):
                 entry = {"op": op, "ordinal": n, "errno": rule.errno}
                 if rule.drop:
                     entry["drop"] = True
+                if rule.hang:
+                    entry["hang"] = True
                 self._fired.append(entry)
         if rule is None:
             return None
+        if rule.hang:
+            # park OUTSIDE the lock: other ops (and release_hangs itself)
+            # must keep flowing while this caller is wedged
+            self._hang_release.wait(rule.hang_timeout_s)
+            return None  # released (or timed out): the op proceeds
         if rule.latency_s > 0.0:
             time.sleep(rule.latency_s)
         if rule.drop:

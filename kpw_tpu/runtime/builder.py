@@ -74,6 +74,17 @@ class Builder:
         self._supervise = False
         self._max_worker_restarts = 5
         self._restart_backoff = 0.1  # seconds; doubles per restart, cap 5 s
+        # degraded operation (all opt-in; the reference has no answer to a
+        # hung write, a full-then-cleared disk, or an unbounded close):
+        # hung-IO watchdog, and fatal-errno pause/resume
+        self._watchdog = False
+        self._io_stall_deadline = 30.0
+        self._watchdog_poll: float | None = None  # derived from the deadline
+        self._abandon_stalled = False
+        self._degraded_mode = False
+        self._pause_probe_interval = 0.5
+        self._pause_probe_max = 5.0
+        self._max_pause: float | None = None  # None = pause indefinitely
         # durability: crash-consistent publish (fsync-before-rename +
         # dir-fsync) and independent structural verification.  All off by
         # default — fsync costs real milliseconds per publish (measured in
@@ -306,6 +317,76 @@ class Builder:
         self._supervise = flag
         self._max_worker_restarts = max_restarts
         self._restart_backoff = restart_backoff_seconds
+        return self
+
+    def watchdog(self, flag: bool = True, *,
+                 io_stall_deadline_seconds: float = 30.0,
+                 poll_interval_seconds: float | None = None,
+                 abandon_stalled: bool = False) -> "Builder":
+        """Hung-IO watchdog (``runtime/watchdog.py``): workers and the
+        pipelined row-group IO thread publish a progress heartbeat around
+        every IO seam, and a supervisor-owned scanner flags any worker
+        whose oldest in-flight IO op is older than
+        ``io_stall_deadline_seconds`` — storage that HANGS rather than
+        errors is otherwise invisible (no errno, no dead thread, no retry
+        fires).  A stall flips ``healthy()`` false, marks the
+        ``parquet.writer.stalled`` meter once per episode, and surfaces
+        per-worker stall age + seam label in ``stats()``.
+
+        With ``abandon_stalled=True`` the stalled worker is condemned:
+        declared failed while its thread is still parked in the hung call,
+        so the PR-3 supervisor (``Builder.supervise`` — required for the
+        restart half) restarts the slot and re-injects the held un-acked
+        offset runs.  Redelivery preserves at-least-once; the stuck tmp is
+        left un-published and swept on the next start.  An abandon
+        consumes a supervisor restart, never a retry budget — the hung
+        call never returned, so the policy never saw an attempt fail.  A
+        *progressing* retry loop (attempts returning, backoff between
+        them) re-stamps the heartbeat and is never treated as a hang.
+        Off by default: zero threads, zero heartbeat cost beyond a dict
+        store per IO call."""
+        if io_stall_deadline_seconds <= 0:
+            raise ValueError("io_stall_deadline_seconds must be positive")
+        if (poll_interval_seconds is not None
+                and poll_interval_seconds <= 0):
+            raise ValueError("poll_interval_seconds must be positive")
+        self._watchdog = flag
+        self._io_stall_deadline = io_stall_deadline_seconds
+        self._watchdog_poll = poll_interval_seconds
+        self._abandon_stalled = abandon_stalled
+        return self
+
+    def degraded_mode(self, flag: bool = True, *,
+                      probe_interval_seconds: float = 0.5,
+                      probe_backoff_max_seconds: float = 5.0,
+                      max_pause_seconds: float | None = None) -> "Builder":
+        """Fatal-errno pause/resume: a worker hitting a fatal-classified
+        errno (ENOSPC/EROFS/EDQUOT — conditions a restart cannot fix but
+        an operator or time often does) PAUSES instead of dying.  The open
+        file is abandoned un-acked, intake stops (the shared queue fills,
+        the fetcher blocks on the bounded put — backpressure reaches the
+        broker session without dropping it), and a probe loop retests the
+        sink with exponential backoff (``probe_interval_seconds`` →
+        ``probe_backoff_max_seconds``).  On a successful probe the worker
+        re-injects its held offset runs (redelivery — the records were
+        never acked) and resumes cleanly.  Pause cause/age land in
+        ``stats()['degraded']`` and the ``parquet.writer.paused`` gauge
+        counts paused workers.  ``max_pause_seconds`` bounds the wait:
+        past it the pause converts into the normal fatal worker death
+        (supervision/terminal semantics take over).  Off by default —
+        reference parity is fatal-errno death, which burns the supervisor
+        restart budget on a condition restarting cannot fix."""
+        if probe_interval_seconds <= 0:
+            raise ValueError("probe_interval_seconds must be positive")
+        if probe_backoff_max_seconds < probe_interval_seconds:
+            raise ValueError("probe_backoff_max_seconds must be >= "
+                             "probe_interval_seconds")
+        if max_pause_seconds is not None and max_pause_seconds <= 0:
+            raise ValueError("max_pause_seconds must be positive")
+        self._degraded_mode = flag
+        self._pause_probe_interval = probe_interval_seconds
+        self._pause_probe_max = probe_backoff_max_seconds
+        self._max_pause = max_pause_seconds
         return self
 
     def durability(self, fsync: bool = True, *,

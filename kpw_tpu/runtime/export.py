@@ -7,6 +7,14 @@ scrape endpoint calls :func:`registry_to_prometheus` (Prometheus
 text-exposition format 0.0.4) or :func:`registry_to_json` on whatever
 cadence it likes — nothing here runs a server or a thread, and gauges
 backed by callables are sampled only at render time.
+
+Both renderers are generic over the registry, so every canonical metric a
+writer registers — including the degraded-operation set (the
+``parquet.writer.stalled`` meter, the ``parquet.writer.paused`` gauge, and
+the failover composite's ``parquet.writer.spilled`` /
+``parquet.writer.reconciled`` / ``parquet.writer.reconcile.failed``
+meters) — shows up in both formats with no per-metric wiring (pinned by
+``test_degraded_metrics_render_in_exporters``).
 """
 
 from __future__ import annotations

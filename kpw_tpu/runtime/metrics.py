@@ -340,6 +340,16 @@ TMP_SWEPT_METER = "parquet.writer.tmp.swept"
 VERIFIED_METER = "parquet.writer.verified"
 VERIFY_FAILED_METER = "parquet.writer.verify.failed"
 QUARANTINED_METER = "parquet.writer.quarantined"
+# degraded-operation layer: hung-IO watchdog stall episodes, workers
+# currently paused on a fatal-but-healable sink condition (gauge), and the
+# spillover failover filesystem's spill/reconcile accounting (finals
+# published onto the fallback, spills migrated back to the primary, and
+# verify-failures-quarantined + migration retries)
+STALLED_METER = "parquet.writer.stalled"
+PAUSED_GAUGE = "parquet.writer.paused"
+SPILLED_METER = "parquet.writer.spilled"
+RECONCILED_METER = "parquet.writer.reconciled"
+RECONCILE_FAILED_METER = "parquet.writer.reconcile.failed"
 
 # the canonical registry docs cite from (tools/check_docs.py verifies
 # every doc-cited metric name is listed here)
@@ -363,4 +373,9 @@ METRIC_NAMES = (
     VERIFIED_METER,
     VERIFY_FAILED_METER,
     QUARANTINED_METER,
+    STALLED_METER,
+    PAUSED_GAUGE,
+    SPILLED_METER,
+    RECONCILED_METER,
+    RECONCILE_FAILED_METER,
 )

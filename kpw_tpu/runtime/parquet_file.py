@@ -31,6 +31,7 @@ class ParquetFile:
         pipeline: bool = False,
         est_record_bytes: float = 64.0,
         retry_policy=None,
+        heartbeat=None,
     ) -> None:
         self.path = path
         self._fs = fs
@@ -38,7 +39,8 @@ class ParquetFile:
         self._writer = ParquetFileWriter(self._sink, columnarizer.schema,
                                          properties, encoder=encoder,
                                          pipeline=pipeline,
-                                         retry_policy=retry_policy)
+                                         retry_policy=retry_policy,
+                                         heartbeat=heartbeat)
         self._columnarizer = columnarizer
         self._batch: list = []
         self._batch_size = batch_size

@@ -26,6 +26,7 @@ import threading
 import time
 from datetime import datetime
 
+from ..core.writer import PipelineError
 from ..ingest.consumer import SmartCommitConsumer
 from ..ingest.offsets import PartitionOffset
 from ..models.proto_bridge import ProtoColumnarizer
@@ -33,6 +34,7 @@ from ..utils import tracing
 from . import metrics as M
 from .parquet_file import ParquetFile
 from .retry import RetryInterrupted, RetryPolicy
+from .watchdog import Heartbeat, Watchdog
 
 logger = logging.getLogger(__name__)
 
@@ -141,7 +143,20 @@ class KafkaProtoParquetWriter:
                              if reg else M.Meter())
         self._recovery_manifest: dict = {"verified_files": 0,
                                          "quarantined_files": []}
+        # degraded-operation state: the hung-IO watchdog (started at
+        # start() when configured), and the fatal-errno pause bookkeeping
+        # (worker index -> {cause, since}; workers enter/exit under _b's
+        # degraded_mode, the paused gauge counts the live set)
+        self._watchdog_obj: Watchdog | None = None
+        self._stalled = reg.meter(M.STALLED_METER) if reg else M.Meter()
+        self._paused: dict[int, dict] = {}
+        self._pause_lock = threading.Lock()
+        self._pause_count = 0
+        self._resume_count = 0
+        self._paused_total_s = 0.0
+        self._last_close_report: dict | None = None
         if reg:
+            reg.gauge(M.PAUSED_GAUGE, lambda: len(self._paused))
             reg.gauge(M.ACK_LAG_GAUGE,
                       lambda: self.ack_lag()["unacked_records"])
             reg.gauge(M.ACK_AGE_GAUGE,
@@ -209,6 +224,13 @@ class KafkaProtoParquetWriter:
                 name=f"KPW-supervisor-{self._b._instance_name}",
                 daemon=True)
             self._supervisor.start()
+        if self._b._watchdog:
+            self._watchdog_obj = Watchdog(
+                lambda: list(self._workers),
+                deadline_s=self._b._io_stall_deadline,
+                poll_interval_s=self._b._watchdog_poll,
+                on_stall=self._on_watchdog_stall)
+            self._watchdog_obj.start()
 
     def _gc_abandoned_tmp(self) -> None:
         """Remove .tmp files left by a previous run of THIS instance name
@@ -286,6 +308,72 @@ class KafkaProtoParquetWriter:
                        path, dest)
         return dest
 
+    # -- degraded operation: watchdog + pause/resume -------------------------
+    def _on_watchdog_stall(self, index: int, worker: "_Worker",
+                           age: float, label: str | None) -> None:
+        """One stall episode crossed the deadline: meter it, and — opt-in
+        — condemn the stuck worker so the supervisor restarts the slot
+        (redelivery preserves at-least-once) and tell a failover
+        filesystem its primary hangs (a hang never raises an errno, so
+        the composite cannot see it on its own)."""
+        self._stalled.mark()
+        logger.error(
+            "watchdog: worker %d stalled %.1fs in %s (deadline %.1fs)",
+            index, age, label or "io", self._b._io_stall_deadline)
+        if not self._b._abandon_stalled:
+            return
+        if hasattr(self.fs, "declare_primary_down"):
+            self.fs.declare_primary_down(
+                f"worker {index} IO hung {age:.1f}s in {label or 'io'}")
+        self._condemn_worker(index, worker, age, label)
+
+    def _condemn_worker(self, index: int, w: "_Worker", age: float,
+                        label: str | None) -> None:
+        # condemn the worker the watchdog actually SCANNED: if the slot
+        # was replaced meanwhile (hung call returned, worker died for
+        # real, supervisor restarted it), condemning the fresh occupant
+        # would burn a restart on a healthy worker
+        if (index >= len(self._workers) or self._workers[index] is not w
+                or w.failed or w.condemned):
+            return
+        w.condemn(f"stalled: IO hung {age:.1f}s in {label or 'io'} "
+                  f"(> io_stall_deadline "
+                  f"{self._b._io_stall_deadline}s); abandoned by watchdog")
+        self._failed.mark()
+        self._notify_worker_death()
+
+    def _enter_pause(self, index: int, exc: BaseException) -> None:
+        with self._pause_lock:
+            self._paused[index] = {"cause": repr(exc),
+                                   "since": time.monotonic()}
+            self._pause_count += 1
+        logger.error(
+            "worker %d PAUSED on fatal sink condition (%r); intake stops, "
+            "probing for recovery", index, exc)
+
+    def _exit_pause(self, index: int) -> None:
+        with self._pause_lock:
+            info = self._paused.pop(index, None)
+            if info is not None:
+                self._paused_total_s += time.monotonic() - info["since"]
+                self._resume_count += 1
+        logger.warning("worker %d resumed from pause", index)
+
+    def _probe_sink(self, index: int) -> bool:
+        """One write-path probe against the sink: the paused worker's
+        recovery test.  Create + write + close + delete under the tmp dir
+        — the same op mix whose fatal failure caused the pause."""
+        path = (f"{self.target_dir}/tmp/"
+                f".probe_{self._b._instance_name}_{index}")
+        try:
+            self.fs.mkdirs(f"{self.target_dir}/tmp")
+            with self.fs.open_write(path) as f:
+                f.write(b"kpw pause probe")
+            self.fs.delete(path)
+            return True
+        except OSError:
+            return False
+
     # -- supervision (beyond the reference: a dead reference worker is a
     # silent log line until process restart) ---------------------------------
     def _notify_worker_death(self) -> None:
@@ -321,8 +409,11 @@ class KafkaProtoParquetWriter:
                     self._check_terminal()
                     continue
                 # let the dying thread finish its cleanup (file abandon)
-                # before reading its held runs
-                w._thread.join(timeout=10)
+                # before reading its held runs — unless it is HUNG in an
+                # IO call that may never return (watchdog condemnation):
+                # waiting 10 s per restart would serialize recovery behind
+                # the very stall being recovered from
+                w._thread.join(timeout=0.2 if w.condemned else 10)
                 delay = min(b._restart_backoff
                             * (2 ** self._restart_counts[i]), 5.0)
                 if self._close_event.wait(delay):
@@ -366,25 +457,95 @@ class KafkaProtoParquetWriter:
     def healthy(self) -> bool:
         """Liveness verdict for callers that never read stats(): True while
         the writer is started, not closed, not terminally failed, every
-        worker thread is alive, and the consumer's fetcher is running.
-        False during a supervised restart window (a worker is down until
-        its replacement starts) and permanently once anything died for
-        good."""
+        worker thread is alive and neither stalled past the watchdog
+        deadline nor paused on a fatal sink condition, and the consumer's
+        fetcher is running.  False during a supervised restart window (a
+        worker is down until its replacement starts), while degraded
+        (stalled/paused), and permanently once anything died for good."""
         if not self._started or self._closed or self._terminal is not None:
             return False
-        return (all(w.alive() for w in self._workers)
+        if self._watchdog_obj is not None and self._watchdog_obj.any_stalled():
+            return False
+        if self._paused:
+            return False
+        return (all(w.alive() and not w.failed for w in self._workers)
                 and self.consumer.fetcher_alive())
 
-    def close(self) -> None:
+    def close(self, deadline: float | None = None) -> dict | None:
+        """Stop the writer.  ``deadline=None`` (the default) keeps the
+        historical semantics exactly: wait up to the fixed per-component
+        timeouts, abandon every open tmp un-acked, raise the terminal
+        verdict if there is one.
+
+        ``deadline=<seconds>`` bounds the WHOLE shutdown: each join gets
+        only the remaining budget, a worker still parked in a hung IO
+        call past its slice is left behind (daemon thread; its open tmp
+        is NOT touched — the hung thread owns the sink — and stays
+        un-published/un-acked, swept and redelivered on the next start),
+        and close() returns a report of what was flushed vs abandoned
+        instead of blocking forever behind a stuck pipeline.  Un-hangable
+        by construction: no step waits longer than the remaining budget
+        (pinned by ``test_close_deadline_returns_under_hung_write``).
+
+        A terminally-failed writer still raises ``WriterFailedError``
+        (the PR-3 contract: terminal failure must never masquerade as a
+        clean shutdown) — deadline or not; the report, including its
+        ``terminal`` field, remains retrievable from a second ``close()``
+        call, which returns it without re-raising.
+        """
         if self._closed:
-            return
+            return self._last_close_report
+        t0 = time.monotonic()
+        t_end = None if deadline is None else t0 + max(0.0, deadline)
+
+        def rem(default: float) -> float:
+            if t_end is None:
+                return default
+            return max(0.0, min(default, t_end - time.monotonic()))
+
         self._closed = True
         self._close_event.set()
+        if self._watchdog_obj is not None:
+            self._watchdog_obj.close(timeout=rem(5))
         if self._supervisor is not None:
-            self._supervisor.join(timeout=30)
+            self._supervisor.join(timeout=rem(30))
+        hung_workers: list[int] = []
         for w in self._workers:
-            w.close()
-        self.consumer.close()
+            # deadline mode never abandons a file whose (possibly hung)
+            # thread may still be inside the sink — the default mode keeps
+            # the historical behavior verbatim
+            clean = w.close(timeout=rem(30),
+                            abandon_if_hung=(deadline is None))
+            if not clean:
+                hung_workers.append(w.index)
+        self.consumer.close(timeout=rem(10))
+        report = {
+            "deadline_s": deadline,
+            "duration_s": round(time.monotonic() - t0, 3),
+            "deadline_met": (t_end is None
+                             or time.monotonic() <= t_end + 0.05),
+            "flushed_records": self._flushed_records.count,
+            "flushed_bytes": self._flushed_bytes.count,
+            "hung_workers": hung_workers,
+            "abandoned_unacked_records":
+                self.ack_lag()["unacked_records"],
+            # a worker hung before its first write still holds its polled
+            # batch: those records are abandoned too (redelivered next
+            # start), and the written-but-unacked gauge alone would say 0
+            "abandoned_held_records": sum(
+                e - s
+                for w in self._workers if w.index in hung_workers
+                for _, s, e in w.held_runs()),
+            "terminal": (str(self._terminal)
+                         if self._terminal is not None else None),
+        }
+        self._last_close_report = report
+        if hung_workers:
+            logger.error(
+                "close(deadline=%s): worker(s) %s still hung in IO at the "
+                "deadline; their open tmp files were left un-published "
+                "(%d written-but-unacked record(s) will be redelivered)",
+                deadline, hung_workers, report["abandoned_unacked_records"])
         if self.span_recorder is not None:
             if self._b._trace_path:
                 try:
@@ -406,6 +567,7 @@ class KafkaProtoParquetWriter:
             # exhausted must not report a clean shutdown — the caller is
             # the only one left who can act (alert, restart the process)
             raise self._terminal
+        return report
 
     def __enter__(self):
         self.start()
@@ -465,6 +627,7 @@ class KafkaProtoParquetWriter:
                 M.VERIFIED_METER: self._verified.snapshot(),
                 M.VERIFY_FAILED_METER: self._verify_failed.snapshot(),
                 M.QUARANTINED_METER: self._quarantined.snapshot(),
+                M.STALLED_METER: self._stalled.snapshot(),
             },
             "file_size": self._file_size_histogram.snapshot(),
             "rotations": {
@@ -499,6 +662,29 @@ class KafkaProtoParquetWriter:
             "consumer": self.consumer.stats(),
             "workers": [w.observability() for w in self._workers],
         }
+        # degraded-operation block: pause/resume accounting always (cheap,
+        # and "not degraded" is itself load-bearing evidence), the
+        # watchdog's live stall set when one is running, and the failover
+        # composite's spill/reconcile snapshot when the sink is one
+        now = time.monotonic()
+        with self._pause_lock:
+            out["degraded"] = {
+                "enabled": b._degraded_mode,
+                "paused_workers": [
+                    {"worker": i, "cause": info["cause"],
+                     "paused_age_s": round(now - info["since"], 3)}
+                    for i, info in sorted(self._paused.items())],
+                "pause_count": self._pause_count,
+                "resume_count": self._resume_count,
+                "paused_total_s": round(
+                    self._paused_total_s
+                    + sum(now - info["since"]
+                          for info in self._paused.values()), 3),
+            }
+        if self._watchdog_obj is not None:
+            out["watchdog"] = self._watchdog_obj.snapshot()
+        if hasattr(self.fs, "failover_stats"):
+            out["failover"] = self.fs.failover_stats()
         # writer-OWNED tracing only: the process-global seam may hold a
         # different writer's (or the user's) instruments, and attributing
         # their timings to this writer would be misdirection — users who
@@ -557,6 +743,17 @@ class _Worker:
         # thread exits, read by healthy()/stats()/the supervisor
         self.failed = False
         self.exit_reason: str | None = None
+        # hung-IO visibility: every IO seam of this slot (the worker
+        # thread's _retry calls AND the current file's pipelined IO
+        # thread) publishes into this heartbeat; the watchdog ages the
+        # oldest pending op.  `condemned` flips when the watchdog abandons
+        # a hung slot: the thread may still be parked in the stuck call,
+        # but it is already declared dead (failed=True), its held runs
+        # redelivered and its slot restarted — if the hung call ever
+        # returns, the zombie sees its stop event and exits WITHOUT
+        # acking (duplicates allowed, loss impossible)
+        self.heartbeat = Heartbeat()
+        self.condemned = False
         # per-worker retry accounting fed by the policy's on_retry hook
         self.retries = 0
         self.backoff_s = 0.0
@@ -604,30 +801,59 @@ class _Worker:
 
     def _retry(self, fn, label: str = ""):
         """Policy-driven retry for this worker's IO: stop-aware, metered
-        (retry count, backoff time, last error) via the on_retry hook."""
-        return self.p.retry_policy.call(fn, stop_event=self._stop,
-                                        on_retry=self._on_retry, label=label)
+        (retry count, backoff time, last error) via the on_retry hook.
+        The whole call publishes a heartbeat-pending op — a call that
+        never returns is a hang the watchdog can age; each retry attempt
+        that DOES return re-stamps it via the hook (a live backoff loop
+        is the retry policy's business, never a hang)."""
+        hb_token = self.heartbeat.io_started(label or "io")
+        try:
+            return self.p.retry_policy.call(fn, stop_event=self._stop,
+                                            on_retry=self._on_retry,
+                                            label=label)
+        finally:
+            self.heartbeat.io_finished(hb_token)
 
     def _on_retry(self, attempt: int, exc: BaseException,
                   sleep_s: float) -> None:
+        self.heartbeat.beat()
         self.retries += 1
         self.backoff_s += sleep_s
         self.last_error = repr(exc)
         self.p._retries.mark()
         self.p._retry_backoff_ms.mark(max(1, int(sleep_s * 1000)))
 
-    def close(self) -> None:
+    def condemn(self, reason: str) -> None:
+        """Watchdog abandon: declare this worker dead while its thread is
+        (probably) still parked in a hung IO call.  The stop event makes
+        an eventually-returning zombie exit without acking; `failed`
+        makes the supervisor treat the slot exactly like a crashed
+        worker (join times out fast, held runs redelivered, slot
+        restarted).  The stuck tmp file is left alone — the hung thread
+        owns the sink — and is swept un-acked on the next start."""
+        self.condemned = True
+        self.exit_reason = reason
+        self.failed = True
+        self._stop.set()
+
+    def close(self, timeout: float = 30.0,
+              abandon_if_hung: bool = True) -> bool:
         """Stop; the open tmp file is abandoned, its offsets never acked —
         those records are redelivered on restart (at-least-once;
         KPW.java:381-398 + SURVEY §3.5 note).  Abandoning also stops the
-        file's pipeline threads."""
+        file's pipeline threads.  Returns False when the thread is still
+        alive after ``timeout`` (hung in IO); with
+        ``abandon_if_hung=False`` (the deadline-bounded close) the open
+        file is then left untouched — the hung thread owns the sink."""
         self._stop.set()
-        self._thread.join(timeout=30)
-        if self.current_file is not None:
+        self._thread.join(timeout=timeout)
+        hung = self._thread.is_alive()
+        if self.current_file is not None and (abandon_if_hung or not hung):
             self.current_file.rotation_reason = "close"
             self.current_file.abandon()
             self._fold_pipe_stats(self.current_file)
             self.current_file = None
+        return not hung
 
     # -- loop (KPW.java:253-292) -------------------------------------------
     def _run(self) -> None:
@@ -646,78 +872,18 @@ class _Worker:
             use_wire = (getattr(b, "_parser_is_default", False)
                         and self.p.columnarizer.wire_capable)
             while not self._stop.is_set():
-                if (self.current_file is not None
-                        and self._is_file_timed_out()):
-                    self._finalize_current_file("time")
-                # batch granularity follows the LIVE bytes/record estimate,
-                # not the static 64 B guess: small-record streams (nested
-                # cfg7-shaped, ~10 B/record encoded) were capped at 1/16 of
-                # the 64 B-based record count — 4-5x smaller batches than
-                # the size band needs, and per-batch shred/append overhead
-                # dominated the measured rate (VERDICT r3 next #8)
-                poll_batch = min(poll_batch_base, _rotation_batch_cap(
-                    b._max_file_size, max(8.0, self._carry_est)))
-                recs, runs = self.p.consumer.poll_many_runs(
-                    self._poll_cap(poll_batch))
-                if not recs:
-                    time.sleep(0.001)
-                    continue
-                # consumed from the queue: from here until these runs are
-                # folded into _written_runs (or individually acked) they
-                # are redeliverable only through held_runs()
-                self._inflight_runs = runs
-                if use_wire and self._try_wire_batch(recs, runs):
-                    self._inflight_runs = []
-                    if self._is_file_full():
-                        self._finalize_current_file()
-                    continue
-                parsed = []  # (record, message) — parsed in bulk so the
-                # per-record loop overhead amortizes (design capacity is
-                # 300k rec/s/instance, KPW.java:463)
-                nbytes = 0
-                for rec in recs:
-                    try:
-                        parsed.append((rec, b._parser(rec.value)))
-                        nbytes += len(rec.value)
-                    except Exception:
-                        if b._on_parse_error == "dead_letter":
-                            logger.exception(
-                                "Dead-lettering unparseable record %s/%s",
-                                rec.partition, rec.offset)
-                            # durability first, like the main path: the raw
-                            # payload lands in the dead-letter file before ack
-                            self._retry(lambda: self._dead_letter(rec),
-                                        "dead_letter")
-                            self.p.consumer.ack(
-                                PartitionOffset(rec.partition, rec.offset))
-                        elif b._on_parse_error == "skip":
-                            logger.exception(
-                                "Skipping unparseable record %s/%s",
-                                rec.partition, rec.offset)
-                            # no durability dependency: ack now
-                            self.p.consumer.ack(
-                                PartitionOffset(rec.partition, rec.offset))
-                        else:
-                            logger.exception(
-                                "Can not parse record; worker %d dies "
-                                "(reference poison-pill parity, "
-                                "KPW.java:271-275)", self.index)
-                            raise
-                if not parsed:
-                    self._inflight_runs = []  # every record was acked above
-                    continue
-                if self.current_file is None:
-                    self._open_file()
-                # append is pure memory; only the (idempotent) flush retries
-                self.current_file.append_records([m for _, m in parsed])
-                self._retry(self.current_file.flush_if_full, "flush")
-                self._note_written(r for r, _ in parsed)
-                self._inflight_runs = []
-                self.p._written_records.mark(len(parsed))
-                self.p._written_bytes.mark(nbytes)
-                self._file_records += len(parsed)
-                if self._is_file_full():
-                    self._finalize_current_file()
+                try:
+                    self._loop_once(b, poll_batch_base, use_wire)
+                except (OSError, PipelineError) as e:
+                    # degraded_mode: a fatal-classified sink condition
+                    # (full disk, read-only remount) pauses this worker —
+                    # probe until it heals, then resume — instead of dying
+                    # into a restart that cannot fix it.  Anything else
+                    # keeps the historical death semantics.
+                    cause = self._pause_cause(e)
+                    if cause is None:
+                        raise
+                    self._pause_until_recovered(cause)
         except RetryInterrupted:
             pass
         except Exception as e:
@@ -736,10 +902,187 @@ class _Worker:
                         self.current_file = None
             finally:
                 # visibility LAST: `failed` flips only after cleanup, so
-                # the supervisor's join-then-read of held_runs() is safe
-                self.p._failed.mark()
-                self.failed = True
-                self.p._notify_worker_death()
+                # the supervisor's join-then-read of held_runs() is safe.
+                # A condemned (watchdog-abandoned) worker was already
+                # declared dead and its slot restarted: the zombie must
+                # not count a second death or wake the supervisor again
+                if not self.condemned:
+                    self.p._failed.mark()
+                    self.failed = True
+                    self.p._notify_worker_death()
+        finally:
+            # a condemned zombie that eventually escaped its hung call
+            # exits through here holding an open (unpublishable) file:
+            # free its pipeline threads and sink best-effort — the slot's
+            # replacement is long since running
+            if self.condemned and self.current_file is not None:
+                try:
+                    self.current_file.rotation_reason = "error"
+                    self.current_file.abandon()
+                except Exception:
+                    logger.exception("condemned worker %d: abandon failed "
+                                     "(ignored)", self.index)
+                self.current_file = None
+
+    def _loop_once(self, b, poll_batch_base: int, use_wire: bool) -> None:
+        """One poll→parse→write→rotate iteration (the body of the
+        reference's worker loop, KPW.java:253-292), extracted so the
+        degraded-mode pause seam can wrap exactly one iteration."""
+        if (self.current_file is not None
+                and self._is_file_timed_out()):
+            self._finalize_current_file("time")
+        # batch granularity follows the LIVE bytes/record estimate,
+        # not the static 64 B guess: small-record streams (nested
+        # cfg7-shaped, ~10 B/record encoded) were capped at 1/16 of
+        # the 64 B-based record count — 4-5x smaller batches than
+        # the size band needs, and per-batch shred/append overhead
+        # dominated the measured rate (VERDICT r3 next #8)
+        poll_batch = min(poll_batch_base, _rotation_batch_cap(
+            b._max_file_size, max(8.0, self._carry_est)))
+        recs, runs = self.p.consumer.poll_many_runs(
+            self._poll_cap(poll_batch))
+        if not recs:
+            time.sleep(0.001)
+            return
+        # consumed from the queue: from here until these runs are
+        # folded into _written_runs (or individually acked) they
+        # are redeliverable only through held_runs()
+        self._inflight_runs = runs
+        if use_wire and self._try_wire_batch(recs, runs):
+            self._inflight_runs = []
+            if self._is_file_full():
+                self._finalize_current_file()
+            return
+        parsed = []  # (record, message) — parsed in bulk so the
+        # per-record loop overhead amortizes (design capacity is
+        # 300k rec/s/instance, KPW.java:463)
+        nbytes = 0
+        for rec in recs:
+            try:
+                parsed.append((rec, b._parser(rec.value)))
+                nbytes += len(rec.value)
+            except Exception:
+                if b._on_parse_error == "dead_letter":
+                    logger.exception(
+                        "Dead-lettering unparseable record %s/%s",
+                        rec.partition, rec.offset)
+                    # durability first, like the main path: the raw
+                    # payload lands in the dead-letter file before ack
+                    self._retry(lambda: self._dead_letter(rec),
+                                "dead_letter")
+                    self.p.consumer.ack(
+                        PartitionOffset(rec.partition, rec.offset))
+                elif b._on_parse_error == "skip":
+                    logger.exception(
+                        "Skipping unparseable record %s/%s",
+                        rec.partition, rec.offset)
+                    # no durability dependency: ack now
+                    self.p.consumer.ack(
+                        PartitionOffset(rec.partition, rec.offset))
+                else:
+                    logger.exception(
+                        "Can not parse record; worker %d dies "
+                        "(reference poison-pill parity, "
+                        "KPW.java:271-275)", self.index)
+                    raise
+        if not parsed:
+            self._inflight_runs = []  # every record was acked above
+            return
+        if self.current_file is None:
+            self._open_file()
+        # append is pure memory; only the (idempotent) flush retries
+        self.current_file.append_records([m for _, m in parsed])
+        self._retry(self.current_file.flush_if_full, "flush")
+        self._note_written(r for r, _ in parsed)
+        self._inflight_runs = []
+        self.p._written_records.mark(len(parsed))
+        self.p._written_bytes.mark(nbytes)
+        self._file_records += len(parsed)
+        if self._is_file_full():
+            self._finalize_current_file()
+
+    # -- pause/resume (degraded_mode) ---------------------------------------
+    def _pause_cause(self, e: BaseException):
+        """The fatal OSError behind ``e`` when degraded_mode should pause
+        on it, else None.  Covers the direct seam (a fatal errno escaping
+        the retry policy) and the pipelined one (a poisoned pipe whose
+        cause was a fatal errno in the row-group IO thread)."""
+        if not self.p._b._degraded_mode or self._stop.is_set():
+            return None
+        cand = e
+        if isinstance(e, PipelineError):
+            cand = e.__cause__
+        if not isinstance(cand, OSError):
+            return None
+        return cand if self.p.retry_policy.is_fatal(cand) else None
+
+    def _pause_until_recovered(self, cause: OSError) -> None:
+        """Fatal-errno pause: abandon the open (unpublishable) file
+        un-acked, stop intake — the shared queue fills and the fetcher's
+        bounded put blocks, so backpressure reaches the consumer while its
+        broker session stays alive — and probe the sink with exponential
+        backoff until it heals.  On resume the held offset runs are
+        re-injected (redelivery; they were never acked) from a side
+        thread, because this worker is the consumer that makes queue
+        space.  ``max_pause_seconds`` exceeded converts the pause into
+        the normal fatal death (supervision semantics take over)."""
+        b = self.p._b
+        if self.current_file is not None:
+            try:
+                self.current_file.rotation_reason = "error"
+                self.current_file.abandon()
+            except Exception:
+                # abandon flushes the sink and can hit the SAME full-disk
+                # condition that triggered the pause — swallowing it is the
+                # whole point of degraded_mode (the tmp is garbage either
+                # way; the sibling death/zombie cleanup paths guard too)
+                logger.exception("worker %d: abandon during pause entry "
+                                 "failed (ignored)", self.index)
+            finally:
+                self._fold_pipe_stats(self.current_file)
+                self.current_file = None
+        held = self.held_runs()
+        self._written_runs = []
+        self._inflight_runs = []
+        self._unacked_count = 0
+        self._oldest_unacked_ts = None
+        self.last_error = repr(cause)
+        self.p._enter_pause(self.index, cause)
+        try:
+            backoff = b._pause_probe_interval
+            t0 = time.monotonic()
+            while True:
+                if self._stop.wait(backoff):
+                    raise RetryInterrupted() from cause
+                if self.p._probe_sink(self.index):
+                    break
+                backoff = min(backoff * 2.0, b._pause_probe_max)
+                if (b._max_pause is not None
+                        and time.monotonic() - t0 > b._max_pause):
+                    logger.error(
+                        "worker %d: pause exceeded max_pause_seconds "
+                        "(%.1fs); converting to fatal death",
+                        self.index, b._max_pause)
+                    raise cause
+        finally:
+            self.p._exit_pause(self.index)
+        if held:
+            threading.Thread(
+                target=self._redeliver_runs, args=(held,),
+                name=f"KPW-resume-redeliver-{self.index}",
+                daemon=True).start()
+
+    def _redeliver_runs(self, runs) -> None:
+        try:
+            for part, start, end in runs:
+                self.p.consumer.redeliver_run(part, start, end - start,
+                                              stop_event=self._stop)
+        except RetryInterrupted:
+            pass
+        except Exception:
+            logger.exception(
+                "resume redelivery failed; the offsets stay un-acked and "
+                "redeliver on the next start")
 
     def _try_wire_batch(self, recs, runs) -> bool:
         """Shred a poll batch through the native wire decoder and append it
@@ -899,10 +1242,14 @@ class _Worker:
             except Exception:
                 pass  # file may be rotating away under us
         ts = self._oldest_unacked_ts
+        stall_age, stall_label = self.heartbeat.stall()
         return {
             "worker": self.index,
             "alive": self.alive(),
             "failed": self.failed,
+            "condemned": self.condemned,
+            "stall_age_s": round(stall_age, 3),
+            "stalled_in": stall_label,
             "exit_reason": self.exit_reason,
             "restarts": self.p._restart_counts[self.index],
             "retries": self.retries,
@@ -941,6 +1288,7 @@ class _Worker:
                 pipeline=self.p._b._pipeline,
                 est_record_bytes=self._carry_est,
                 retry_policy=self.p.retry_policy,
+                heartbeat=self.heartbeat,
             )
 
         self.current_file = self._retry(make, "open")

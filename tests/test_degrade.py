@@ -1,0 +1,627 @@
+"""Degraded-operation tests: hung-IO watchdog, spillover failover,
+fatal-errno pause/resume, and deadline-bounded shutdown.
+
+The failure shapes here are the ones PRs 3-4 could not model: storage that
+HANGS rather than errors (no errno, no dead thread — invisible to retry
+classification and supervision alike), disks that fill and later recover
+(fatal-by-default, yet restarting cannot fix them), and a `close()` that
+must return within a budget even when a write will never come back.  Every
+test asserts the at-least-once invariant mechanically where it applies:
+acked offsets live in structurally verified published files, nothing
+unverified is ever deleted, and abandoned work is redeliverable.
+"""
+
+import errno
+import threading
+import time
+
+import pyarrow.parquet as pq
+import pytest
+
+from kpw_tpu import (
+    Builder,
+    FailoverFileSystem,
+    FakeBroker,
+    FaultInjectingFileSystem,
+    FaultSchedule,
+    MemoryFileSystem,
+    MetricRegistry,
+    RetryPolicy,
+    SmartCommitConsumer,
+    registry_to_json,
+    registry_to_prometheus,
+)
+from kpw_tpu.io.verify import verify_file
+from kpw_tpu.runtime import metrics as M
+from kpw_tpu.runtime.watchdog import Heartbeat
+
+from proto_helpers import sample_message_class
+
+TOPIC = "degrade"
+
+
+def produce_indexed(broker, cls, rows, parts, pad=80):
+    for i in range(rows):
+        m = cls(query=f"q-{i}-" + "x" * pad, timestamp=i)
+        broker.produce(TOPIC, m.SerializeToString(), partition=i % parts)
+
+
+def make_writer(broker, fs, *, target="/out", group="g", parts=2, **knobs):
+    b = (Builder().broker(broker).topic(TOPIC)
+         .proto_class(sample_message_class()).target_dir(target)
+         .filesystem(fs).instance_name("degrade").group_id(group)
+         .batch_size(256)
+         .retry_policy(knobs.pop("retry_policy",
+                                 RetryPolicy(base_sleep=0.005,
+                                             max_sleep=0.05)))
+         .max_file_size(128 * 1024).block_size(32 * 1024)
+         .max_file_open_duration_seconds(0.4))
+    for name, args in knobs.items():
+        if isinstance(args, dict):
+            getattr(b, name)(**args)
+        else:
+            getattr(b, name)(*args if isinstance(args, tuple) else (args,))
+    return b.build()
+
+
+def wait_until(cond, timeout=30.0, interval=0.01, msg="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def committed_total(broker, group, parts):
+    return sum(broker.committed(group, TOPIC, p) for p in range(parts))
+
+
+def verified_timestamps(fs, target="/out"):
+    """{timestamp: count} across published files under ``target`` on
+    ``fs``, asserting every one passes the independent verifier and no
+    tmp/quarantine file is counted as published."""
+    got = {}
+    for f in fs.list_files(target, extension=".parquet"):
+        if f"{target}/tmp/" in f or "/quarantine/" in f:
+            continue
+        rep = verify_file(fs, f)
+        assert rep.ok, f"published file fails verification: {f}: {rep.errors}"
+        for r in pq.read_table(fs.open_read(f)).to_pylist():
+            got[r["timestamp"]] = got.get(r["timestamp"], 0) + 1
+    return got
+
+
+def assert_acked_covered(broker, group, parts, got):
+    missing = [
+        (p, off)
+        for p in range(parts)
+        for off in range(broker.committed(group, TOPIC, p))
+        if got.get(off * parts + p, 0) < 1
+    ]
+    assert missing == [], f"acked offsets missing from verified files: " \
+                          f"{missing[:10]} (+{max(0, len(missing) - 10)})"
+
+
+# ---------------------------------------------------------------------------
+# heartbeat + watchdog
+# ---------------------------------------------------------------------------
+
+def test_heartbeat_stall_tracking():
+    hb = Heartbeat()
+    assert hb.stall() == (0.0, None)
+    token = hb.io_started("flush")
+    time.sleep(0.05)
+    age, label = hb.stall()
+    assert age >= 0.05 and label == "flush"
+    hb.beat()  # a progressing retry loop re-stamps the pending op
+    age2, _ = hb.stall()
+    assert age2 < age
+    hb.io_finished(token)
+    assert hb.stall() == (0.0, None)
+    assert hb.beats == 2
+
+
+def test_watchdog_flags_stall_and_recovers_health():
+    cls = sample_message_class()
+    rows, parts = 3000, 2
+    broker = FakeBroker()
+    broker.create_topic(TOPIC, parts)
+    produce_indexed(broker, cls, rows, parts)
+    sched = FaultSchedule(seed=1).hang_nth("write", 1)
+    fs = FaultInjectingFileSystem(MemoryFileSystem(), sched)
+    w = make_writer(broker, fs,
+                    watchdog=dict(io_stall_deadline_seconds=0.3,
+                                  poll_interval_seconds=0.05),
+                    metric_registry=MetricRegistry())
+    w.start()
+    try:
+        wait_until(lambda: w.stats()["meters"][M.STALLED_METER]["count"] >= 1,
+                   msg="stall metered")
+        st = w.stats()
+        assert not w.healthy()
+        assert st["watchdog"]["stalled_workers"], st["watchdog"]
+        assert st["watchdog"]["stalled_workers"][0]["age_s"] >= 0.3
+        # per-worker surfacing too
+        assert st["workers"][0]["stall_age_s"] > 0
+        # release: the op completes, the stall clears, health returns
+        sched.release_hangs()
+        wait_until(lambda: committed_total(broker, "g", parts) >= rows
+                   and w.ack_lag()["unacked_records"] == 0,
+                   msg="drain after release")
+        wait_until(lambda: not w.stats()["watchdog"]["stalled_workers"],
+                   msg="stall clears")
+        assert w.healthy()
+    finally:
+        sched.release_hangs()
+        w.close()
+
+
+def test_watchdog_abandon_restarts_slot_at_least_once():
+    """A never-returning write is abandoned by the watchdog: the slot is
+    restarted through the supervisor, the held offsets are redelivered,
+    and the run completes with every acked offset in a verified published
+    file — the hang costs duplicates, never loss."""
+    cls = sample_message_class()
+    rows, parts = 3000, 2
+    broker = FakeBroker()
+    broker.create_topic(TOPIC, parts)
+    produce_indexed(broker, cls, rows, parts)
+    sched = FaultSchedule(seed=2).hang_nth("write", 1)  # first write: forever
+    inner = MemoryFileSystem()
+    fs = FaultInjectingFileSystem(inner, sched)
+    w = make_writer(broker, fs,
+                    supervise=(True, 3, 0.01),
+                    watchdog=dict(io_stall_deadline_seconds=0.3,
+                                  poll_interval_seconds=0.05,
+                                  abandon_stalled=True))
+    w.start()
+    try:
+        wait_until(lambda: committed_total(broker, "g", parts) >= rows
+                   and w.ack_lag()["unacked_records"] == 0,
+                   msg="drain after watchdog abandon")
+        st = w.stats()
+        assert st["meters"][M.STALLED_METER]["count"] >= 1
+        assert st["supervision"]["restarts_total"] == 1
+        assert st["consumer"]["redelivered_records"] > 0
+        got = verified_timestamps(fs)
+        assert_acked_covered(broker, "g", parts, got)
+    finally:
+        w.close()
+        sched.release_hangs()  # unpark the zombie so the thread can exit
+
+
+def test_watchdog_abandon_consumes_no_retry_budget():
+    """Budget-interaction pin (see README/PARITY 'three budgets' table): a
+    watchdog abandon goes through the SUPERVISOR restart budget and never
+    touches the retry budget — the hung call never returned, so the retry
+    policy never saw an attempt fail.  A two-attempt policy survives a
+    hang un-consumed."""
+    cls = sample_message_class()
+    rows, parts = 2000, 2
+    broker = FakeBroker()
+    broker.create_topic(TOPIC, parts)
+    produce_indexed(broker, cls, rows, parts)
+    sched = FaultSchedule(seed=3).hang_nth("write", 1)
+    fs = FaultInjectingFileSystem(MemoryFileSystem(), sched)
+    w = make_writer(broker, fs,
+                    retry_policy=RetryPolicy(base_sleep=0.005,
+                                             max_sleep=0.05,
+                                             max_attempts=2),
+                    supervise=(True, 3, 0.01),
+                    watchdog=dict(io_stall_deadline_seconds=0.3,
+                                  poll_interval_seconds=0.05,
+                                  abandon_stalled=True))
+    w.start()
+    try:
+        wait_until(lambda: committed_total(broker, "g", parts) >= rows
+                   and w.ack_lag()["unacked_records"] == 0,
+                   msg="drain")
+        st = w.stats()
+        assert st["meters"]["parquet.writer.retries"]["count"] == 0
+        assert st["supervision"]["restart_counts"][0] == 1
+        assert st["meters"][M.STALLED_METER]["count"] >= 1
+    finally:
+        w.close()
+        sched.release_hangs()
+
+
+# ---------------------------------------------------------------------------
+# failover filesystem
+# ---------------------------------------------------------------------------
+
+def test_failover_spill_and_reconcile_invariant():
+    """Primary dies (fatal errno on open) mid-run -> publishes spill to
+    the fallback -> primary heals -> the reconciler migrates every spill
+    back (verify-first, durable_rename) -> at the end every acked offset
+    is in a verified published file ON THE PRIMARY and the fallback holds
+    no finals."""
+    cls = sample_message_class()
+    rows, parts = 6000, 2
+    broker = FakeBroker()
+    broker.create_topic(TOPIC, parts)
+    produce_indexed(broker, cls, rows, parts)
+    sched = FaultSchedule(seed=4).recover_after("open", nth=2,
+                                                err=errno.ENOSPC)
+    primary_inner = MemoryFileSystem()
+    primary = FaultInjectingFileSystem(primary_inner, sched)
+    fallback = MemoryFileSystem()
+    reg = MetricRegistry()
+    ffs = FailoverFileSystem(primary, fallback, probe_interval_s=0.05,
+                             registry=reg)
+    w = make_writer(broker, ffs, metric_registry=reg)
+    w.start()
+    try:
+        wait_until(lambda: ffs.failover_stats()["spilled"] >= 2,
+                   msg="spills on the fallback")
+        assert ffs.degraded()
+        assert not w.healthy() or True  # degraded() is the composite's verdict
+        sched.heal()
+        wait_until(lambda: not ffs.degraded(), msg="primary recovery")
+        wait_until(lambda: committed_total(broker, "g", parts) >= rows
+                   and w.ack_lag()["unacked_records"] == 0,
+                   msg="drain")
+        st = w.stats()["failover"]
+        assert st["failovers"] == 1 and st["recoveries"] == 1
+        assert st["reconciled"] == st["spilled"] >= 2
+        assert st["reconcile_failed"] == 0
+        assert st["spilled_pending"] == []
+    finally:
+        w.close()
+        ffs.close()
+    # the invariant is checked on the PRIMARY's inner store alone
+    got = verified_timestamps(primary_inner)
+    assert_acked_covered(broker, "g", parts, got)
+    leftovers = [f for f in fallback.list_files("/out", extension=".parquet")
+                 if "/quarantine/" not in f and "/out/tmp/" not in f]
+    assert leftovers == [], f"fallback still holds finals: {leftovers}"
+
+
+def test_failover_quarantines_unverifiable_spill_never_deletes():
+    primary = MemoryFileSystem()
+    fallback = MemoryFileSystem()
+    ffs = FailoverFileSystem(primary, fallback, probe_interval_s=30,
+                             probe_dir="/t/tmp")
+    try:
+        ffs.mkdirs("/t/tmp")
+        ffs.declare_primary_down("test: operator verdict")
+        assert ffs.degraded()
+        # a spilled "final" that is NOT valid parquet (torn mid-spill)
+        with ffs.open_write("/t/tmp/x.tmp") as f:
+            f.write(b"PAR1 garbage, not a parquet file")
+        ffs.rename("/t/tmp/x.tmp", "/t/garbage.parquet")
+        st = ffs.failover_stats()
+        assert st["spilled"] == 1
+        # primary is actually healthy: reconcile now
+        assert ffs.reconcile_now() is True
+        st = ffs.failover_stats()
+        assert st["reconciled"] == 0
+        assert st["reconcile_failed"] == 1
+        q = st["quarantined_spills"]
+        assert len(q) == 1 and q[0]["path"] == "/t/garbage.parquet"
+        # moved on the FALLBACK, never deleted, never migrated
+        assert fallback.exists(q[0]["quarantined_to"])
+        qbytes = fallback.open_read(q[0]["quarantined_to"]).read()
+        assert qbytes == b"PAR1 garbage, not a parquet file"
+        assert not primary.exists("/t/garbage.parquet")
+        assert not fallback.exists("/t/garbage.parquet")
+        assert not ffs.degraded()  # quarantine does not block recovery
+    finally:
+        ffs.close()
+
+
+def test_failover_declared_down_spills_then_reconciles():
+    """The watchdog-declared path: no errno ever fires — an external
+    verdict flips the route, spills happen, and reconciliation brings a
+    VALID spilled final home via durable_rename."""
+    primary = MemoryFileSystem()
+    fallback = MemoryFileSystem()
+    ffs = FailoverFileSystem(primary, fallback, probe_interval_s=30,
+                             probe_dir="/t/tmp")
+    try:
+        ffs.mkdirs("/t/tmp")
+        # build a real (valid) parquet file through the composite while
+        # degraded, publish it: it must land on the fallback
+        ffs.declare_primary_down("watchdog: worker 0 IO hung")
+        import numpy as np
+        from kpw_tpu.core.schema import (Field, PhysicalType, Repetition,
+                                         Schema)
+        from kpw_tpu.core.writer import (ParquetFileWriter,
+                                         columns_from_arrays)
+
+        schema = Schema([Field("v", Repetition.REQUIRED,
+                               physical_type=PhysicalType.INT64)])
+        sink = ffs.open_write("/t/tmp/spill.tmp")
+        pw = ParquetFileWriter(sink, schema)
+        pw.write_batch(columns_from_arrays(
+            schema, {"v": np.arange(16, dtype=np.int64)}))
+        pw.close()
+        sink.close()
+        ffs.durable_rename("/t/tmp/spill.tmp", "/t/spill.parquet")
+        assert fallback.exists("/t/spill.parquet")
+        assert not primary.exists("/t/spill.parquet")
+        assert ffs.failover_stats()["spilled"] == 1
+        assert ffs.reconcile_now() is True
+        assert primary.exists("/t/spill.parquet")
+        assert verify_file(primary, "/t/spill.parquet").ok
+        assert not fallback.exists("/t/spill.parquet")
+        assert ffs.failover_stats()["reconciled"] == 1
+        assert not ffs.degraded()
+    finally:
+        ffs.close()
+
+
+def test_failover_close_does_not_degrade_routing():
+    """Closing the composite stops the reconciler only: a healthy
+    composite must not start spilling to the fallback because its
+    reconciler was shut down (post-review regression pin)."""
+    primary = MemoryFileSystem()
+    fallback = MemoryFileSystem()
+    ffs = FailoverFileSystem(primary, fallback, probe_interval_s=0.05)
+    ffs.close()
+    assert not ffs.degraded()
+    ffs.mkdirs("/t/tmp")
+    with ffs.open_write("/t/tmp/a.tmp") as f:
+        f.write(b"x")
+    ffs.rename("/t/tmp/a.tmp", "/t/a.parquet")
+    assert primary.exists("/t/a.parquet")
+    assert not fallback.exists("/t/a.parquet")
+    assert ffs.failover_stats()["spilled"] == 0
+
+
+# ---------------------------------------------------------------------------
+# pause/resume (degraded_mode)
+# ---------------------------------------------------------------------------
+
+def test_pause_resume_on_fatal_errno():
+    """ENOSPC pauses the worker instead of killing it: intake stops (the
+    bounded queue fills and the fetcher blocks — backpressure without
+    dropping the session), a probe loop waits out the condition, and the
+    writer resumes cleanly once it heals.  Zero deaths, zero restarts,
+    full drain."""
+    cls = sample_message_class()
+    rows, parts = 6000, 2
+    broker = FakeBroker()
+    broker.create_topic(TOPIC, parts)
+    produce_indexed(broker, cls, rows, parts)
+    sched = FaultSchedule(seed=5).recover_after("write", nth=8,
+                                                err=errno.ENOSPC)
+    fs = FaultInjectingFileSystem(MemoryFileSystem(), sched)
+    w = make_writer(broker, fs,
+                    degraded_mode=dict(probe_interval_seconds=0.05,
+                                       probe_backoff_max_seconds=0.2),
+                    max_queued_records_in_consumer=2000)
+    w.start()
+    try:
+        wait_until(lambda: w.stats()["degraded"]["paused_workers"],
+                   msg="pause entered")
+        st = w.stats()
+        assert not w.healthy()
+        assert "ENOSPC" in st["degraded"]["paused_workers"][0]["cause"] \
+            or "28" in st["degraded"]["paused_workers"][0]["cause"]
+        # backpressure: the queue fills to capacity while paused, and the
+        # fetcher session stays ALIVE (blocked, not dead)
+        wait_until(lambda: (w.stats()["consumer"]["queue"]["depth"]
+                            == w.stats()["consumer"]["queue"]["capacity"]),
+                   msg="queue backpressure under pause")
+        assert w.stats()["consumer"]["fetcher_alive"]
+        sched.heal()
+        wait_until(lambda: committed_total(broker, "g", parts) >= rows
+                   and w.ack_lag()["unacked_records"] == 0,
+                   msg="drain after resume")
+        st = w.stats()
+        assert st["degraded"]["pause_count"] == 1
+        assert st["degraded"]["resume_count"] == 1
+        assert st["degraded"]["paused_workers"] == []
+        assert st["degraded"]["paused_total_s"] > 0
+        assert st["meters"]["parquet.writer.failed"]["count"] == 0
+        assert st["supervision"]["restarts_total"] == 0
+        assert w.healthy()
+        got = verified_timestamps(fs)
+        assert_acked_covered(broker, "g", parts, got)
+    finally:
+        w.close()
+
+
+def test_pause_max_pause_converts_to_fatal_death():
+    cls = sample_message_class()
+    rows, parts = 2000, 2
+    broker = FakeBroker()
+    broker.create_topic(TOPIC, parts)
+    produce_indexed(broker, cls, rows, parts)
+    sched = FaultSchedule(seed=6).recover_after("write", nth=6,
+                                                err=errno.EROFS)  # never heals
+    fs = FaultInjectingFileSystem(MemoryFileSystem(), sched)
+    w = make_writer(broker, fs,
+                    degraded_mode=dict(probe_interval_seconds=0.05,
+                                       probe_backoff_max_seconds=0.1,
+                                       max_pause_seconds=0.3))
+    w.start()
+    try:
+        wait_until(lambda: w.stats()["meters"][
+            "parquet.writer.failed"]["count"] >= 1,
+            msg="pause converts to death past max_pause")
+        st = w.stats()
+        assert st["degraded"]["pause_count"] == 1
+        assert st["degraded"]["paused_workers"] == []  # exited the pause
+        assert st["workers"][0]["failed"]
+        assert not w.healthy()
+    finally:
+        w.close()
+
+
+# ---------------------------------------------------------------------------
+# deadline-bounded shutdown
+# ---------------------------------------------------------------------------
+
+def test_close_deadline_returns_under_hung_write():
+    """Acceptance pin: with ALL defaults (no watchdog, no failover, no
+    degraded_mode) and a write that never returns, ``close(deadline=2)``
+    comes back within the budget, reports the hung worker, and the stuck
+    file is abandoned un-acked (nothing published, nothing committed —
+    the records redeliver on the next start)."""
+    cls = sample_message_class()
+    rows, parts = 2000, 2
+    broker = FakeBroker()
+    broker.create_topic(TOPIC, parts)
+    produce_indexed(broker, cls, rows, parts)
+    sched = FaultSchedule(seed=7).hang_nth("write", 1)
+    fs = FaultInjectingFileSystem(MemoryFileSystem(), sched)
+    w = make_writer(broker, fs)
+    w.start()
+    try:
+        wait_until(lambda: sched.counts().get("write", 0) >= 1,
+                   msg="the hang engaged")
+        time.sleep(0.1)  # let the worker actually park
+        t0 = time.monotonic()
+        report = w.close(deadline=2.0)
+        dt = time.monotonic() - t0
+        assert dt < 6.0, f"close(deadline=2.0) took {dt:.1f}s"
+        assert report["deadline_met"]
+        assert report["hung_workers"] == [0]
+        assert report["abandoned_held_records"] > 0
+        # un-acked: nothing was ever published, so nothing may be committed
+        assert committed_total(broker, "g", parts) == 0
+        published = [f for f in fs.list_files("/out", extension=".parquet")
+                     if "/out/tmp/" not in f]
+        assert published == []
+    finally:
+        sched.release_hangs()
+
+
+def test_close_default_keeps_historical_semantics():
+    cls = sample_message_class()
+    rows, parts = 2000, 2
+    broker = FakeBroker()
+    broker.create_topic(TOPIC, parts)
+    produce_indexed(broker, cls, rows, parts)
+    fs = MemoryFileSystem()
+    w = make_writer(broker, fs)
+    w.start()
+    wait_until(lambda: committed_total(broker, "g", parts) >= rows
+               and w.ack_lag()["unacked_records"] == 0, msg="drain")
+    report = w.close()
+    assert report["deadline_s"] is None and report["deadline_met"]
+    assert report["hung_workers"] == []
+    assert report["flushed_records"] == rows
+    # idempotent close returns the same report
+    assert w.close() is report
+
+
+# ---------------------------------------------------------------------------
+# consumer close under a blocked put (satellite fix)
+# ---------------------------------------------------------------------------
+
+def test_consumer_close_releases_blocked_put():
+    """Closing while the shared buffer is full and the fetcher is blocked
+    in a put-stall must not deadlock: close() notifies the buffer
+    condition, the blocked _put_batch re-checks _running and bails, and
+    close returns promptly."""
+    cls = sample_message_class()
+    broker = FakeBroker()
+    broker.create_topic(TOPIC, 1)
+    produce_indexed(broker, cls, 500, 1, pad=4)
+    c = SmartCommitConsumer(broker=broker, group_id="g",
+                            max_queued_records=50)
+    c.subscribe(TOPIC)
+    c.start()
+    # wait for the buffer to fill and the fetcher to wedge in its put
+    wait_until(lambda: c.queue_depth() == 50, msg="buffer full")
+    wait_until(lambda: c.stats()["queue"]["put_stall_s"] > 0,
+               msg="fetcher blocked in put")
+    t0 = time.monotonic()
+    c.close()
+    dt = time.monotonic() - t0
+    assert dt < 3.0, f"close() blocked {dt:.1f}s behind a full buffer"
+    assert not c.fetcher_alive()
+
+
+# ---------------------------------------------------------------------------
+# metrics exposure
+# ---------------------------------------------------------------------------
+
+def test_degraded_metrics_render_in_exporters():
+    """Every new canonical name is registered and flows through BOTH
+    renderers with no per-metric wiring: the stalled meter + paused gauge
+    (writer-registered) and the spilled/reconciled/reconcile.failed
+    meters (failover-registered)."""
+    reg = MetricRegistry()
+    broker = FakeBroker()
+    broker.create_topic(TOPIC, 1)
+    ffs = FailoverFileSystem(MemoryFileSystem(), MemoryFileSystem(),
+                             probe_interval_s=30, registry=reg)
+    w = make_writer(broker, ffs, metric_registry=reg)
+    try:
+        names = set(reg.names())
+        for name in (M.STALLED_METER, M.PAUSED_GAUGE, M.SPILLED_METER,
+                     M.RECONCILED_METER, M.RECONCILE_FAILED_METER):
+            assert name in M.METRIC_NAMES
+            assert name in names, f"{name} not registered"
+        js = registry_to_json(reg)
+        assert js[M.PAUSED_GAUGE]["type"] == "gauge"
+        assert js[M.SPILLED_METER]["type"] == "meter"
+        prom = registry_to_prometheus(reg)
+        assert "parquet_writer_stalled_total" in prom
+        assert "parquet_writer_paused" in prom
+        assert "parquet_writer_reconcile_failed_total" in prom
+    finally:
+        del w
+        ffs.close()
+
+
+# ---------------------------------------------------------------------------
+# torture: the primary dies twice in one 40k run (slow)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_degrade_torture_double_primary_death():
+    """40k records; the primary dies twice — once via fatal errno
+    (recover_after heals after N failed ops, probe-driven) and once via a
+    declared-down verdict (the watchdog path) — and reconciliation
+    completes both times: at the end every acked offset is in a verified
+    published file on the PRIMARY, nothing unverified was deleted, and
+    the fallback holds no finals."""
+    cls = sample_message_class()
+    rows, parts = 40_000, 2
+    broker = FakeBroker()
+    broker.create_topic(TOPIC, parts)
+    produce_indexed(broker, cls, rows, parts)
+    # death #1: opens fail fatally from the 3rd; the window heals after 8
+    # fired ops (writer opens + reconciler probes both count)
+    sched = FaultSchedule(seed=9).recover_after("open", nth=3,
+                                                err=errno.ENOSPC,
+                                                heal_after_ops=8)
+    primary_inner = MemoryFileSystem()
+    primary = FaultInjectingFileSystem(primary_inner, sched)
+    fallback = MemoryFileSystem()
+    reg = MetricRegistry()
+    ffs = FailoverFileSystem(primary, fallback, probe_interval_s=0.1,
+                             registry=reg)
+    w = make_writer(broker, ffs, metric_registry=reg,
+                    supervise=(True, 3, 0.01))
+    w.start()
+    try:
+        wait_until(lambda: ffs.failover_stats()["recoveries"] >= 1,
+                   timeout=60, msg="first death + recovery")
+        # death #2: the declared-down path, mid-stream
+        wait_until(lambda: ffs.failover_stats()["spilled"]
+                   < committed_total(broker, "g", parts),  # still running
+                   timeout=60, msg="stream alive")
+        ffs.declare_primary_down("torture: second kill")
+        wait_until(lambda: ffs.failover_stats()["recoveries"] >= 2,
+                   timeout=60, msg="second recovery")
+        wait_until(lambda: committed_total(broker, "g", parts) >= rows
+                   and w.ack_lag()["unacked_records"] == 0,
+                   timeout=120, msg="full drain")
+        st = w.stats()["failover"]
+        assert st["failovers"] >= 2 and st["recoveries"] >= 2
+        assert st["spilled_pending"] == []
+        assert st["reconciled"] == st["spilled"]
+    finally:
+        w.close()
+        ffs.close()
+    got = verified_timestamps(primary_inner)
+    assert_acked_covered(broker, "g", parts, got)
+    leftovers = [f for f in fallback.list_files("/out", extension=".parquet")
+                 if "/quarantine/" not in f and "/out/tmp/" not in f]
+    assert leftovers == []

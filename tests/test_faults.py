@@ -587,3 +587,93 @@ def test_writer_publish_survives_post_rename_fsync_failure():
             got[row["timestamp"]] += 1
     # exactly once: the resumed publish must not duplicate the file
     assert got == collections.Counter({i: 1 for i in range(rows)})
+
+
+# ---------------------------------------------------------------------------
+# degraded-operation rules: hang + recover_after (PR-5 prerequisites)
+# ---------------------------------------------------------------------------
+
+def test_hang_rule_blocks_until_released():
+    sched = FaultSchedule(seed=0).hang_nth("write", 1)
+    fs = FaultInjectingFileSystem(MemoryFileSystem(), sched)
+    fs.mkdirs("/h")
+    f = fs.open_write("/h/a")
+    done = threading.Event()
+
+    def park():
+        f.write(b"payload")  # parks inside check() until released
+        f.close()
+        done.set()
+
+    t = threading.Thread(target=park, daemon=True)
+    t.start()
+    # the op must be PARKED, not failed: no exception, no return
+    assert not done.wait(0.3)
+    assert t.is_alive()
+    fired = sched.fired()
+    assert fired and fired[0]["hang"] is True and fired[0]["errno"] is None
+    sched.release_hangs()
+    assert done.wait(5), "released hang must let the op proceed"
+    # the write went through after release (hang never corrupts)
+    with fs.open_read("/h/a") as fin:
+        assert fin.read() == b"payload"
+
+
+def test_hang_rule_timeout_proceeds():
+    sched = FaultSchedule(seed=0).hang_nth("write", 1, timeout_s=0.2)
+    fs = FaultInjectingFileSystem(MemoryFileSystem(), sched)
+    fs.mkdirs("/h")
+    t0 = time.monotonic()
+    with fs.open_write("/h/a") as f:
+        f.write(b"x")
+    dt = time.monotonic() - t0
+    assert dt >= 0.2, "timeout-bounded hang must actually park"
+    assert fs.open_read("/h/a").read() == b"x"
+
+
+def test_stop_releases_hangs():
+    sched = FaultSchedule(seed=0).hang_nth("rename", 1)
+    fs = FaultInjectingFileSystem(MemoryFileSystem(), sched)
+    fs.mkdirs("/h")
+    with fs.open_write("/h/a") as f:
+        f.write(b"x")
+    done = threading.Event()
+
+    def park():
+        fs.rename("/h/a", "/h/b")
+        done.set()
+
+    threading.Thread(target=park, daemon=True).start()
+    assert not done.wait(0.2)
+    sched.stop()  # drain semantics: stop() must not hold hostages
+    assert done.wait(5)
+    assert fs.exists("/h/b")
+
+
+def test_recover_after_heals_after_n_ops():
+    sched = FaultSchedule(seed=0).recover_after(
+        "open", nth=2, err=errno.ENOSPC, heal_after_ops=3)
+    fs = FaultInjectingFileSystem(MemoryFileSystem(), sched)
+    fs.mkdirs("/r")
+    fs.open_write("/r/1").close()  # ordinal 1: before the window
+    for i in range(3):             # ordinals 2-4: the dead window
+        with pytest.raises(InjectedFault) as ei:
+            fs.open_write(f"/r/dead{i}")
+        assert ei.value.errno == errno.ENOSPC
+    fs.open_write("/r/5").close()  # healed after 3 fired ops
+    fs.open_write("/r/6").close()
+
+
+def test_recover_after_heal_call():
+    sched = FaultSchedule(seed=0).recover_after("open", nth=1,
+                                                err=errno.EROFS)
+    fs = FaultInjectingFileSystem(MemoryFileSystem(), sched)
+    fs.mkdirs("/r")
+    for i in range(4):  # open-ended until the explicit heal
+        with pytest.raises(InjectedFault):
+            fs.open_write(f"/r/dead{i}")
+    sched.heal()
+    fs.open_write("/r/ok").close()
+    # the fired log kept every pre-heal failure
+    assert len([e for e in sched.fired()
+                if e["errno"] == errno.EROFS]) == 4

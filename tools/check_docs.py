@@ -79,6 +79,18 @@ CHECKS = [
      ["crash:fsync_overhead_pct"]),
     ("PARITY.md", r"records `fsync_overhead_pct` \*\*\+([\d.]+)%\*\*",
      ["crash:fsync_overhead_pct"]),
+    # degraded-operation PR: spillover/reconciliation and close-deadline
+    # quotes reconcile against the degrade artifact (`degrade:` prefix)
+    ("README.md", r"spills (\d+) finals\s+to the fallback",
+     ["degrade:outcome.spilled_files"]),
+    ("README.md", r"all (\d+) acked offsets \(recorded as",
+     ["degrade:outcome.acked_offsets_checked"]),
+    ("README.md", r"close under a hung write returned in\s+([\d.]+)\s?s",
+     ["degrade:close_deadline.returned_in_s"]),
+    ("PARITY.md", r"all (\d+)\s+`acked_offsets_checked`",
+     ["degrade:outcome.acked_offsets_checked"]),
+    ("PARITY.md", r"close under a hung\s+write returned in ([\d.]+)\s?s",
+     ["degrade:close_deadline.returned_in_s"]),
 ]
 
 
@@ -281,6 +293,11 @@ def main() -> int:
                                 os.path.join(ROOT, "BENCH_CRASH_r08.json"))
     if os.path.exists(crash_path):
         key_record["crash"] = json.load(open(crash_path))
+    # the degraded-operation artifact (bench.py --degrade) is the fifth
+    degrade_path = os.environ.get(
+        "KPW_DEGRADE_PATH", os.path.join(ROOT, "BENCH_DEGRADE_r09.json"))
+    if os.path.exists(degrade_path):
+        key_record["degrade"] = json.load(open(degrade_path))
     docs = {f: open(os.path.join(ROOT, f)).read()
             for f in ({c[0] for c in CHECKS} | set(KEY_DOCS)
                       | set(NAME_DOCS))}
@@ -300,6 +317,8 @@ def main() -> int:
             root = rec
             if spec.startswith("crash:"):
                 root, spec = key_record.get("crash", {}), spec[6:]
+            elif spec.startswith("degrade:"):
+                root, spec = key_record.get("degrade", {}), spec[8:]
             try:
                 expect = float(art(root, spec)) / scale
             except (KeyError, TypeError):
