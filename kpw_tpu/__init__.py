@@ -27,6 +27,7 @@ from .ingest import (  # noqa: E402,F401
     FaultInjectingBroker,
     KafkaBrokerClient,
     PartitionOffset,
+    RecordBatch,
     SmartCommitConsumer,
 )
 from .io import (  # noqa: E402,F401

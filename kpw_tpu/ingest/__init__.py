@@ -9,7 +9,7 @@ backpressure.  The broker itself is pluggable: the in-process ``FakeBroker``
 embedded Kafka broker) or any client implementing the same small interface.
 """
 
-from .broker import FakeBroker, Record  # noqa: F401
+from .broker import FakeBroker, Record, RecordBatch  # noqa: F401
 from .offsets import PagedOffsetTracker, PartitionOffset  # noqa: F401
 from .consumer import SmartCommitConsumer  # noqa: F401
 from .kafka_client import KafkaBrokerClient  # noqa: F401  (needs kafka-python at construction)

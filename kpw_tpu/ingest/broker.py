@@ -8,13 +8,25 @@ interface a real Kafka wire client can implement later.  Scale-out data
 parallelism (multiple writer instances sharing a consumer group —
 KafkaProtoParquetWriter.java:72-76) is modeled with range partition
 assignment and rebalance-on-membership-change.
+
+Storage is batch-native: each partition log is ONE contiguous payload
+buffer plus a record-offset table (record i = ``buf[offs[i]:offs[i+1]]``),
+guarded by its own lock — the wire-page layout a real broker hands a fetch
+response in.  ``fetch_batch`` returns that layout directly as a
+:class:`RecordBatch` (one buffer copy per batch, no per-record objects);
+``fetch`` is the compatibility surface that materializes one frozen
+:class:`Record` dataclass per payload, the per-record cost the batch path
+exists to avoid.  Group membership / committed offsets stay under one
+metadata lock; produce/fetch never contend across partitions.
 """
 
 from __future__ import annotations
 
 import threading
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
+
+import numpy as np
 
 
 @dataclass(frozen=True)
@@ -27,12 +39,129 @@ class Record:
     timestamp: float = 0.0
 
 
-class FakeBroker:
-    """Thread-safe in-memory broker."""
+class RecordBatch:
+    """Batch-native ingest handoff: ``count`` serialized payloads in one
+    contiguous immutable buffer plus an int64 offset table (record i =
+    ``payload[offsets[i]:offsets[i+1]]``; ``offsets[0]`` may be nonzero —
+    a :meth:`slice` shares the parent's buffer) and the
+    ``(partition, start_offset, count)`` run metadata the run-native ack
+    machinery (``poll_many_runs``/``ack_run``) consumes directly.
+
+    Offsets within a batch are contiguous BY CONTRACT (``start_offset + i``
+    is record i's offset): a source with offset gaps (a compacted real
+    topic) must deliver per-record ``Record`` lists instead — the batch
+    run shortcut would otherwise ack offsets that were never delivered.
+    Record keys do not ride the batch path (the writer never reads them);
+    :meth:`to_records` materializes keyless Records for the per-record
+    compatibility route.
+    """
+
+    __slots__ = ("topic", "partition", "start_offset", "payload", "offsets",
+                 "timestamp")
+
+    def __init__(self, topic: str, partition: int, start_offset: int,
+                 payload: bytes, offsets: np.ndarray,
+                 timestamp: float = 0.0) -> None:
+        self.topic = topic
+        self.partition = partition
+        self.start_offset = start_offset
+        self.payload = payload
+        self.offsets = offsets  # int64, len == count + 1, ascending
+        self.timestamp = timestamp
+
+    def __len__(self) -> int:
+        return len(self.offsets) - 1
+
+    @property
+    def run(self) -> tuple[int, int, int]:
+        """The batch as one contiguous (partition, start_offset, count)
+        ack run."""
+        return (self.partition, self.start_offset, len(self))
+
+    def payload_at(self, i: int) -> bytes:
+        o = self.offsets
+        return self.payload[int(o[i]): int(o[i + 1])]
+
+    def slice(self, start: int, count: int) -> "RecordBatch":
+        """Zero-copy window [start, start+count): shares the payload
+        buffer, the offset table is a numpy view."""
+        return RecordBatch(self.topic, self.partition,
+                           self.start_offset + start, self.payload,
+                           self.offsets[start: start + count + 1],
+                           self.timestamp)
+
+    def to_records(self) -> list[Record]:
+        """Materialize per-record frozen ``Record`` dataclasses — the
+        compatibility/fallback route (poison-pill reparse, dead-letter)."""
+        o, pl = self.offsets, self.payload
+        t, p, base, ts = (self.topic, self.partition, self.start_offset,
+                          self.timestamp)
+        return [Record(t, p, base + i, None, pl[int(o[i]): int(o[i + 1])], ts)
+                for i in range(len(o) - 1)]
+
+
+class _PartitionLog:
+    """One partition's contiguous append log, under its own lock.  The
+    offset table is a growable int64 numpy array (``offs[0..n]`` valid)
+    so a fetch slices it in C instead of converting a Python list per
+    batch."""
+
+    __slots__ = ("lock", "buf", "offs", "n", "keys", "ts")
 
     def __init__(self) -> None:
+        self.lock = threading.Lock()
+        self.buf = bytearray()
+        self.offs = np.zeros(64, np.int64)  # byte offsets; offs[0..n] valid
+        self.n = 0  # record count
+        self.keys: dict[int, bytes] = {}  # record offset -> key (sparse)
+        self.ts: list[float] = []
+
+    def _ensure(self, extra: int) -> None:
+        need = self.n + 1 + extra
+        if need > len(self.offs):
+            new = np.empty(max(need, 2 * len(self.offs)), np.int64)
+            new[: self.n + 1] = self.offs[: self.n + 1]
+            self.offs = new
+
+    def append_one(self, value: bytes, key, now: float) -> int:
+        with self.lock:
+            self._ensure(1)
+            off = self.n
+            self.buf += value
+            self.offs[off + 1] = self.offs[off] + len(value)
+            self.n = off + 1
+            self.ts.append(now)
+            if key is not None:
+                self.keys[off] = key
+            return off
+
+    def append_many(self, values, now: float) -> tuple[int, int]:
+        """One lock round for the whole batch; returns (first_offset, n)."""
+        if not values:
+            return self.n, 0
+        lens = np.fromiter(map(len, values), np.int64, count=len(values))
+        blob = b"".join(values)
+        with self.lock:
+            first = self.n
+            self._ensure(len(values))
+            self.buf += blob
+            base = self.offs[first]
+            np.cumsum(lens, out=self.offs[first + 1: first + 1 + len(values)])
+            self.offs[first + 1: first + 1 + len(values)] += base
+            self.n = first + len(values)
+            self.ts.extend([now] * len(values))
+            return first, len(values)
+
+
+class FakeBroker:
+    """Thread-safe in-memory broker (sharded per-partition log locks)."""
+
+    def __init__(self) -> None:
+        # metadata lock: topic map shape, consumer groups, committed
+        # offsets, the round-robin cursor.  Payload appends/reads take only
+        # the owning partition's log lock.
         self._lock = threading.RLock()
-        self._logs: dict[str, list[list[Record]]] = {}
+        self._logs: dict[str, list[_PartitionLog]] = {}
         self._committed: dict[tuple[str, str, int], int] = {}  # (group, topic, part) -> next offset
         self._groups: dict[tuple[str, str], list[str]] = {}  # (group, topic) -> member ids
         self._generation: dict[tuple[str, str], int] = {}
@@ -43,41 +172,112 @@ class FakeBroker:
         with self._lock:
             if topic in self._logs:
                 raise ValueError(f"topic exists: {topic}")
-            self._logs[topic] = [[] for _ in range(partitions)]
+            self._logs[topic] = [_PartitionLog() for _ in range(partitions)]
 
     def num_partitions(self, topic: str) -> int:
         with self._lock:
             return len(self._logs[topic])
 
-    def produce(self, topic: str, value: bytes, key: bytes | None = None,
-                partition: int | None = None) -> tuple[int, int]:
+    def _route(self, topic: str, key: bytes | None, partition: int | None,
+               advance_rr: int = 1) -> tuple[list[_PartitionLog], int, int]:
+        """Resolve (logs, partition, rr_base) under the metadata lock;
+        auto-creates a 1-partition topic on first produce."""
         with self._lock:
             if topic not in self._logs:
-                self._logs[topic] = [[]]
+                self._logs[topic] = [_PartitionLog()]
             parts = self._logs[topic]
+            rr_base = self._rr
             if partition is None:
                 if key is not None:
                     partition = hash(key) % len(parts)
                 else:
                     partition = self._rr % len(parts)
-                    self._rr += 1
-            log = parts[partition]
-            rec = Record(topic, partition, len(log), key, value, time.time())
-            log.append(rec)
-            return partition, rec.offset
+                    self._rr += advance_rr
+            return parts, partition, rr_base
+
+    def produce(self, topic: str, value: bytes, key: bytes | None = None,
+                partition: int | None = None) -> tuple[int, int]:
+        parts, partition, _ = self._route(topic, key, partition)
+        return partition, parts[partition].append_one(value, key, time.time())
+
+    def produce_many(self, topic: str, values,
+                     partition: int | None = None) -> dict[int, tuple[int, int]]:
+        """Append a whole batch of payloads with ONE lock round per
+        partition touched (vs one per record via :meth:`produce`) — the
+        topic-priming fast path for benchmarks and chaos tests.
+
+        ``partition=None`` stripes round-robin exactly like a
+        ``produce()`` loop would (value i lands on partition
+        ``(rr + i) % n``), so indexed-identity checks built on the loop's
+        placement hold unchanged.  Returns ``{partition: (first_offset,
+        count)}``."""
+        values = list(values)
+        if not values:
+            return {}
+        parts, part0, rr_base = self._route(topic, None, partition,
+                                            advance_rr=len(values))
+        now = time.time()
+        if partition is not None or len(parts) == 1:
+            first, n = parts[part0].append_many(values, now)
+            return {part0: (first, n)}
+        out: dict[int, tuple[int, int]] = {}
+        nparts = len(parts)
+        for i in range(nparts):
+            p = (rr_base + i) % nparts
+            sub = values[i::nparts]
+            if sub:
+                out[p] = parts[p].append_many(sub, now)
+        return out
 
     # -- fetch -------------------------------------------------------------
     def fetch(self, topic: str, partition: int, offset: int,
               max_records: int = 500) -> list[Record]:
+        """Per-record compatibility fetch: materializes one frozen
+        ``Record`` per payload (the cost :meth:`fetch_batch` avoids)."""
         with self._lock:
             parts = self._logs.get(topic)
             if parts is None or partition >= len(parts):
                 return []
-            return parts[partition][offset : offset + max_records]
+            log = parts[partition]
+        with log.lock:
+            if offset >= log.n:
+                return []
+            j = min(offset + max_records, log.n)
+            mv = memoryview(log.buf)
+            offs = log.offs
+            keys, ts = log.keys, log.ts
+            return [Record(topic, partition, i, keys.get(i),
+                           bytes(mv[offs[i]: offs[i + 1]]), ts[i])
+                    for i in range(offset, j)]
+
+    def fetch_batch(self, topic: str, partition: int, offset: int,
+                    max_records: int = 2000) -> RecordBatch | None:
+        """Batch-native fetch: up to ``max_records`` payloads as ONE
+        contiguous buffer + offset table (a single copy out of the log
+        page, no per-record object construction).  Returns None when
+        nothing is available at ``offset``."""
+        with self._lock:
+            parts = self._logs.get(topic)
+            if parts is None or partition >= len(parts):
+                return None
+            log = parts[partition]
+        with log.lock:
+            if offset >= log.n:
+                return None
+            j = min(offset + max_records, log.n)
+            a = int(log.offs[offset])
+            payload = bytes(memoryview(log.buf)[a: int(log.offs[j])])
+            offsets = log.offs[offset: j + 1].copy()  # C slice copy
+            ts = log.ts[offset]
+        if a:
+            offsets -= a
+        return RecordBatch(topic, partition, offset, payload, offsets, ts)
 
     def end_offset(self, topic: str, partition: int) -> int:
         with self._lock:
-            return len(self._logs[topic][partition])
+            log = self._logs[topic][partition]
+        with log.lock:
+            return log.n
 
     # -- consumer groups ---------------------------------------------------
     def join_group(self, group: str, topic: str, member_id: str) -> None:

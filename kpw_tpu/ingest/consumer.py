@@ -12,14 +12,19 @@ bounded queue (``maxQueuedRecordsInConsumer``, KPW.java:468).
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 import uuid
 from collections import deque
 
 from ..runtime.retry import RetryInterrupted, RetryPolicy
-from .broker import FakeBroker, Record
+from ..utils.tracing import stage
+from .autotune import IngestAutotuner
+from .broker import FakeBroker, Record, RecordBatch
 from .offsets import PagedOffsetTracker, PartitionOffset
+
+logger = logging.getLogger(__name__)
 
 
 class SmartCommitConsumer:
@@ -33,6 +38,8 @@ class SmartCommitConsumer:
         fetch_max_records: int = 2000,
         member_id: str | None = None,
         retry_policy: RetryPolicy | None = None,
+        batch_ingest: bool = False,
+        autotuner: IngestAutotuner | None = None,
     ) -> None:
         self.broker = broker
         self.group_id = group_id
@@ -41,10 +48,14 @@ class SmartCommitConsumer:
         # Batch-native bounded buffer: a deque of record *batches* under one
         # condition, so the fetcher pays one lock round per fetch and
         # workers one per poll_many — the per-record queue.Queue handoff was
-        # the throughput ceiling (~2 us/record each side).  The record-count
-        # bound is hard (reference BlockingQueue capacity semantics):
-        # oversized batches are admitted in slices, see _put_batch.
-        self._buf: "deque[list[Record]]" = deque()
+        # the throughput ceiling (~2 us/record each side).  Entries are
+        # either plain ``list[Record]`` (compatibility route, redelivery)
+        # or zero-copy :class:`RecordBatch` fetch slices (``batch_ingest``:
+        # contiguous payload buffer + offsets, no per-record objects).  The
+        # record-count bound is hard (reference BlockingQueue capacity
+        # semantics): oversized batches are admitted in slices, see
+        # _put_batch.
+        self._buf: "deque[list[Record] | RecordBatch]" = deque()
         self._head_pos = 0  # consumed prefix of _buf[0]
         self._buf_count = 0
         self._buf_max = max_queued_records
@@ -79,6 +90,14 @@ class SmartCommitConsumer:
         self._broker_retries = 0   # fetch+commit retry count (stats)
         self._redelivered = 0      # records re-injected by redeliver_run
         self._fetcher_error: str | None = None
+        # batch-native ingest: ride broker.fetch_batch (contiguous buffer +
+        # offsets, no per-record Record construction) when the broker has
+        # one; falls back to the per-record fetch path silently otherwise
+        self._batch_ingest = batch_ingest
+        self._batch_fetches = 0    # fetch_batch calls that delivered (stats)
+        # backpressure autotuning (owned by the writer; ticked from the
+        # fetch loop): None = fixed knobs, reference parity
+        self._autotune = autotuner
 
     # -- lifecycle ---------------------------------------------------------
     def subscribe(self, topic: str) -> None:
@@ -138,46 +157,85 @@ class SmartCommitConsumer:
         contiguous (partition, start_offset, count) runs, in record order.
         Buffered batches are single-partition fetch slices, so runs come out
         O(1) per slice instead of the caller re-deriving them per record —
-        the ack-bookkeeping fast path for the streaming worker."""
+        the ack-bookkeeping fast path for the streaming worker.  A gapped
+        batch (compacted topic) falls back to exact per-record runs: the
+        run shortcut must never claim an offset that was not delivered."""
         runs: list[tuple[int, int, int]] = []
         with self._buf_cond:
             recs = self._drain_locked(max_records, runs)
         return recs, runs
 
-    def _drain_locked(self, max_records: int,
-                      runs: list | None = None) -> list[Record]:
+    def poll_many_batches(self, max_records: int):
+        """Batch-native drain: up to ``max_records`` without blocking,
+        returned as the raw queue chunks — zero-copy :class:`RecordBatch`
+        views of the fetcher's contiguous fetch slices and/or plain
+        ``list[Record]`` chunks (redelivered runs, record-mode leftovers)
+        — plus the (partition, start, count) ack runs, in record order.
+        The streaming worker's fast path: payload buffers go straight to
+        the wire shredder, Records are never materialized."""
+        runs: list[tuple[int, int, int]] = []
+        items: list = []
+        with self._buf_cond:
+            self._drain_locked(max_records, runs, items)
+        return items, runs
+
+    def _drain_locked(self, max_records: int, runs: list | None = None,
+                      items: list | None = None) -> list[Record]:
+        """Drain up to ``max_records`` under the buffer condition.  Returns
+        the drained records materialized (the poll/poll_many surface);
+        with ``items`` supplied the raw chunks (RecordBatch views / Record
+        lists) are appended there instead and the return list stays empty.
+        ``runs`` collects the drained ack runs either way."""
         out: list[Record] = []
-        while self._buf and len(out) < max_records:
+        taken = 0
+        while self._buf and taken < max_records:
             head = self._buf[0]
             avail = len(head) - self._head_pos
-            take = max_records - len(out)
-            if take >= avail:
-                chunk = head[self._head_pos:] if self._head_pos else head
-                self._buf.popleft()
-                self._head_pos = 0
-                self._buf_count -= avail
+            want = max_records - taken
+            take = avail if want >= avail else want
+            if isinstance(head, RecordBatch):
+                # zero-copy window; a RecordBatch is contiguous by contract
+                # so its run is O(1)
+                chunk = (head if take == len(head)
+                         else head.slice(self._head_pos, take))
+                if runs is not None:
+                    runs.append(chunk.run)
             else:
                 # partial drain: advance an index into the head batch (O(1)
                 # per-record consumption for poll() users; no reslicing)
-                chunk = head[self._head_pos: self._head_pos + take]
+                chunk = (head[self._head_pos: self._head_pos + take]
+                         if (self._head_pos or take < len(head)) else head)
+                if runs is not None and chunk:
+                    first, last = chunk[0], chunk[-1]
+                    if last.offset - first.offset == len(chunk) - 1:
+                        runs.append((first.partition, first.offset,
+                                     len(chunk)))
+                    else:  # gap inside a batch (compacted topic): exact
+                        runs.extend((r.partition, r.offset, 1)
+                                    for r in chunk)
+            if take == avail:
+                self._buf.popleft()
+                self._head_pos = 0
+            else:
                 self._head_pos += take
-                self._buf_count -= take
-            out.extend(chunk)
-            self._records_out += len(chunk)
-            if runs is not None and chunk:
-                first, last = chunk[0], chunk[-1]
-                if last.offset - first.offset == len(chunk) - 1:
-                    runs.append((first.partition, first.offset, len(chunk)))
-                else:  # gap inside a batch (compacted topic): exact per record
-                    runs.extend((r.partition, r.offset, 1) for r in chunk)
-        if out:
+            self._buf_count -= take
+            self._records_out += take
+            taken += take
+            if items is not None:
+                items.append(chunk)
+            elif isinstance(chunk, RecordBatch):
+                out.extend(chunk.to_records())
+            else:
+                out.extend(chunk)
+        if taken:
             self._buf_cond.notify_all()
         return out
 
-    def _put_batch(self, records: list[Record],
+    def _put_batch(self, records: "list[Record] | RecordBatch",
                    stop_event: threading.Event | None = None) -> bool:
-        """Fetcher side: enqueue one tracked batch, blocking while the
-        record-count bound is reached.  The bound is HARD (the reference's
+        """Fetcher side: enqueue one tracked batch (a Record list or a
+        zero-copy RecordBatch), blocking while the record-count bound is
+        reached.  The bound is HARD (the reference's
         maxQueuedRecordsInConsumer is a BlockingQueue capacity): an
         oversized batch is admitted in slices as space opens, never
         overshooting ``max_queued_records``.  Returns False when shut down
@@ -187,8 +245,10 @@ class SmartCommitConsumer:
         already-admitted slices may be redelivered — at-least-once allows
         the duplicates)."""
         pos = 0
+        n = len(records)
+        is_batch = isinstance(records, RecordBatch)
         with self._buf_cond:
-            while pos < len(records):
+            while pos < n:
                 space = self._buf_max - self._buf_count
                 if space <= 0:
                     if not self._running or (stop_event is not None
@@ -198,13 +258,19 @@ class SmartCommitConsumer:
                     self._buf_cond.wait(0.05)
                     self._put_stall_s += time.perf_counter() - t0
                     continue
-                part = records[pos: pos + space] if (pos or space < len(records) - pos) else records
+                take = min(space, n - pos)
+                if pos == 0 and take == n:
+                    part = records
+                elif is_batch:
+                    part = records.slice(pos, take)
+                else:
+                    part = records[pos: pos + take]
                 self._buf.append(part)
-                self._buf_count += len(part)
-                self._records_in += len(part)
+                self._buf_count += take
+                self._records_in += take
                 if self._buf_count > self._buf_hwm:
                     self._buf_hwm = self._buf_count
-                pos += len(part)
+                pos += take
                 self._buf_cond.notify_all()
         return True
 
@@ -276,6 +342,11 @@ class SmartCommitConsumer:
             "fetcher_error": self._fetcher_error,
             "broker_retries": self._broker_retries,
             "redelivered_records": self._redelivered,
+            "batch_ingest": self._batch_ingest,
+            "batch_fetches": self._batch_fetches,
+            "autotune": (self._autotune.snapshot()
+                         if self._autotune is not None
+                         else {"enabled": False}),
             "tracker": self.tracker.snapshot(),
         }
 
@@ -337,6 +408,15 @@ class SmartCommitConsumer:
                 break
             # contiguous run starting at i, clipped at the next page boundary
             start = records[i].offset
+            if i > 0 and start > records[i - 1].offset + 1:
+                # compacted-topic gap INSIDE the batch: those offsets can
+                # never be delivered or acked, and an un-ackable hole would
+                # park the commit frontier forever — skip them (marked
+                # delivered+acked; Kafka semantics: the committed offset may
+                # pass compacted-away offsets).  Any frontier advance rides
+                # the next real ack's broker commit.
+                tr.skip_run(partition, records[i - 1].offset + 1,
+                            start - records[i - 1].offset - 1)
             page_end_off = (start // page + 1) * page
             if contiguous:
                 j = i + min(n - i, page_end_off - start)
@@ -349,6 +429,35 @@ class SmartCommitConsumer:
             accepted_until = j
             i = j
         return records[:accepted_until] if accepted_until < n else records
+
+    def _track_run_batch(self, partition: int, pos: int,
+                         rb: RecordBatch) -> RecordBatch | None:
+        """Track one contiguous RecordBatch run, chunked at offset-tracker
+        page boundaries with a backpressure re-check per chunk — the batch
+        analog of :meth:`_track_batch` at the same granularity (the
+        open-page bound may be exceeded by at most the one page that trips
+        it).  A head gap (the batch starts past the fetch position:
+        offsets compacted away at the source) is pre-acked so the commit
+        frontier can cross it — the ack-correctness seam the RecordBatch
+        contiguity contract must honor.  Returns the accepted prefix as a
+        zero-copy slice, or None when backpressure admitted nothing."""
+        tr = self.tracker
+        start = rb.start_offset
+        if start > pos:
+            tr.skip_run(partition, pos, start - pos)
+        page = tr.page_size
+        end = start + len(rb)
+        off = start
+        while off < end:
+            if tr.is_backpressured(partition):
+                break
+            take = min(end, (off // page + 1) * page) - off
+            tr.track_run(partition, off, take)
+            off += take
+        accepted = off - start
+        if accepted == 0:
+            return None
+        return rb if accepted == len(rb) else rb.slice(0, accepted)
 
     def _refresh_assignment(self) -> None:
         gen = self.broker.generation(self.group_id, self._topic)
@@ -364,25 +473,26 @@ class SmartCommitConsumer:
             self.tracker.reset_partition(p, base)
 
     def _fetch_loop(self) -> None:
-        import logging
-
         try:
             self._fetch_loop_inner()
         except RetryInterrupted:
             pass  # close() during a fetch retry: clean shutdown
         except Exception as e:
             self._fetcher_error = repr(e)
-            logging.getLogger(__name__).exception(
+            logger.exception(
                 "consumer fetcher thread died; poll() will starve")
             raise
 
     def _fetch_loop_inner(self) -> None:
-        import time
-
-        from ..utils.tracing import stage
-
+        # feature-detect ONCE: batch-native fetch needs a broker with
+        # fetch_batch (FakeBroker, a batch-capable client, or a fault
+        # wrapper mirroring one); anything else rides the Record path
+        use_batch = (self._batch_ingest
+                     and callable(getattr(self.broker, "fetch_batch", None)))
         while self._running:
             self._refresh_assignment()
+            if self._autotune is not None:
+                self._apply_autotune()
             fetched = 0
             for p in list(self._assigned):
                 if not self._running:
@@ -394,6 +504,25 @@ class SmartCommitConsumer:
                     self._backpressure_skips += 1
                     continue
                 pos = self._positions.get(p, 0)
+                if use_batch:
+                    with stage("consumer.fetch"):
+                        rb = self._retry.call(
+                            lambda: self.broker.fetch_batch(
+                                self._topic, p, pos, self._fetch_max),
+                            stop_event=self._stop_event,
+                            on_retry=self._count_retry, label="broker.fetch")
+                    if rb is None or len(rb) == 0:
+                        continue
+                    self._batch_fetches += 1
+                    with stage("consumer.track"):
+                        rb = self._track_run_batch(p, pos, rb)
+                    if rb is None:
+                        continue
+                    if not self._put_batch(rb):
+                        break  # shutting down: position not advanced
+                    self._positions[p] = rb.start_offset + len(rb)
+                    fetched += len(rb)
+                    continue
                 with stage("consumer.fetch"):
                     # transient poll errors back off and retry in place;
                     # only a fatal-classified error (or retry-budget
@@ -404,6 +533,12 @@ class SmartCommitConsumer:
                         stop_event=self._stop_event,
                         on_retry=self._count_retry, label="broker.fetch")
                 with stage("consumer.track"):
+                    if records and records[0].offset > pos:
+                        # head gap (offsets compacted away at the source):
+                        # pre-ack so the frontier can cross it, mirroring
+                        # the interior-gap handling in _track_batch
+                        self.tracker.skip_run(p, pos,
+                                              records[0].offset - pos)
                     accepted = self._track_batch(p, records)
                 if not accepted:
                     continue
@@ -413,3 +548,16 @@ class SmartCommitConsumer:
                 fetched += len(accepted)
             if fetched == 0:
                 time.sleep(0.001)
+
+    def _apply_autotune(self) -> None:
+        """Tick the autotuner with the queue's cumulative counters and
+        apply the tuned knobs.  Raising the queue bound must wake a
+        fetcher/redelivery blocked on the old (smaller) bound — they
+        re-read ``_buf_max`` under the condition."""
+        tun = self._autotune
+        tun.observe(time.perf_counter(), self._records_in, self._records_out)
+        self._fetch_max = tun.fetch_max
+        if tun.queue_cap != self._buf_max:
+            with self._buf_cond:
+                self._buf_max = tun.queue_cap
+                self._buf_cond.notify_all()

@@ -39,10 +39,17 @@ class FaultInjectingBroker:
         self._rebalance_at = set(rebalance_on_fetch)
         self._fetch_n = 0
         self._lock = threading.Lock()
+        if not callable(getattr(inner, "fetch_batch", None)):
+            # shadow the class method so feature detection
+            # (callable(getattr(broker, "fetch_batch", None))) sees exactly
+            # what the inner broker offers
+            self.fetch_batch = None
 
     # -- faulted surface -----------------------------------------------------
-    def fetch(self, topic: str, partition: int, offset: int,
-              max_records: int = 500):
+    def _fetch_gate(self) -> None:
+        """Shared ordinal counting + rebalance/fault firing for both fetch
+        shapes: the schedule sees ONE stream of fetch ops, so an ordinal
+        fires regardless of which path the consumer rides."""
         with self._lock:
             self._fetch_n += 1
             n = self._fetch_n
@@ -50,7 +57,20 @@ class FaultInjectingBroker:
             self._gen_extra += 1
             self.schedule.note("rebalance", n)
         self.schedule.check("fetch")
+
+    def fetch(self, topic: str, partition: int, offset: int,
+              max_records: int = 500):
+        self._fetch_gate()
         return self.inner.fetch(topic, partition, offset, max_records)
+
+    def fetch_batch(self, topic: str, partition: int, offset: int,
+                    max_records: int = 2000):
+        """Batch-native fetch rides the same fault gate as :meth:`fetch`
+        (instances wrapping a broker without ``fetch_batch`` shadow this
+        method with None in ``__init__`` so feature detection matches the
+        inner broker)."""
+        self._fetch_gate()
+        return self.inner.fetch_batch(topic, partition, offset, max_records)
 
     def commit(self, group: str, topic: str, partition: int,
                offset: int) -> None:

@@ -178,6 +178,21 @@ class PagedOffsetTracker:
             t.ack_run(start, count)
             return t.advance()
 
+    def skip_run(self, partition: int, start: int, count: int) -> None:
+        """Mark [start, start+count) as never-deliverable (offsets
+        compacted away at the source): delivered AND acked in one pass,
+        so the commit frontier can cross the hole — an ack alone leaves
+        ``delivered_end`` behind on every page the gap covers and
+        ``advance()`` would park at the gap page forever (and the stuck
+        open pages would trip backpressure permanently).  Any frontier
+        advance is committed by the next real ack."""
+        if count <= 0:
+            return
+        with self._lock:
+            t = self._part(partition)
+            t.track_run(start, count)
+            t.ack_run(start, count)
+
     def committed(self, partition: int) -> int:
         with self._lock:
             return self._part(partition).committed

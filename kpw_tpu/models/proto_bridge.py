@@ -153,7 +153,7 @@ _WIRE_KINDS = {
 class _WirePlan:
     """Precomputed arrays driving kpw_proto_shred for a flat schema."""
 
-    __slots__ = ("fnum", "kinds", "flags", "dtypes", "optional")
+    __slots__ = ("fnum", "kinds", "flags", "dtypes", "optional", "_cont")
 
     def __init__(self, fnum, kinds, flags, dtypes, optional) -> None:
         self.fnum = fnum          # uint32 (n_fields,)
@@ -161,6 +161,8 @@ class _WirePlan:
         self.flags = flags        # uint8
         self.dtypes = dtypes      # numpy dtype or None (span) per field
         self.optional = optional  # bool per field (needs presence/def levels)
+        self._cont = None         # cached (fnum, kinds, flags) buffer forms
+        #                           for the C-extension shred_flat_buf entry
 
 
 # nested-plan kinds/flags — mirrored in kpw_tpu/native/src/shred_nested.cc
@@ -502,25 +504,10 @@ class ProtoColumnarizer:
             nplan = self._nested = self._nested_plan()
         return nplan is not None
 
-    def columnarize_payloads(self, payloads: list) -> ColumnBatch:
-        """Shred serialized (un-parsed) messages straight to a ColumnBatch
-        via the C++ wire decoders — no Python message objects.  Flat scalar
-        schemas ride kpw_proto_shred; anything else (repeated / nested /
-        enum) rides kpw_proto_shred_nested.  Raises WireShredError when any
-        record needs the Python fallback; raises ValueError when the schema
-        is not wire-capable (check :attr:`wire_capable` first)."""
-        if not self.wire_capable:
-            raise ValueError("schema is not wire-shreddable")
-        if self._wire is None:
-            return self._columnarize_payloads_nested(payloads)
-        plan: _WirePlan = self._wire
-        from ..native import lib as _native_lib, pyshred as _pyshred
-
-        L = _native_lib()
-        n = len(payloads)
-        nf = len(plan.fnum)
+    def _alloc_flat_outputs(self, plan: "_WirePlan", n: int):
+        """Per-field output arrays for one flat wire-shred call."""
         out_vals, out_pos, out_len, out_pres = [], [], [], []
-        for f in range(nf):
+        for f in range(len(plan.fnum)):
             dt = plan.dtypes[f]
             if dt is None:
                 out_vals.append(None)
@@ -531,33 +518,19 @@ class ProtoColumnarizer:
                 out_pos.append(None)
                 out_len.append(None)
             out_pres.append(np.zeros(n, np.uint8) if plan.optional[f] else None)
+        return out_vals, out_pos, out_len, out_pres
 
-        # zero-copy C-extension entry: reads the payload bytes objects in
-        # place (no b"".join, no fromiter length walk — ~35 ms per 300k
-        # records on the streaming hot path); span positions come back
-        # record-relative and strings gather straight into their final
-        # ByteColumn payload (one copy total)
-        pys = _pyshred()
-        buf = None
-        if pys is not None:
-            try:
-                err, total = pys.shred_flat(
-                    payloads, plan.fnum, plan.kinds, plan.flags,
-                    tuple(out_vals), tuple(out_pos), tuple(out_len),
-                    tuple(out_pres))
-            except TypeError:
-                pys = None  # non-bytes payloads: ctypes join path below
-        if pys is None:
-            lens = np.fromiter(map(len, payloads), np.int64, count=n)
-            offs = np.zeros(n + 1, np.int64)
-            np.cumsum(lens, out=offs[1:])
-            buf = b"".join(payloads)
-            total = int(offs[-1])
-            err = L.proto_shred(buf, offs, nf, plan.fnum, plan.kinds,
-                                plan.flags, out_vals, out_pos, out_len,
-                                out_pres)
-        if err >= 0:
-            raise WireShredError(int(err))
+    def _flat_chunks(self, plan: "_WirePlan", n: int, out_vals, out_pos,
+                     out_len, out_pres, pys, payloads, buf, L,
+                     gather_buf=None) -> list:
+        """Assemble ColumnChunkData from flat shredder outputs.  With
+        ``pys`` the span positions are record-relative and strings gather
+        from the payload objects (gather_iov); on the contiguous path
+        (``pys=None``) positions are absolute into ``buf`` and strings
+        gather with ``gather_buf`` (the C extension's GIL-releasing
+        gather) or ctypes gather_spans.  One shared implementation: the
+        RecordBatch buffer path and the payload-list path must stay
+        byte-identical by construction."""
         all_recs = None
         chunks = []
         for f, col in enumerate(self.schema.columns):
@@ -581,6 +554,10 @@ class ProtoColumnarizer:
                 np.cumsum(ln, out=offsets[1:])
                 if pys is not None:
                     payload = pys.gather_iov(payloads, rec_idx, pos, ln)
+                elif gather_buf is not None:
+                    payload = gather_buf(
+                        buf, np.ascontiguousarray(pos, np.int64),
+                        np.ascontiguousarray(ln, np.int32))
                 else:
                     payload = L.gather_spans(buf, pos, ln)
                 values = ByteColumn(payload, offsets)
@@ -589,24 +566,135 @@ class ProtoColumnarizer:
                 if pres is not None:
                     values = values[mask]
             chunks.append(ColumnChunkData(col, values, def_levels, None, n))
+        return chunks
+
+    def columnarize_payloads(self, payloads: list) -> ColumnBatch:
+        """Shred serialized (un-parsed) messages straight to a ColumnBatch
+        via the C++ wire decoders — no Python message objects.  Flat scalar
+        schemas ride kpw_proto_shred; anything else (repeated / nested /
+        enum) rides kpw_proto_shred_nested.  Raises WireShredError when any
+        record needs the Python fallback; raises ValueError when the schema
+        is not wire-capable (check :attr:`wire_capable` first)."""
+        if not self.wire_capable:
+            raise ValueError("schema is not wire-shreddable")
+        n = len(payloads)
+        if self._wire is None:
+            lens = np.fromiter(map(len, payloads), np.int64, count=n)
+            offs = np.zeros(n + 1, np.int64)
+            np.cumsum(lens, out=offs[1:])
+            return self._shred_nested(b"".join(payloads), offs)
+        plan: _WirePlan = self._wire
+        from ..native import lib as _native_lib, pyshred as _pyshred
+
+        L = _native_lib()
+        out_vals, out_pos, out_len, out_pres = \
+            self._alloc_flat_outputs(plan, n)
+
+        # zero-copy C-extension entry: reads the payload bytes objects in
+        # place (no b"".join, no fromiter length walk — ~35 ms per 300k
+        # records on the streaming hot path); span positions come back
+        # record-relative and strings gather straight into their final
+        # ByteColumn payload (one copy total)
+        pys = _pyshred()
+        buf = None
+        if pys is not None:
+            try:
+                err, total = pys.shred_flat(
+                    payloads, plan.fnum, plan.kinds, plan.flags,
+                    tuple(out_vals), tuple(out_pos), tuple(out_len),
+                    tuple(out_pres))
+            except TypeError:
+                pys = None  # non-bytes payloads: ctypes join path below
+        if pys is None:
+            lens = np.fromiter(map(len, payloads), np.int64, count=n)
+            offs = np.zeros(n + 1, np.int64)
+            np.cumsum(lens, out=offs[1:])
+            buf = b"".join(payloads)
+            total = int(offs[-1])
+            err = L.proto_shred(buf, offs, len(plan.fnum), plan.fnum,
+                                plan.kinds, plan.flags, out_vals, out_pos,
+                                out_len, out_pres)
+        if err >= 0:
+            raise WireShredError(int(err))
+        chunks = self._flat_chunks(plan, n, out_vals, out_pos, out_len,
+                                   out_pres, pys, payloads, buf, L)
         batch = ColumnBatch(chunks, n)
         batch.wire_bytes = int(total)  # payload bytes, for byte metering
         return batch
 
-    def _columnarize_payloads_nested(self, payloads: list) -> ColumnBatch:
-        """Nested/repeated/enum wire shred via kpw_proto_shred_nested; the
-        output (values for present entries + per-visit def/rep levels) is
-        element-identical to :meth:`columnarize` over the parsed messages
-        (asserted by tests/test_nested_shred.py)."""
+    def columnarize_buffer(self, buf, offsets) -> ColumnBatch:
+        """Batch-native zero-copy intake: shred serialized records that
+        already live in ONE contiguous buffer (record i =
+        ``buf[offsets[i]:offsets[i+1]]``; int64 offsets of length n+1,
+        ascending, ``offsets[0]`` may be nonzero — a RecordBatch slice
+        shares its parent's buffer) straight to a ColumnBatch.  This is
+        the :class:`~kpw_tpu.ingest.broker.RecordBatch` handoff's
+        consumer: no per-record ``bytes`` objects, no join — the broker's
+        fetch buffer goes to the C++ shredder as-is.  Output is
+        byte-identical to :meth:`columnarize_payloads` over the same
+        records (shared assembly, pinned by test_batch_ingest).  Raises
+        WireShredError / ValueError exactly like
+        :meth:`columnarize_payloads`."""
+        if not self.wire_capable:
+            raise ValueError("schema is not wire-shreddable")
+        buf = bytes(buf)  # no-op for bytes; one copy for memoryview input
+        offs = np.ascontiguousarray(offsets, np.int64)
+        n = len(offs) - 1
+        # validate the caller-supplied offset table before any decoder
+        # (C entries re-check too, but the ctypes and nested routes read
+        # it raw): one malformed interior offset is an out-of-bounds read
+        if n > 0 and (int(offs[0]) < 0 or int(offs[-1]) > len(buf)
+                      or not bool((np.diff(offs) >= 0).all())):
+            raise ValueError(
+                "offsets must be ascending and within the buffer")
+        if self._wire is None:
+            return self._shred_nested(buf, offs)
+        plan: _WirePlan = self._wire
+        from ..native import lib as _native_lib, pyshred as _pyshred
+
+        L = _native_lib()
+        out_vals, out_pos, out_len, out_pres = \
+            self._alloc_flat_outputs(plan, n)
+        # prefer the C-extension entry (shred_flat_buf/gather_buf): decode
+        # and gather run with the GIL RELEASED, so the encode pipeline
+        # thread overlaps them — the ctypes route's per-call marshalling
+        # was measurable GIL pressure on the 2-core streaming path
+        pys = _pyshred()
+        shred_buf = getattr(pys, "shred_flat_buf", None)
+        gather_buf = getattr(pys, "gather_buf", None)
+        if shred_buf is not None:
+            if not plan._cont:
+                plan._cont = (np.ascontiguousarray(plan.fnum, np.uint32),
+                              bytes(np.ascontiguousarray(plan.kinds, np.uint8)),
+                              bytes(np.ascontiguousarray(plan.flags, np.uint8)))
+            fnum_c, kinds_c, flags_c = plan._cont
+            err, _ = shred_buf(buf, offs, fnum_c, kinds_c, flags_c,
+                               tuple(out_vals), tuple(out_pos),
+                               tuple(out_len), tuple(out_pres))
+        else:
+            err = L.proto_shred(buf, offs, len(plan.fnum), plan.fnum,
+                                plan.kinds, plan.flags, out_vals, out_pos,
+                                out_len, out_pres)
+        if err >= 0:
+            raise WireShredError(int(err))
+        chunks = self._flat_chunks(plan, n, out_vals, out_pos, out_len,
+                                   out_pres, None, None, buf, L,
+                                   gather_buf=gather_buf)
+        batch = ColumnBatch(chunks, n)
+        batch.wire_bytes = int(offs[-1] - offs[0])
+        return batch
+
+    def _shred_nested(self, buf: bytes, offs: np.ndarray) -> ColumnBatch:
+        """Nested/repeated/enum wire shred via kpw_proto_shred_nested over
+        a contiguous buffer + record offsets; the output (values for
+        present entries + per-visit def/rep levels) is element-identical
+        to :meth:`columnarize` over the parsed messages (asserted by
+        tests/test_nested_shred.py)."""
         from ..native import lib as _native_lib
 
         plan: _NestedPlan = self._nested
         L = _native_lib()
-        n = len(payloads)
-        lens = np.fromiter(map(len, payloads), np.int64, count=n)
-        offs = np.zeros(n + 1, np.int64)
-        np.cumsum(lens, out=offs[1:])
-        buf = b"".join(payloads)
+        n = len(offs) - 1
         res = L.proto_shred_nested(buf, offs, plan)
         if isinstance(res, int):
             raise WireShredError(res)
@@ -634,7 +722,7 @@ class ProtoColumnarizer:
         finally:
             res.close()
         batch = ColumnBatch(chunks, n)
-        batch.wire_bytes = int(offs[-1])
+        batch.wire_bytes = int(offs[-1] - offs[0])
         return batch
 
     @staticmethod

@@ -32,6 +32,13 @@ int64_t kpw_proto_shred_iov(const uint8_t* const* ptrs, const int64_t* lens,
 void kpw_gather_spans_iov(const uint8_t* const* ptrs, const int32_t* rec_idx,
                           const int64_t* pos, const int32_t* len, int64_t n,
                           uint8_t* out);
+int64_t kpw_proto_shred(const uint8_t* buf, const int64_t* offs,
+                        int64_t n_rec, int32_t n_fields, const uint32_t* fnum,
+                        const uint8_t* kind, const uint8_t* flags,
+                        void* const* out_vals, int64_t* const* out_pos,
+                        int32_t* const* out_len, uint8_t* const* out_pres);
+void kpw_gather_spans(const uint8_t* src, const int64_t* pos,
+                      const int32_t* len, int64_t n, uint8_t* out);
 }
 
 namespace {
@@ -174,11 +181,124 @@ PyObject* py_gather_iov(PyObject*, PyObject* args) {
   return out;
 }
 
+// shred_flat_buf(buf, offs i64 buffer (n_rec+1, ascending; offs[0] may be
+// nonzero — a RecordBatch slice window), fnum, kinds, flags, vals_t,
+// pos_t, len_t, pres_t) -> (rc, total).  The batch-native ingest entry:
+// one contiguous fetch buffer goes to the decoder AS-IS (no per-record
+// bytes objects, no join), GIL released around the decode like
+// shred_flat — the ctypes route's Python-side marshalling per call was
+// measurable GIL pressure against the encode pipeline thread.
+PyObject* py_shred_flat_buf(PyObject*, PyObject* args) {
+  PyObject *buf_o, *offs_o, *fnum_o, *kinds_o, *flags_o;
+  PyObject *vals_t, *pos_t, *len_t, *pres_t;
+  if (!PyArg_ParseTuple(args, "OOOOOO!O!O!O!", &buf_o, &offs_o, &fnum_o,
+                        &kinds_o, &flags_o, &PyTuple_Type, &vals_t,
+                        &PyTuple_Type, &pos_t, &PyTuple_Type, &len_t,
+                        &PyTuple_Type, &pres_t))
+    return nullptr;
+  BufferSet bufs;
+  void *buf_p, *offs_p, *fnum_p, *kinds_p, *flags_p;
+  if (!bufs.get(buf_o, &buf_p, PyBUF_SIMPLE) ||
+      !bufs.get(offs_o, &offs_p, PyBUF_SIMPLE) ||
+      !bufs.get(fnum_o, &fnum_p, PyBUF_SIMPLE) ||
+      !bufs.get(kinds_o, &kinds_p, PyBUF_SIMPLE) ||
+      !bufs.get(flags_o, &flags_p, PyBUF_SIMPLE))
+    return nullptr;
+  // record count from the offsets buffer's own view (len n_rec + 1)
+  Py_ssize_t n_rec = bufs.views[1].len / Py_ssize_t(sizeof(int64_t)) - 1;
+  if (n_rec < 0) {
+    PyErr_SetString(PyExc_ValueError, "offs must hold >= 1 int64");
+    return nullptr;
+  }
+  const int64_t* offs = static_cast<const int64_t*>(offs_p);
+  if (n_rec > 0 && (offs[0] < 0 ||
+                    offs[n_rec] > int64_t(bufs.views[0].len))) {
+    PyErr_SetString(PyExc_ValueError, "offs out of buffer bounds");
+    return nullptr;
+  }
+  // full ascending walk, not just the end points: one malformed interior
+  // offset would otherwise send the decoder out of buffer bounds
+  for (Py_ssize_t i = 0; i < n_rec; i++) {
+    if (offs[i + 1] < offs[i]) {
+      PyErr_SetString(PyExc_ValueError, "offs must be ascending");
+      return nullptr;
+    }
+  }
+  Py_ssize_t nf = PyTuple_GET_SIZE(vals_t);
+  if (PyTuple_GET_SIZE(pos_t) != nf || PyTuple_GET_SIZE(len_t) != nf ||
+      PyTuple_GET_SIZE(pres_t) != nf) {
+    PyErr_SetString(PyExc_ValueError, "output tuples must align");
+    return nullptr;
+  }
+  std::vector<void*> vals(nf);
+  std::vector<int64_t*> pos(nf);
+  std::vector<int32_t*> lenp(nf);
+  std::vector<uint8_t*> pres(nf);
+  for (Py_ssize_t f = 0; f < nf; f++) {
+    void *a, *b, *c, *d;
+    if (!bufs.get(PyTuple_GET_ITEM(vals_t, f), &a) ||
+        !bufs.get(PyTuple_GET_ITEM(pos_t, f), &b) ||
+        !bufs.get(PyTuple_GET_ITEM(len_t, f), &c) ||
+        !bufs.get(PyTuple_GET_ITEM(pres_t, f), &d))
+      return nullptr;
+    vals[f] = a;
+    pos[f] = static_cast<int64_t*>(b);
+    lenp[f] = static_cast<int32_t*>(c);
+    pres[f] = static_cast<uint8_t*>(d);
+  }
+  int64_t total = n_rec > 0 ? offs[n_rec] - offs[0] : 0;
+  int64_t rc;
+  Py_BEGIN_ALLOW_THREADS
+  rc = kpw_proto_shred(static_cast<const uint8_t*>(buf_p), offs, n_rec,
+                       int32_t(nf), static_cast<const uint32_t*>(fnum_p),
+                       static_cast<const uint8_t*>(kinds_p),
+                       static_cast<const uint8_t*>(flags_p), vals.data(),
+                       pos.data(), lenp.data(), pres.data());
+  Py_END_ALLOW_THREADS
+  return Py_BuildValue("LL", static_cast<long long>(rc),
+                       static_cast<long long>(total));
+}
+
+// gather_buf(buf, pos i64 buffer, len i32 buffer) -> bytes: span
+// concatenation out of ONE contiguous buffer (absolute positions, the
+// shred_flat_buf counterpart of gather_iov), GIL released around the copy.
+PyObject* py_gather_buf(PyObject*, PyObject* args) {
+  PyObject *buf_o, *pos_o, *len_o;
+  if (!PyArg_ParseTuple(args, "OOO", &buf_o, &pos_o, &len_o)) return nullptr;
+  if (pos_o == Py_None || len_o == Py_None) {
+    PyErr_SetString(PyExc_TypeError,
+                    "gather_buf: pos/len buffers must not be None");
+    return nullptr;
+  }
+  BufferSet bufs;
+  void *buf_p, *pos_p, *len_p;
+  if (!bufs.get(buf_o, &buf_p, PyBUF_SIMPLE) ||
+      !bufs.get(pos_o, &pos_p, PyBUF_SIMPLE) ||
+      !bufs.get(len_o, &len_p, PyBUF_SIMPLE))
+    return nullptr;
+  Py_ssize_t n = bufs.views[2].len / Py_ssize_t(sizeof(int32_t));
+  const int32_t* ln = static_cast<const int32_t*>(len_p);
+  int64_t out_len = 0;
+  for (Py_ssize_t i = 0; i < n; i++) out_len += ln[i];
+  PyObject* out = PyBytes_FromStringAndSize(nullptr, out_len);
+  if (out == nullptr) return nullptr;
+  uint8_t* dst = reinterpret_cast<uint8_t*>(PyBytes_AS_STRING(out));
+  Py_BEGIN_ALLOW_THREADS
+  kpw_gather_spans(static_cast<const uint8_t*>(buf_p),
+                   static_cast<const int64_t*>(pos_p), ln, n, dst);
+  Py_END_ALLOW_THREADS
+  return out;
+}
+
 PyMethodDef methods[] = {
     {"shred_flat", py_shred_flat, METH_VARARGS,
      "Zero-copy flat wire shred over a list of payload bytes."},
     {"gather_iov", py_gather_iov, METH_VARARGS,
      "Concatenate spans (rec_idx, pos, len) from payload bytes -> bytes."},
+    {"shred_flat_buf", py_shred_flat_buf, METH_VARARGS,
+     "Flat wire shred over one contiguous buffer + record offsets."},
+    {"gather_buf", py_gather_buf, METH_VARARGS,
+     "Concatenate spans (pos, len) from one contiguous buffer -> bytes."},
     {nullptr, nullptr, 0, nullptr}};
 
 PyModuleDef moduledef = {PyModuleDef_HEAD_INIT, "_kpw_pyshred",

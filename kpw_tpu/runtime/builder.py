@@ -39,6 +39,15 @@ class Builder:
         self._offset_tracker_page_size = 300_000  # (:466)
         self._offset_tracker_max_open_pages: int | None = None  # derived (:735-746)
         self._max_queued_records = 100_000  # (:468)
+        self._fetch_max_records = 2000  # per broker fetch (seed when autotuned)
+        # batch-native ingest: RecordBatch handoff broker -> queue -> wire
+        # shredder (contiguous buffer + offsets, no per-record objects);
+        # engages automatically when the broker offers fetch_batch AND the
+        # wire fast path is live, else the per-record Record route runs
+        self._batch_ingest = True
+        # backpressure autotuning: derive fetch size / queue depth / poll
+        # batch from measured stage rates (off = reference's fixed knobs)
+        self._autotune = False
         self._block_size = 128 * 1024 * 1024  # (:473)
         self._page_size = 1024 * 1024  # sane default; NOT the reference quirk
         self._codec = 0  # UNCOMPRESSED (:484)
@@ -160,6 +169,45 @@ class Builder:
 
     def max_queued_records_in_consumer(self, n: int) -> "Builder":
         self._max_queued_records = n
+        return self
+
+    def fetch_max_records(self, n: int) -> "Builder":
+        """Records per broker fetch round (the reference's fetch sizing is
+        Kafka client config; here it is explicit).  With :meth:`autotune`
+        this is only the seed — the live value follows the measured drain
+        rate."""
+        if n < 1:
+            raise ValueError("fetch_max_records must be >= 1")
+        self._fetch_max_records = n
+        return self
+
+    def batch_ingest(self, flag: bool) -> "Builder":
+        """Batch-native zero-copy ingest (default ON): the consumer fetches
+        ``RecordBatch`` pages (one contiguous payload buffer + offset
+        table per fetch, no per-record ``Record`` construction), the
+        bounded queue carries them intact, acks ride their (partition,
+        start, count) runs, and the wire shredder consumes buffer+offsets
+        directly.  Requires a batch-capable broker (``fetch_batch``) and
+        the wire fast path; anything else silently rides the per-record
+        compatibility route, which also remains the poison-pill fallback.
+        Pin False to force the per-record ``Record`` path everywhere
+        (byte-identical output — pinned by test_batch_ingest)."""
+        self._batch_ingest = flag
+        return self
+
+    def autotune(self, flag: bool = True) -> "Builder":
+        """Backpressure autotuning (default OFF — reference parity is the
+        fixed constants): derive the ingest knobs from measured stage
+        rates instead of ``fetch_max_records`` / ``max_queued_records`` /
+        ``batch_size`` as configured.  The fetcher sizes each fetch to
+        ~50 ms of the queue's measured drain rate and the queue bound to
+        ~0.5 s of it (never above the configured ``max_queued_records`` —
+        that stays a hard ceiling); each worker sizes its poll batch to
+        ~50 ms of its own measured shred+append rate, still clipped by
+        the rotation-overshoot cap.  Tuned values and the rates that
+        produced them are surfaced in ``stats()['consumer']['autotune']``
+        and per-worker ``poll_batch``/``proc_rate_rps``."""
+        self._autotune = flag
         return self
 
     # -- parquet properties ------------------------------------------------

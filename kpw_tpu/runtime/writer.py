@@ -27,10 +27,13 @@ import time
 from datetime import datetime
 
 from ..core.writer import PipelineError
+from ..ingest.autotune import IngestAutotuner
+from ..ingest.broker import RecordBatch
 from ..ingest.consumer import SmartCommitConsumer
 from ..ingest.offsets import PartitionOffset
-from ..models.proto_bridge import ProtoColumnarizer
+from ..models.proto_bridge import ProtoColumnarizer, WireShredError
 from ..utils import tracing
+from ..utils.tracing import stage
 from . import metrics as M
 from .parquet_file import ParquetFile
 from .retry import RetryInterrupted, RetryPolicy
@@ -91,13 +94,22 @@ class KafkaProtoParquetWriter:
         # consumer broker IO): infinite-attempt backoff with fatal-errno
         # classification by default; Builder.retry_policy overrides
         self.retry_policy = b._retry_policy or RetryPolicy()
+        # backpressure autotuning (opt-in): one tuner shared by the
+        # consumer's fetch loop (fetch size, queue depth) and the workers'
+        # poll sizing, all derived from measured stage rates
+        self.autotuner = (IngestAutotuner(b._fetch_max_records,
+                                          b._max_queued_records)
+                          if b._autotune else None)
         self.consumer = SmartCommitConsumer(
             broker=b._broker,
             group_id=b._group_id,
             page_size=b._offset_tracker_page_size,
             max_open_pages_per_partition=b._offset_tracker_max_open_pages,
             max_queued_records=b._max_queued_records,
+            fetch_max_records=b._fetch_max_records,
             retry_policy=self.retry_policy,
+            batch_ingest=b._batch_ingest,
+            autotuner=self.autotuner,
         )
         self.consumer.subscribe(b._topic)
         self._workers: list[_Worker] = []
@@ -771,6 +783,10 @@ class _Worker:
         # encoded-bytes/record estimate carried across rotations so every
         # file (not just the first's successors) rotates tightly
         self._carry_est = 64.0
+        # measured shred+append rate (records/s EWMA) and the poll batch
+        # it produced — the worker half of backpressure autotuning
+        self._proc_rate = 0.0
+        self._last_poll_batch = 0
         # ack-lag accounting: records in _written_runs (written, not yet
         # acked) and when the oldest of them was first written.  Written by
         # this worker thread only; the parent's ack_lag() reads them
@@ -871,9 +887,14 @@ class _Worker:
             # disqualifies the raw-bytes path.
             use_wire = (getattr(b, "_parser_is_default", False)
                         and self.p.columnarizer.wire_capable)
+            # batch-native poll: drain RecordBatch views (contiguous buffer
+            # + offsets, no Record materialization) straight into the wire
+            # shredder — only meaningful when the wire path is live, since
+            # the Python parse path needs Records anyway
+            use_batch = use_wire and getattr(b, "_batch_ingest", True)
             while not self._stop.is_set():
                 try:
-                    self._loop_once(b, poll_batch_base, use_wire)
+                    self._loop_once(b, poll_batch_base, use_wire, use_batch)
                 except (OSError, PipelineError) as e:
                     # degraded_mode: a fatal-classified sink condition
                     # (full disk, read-only remount) pauses this worker —
@@ -924,7 +945,8 @@ class _Worker:
                                      "(ignored)", self.index)
                 self.current_file = None
 
-    def _loop_once(self, b, poll_batch_base: int, use_wire: bool) -> None:
+    def _loop_once(self, b, poll_batch_base: int, use_wire: bool,
+                   use_batch: bool = False) -> None:
         """One poll→parse→write→rotate iteration (the body of the
         reference's worker loop, KPW.java:253-292), extracted so the
         degraded-mode pause seam can wrap exactly one iteration."""
@@ -937,22 +959,52 @@ class _Worker:
         # the 64 B-based record count — 4-5x smaller batches than
         # the size band needs, and per-batch shred/append overhead
         # dominated the measured rate (VERDICT r3 next #8)
+        tuner = self.p.autotuner
+        if tuner is not None:
+            # autotuned poll sizing: this worker's own measured
+            # processing rate over the tuner's poll horizon, instead of
+            # the fixed batch_size constant
+            poll_batch_base = tuner.poll_batch(self._proc_rate)
         poll_batch = min(poll_batch_base, _rotation_batch_cap(
             b._max_file_size, max(8.0, self._carry_est)))
-        recs, runs = self.p.consumer.poll_many_runs(
-            self._poll_cap(poll_batch))
-        if not recs:
-            time.sleep(0.001)
-            return
-        # consumed from the queue: from here until these runs are
-        # folded into _written_runs (or individually acked) they
-        # are redeliverable only through held_runs()
-        self._inflight_runs = runs
-        if use_wire and self._try_wire_batch(recs, runs):
-            self._inflight_runs = []
-            if self._is_file_full():
-                self._finalize_current_file()
-            return
+        self._last_poll_batch = poll_batch
+        if use_batch:
+            items, runs = self.p.consumer.poll_many_batches(
+                self._poll_cap(poll_batch))
+            if not items:
+                time.sleep(0.001)
+                return
+            t0 = time.perf_counter()
+            # consumed from the queue: from here until these runs are
+            # folded into _written_runs (or individually acked) they
+            # are redeliverable only through held_runs()
+            self._inflight_runs = runs
+            if self._try_wire_items(items, runs):
+                self._inflight_runs = []
+                self._note_proc_rate(sum(c for _, _, c in runs), t0)
+                if self._is_file_full():
+                    self._finalize_current_file()
+                return
+            # wire fallback (a record the shredder could not prove clean):
+            # materialize Records and re-run the batch on the exact
+            # per-record path below, which owns the poison-pill policies
+            recs = [r for it in items
+                    for r in (it.to_records()
+                              if isinstance(it, RecordBatch) else it)]
+        else:
+            recs, runs = self.p.consumer.poll_many_runs(
+                self._poll_cap(poll_batch))
+            if not recs:
+                time.sleep(0.001)
+                return
+            t0 = time.perf_counter()
+            self._inflight_runs = runs
+            if use_wire and self._try_wire_items([recs], runs):
+                self._inflight_runs = []
+                self._note_proc_rate(len(recs), t0)
+                if self._is_file_full():
+                    self._finalize_current_file()
+                return
         parsed = []  # (record, message) — parsed in bulk so the
         # per-record loop overhead amortizes (design capacity is
         # 300k rec/s/instance, KPW.java:463)
@@ -1084,20 +1136,32 @@ class _Worker:
                 "resume redelivery failed; the offsets stay un-acked and "
                 "redeliver on the next start")
 
-    def _try_wire_batch(self, recs, runs) -> bool:
-        """Shred a poll batch through the native wire decoder and append it
-        columnar.  ``runs`` is the batch as (partition, start, count) runs
-        from poll_many_runs — ack bookkeeping and byte metering fold whole
-        runs instead of walking 150k records per second in Python.  Returns
-        False when any record needs the Python fallback (the whole batch
-        re-runs there; shredder outputs are discarded)."""
-        from ..models.proto_bridge import WireShredError
-        from ..utils.tracing import stage
-
+    def _try_wire_items(self, items, runs) -> bool:
+        """Shred a poll's worth of queue chunks through the native wire
+        decoder and append them columnar.  ``items`` mixes zero-copy
+        RecordBatch views (batch-native ingest: buffer + offsets straight
+        to the C++ shredder, no per-record bytes lists) and plain Record
+        lists (the compatibility route / redelivered runs); ``runs`` is
+        the whole poll as (partition, start, count) ack runs — bookkeeping
+        and byte metering fold whole runs instead of walking 150k records
+        per second in Python.  Returns False when any record needs the
+        Python fallback (the whole poll re-runs there; shredder outputs
+        are discarded — nothing was appended yet)."""
+        col = self.p.columnarizer
+        batches = []
+        nrecs = 0
+        nbytes = 0
         try:
             with stage("worker.shred"):
-                batch = self.p.columnarizer.columnarize_payloads(
-                    [r.value for r in recs])
+                for it in items:
+                    if isinstance(it, RecordBatch):
+                        cb = col.columnarize_buffer(it.payload, it.offsets)
+                    else:
+                        cb = col.columnarize_payloads([r.value for r in it])
+                    batches.append(cb)
+                    nrecs += cb.num_rows
+                    nbytes += (cb.wire_bytes if cb.wire_bytes is not None
+                               else sum(len(r.value) for r in it))
         except WireShredError:
             return False
         if self.current_file is None:
@@ -1106,15 +1170,22 @@ class _Worker:
         # buffer are OLDER than this batch — hand them to the writer first
         self._retry(self.current_file.flush_buffered, "flush_buffered")
         with stage("worker.append"):
-            self.current_file.append_batch(batch)  # pure memory
+            for cb in batches:
+                self.current_file.append_batch(cb)  # pure memory
         self._retry(self.current_file.maybe_flush_row_group, "flush")
         self._note_written_runs(runs)
-        self.p._written_records.mark(len(recs))
-        self.p._written_bytes.mark(batch.wire_bytes
-                                   if batch.wire_bytes is not None
-                                   else sum(len(r.value) for r in recs))
-        self._file_records += len(recs)
+        self.p._written_records.mark(nrecs)
+        self.p._written_bytes.mark(nbytes)
+        self._file_records += nrecs
         return True
+
+    def _note_proc_rate(self, n: int, t0: float) -> None:
+        """EWMA of this worker's shred+append processing rate (records/s,
+        poll-to-appended) — the autotuner's poll-sizing input."""
+        dt = time.perf_counter() - t0
+        if dt <= 0 or n <= 0:
+            return
+        self._proc_rate += 0.3 * (n / dt - self._proc_rate)
 
     def _note_written(self, records) -> None:
         """Fold records into the held ack runs (extends the last run when
@@ -1258,6 +1329,8 @@ class _Worker:
             "unacked_records": self._unacked_count,
             "oldest_unacked_age_s": (round(time.time() - ts, 6)
                                      if ts is not None else 0.0),
+            "proc_rate_rps": round(self._proc_rate, 1),
+            "poll_batch": self._last_poll_batch,
             "pipeline": tot,
         }
 
@@ -1334,7 +1407,12 @@ class _Worker:
         self._oldest_unacked_ts = None
 
     def _rename_and_move(self, tmp_path: str) -> None:
-        # (KPW.java:359-378)
+        # (KPW.java:359-378); spanned as one publish stage so the e2e
+        # stall breakdown can attribute verify+rename time per file
+        with stage("worker.publish"):
+            self._rename_and_move_inner(tmp_path)
+
+    def _rename_and_move_inner(self, tmp_path: str) -> None:
         if self.p._b._verify_on_publish:
             # independent read-back BEFORE the rename: a structurally
             # invalid tmp (bad encode, torn write a retry never healed)

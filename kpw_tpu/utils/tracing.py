@@ -41,6 +41,7 @@ STAGE_NAMES = (
     "consumer.track",
     "worker.shred",
     "worker.append",
+    "worker.publish",
     "rowgroup.encode",
     "rowgroup.launch",
     "rowgroup.assemble",

@@ -1,0 +1,410 @@
+"""Batch-native zero-copy ingest: RecordBatch handoff broker -> queue ->
+wire shredder.
+
+The contract under test, end to end:
+
+* ``FakeBroker.produce_many`` / ``fetch_batch`` place and return records
+  exactly like a ``produce()`` loop / per-record ``fetch`` would,
+* the bounded queue's hard record-count bound holds for RecordBatch
+  slices exactly as for Record lists,
+* ``poll_many_runs`` on a GAPPED (compacted-topic) batch falls back to
+  exact per-record runs and acking those runs advances the commit
+  frontier across the gap — the ack-correctness seam the RecordBatch
+  contiguity contract must honor,
+* the RecordBatch path and the per-record ``Record`` fallback path
+  produce IDENTICAL published parquet bytes for the same input stream,
+* the full writer streams the batch path to ack-lag exactly 0 with the
+  same published content as the pinned-off Record path, and the PR-3
+  chaos invariant (acked ⊆ published, in structurally verified files)
+  holds with the batch path enabled under injected faults.
+"""
+
+import collections
+import errno
+import time
+
+import numpy as np
+import pyarrow.parquet as pq
+
+from kpw_tpu import Builder, FakeBroker, MemoryFileSystem, RecordBatch
+from kpw_tpu.ingest import SmartCommitConsumer
+from kpw_tpu.ingest.broker import Record
+from kpw_tpu.models.proto_bridge import ProtoColumnarizer
+from kpw_tpu.runtime.parquet_file import ParquetFile
+
+from proto_helpers import sample_message_class
+
+from test_chaos import assert_at_least_once_invariant, run_chaos
+
+
+def _payloads(rows, pad=0):
+    cls = sample_message_class()
+    filler = "x" * pad
+    return cls, [cls(query=f"q-{i}-{filler}", timestamp=i).SerializeToString()
+                 for i in range(rows)]
+
+
+# -- broker batch surface ----------------------------------------------------
+
+def test_produce_many_matches_produce_loop():
+    _, payloads = _payloads(100)
+    a, b = FakeBroker(), FakeBroker()
+    a.create_topic("t", 3)
+    b.create_topic("t", 3)
+    placement = a.produce_many("t", payloads)
+    for p in payloads:
+        b.produce("t", p)
+    for part in range(3):
+        assert ([r.value for r in a.fetch("t", part, 0, 999)]
+                == [r.value for r in b.fetch("t", part, 0, 999)])
+    assert sum(n for _, n in placement.values()) == 100
+    # single-partition form: one contiguous run, correct first offset
+    out = a.produce_many("t", payloads[:7], partition=1)
+    (first, n), = out.values()
+    assert n == 7 and first == a.end_offset("t", 1) - 7
+
+
+def test_fetch_batch_matches_fetch():
+    _, payloads = _payloads(50)
+    broker = FakeBroker()
+    broker.create_topic("t", 1)
+    broker.produce_many("t", payloads)
+    recs = broker.fetch("t", 0, 10, 20)
+    rb = broker.fetch_batch("t", 0, 10, 20)
+    assert isinstance(rb, RecordBatch)
+    assert rb.run == (0, 10, 20)
+    assert [rb.payload_at(i) for i in range(len(rb))] == [r.value for r in recs]
+    # zero-copy slice shares the buffer, rebases the run
+    s = rb.slice(5, 10)
+    assert s.payload is rb.payload
+    assert s.run == (0, 15, 10)
+    assert [r.offset for r in s.to_records()] == list(range(15, 25))
+    assert [r.value for r in s.to_records()] == [r.value for r in recs[5:15]]
+    # exhausted position -> None
+    assert broker.fetch_batch("t", 0, 50, 10) is None
+
+
+def test_queue_bound_hard_with_batches():
+    """max_queued_records stays a hard bound when the queue carries
+    RecordBatch slices (the batch analog of
+    test_consumer_queue_bound_is_hard)."""
+    _, payloads = _payloads(500)
+    broker = FakeBroker()
+    broker.create_topic("t", 1)
+    broker.produce_many("t", payloads)
+    c = SmartCommitConsumer(broker, "g", max_queued_records=64,
+                            fetch_max_records=500, batch_ingest=True)
+    c.subscribe("t")
+    c.start()
+    try:
+        deadline = time.time() + 5
+        while c._buf_count < 64 and time.time() < deadline:
+            time.sleep(0.001)
+        for _ in range(50):
+            assert c._buf_count <= 64
+            time.sleep(0.001)
+        got = 0
+        vals = []
+        while got < 500 and time.time() < deadline:
+            items, _ = c.poll_many_batches(32)
+            for it in items:
+                assert isinstance(it, RecordBatch)
+                vals.extend(it.payload_at(i) for i in range(len(it)))
+                got += len(it)
+            assert c._buf_count <= 64
+        assert vals == payloads
+        assert c.stats()["batch_fetches"] > 0
+    finally:
+        c.close()
+
+
+# -- gapped (compacted-topic) runs: the ack-correctness seam -----------------
+
+def test_poll_many_runs_gapped_batch_falls_back_per_record():
+    """A buffered batch with offset gaps (compacted topic) must come out
+    of poll_many_runs as exact per-record runs — the O(1) run shortcut
+    must never claim an offset that was not delivered — and acking those
+    runs (plus the tracker's gap pre-ack) must advance the commit
+    frontier ACROSS the gap instead of parking on it forever."""
+    broker = FakeBroker()
+    broker.create_topic("t", 1)
+    c = SmartCommitConsumer(broker, "g", page_size=100,
+                            max_open_pages_per_partition=4)
+    c.subscribe("t")
+    # offsets 0,1,2,4,5,9: two interior gaps (3 and 6-8), as a compacted
+    # source would deliver them
+    offsets = [0, 1, 2, 4, 5, 9]
+    recs = [Record("t", 0, off, None, b"v%d" % off, 0.0) for off in offsets]
+    accepted = c._track_batch(0, recs)
+    assert len(accepted) == len(recs)
+    assert c._put_batch(recs)
+    got, runs = c.poll_many_runs(100)
+    assert [r.offset for r in got] == offsets
+    # contiguous prefix would merge; the gapped tail must be per-record
+    assert runs == [(0, 0, 1), (0, 1, 1), (0, 2, 1), (0, 4, 1), (0, 5, 1),
+                    (0, 9, 1)]
+    for p, s, n in runs:
+        c.ack_run(p, s, n)
+    # every delivered offset acked + gaps pre-acked at track time -> the
+    # frontier crosses both gaps
+    assert c.tracker.committed(0) == 10
+
+
+def test_track_run_batch_head_gap_pre_acked():
+    """The RecordBatch route's head-gap handling: a batch starting past
+    the fetch position (offsets compacted away) skips the hole
+    (delivered+acked) so the frontier can cross it."""
+    broker = FakeBroker()
+    broker.create_topic("t", 1)
+    c = SmartCommitConsumer(broker, "g", page_size=100,
+                            max_open_pages_per_partition=4)
+    c.subscribe("t")
+    payload = b"ab" * 3
+    rb = RecordBatch("t", 0, 5, payload, np.array([0, 2, 4, 6], np.int64))
+    out = c._track_run_batch(0, 0, rb)  # fetch position was 0, batch at 5
+    assert out is rb
+    c.ack_run(0, 5, 3)
+    assert c.tracker.committed(0) == 8
+
+
+def test_gap_spanning_page_boundary_does_not_park_frontier():
+    """A compaction gap that CROSSES offset-tracker page boundaries must
+    not park the commit frontier or leak open pages into permanent
+    backpressure: the skip marks the hole delivered+acked on every page
+    it covers (an ack alone leaves delivered_end behind on the gap pages
+    and advance() would stop there forever)."""
+    broker = FakeBroker()
+    broker.create_topic("t", 1)
+    c = SmartCommitConsumer(broker, "g", page_size=100,
+                            max_open_pages_per_partition=2)
+    c.subscribe("t")
+    # committed base 90 (what _refresh_assignment seeds from the broker);
+    # head gap [90, 110) starts in page 0 and ends in page 1
+    c.tracker.reset_partition(0, 90)
+    payload = b"cd" * 3
+    rb = RecordBatch("t", 0, 110, payload, np.array([0, 2, 4, 6], np.int64))
+    out = c._track_run_batch(0, 90, rb)
+    assert out is rb
+    c.ack_run(0, 110, 3)
+    assert c.tracker.committed(0) == 113
+    assert not c.tracker.is_backpressured(0)
+    # interior gap [3, 205) spanning two whole pages, via the Record path
+    c2 = SmartCommitConsumer(broker, "g2", page_size=100,
+                             max_open_pages_per_partition=2)
+    c2.subscribe("t")
+    recs = [Record("t", 0, off, None, b"v%d" % off, 0.0)
+            for off in (0, 1, 2, 205, 206)]
+    accepted = c2._track_batch(0, recs)
+    assert len(accepted) == len(recs)
+    for p, s, n in [(0, 0, 3), (0, 205, 2)]:
+        c2.ack_run(p, s, n)
+    assert c2.tracker.committed(0) == 207
+    assert not c2.tracker.is_backpressured(0)
+
+
+def test_columnarize_buffer_rejects_malformed_offsets():
+    """Caller-supplied offset tables are validated before any decoder
+    sees them: a descending or out-of-bounds interior offset must raise
+    ValueError, never reach C with an out-of-bounds read."""
+    import pytest
+
+    cls, payloads = _payloads(3)
+    col = ProtoColumnarizer(cls)
+    buf = b"".join(payloads)
+    good = np.zeros(4, np.int64)
+    np.cumsum([len(p) for p in payloads], out=good[1:])
+    col.columnarize_buffer(buf, good)  # sanity: valid table shreds
+    for bad in (
+        np.array([0, len(buf) + 999, len(buf)], np.int64),  # interior OOB
+        np.array([0, good[2], good[1], good[3]], np.int64),  # descending
+        np.array([-1, good[1], good[2], good[3]], np.int64),  # negative
+        np.array([0, good[1], len(buf) + 1], np.int64),      # end OOB
+    ):
+        with pytest.raises(ValueError):
+            col.columnarize_buffer(buf, bad)
+
+
+# -- byte identity -----------------------------------------------------------
+
+def test_batch_and_record_paths_byte_identical():
+    """Same input stream, same batch splits: the RecordBatch buffer path
+    (columnarize_buffer) and the per-record Record fallback path
+    (columnarize_payloads over fetched Record values) must publish
+    byte-identical parquet files."""
+    cls, payloads = _payloads(4000, pad=10)
+    broker = FakeBroker()
+    broker.create_topic("t", 1)
+    broker.produce_many("t", payloads)
+    col = ProtoColumnarizer(cls)
+    assert col.wire_capable
+
+    from kpw_tpu.core.writer import WriterProperties
+
+    props = WriterProperties(row_group_size=64 * 1024,
+                             data_page_size=8 * 1024)
+    fs = MemoryFileSystem()
+    fs.mkdirs("/id")
+    step = 700  # odd-sized batches: exercises tail batches too
+
+    fa = ParquetFile(fs, "/id/batch.parquet", col, props, batch_size=step)
+    pos = 0
+    while True:
+        rb = broker.fetch_batch("t", 0, pos, step)
+        if rb is None:
+            break
+        fa.append_batch(col.columnarize_buffer(rb.payload, rb.offsets))
+        pos += len(rb)
+    fa.close()
+
+    fb = ParquetFile(fs, "/id/record.parquet", col, props, batch_size=step)
+    pos = 0
+    while True:
+        recs = broker.fetch("t", 0, pos, step)
+        if not recs:
+            break
+        fb.append_batch(col.columnarize_payloads([r.value for r in recs]))
+        pos += len(recs)
+    fb.close()
+
+    with fs.open_read("/id/batch.parquet") as f:
+        batch_bytes = f.read()
+    with fs.open_read("/id/record.parquet") as f:
+        record_bytes = f.read()
+    assert batch_bytes == record_bytes
+    assert len(batch_bytes) > 1000
+    # and the bytes are real parquet with the full stream in order
+    table = pq.read_table(fs.open_read("/id/batch.parquet"))
+    assert table.column("timestamp").to_pylist() == list(range(4000))
+
+
+# -- full writer -------------------------------------------------------------
+
+def _stream(broker, cls, parts, rows, batch_ingest, tag):
+    fs = MemoryFileSystem()
+    w = (Builder().broker(broker).topic("t").proto_class(cls)
+         .target_dir("/out").filesystem(fs).instance_name(f"bi-{tag}")
+         .group_id(f"g-{tag}").batch_ingest(batch_ingest)
+         .max_file_size(256 * 1024).block_size(32 * 1024)
+         .max_file_open_duration_seconds(0.3).build())
+    w.start()
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        if (sum(broker.committed(f"g-{tag}", "t", p) for p in range(parts))
+                >= rows and w.ack_lag()["unacked_records"] == 0):
+            break
+        time.sleep(0.01)
+    stats = w.stats()
+    lag = w.ack_lag()
+    w.close()
+    got = collections.Counter()
+    for f in fs.list_files("/out", extension=".parquet"):
+        if "/out/tmp/" in f:
+            continue
+        for r in pq.read_table(fs.open_read(f)).to_pylist():
+            got[r["timestamp"]] += 1
+    return got, stats, lag
+
+
+def test_streaming_batch_path_matches_record_path_content():
+    """Full writer, same produced stream: the batch-native path drains to
+    ack-lag exactly 0 with every record published, same content set as
+    the pinned-off per-record path; the batch path demonstrably engaged
+    (batch_fetches > 0) while the pinned-off arm never batch-fetched."""
+    rows, parts = 6000, 2
+    cls, payloads = _payloads(rows)
+    broker = FakeBroker()
+    broker.create_topic("t", parts)
+    broker.produce_many("t", payloads)
+
+    got_b, stats_b, lag_b = _stream(broker, cls, parts, rows, True, "on")
+    got_r, stats_r, lag_r = _stream(broker, cls, parts, rows, False, "off")
+    assert lag_b["unacked_records"] == 0 and lag_b["oldest_unacked_age_s"] == 0.0
+    assert lag_r["unacked_records"] == 0
+    assert set(got_b) == set(range(rows)) == set(got_r)
+    assert stats_b["consumer"]["batch_ingest"] is True
+    assert stats_b["consumer"]["batch_fetches"] > 0
+    assert stats_r["consumer"]["batch_fetches"] == 0
+
+
+def test_chaos_invariant_with_batch_path():
+    """The PR-3 at-least-once invariant under injected faults with the
+    batch-native path enabled AND demonstrably engaged: transient
+    write/rename/fetch faults, a torn write, a forced rebalance, a fatal
+    worker kill — every acked offset's record in a structurally verified
+    published file, ack-lag exactly 0."""
+    rows, parts = 3000, 2
+
+    def schedule(s):
+        s.fail_nth("write", 14, err=errno.ENOSPC)  # fatal: worker kill
+        s.fail_nth("write", 5, count=2)
+        s.fail_nth("write", 9, partial=0.5)        # torn write
+        s.fail_nth("rename", 1)
+        s.fail_nth("fetch", 3, count=2)
+        s.fail_nth("commit", 1)
+        return (6,)                                # rebalance mid-run
+
+    w, broker, fs, sched, identity = run_chaos(rows, parts, 1, schedule,
+                                               expected_deaths=1)
+    try:
+        got, files, committed = assert_at_least_once_invariant(
+            w, broker, fs, identity, parts)
+        assert committed >= rows
+        assert set(got) == set(range(rows))
+        stats = w.stats()
+        assert stats["consumer"]["batch_ingest"] is True
+        assert stats["consumer"]["batch_fetches"] > 0, \
+            "batch path never engaged under chaos"
+        assert stats["supervision"]["restarts_total"] >= 1
+    finally:
+        w.close()
+
+
+def test_autotune_surfaces_tuned_values():
+    """Autotuned knobs land in stats(): tuned fetch/queue sizing plus the
+    measured rates that produced them; the configured queue bound stays a
+    hard ceiling."""
+    rows, parts = 20_000, 2
+    cls, payloads = _payloads(rows)
+    broker = FakeBroker()
+    broker.create_topic("t", parts)
+    broker.produce_many("t", payloads)
+    fs = MemoryFileSystem()
+    w = (Builder().broker(broker).topic("t").proto_class(cls)
+         .target_dir("/out").filesystem(fs).instance_name("tune")
+         .group_id("g").autotune(True)
+         .max_file_size(512 * 1024).block_size(64 * 1024)
+         .max_file_open_duration_seconds(0.3).build())
+    w.start()
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        if (sum(broker.committed("g", "t", p) for p in range(parts)) >= rows
+                and w.ack_lag()["unacked_records"] == 0):
+            break
+        time.sleep(0.01)
+    stats = w.stats()
+    w.close()
+    tune = stats["consumer"]["autotune"]
+    assert tune["enabled"] is True
+    assert tune["retunes"] >= 1
+    assert tune["drain_rate_rps"] > 0
+    assert 1 <= tune["fetch_max_records"] <= 65536
+    assert tune["max_queued_records"] <= tune["configured_max_queued_records"]
+    workers = stats["workers"]
+    assert workers[0]["poll_batch"] >= 1
+    assert workers[0]["proc_rate_rps"] > 0
+
+
+def test_autotune_disabled_keeps_fixed_knobs():
+    cls, _ = _payloads(1)
+    broker = FakeBroker()
+    broker.create_topic("t", 1)
+    fs = MemoryFileSystem()
+    w = (Builder().broker(broker).topic("t").proto_class(cls)
+         .target_dir("/out").filesystem(fs).instance_name("fixed")
+         .group_id("g").build())
+    assert w.autotuner is None
+    w.start()
+    stats = w.stats()
+    w.close()
+    assert stats["consumer"]["autotune"] == {"enabled": False}
+    assert stats["consumer"]["queue"]["capacity"] == 100_000

@@ -91,6 +91,18 @@ CHECKS = [
      ["degrade:outcome.acked_offsets_checked"]),
     ("PARITY.md", r"close under a hung\s+write returned in ([\d.]+)\s?s",
      ["degrade:close_deadline.returned_in_s"]),
+    # sustained-throughput PR: e2e headline + batch-ingest A/B quotes
+    # reconcile against the e2e artifact (`e2e:` prefix)
+    ("README.md", r"sustains\s+\*\*([\d.]+)k records/s\*\* \(median",
+     [("e2e:records_per_sec_median", 1e3)]),
+    ("README.md", r"batch-native RecordBatch ingest \*\*([\d.]+)x\*\* over",
+     ["e2e:batch_ab.speedup_x"]),
+    ("PARITY.md", r"`records_per_sec_median` \*\*([\d.]+)k\*\*",
+     [("e2e:records_per_sec_median", 1e3)]),
+    ("PARITY.md", r"`speedup_x`\s+\*\*([\d.]+)x\*\* \(arm medians",
+     ["e2e:batch_ab.speedup_x"]),
+    ("PARITY.md", r"p99 ack-lag ([\d.]+)k records \(`ack_lag_p99_records`",
+     [("e2e:ack_lag_p99_records", 1e3)]),
 ]
 
 
@@ -298,6 +310,11 @@ def main() -> int:
         "KPW_DEGRADE_PATH", os.path.join(ROOT, "BENCH_DEGRADE_r09.json"))
     if os.path.exists(degrade_path):
         key_record["degrade"] = json.load(open(degrade_path))
+    # the sustained-throughput artifact (bench.py --e2e) is the sixth
+    e2e_path = os.environ.get(
+        "KPW_E2E_PATH", os.path.join(ROOT, "BENCH_E2E_r10.json"))
+    if os.path.exists(e2e_path):
+        key_record["e2e"] = json.load(open(e2e_path))
     docs = {f: open(os.path.join(ROOT, f)).read()
             for f in ({c[0] for c in CHECKS} | set(KEY_DOCS)
                       | set(NAME_DOCS))}
@@ -319,6 +336,8 @@ def main() -> int:
                 root, spec = key_record.get("crash", {}), spec[6:]
             elif spec.startswith("degrade:"):
                 root, spec = key_record.get("degrade", {}), spec[8:]
+            elif spec.startswith("e2e:"):
+                root, spec = key_record.get("e2e", {}), spec[4:]
             try:
                 expect = float(art(root, spec)) / scale
             except (KeyError, TypeError):
