@@ -15,6 +15,7 @@ import struct
 import numpy as np
 
 from .schema import PhysicalType
+from .thrift import varint_bytes, zigzag
 
 
 def bit_width(max_value: int) -> int:
@@ -69,15 +70,11 @@ def _runs(values: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
 
 def _rle_run(value: int, count: int, width: int) -> bytes:
     nbytes = (width + 7) // 8
-    from .thrift import varint_bytes
-
     return varint_bytes(count << 1) + int(value).to_bytes(nbytes, "little")
 
 
 def _bitpack_run(values: np.ndarray, width: int) -> bytes:
     """values are padded here to a multiple of 8; count = #groups."""
-    from .thrift import varint_bytes
-
     pad = (-len(values)) % 8
     if pad:
         values = np.concatenate([values, np.zeros(pad, values.dtype)])
@@ -94,8 +91,6 @@ def rle_hybrid_encode(values: np.ndarray, width: int) -> bytes:
         return b""
     if width == 0:
         # all values are zero-width (single possible value): one RLE run
-        from .thrift import varint_bytes
-
         return varint_bytes(n << 1)
     values = np.ascontiguousarray(values, dtype=np.uint64)
     run_vals, run_lens = _runs(values)
@@ -282,8 +277,6 @@ def delta_binary_packed_encode(values: np.ndarray, bit_size: int = 64) -> bytes:
     bit widths + packed deltas.  ``bit_size`` selects the ring arithmetic:
     INT32 columns use 32-bit wraparound deltas (so widths never exceed 32),
     INT64 uses 64-bit — matching what readers decode into."""
-    from .thrift import varint_bytes, zigzag
-
     itype = np.int64 if bit_size == 64 else np.int32
     utype = np.uint64 if bit_size == 64 else np.uint32
     v = np.asarray(values, itype)

@@ -12,6 +12,7 @@ from __future__ import annotations
 import os
 import threading
 import zlib
+from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -142,8 +143,6 @@ def shared_assembly_pool():
     global _POOL
     with _POOL_LOCK:
         if _POOL is None:
-            from concurrent.futures import ThreadPoolExecutor
-
             _POOL = ThreadPoolExecutor(
                 max_workers=max(2, os.cpu_count() or 1),
                 thread_name_prefix="kpw-encode")

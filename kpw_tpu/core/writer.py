@@ -224,8 +224,6 @@ class ParquetFileWriter:
         Conservative by construction: an encoder that overrode encode_many
         itself (a custom backend, a test double) keeps its override on the
         single encode stage — the split path would silently bypass it."""
-        from .pages import CpuChunkEncoder
-
         cls = type(self.encoder)
         return (getattr(cls, "split_launch_overlaps", False)
                 and getattr(cls, "encode_many", None)

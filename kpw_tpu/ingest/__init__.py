@@ -13,4 +13,7 @@ from .broker import FakeBroker, Record, RecordBatch  # noqa: F401
 from .offsets import PagedOffsetTracker, PartitionOffset  # noqa: F401
 from .consumer import SmartCommitConsumer  # noqa: F401
 from .kafka_client import KafkaBrokerClient  # noqa: F401  (needs kafka-python at construction)
+# lint: fault-isolation ok — the package's public opt-in seam: tests and
+# benchmarks import FaultInjectingBroker from here; no production call
+# path references it (enforced by tools/analyze's fault-isolation pass)
 from .faults import FaultInjectingBroker  # noqa: F401

@@ -377,6 +377,12 @@ class SmartCommitConsumer:
         def do() -> None:
             with self._commit_lock:
                 cur = self.tracker.committed(partition)
+                # lint: lock-discipline ok — the lock exists precisely to
+                # make frontier-read + broker commit one atomic step: a
+                # real Kafka broker does NOT guard commit monotonicity, so
+                # committing outside it lets a backed-off retry push a
+                # stale lower offset over a newer one.  Retry sleeps
+                # happen in _retry.call, outside this closure/lock.
                 self.broker.commit(self.group_id, self._topic, partition,
                                    max(offset, cur))
         self._retry.call(do, stop_event=self._stop_event,

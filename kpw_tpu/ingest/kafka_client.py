@@ -180,6 +180,10 @@ class KafkaBrokerClient:
                     # against a closed consumer whose assignment() persists
                     if not cached.closed and tp in cached.consumer.assignment():
                         return cached
+            # lint: swallowed-exceptions ok — probing a cached owner that
+            # may be mid-close: kafka-python raises client-internal types
+            # here; any failure just invalidates the cache and the full
+            # member scan below re-resolves authoritatively
             except Exception:
                 pass  # closed/leaving consumer: fall through to the scan
         for member in self._group_members(group):
@@ -230,6 +234,10 @@ class KafkaBrokerClient:
                 member = members[0]
             try:
                 with member.lock:
+                    # lint: lock-discipline ok — kafka-python KafkaConsumer
+                    # is not thread-safe; member.lock IS the serialization
+                    # of every call into it, so the (network-blocking)
+                    # commit must run under it by the client's contract
                     member.consumer.commit({TopicPartition(topic, partition):
                                             OffsetAndMetadata(offset, None, -1)})
                 return

@@ -4,6 +4,10 @@ analog), with the atomic tmp→rename publish the correctness protocol needs
 
 from .fs import FileSystem, LocalFileSystem, MemoryFileSystem  # noqa: F401
 from .hdfs import HdfsFileSystem  # noqa: F401  (needs libhdfs at construction)
+# lint: fault-isolation ok — the package's public opt-in seam: tests and
+# benchmarks import these names from here; no production call path
+# references them (enforced by tools/analyze's fault-isolation pass on
+# every other module)
 from .faults import (  # noqa: F401
     FaultInjectingFileSystem,
     FaultSchedule,

@@ -17,6 +17,31 @@ _SRCS = [os.path.join(_SRC_DIR, "src", "codecs.cc"),
          os.path.join(_SRC_DIR, "src", "encode.cc"),
          os.path.join(_SRC_DIR, "src", "shred.cc"),
          os.path.join(_SRC_DIR, "src", "shred_nested.cc")]
+
+
+def _sanitize_mode() -> bool:
+    """ASan+UBSan build mode (KPW_NATIVE_SANITIZE=1): every native
+    entry point — the wire shredders, codecs, thrift-adjacent buffer
+    walks — compiles with -fsanitize=address,undefined so the fuzz
+    harness (tools/fuzz.py) and the shred/verify test subsets run with
+    out-of-bounds reads and UB trapping instead of silently reading
+    garbage (the PR-6 ``shred_flat_buf`` malformed-offset OOB class).
+    Sanitized artifacts cache under distinct names so the normal build
+    is never polluted; the host python is uninstrumented, so the runner
+    (tools/sanitize.sh) must LD_PRELOAD libasan/libubsan."""
+    return os.environ.get("KPW_NATIVE_SANITIZE", "") == "1"
+
+
+_SAN_FLAGS = ["-fsanitize=address,undefined", "-fno-sanitize-recover=all",
+              "-fno-omit-frame-pointer", "-g", "-O1"]
+
+
+def _so_path(base: str) -> str:
+    if _sanitize_mode():
+        return base.replace(".so", "_san.so")
+    return base
+
+
 _SO = os.path.join(_SRC_DIR, "_kpw_native.so")
 
 
@@ -45,16 +70,22 @@ _TAG = _SO + ".hosttag"
 
 
 def _build() -> str:
-    if (os.path.exists(_SO)
-            and all(os.path.getmtime(_SO) >= os.path.getmtime(s) for s in _SRCS)
-            and os.path.exists(_TAG)
-            and open(_TAG).read() == _host_tag()):
-        return _SO
+    so = _so_path(_SO)
+    tag = so + ".hosttag"
+    if (os.path.exists(so)
+            and all(os.path.getmtime(so) >= os.path.getmtime(s) for s in _SRCS)
+            and os.path.exists(tag)
+            and open(tag).read() == _host_tag()):
+        return so
     # -march=native is a ~1.8x dictionary-build win; the host-tag check above
     # guarantees the cached binary only runs on the CPU family it was
     # compiled for.
     fast = ["-O3", "-march=native", "-funroll-loops"]
     plain = ["-O3"]
+    if _sanitize_mode():
+        # sanitized artifacts trade speed for trap-on-UB/OOB; one flag
+        # level (plus the no-zstd fallback) keeps failure modes obvious
+        fast = plain = list(_SAN_FLAGS)
     tail = ["-fPIC", "-shared", "-std=c++17", "-o"]
     # build into a temp file then atomic-rename (parallel test runners)
     fd, tmp = tempfile.mkstemp(suffix=".so", dir=_SRC_DIR)
@@ -80,13 +111,13 @@ def _build() -> str:
             raise RuntimeError(
                 "native library build failed at every flag level:\n"
                 + last_err.decode(errors="replace"))
-        os.replace(tmp, _SO)
-        with open(_TAG, "w") as f:
+        os.replace(tmp, so)
+        with open(tag, "w") as f:
             f.write(_host_tag())
     finally:
         if os.path.exists(tmp):
             os.unlink(tmp)
-    return _SO
+    return so
 
 
 class NestedShredResult:
@@ -135,6 +166,9 @@ class NestedShredResult:
     def __del__(self) -> None:  # pragma: no cover - GC safety net
         try:
             self.close()
+        # lint: swallowed-exceptions ok — __del__ runs at arbitrary GC
+        # points (possibly interpreter teardown); raising here aborts the
+        # process with an unraisable-exception warning, not a diagnosis
         except Exception:
             pass
 
@@ -661,18 +695,23 @@ _PYSHRED_TAG = _PYSHRED_SO + ".hosttag"
 def _build_pyshred() -> str:
     """Compile the _kpw_pyshred extension (pyshred.cc + shred.cc — the
     decoder compiles into both .so files from the same source, so the two
-    paths cannot drift).  Same cache/hosttag discipline as _build."""
-    if (os.path.exists(_PYSHRED_SO)
-            and all(os.path.getmtime(_PYSHRED_SO) >= os.path.getmtime(s)
+    paths cannot drift).  Same cache/hosttag discipline as _build, and
+    the same KPW_NATIVE_SANITIZE=1 ASan/UBSan mode (distinct cache)."""
+    so = _so_path(_PYSHRED_SO)
+    tag = so + ".hosttag"
+    if (os.path.exists(so)
+            and all(os.path.getmtime(so) >= os.path.getmtime(s)
                     for s in _PYSHRED_SRCS)
-            and os.path.exists(_PYSHRED_TAG)
-            and open(_PYSHRED_TAG).read() == _host_tag()):
-        return _PYSHRED_SO
+            and os.path.exists(tag)
+            and open(tag).read() == _host_tag()):
+        return so
     import sysconfig
 
     inc = sysconfig.get_paths()["include"]
     fast = ["-O3", "-march=native", "-funroll-loops"]
     plain = ["-O3"]
+    if _sanitize_mode():
+        fast = plain = list(_SAN_FLAGS)
     tail = ["-fPIC", "-shared", "-std=c++17", f"-I{inc}", "-o"]
     fd, tmp = tempfile.mkstemp(suffix=".so", dir=_SRC_DIR)
     os.close(fd)
@@ -689,13 +728,13 @@ def _build_pyshred() -> str:
         else:
             raise RuntimeError("pyshred build failed:\n"
                                + last_err.decode(errors="replace"))
-        os.replace(tmp, _PYSHRED_SO)
-        with open(_PYSHRED_TAG, "w") as f:
+        os.replace(tmp, so)
+        with open(tag, "w") as f:
             f.write(_host_tag())
     finally:
         if os.path.exists(tmp):
             os.unlink(tmp)
-    return _PYSHRED_SO
+    return so
 
 
 def load_pyshred():

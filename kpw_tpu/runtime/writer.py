@@ -21,12 +21,14 @@ from __future__ import annotations
 
 import logging
 import random
+import re
 import struct
 import threading
 import time
 from datetime import datetime
 
 from ..core.writer import PipelineError
+from ..io.verify import verify_dir, verify_file
 from ..ingest.autotune import IngestAutotuner
 from ..ingest.broker import RecordBatch
 from ..ingest.consumer import SmartCommitConsumer
@@ -250,8 +252,6 @@ class KafkaProtoParquetWriter:
         second live writer sharing the instance name would lose its open
         file).  Scoped to the ``{instance}_`` prefix so other instances
         writing to the same target directory are untouched."""
-        import re
-
         tmp_dir = f"{self.target_dir}/tmp"
         # strict tmp-name shape '{instance}_{worker}_{rand}.tmp' — a bare
         # prefix test would also match instance names that extend ours
@@ -285,8 +285,6 @@ class KafkaProtoParquetWriter:
         never acked OR are redelivered duplicates, so removing the file
         from the published set preserves at-least-once.  The manifest of
         what happened lands in ``stats()['recovery']``."""
-        from ..io.verify import verify_dir
-
         reports = verify_dir(self.fs, self.target_dir)
         for rep in reports:
             if rep.ok:
@@ -1310,6 +1308,9 @@ class _Worker:
         if f is not None:
             try:
                 self._fold_into(tot, f.pipeline_stats())
+            # lint: swallowed-exceptions ok — observability fold over a
+            # file that may be rotating away under us; a racing snapshot
+            # is droppable, and raising would take down the stats() scrape
             except Exception:
                 pass  # file may be rotating away under us
         ts = self._oldest_unacked_ts
@@ -1420,8 +1421,6 @@ class _Worker:
             # data error, not an IO error — quarantine the tmp and die
             # un-acked (redelivery), instead of retrying a rename that
             # would publish garbage
-            from ..io.verify import verify_file
-
             rep = verify_file(self.p.fs, tmp_path)
             if rep.ok:
                 self.p._verified.mark()

@@ -1,0 +1,10 @@
+"""Fixture: must trip EXACTLY the fault-isolation pass (a production-
+shaped module importing the fault-injection machinery and test code).
+Never imported; parsed by tools/analyze only."""
+
+from kpw_tpu.io import faults  # noqa: F401  (injection into production)
+import tests.fake_kafka  # noqa: F401,E402  (test double into production)
+
+
+def use() -> object:
+    return faults.FaultSchedule(seed=0)

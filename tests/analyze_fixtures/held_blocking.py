@@ -1,0 +1,14 @@
+"""Fixture: must trip EXACTLY the lock-discipline pass (blocking call
+while a lock is held).  Never imported; parsed by tools/analyze only."""
+
+import threading
+import time
+
+_state_lock = threading.Lock()
+state = {}
+
+
+def slow_update(broker) -> None:
+    with _state_lock:
+        time.sleep(0.1)            # blocking under a held lock
+        state["n"] = broker.fetch("t", 0, 0, 10)  # broker IO under it too

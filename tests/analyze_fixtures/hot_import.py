@@ -1,0 +1,9 @@
+"""Fixture: must trip EXACTLY the hot-imports pass (function-local
+import; the fixture harness runs with hot_all so this file counts as a
+hot module).  Never imported; parsed by tools/analyze only."""
+
+
+def per_record_hot_loop(records) -> int:
+    import json  # function-local: pays a sys.modules probe per call
+
+    return sum(len(json.dumps(r)) for r in records)

@@ -1,0 +1,21 @@
+"""Fixture: must trip EXACTLY the lock-discipline pass (static
+lock-order cycle) — two functions acquire the same two locks in
+opposite orders.  Never imported; parsed by tools/analyze only."""
+
+import threading
+
+_lock_a = threading.Lock()
+_lock_b = threading.Lock()
+state = {}
+
+
+def path_one() -> None:
+    with _lock_a:
+        with _lock_b:  # edge a -> b
+            state["x"] = 1
+
+
+def path_two() -> None:
+    with _lock_b:
+        with _lock_a:  # edge b -> a: closes the cycle
+            state["y"] = 2

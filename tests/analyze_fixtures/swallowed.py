@@ -1,0 +1,17 @@
+"""Fixture: must trip EXACTLY the swallowed-exceptions pass (a broad
+handler that does nothing, and a bare except).  Never imported; parsed
+by tools/analyze only."""
+
+
+def lossy(op) -> None:
+    try:
+        op()
+    except Exception:
+        pass  # the failure evidence evaporates here
+
+
+def lossier(op) -> None:
+    try:
+        op()
+    except:  # noqa: E722 — bare except, the worst shape
+        print("something happened")

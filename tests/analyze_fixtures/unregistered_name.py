@@ -1,0 +1,8 @@
+"""Fixture: must trip EXACTLY the canonical-names pass (a stage() span
+and a meter constructed with names absent from STAGE_NAMES /
+METRIC_NAMES).  Never imported; parsed by tools/analyze only."""
+
+
+def instrumented(stage, registry) -> None:
+    with stage("bogus.stage.name"):
+        registry.meter("parquet.writer.bogus.metric").mark()

@@ -24,9 +24,52 @@ jax.config.update("jax_platforms", "cpu")
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+import pytest
+
+
 def pytest_configure(config):
     # tier-1 runs `-m 'not slow'`; register the marker so the chaos torture
     # test is deselectable without a PytestUnknownMarkWarning
     config.addinivalue_line(
         "markers", "slow: long-running chaos/torture tests excluded from "
         "the tier-1 fast suite")
+
+
+@pytest.fixture
+def lockcheck_detector():
+    """Opt-in runtime lock-order detector (kpw_tpu/utils/lockcheck.py):
+    installs the instrumented lock factory for the duration of one test,
+    so every kpw_tpu lock created inside it joins the live lock-order
+    graph; a cycle or a sleep-under-lock raises in the offending thread
+    and is recorded on the detector.  The highest-risk suites (chaos,
+    degrade, batch-ingest) pull this in via a module-local autouse
+    fixture — their assertions run unchanged under it.  Set
+    ``KPW_LOCKCHECK=1`` to force-install for EVERY test instead."""
+    from kpw_tpu.utils import lockcheck
+
+    det = lockcheck.install()
+    try:
+        yield det
+    finally:
+        lockcheck.uninstall()
+
+
+@pytest.fixture(autouse=True)
+def _lockcheck_from_env(request):
+    """KPW_LOCKCHECK=1 runs the whole suite under the detector (skipped
+    for tests that already pull lockcheck_detector in explicitly)."""
+    if (os.environ.get("KPW_LOCKCHECK") != "1"
+            or "lockcheck_detector" in request.fixturenames):
+        yield
+        return
+    from kpw_tpu.utils import lockcheck
+
+    det = lockcheck.install()
+    try:
+        yield
+    finally:
+        lockcheck.uninstall()
+        if det.violations:
+            raise AssertionError(
+                f"lockcheck recorded {len(det.violations)} violation(s): "
+                f"{[repr(v) for v in det.violations]}")

@@ -25,6 +25,7 @@ import time
 
 import numpy as np
 import pyarrow.parquet as pq
+import pytest
 
 from kpw_tpu import Builder, FakeBroker, MemoryFileSystem, RecordBatch
 from kpw_tpu.ingest import SmartCommitConsumer
@@ -35,6 +36,18 @@ from kpw_tpu.runtime.parquet_file import ParquetFile
 from proto_helpers import sample_message_class
 
 from test_chaos import assert_at_least_once_invariant, run_chaos
+
+
+@pytest.fixture(autouse=True)
+def _lockcheck(lockcheck_detector):
+    # batch-ingest suite runs under the runtime lock-order detector: the
+    # zero-copy RecordBatch path crosses the broker's per-partition
+    # locks, the consumer's buffer condition and the tracker lock on
+    # every fetch — the teardown assert proves the interleavings the
+    # tests drive recorded no ordering cycle (assertions unchanged)
+    yield lockcheck_detector
+    assert not lockcheck_detector.violations, [
+        repr(v) for v in lockcheck_detector.violations]
 
 
 def _payloads(rows, pad=0):

@@ -41,6 +41,20 @@ from proto_helpers import sample_message_class
 TOPIC = "chaos"
 
 
+@pytest.fixture(autouse=True)
+def _lockcheck(lockcheck_detector):
+    # the whole chaos suite runs under the runtime lock-order detector
+    # (kpw_tpu/utils/lockcheck.py): every writer/consumer/broker lock the
+    # tests create joins the live ordering graph, and a cycle or a
+    # sleep-under-lock raises in the offending thread.  The tests'
+    # assertions are unchanged; teardown additionally proves the run
+    # recorded no violations (no new ordering cycles under fault
+    # injection — ISSUE 7 acceptance).
+    yield lockcheck_detector
+    assert not lockcheck_detector.violations, [
+        repr(v) for v in lockcheck_detector.violations]
+
+
 def produce_indexed(broker, cls, rows, parts, pad=0):
     """Produce ``rows`` records round-robin over ``parts`` partitions;
     returns {(partition, offset): timestamp} — the identity map the

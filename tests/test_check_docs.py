@@ -169,3 +169,59 @@ def test_verifier_claim_without_test_fails():
 def test_neutral_verified_prose_not_a_claim():
     assert _claim_failures(
         "page checksums are verified by pyarrow's strict reader.") == []
+
+
+# --- analyze-pass + name-completeness reconciliation (ISSUE 7) --------------
+
+def _analyze_failures(readme: str) -> list:
+    return check_docs.check_analyze_docs({"README.md": readme})
+
+
+_SECTION = ("## Correctness tooling\n\n"
+            "The `lock-discipline` pass and the `hot-imports` pass run "
+            "via tools/analyze; allowlist entries are "
+            "`kpw_tpu.ops.backend`.\n"
+            "Also `canonical-names`, `fault-isolation` and "
+            "`swallowed-exceptions` are lint passes.\n\n## Next\n")
+
+
+def test_analyze_section_required():
+    out = _analyze_failures("# readme with no tooling section\n")
+    assert len(out) == 1 and "Correctness tooling" in out[0]
+
+
+def test_bogus_pass_name_flagged():
+    out = _analyze_failures(_SECTION.replace(
+        "`lock-discipline` pass", "`bogus-pass` pass"))
+    assert any("bogus-pass" in f for f in out)
+
+
+def test_registered_pass_must_be_documented():
+    out = _analyze_failures(_SECTION.replace("`fault-isolation`", "`x`"))
+    assert any("fault-isolation" in f and "not documented" in f
+               for f in out)
+
+
+def test_stale_allowlist_citation_flagged():
+    out = _analyze_failures(_SECTION.replace(
+        "`kpw_tpu.ops.backend`", "`kpw_tpu.ops.nonexistent`"))
+    assert any("nonexistent" in f for f in out)
+
+
+def test_committed_analyze_section_passes():
+    docs = {"README.md": open(os.path.join(
+        HERE, os.pardir, "README.md")).read()}
+    assert check_docs.check_analyze_docs(docs) == []
+
+
+def test_name_completeness_flags_undocumented_registry_entry():
+    docs = {f: "prose citing nothing" for f in check_docs.NAME_DOCS}
+    out = check_docs.check_name_completeness(docs)
+    # every canonical name missing -> every one reported
+    assert len(out) == len(check_docs._canonical_names())
+
+
+def test_name_completeness_passes_on_committed_docs():
+    docs = {f: open(os.path.join(HERE, os.pardir, f)).read()
+            for f in check_docs.NAME_DOCS}
+    assert check_docs.check_name_completeness(docs) == []

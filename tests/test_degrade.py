@@ -40,6 +40,18 @@ from proto_helpers import sample_message_class
 TOPIC = "degrade"
 
 
+@pytest.fixture(autouse=True)
+def _lockcheck(lockcheck_detector):
+    # degrade suite runs under the runtime lock-order detector (see
+    # tests/conftest.py lockcheck_detector): watchdog + failover +
+    # pause/resume exercise the widest lock surface in the repo, and the
+    # teardown assert proves no ordering cycle or sleep-under-lock
+    # appeared while the existing assertions ran unchanged
+    yield lockcheck_detector
+    assert not lockcheck_detector.violations, [
+        repr(v) for v in lockcheck_detector.violations]
+
+
 def produce_indexed(broker, cls, rows, parts, pad=80):
     for i in range(rows):
         m = cls(query=f"q-{i}-" + "x" * pad, timestamp=i)

@@ -1,0 +1,231 @@
+"""Runtime lock-order detector (kpw_tpu/utils/lockcheck.py): the seeded
+two-thread inversion is reported with both stacks, blocking calls under
+held locks raise, the condition-wait release pattern stays legal, and
+the PR-1 ``string_stats`` race shape is pinned as a regression — the
+ORIGINAL unguarded merge (reintroduced in a test-local copy) fires the
+detector; the current guarded merge does not."""
+
+import threading
+
+import pytest
+
+from kpw_tpu.utils import lockcheck
+
+
+@pytest.fixture
+def det():
+    # instrument this test module's lock creations too (the conftest
+    # fixture instruments kpw_tpu only — production code under test).
+    # Under KPW_LOCKCHECK=1 the conftest env fixture has already
+    # installed a detector: step out of it for this test (uninstall is
+    # idempotent, so the env fixture's teardown stays safe).
+    if lockcheck.active() is not None:
+        lockcheck.uninstall()
+    d = lockcheck.install(prefixes=("kpw_tpu", __name__.split(".")[-1],
+                                    "test_lockcheck"))
+    try:
+        yield d
+    finally:
+        lockcheck.uninstall()
+
+
+def _two_locks():
+    return threading.Lock(), threading.Lock()
+
+
+def test_seeded_lock_inversion_reports_both_stacks(det):
+    """Two threads, opposite acquisition orders, deterministically
+    sequenced: the second ordering must raise LockOrderError BEFORE
+    blocking (report instead of deadlock), and the report must carry
+    BOTH acquisition stacks."""
+    a, b = _two_locks()
+    order_one_done = threading.Event()
+    errors: list[BaseException] = []
+
+    def order_one():
+        with a:
+            with b:  # records edge a -> b (stack kept)
+                pass
+        order_one_done.set()
+
+    def order_two():
+        order_one_done.wait(5)
+        try:
+            with b:
+                with a:  # closes the cycle: must raise, not deadlock
+                    pass
+        except lockcheck.LockOrderError as e:
+            errors.append(e)
+
+    t1 = threading.Thread(target=order_one)
+    t2 = threading.Thread(target=order_two)
+    t1.start(); t2.start()
+    t1.join(5); t2.join(5)
+    assert not t2.is_alive(), "inversion deadlocked instead of raising"
+    assert len(errors) == 1, "LockOrderError not raised on cycle formation"
+    msg = str(errors[0])
+    assert "this acquisition" in msg and "reverse edge" in msg
+    # both stacks are present: each shows its own acquiring function
+    assert "order_two" in msg and "order_one" in msg
+    # and the detector recorded the violation for post-hoc assertion
+    assert len(det.violations) == 1
+
+
+def test_sleep_under_held_lock_raises(det):
+    import time
+
+    lk, _ = _two_locks()
+    with pytest.raises(lockcheck.LockHeldBlockingError):
+        with lk:
+            time.sleep(0.01)
+    # no lock held: sleep is fine again
+    time.sleep(0.001)
+    assert len(det.violations) == 1
+
+
+def test_wrap_blocking_guards_arbitrary_callables(det):
+    lk, _ = _two_locks()
+    calls = []
+    guarded = lockcheck.wrap_blocking(lambda x: calls.append(x),
+                                      label="broker.fetch")
+    guarded(1)  # no lock held: passes through
+    with pytest.raises(lockcheck.LockHeldBlockingError):
+        with lk:
+            guarded(2)
+    assert calls == [1]
+
+
+def test_condition_wait_is_not_a_violation(det):
+    """wait() releases the condition it is called on — the repo's
+    standard producer/consumer shape must run clean under the
+    detector."""
+    cond = threading.Condition()
+    got = []
+
+    def consumer():
+        with cond:
+            cond.wait_for(lambda: bool(got), timeout=5)
+
+    t = threading.Thread(target=consumer)
+    t.start()
+    with cond:
+        got.append(1)
+        cond.notify_all()
+    t.join(5)
+    assert not t.is_alive()
+    assert det.violations == []
+
+
+def test_rlock_reentrancy_is_not_a_cycle(det):
+    rl = threading.RLock()
+    with rl:
+        with rl:
+            pass
+    assert det.violations == []
+
+
+def test_uninstall_restores_primitives():
+    import time
+
+    if lockcheck.active() is not None:  # KPW_LOCKCHECK=1 env mode
+        lockcheck.uninstall()
+    real_lock = threading.Lock
+    d = lockcheck.install()
+    try:
+        assert threading.Lock is not real_lock
+    finally:
+        lockcheck.uninstall()
+    assert threading.Lock is real_lock
+    assert time.sleep.__name__ == "sleep" or "blocking" not in \
+        time.sleep.__name__
+
+
+# -- the PR-1 string_stats race, pinned -------------------------------------
+
+class _StatsMerger:
+    """Test-local copy of the mesh encoder's string-stats merge in BOTH
+    historical shapes: ``merge_unguarded`` is the ORIGINAL PR-1-era
+    pattern (read-modify-write on the shared dict with no lock — the
+    shipped race), ``merge_guarded`` is the current pattern
+    (parallel/mesh_encoder.py ``_merge_string_stats``: per-call locals
+    merged under ``_stats_lock``)."""
+
+    def __init__(self, lock, stats) -> None:
+        self._stats_lock = lock
+        self.string_stats = stats
+
+    def merge_unguarded(self, col_stats: dict) -> None:
+        for k, v in col_stats.items():
+            if k in ("k_global_max", "k_local_max"):
+                self.string_stats[k] = max(self.string_stats.get(k, 0), v)
+            else:
+                self.string_stats[k] = self.string_stats.get(k, 0) + v
+
+    def merge_guarded(self, col_stats: dict) -> None:
+        with self._stats_lock:
+            for k, v in col_stats.items():
+                if k in ("k_global_max", "k_local_max"):
+                    self.string_stats[k] = max(self.string_stats.get(k, 0),
+                                               v)
+                else:
+                    self.string_stats[k] = self.string_stats.get(k, 0) + v
+
+
+def _hammer(merge, n_threads=4, n_iters=50):
+    errs: list[BaseException] = []
+
+    def worker():
+        try:
+            for i in range(n_iters):
+                merge({"columns": 1, "exchanged_payload_bytes": i,
+                       "k_global_max": i % 7})
+        except lockcheck.UnguardedMutationError as e:
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(10)
+    return errs
+
+
+def test_string_stats_unguarded_merge_detected(det):
+    """Regression pin for the PR-1 race: the original unguarded merge
+    pattern, run under the detector with threads, is flagged — the
+    detector would have caught the bug the day it shipped."""
+    lk = threading.Lock()
+    stats = lockcheck.guard_mutations(lk)
+    merger = _StatsMerger(lk, stats)
+    errs = _hammer(merger.merge_unguarded)
+    assert errs, "detector did not flag the original unguarded merge"
+    assert det.violations, "violation not recorded on the detector"
+    assert "without holding" in str(errs[0])
+
+
+def test_string_stats_guarded_merge_is_clean(det):
+    """The CURRENT merge shape (locked) runs clean under the same
+    detector AND counts exactly — no dropped updates."""
+    lk = threading.Lock()
+    stats = lockcheck.guard_mutations(lk)
+    merger = _StatsMerger(lk, stats)
+    errs = _hammer(merger.merge_guarded, n_threads=4, n_iters=50)
+    assert errs == []
+    assert det.violations == []
+    assert stats["columns"] == 4 * 50  # exact: the race dropped updates
+
+
+def test_real_mesh_encoder_merge_still_guarded():
+    """The production `_merge_string_stats` still takes the stats lock
+    (source-level pin: if someone removes the `with self._stats_lock`,
+    this fails before any scheduler luck is involved)."""
+    try:
+        import inspect
+
+        from kpw_tpu.parallel.mesh_encoder import MeshChunkEncoder
+    except ImportError:
+        pytest.skip("mesh encoder unavailable in this build")
+    src = inspect.getsource(MeshChunkEncoder._merge_string_stats)
+    assert "with self._stats_lock" in src
+    src2 = inspect.getsource(MeshChunkEncoder._merge_stats)
+    assert "with self._stats_lock" in src2

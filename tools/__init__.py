@@ -1,0 +1,3 @@
+# tools/ is a package so the analyzer runs as `python -m tools.analyze`
+# (the scripts in here — check_docs.py, rg_quick.py, ... — are still
+# directly runnable; nothing imports this module for side effects).

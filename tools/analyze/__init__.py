@@ -1,0 +1,45 @@
+"""Project-specific static analysis: the bug classes this repo has
+shipped-then-fixed, mechanized as lint passes.
+
+Run the whole suite over the production tree:
+
+    python -m tools.analyze              # exit 0 = clean
+
+Or a subset / specific files (fixture tests use this):
+
+    python -m tools.analyze --pass lock-discipline kpw_tpu/ingest
+    python -m tools.analyze --hot-all tests/analyze_fixtures/hot_import.py
+
+Passes (see each module's docstring for the rule and its history):
+
+* ``lock-discipline`` — no blocking calls under held locks; static
+  lock-order graph must be acyclic (tools/analyze/locks.py)
+* ``hot-imports`` — no function-local imports in the hot modules
+  (tools/analyze/hotimports.py, with the optional-dependency ALLOWLIST)
+* ``canonical-names`` — stage()/metric literals registered in
+  STAGE_NAMES/METRIC_NAMES, registries fully used (tools/analyze/names.py)
+* ``fault-isolation`` — production never imports fault injection or
+  tests/ (tools/analyze/faultiso.py)
+* ``swallowed-exceptions`` — no bare/do-nothing broad handlers
+  (tools/analyze/swallow.py)
+
+Suppression is per-site and justified: ``# lint: <pass> ok — <reason>``
+on the flagged line or the line above.  A reason-less annotation is
+itself a finding.  The runtime complement (lock-order inversions only a
+live interleaving exposes) is ``kpw_tpu/utils/lockcheck.py``.
+"""
+
+from __future__ import annotations
+
+from . import faultiso, hotimports, locks, names, swallow
+
+# registration order = report order
+PASSES = {
+    locks.PASS_NAME: locks,
+    hotimports.PASS_NAME: hotimports,
+    names.PASS_NAME: names,
+    faultiso.PASS_NAME: faultiso,
+    swallow.PASS_NAME: swallow,
+}
+
+PASS_NAMES = tuple(PASSES)

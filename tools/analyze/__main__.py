@@ -1,0 +1,74 @@
+"""CLI for the lint suite: ``python -m tools.analyze [paths...]``.
+
+Exit codes: 0 clean, 1 findings, 2 usage/parse error.  ``--json`` emits
+the findings as a JSON array for tooling; ``--list`` prints the pass
+registry (what check_docs reconciles README's pass citations against).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from . import PASSES
+from .common import Config, collect_files
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.analyze",
+        description="project lint suite (see tools/analyze/__init__.py)")
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to scan (default: the production "
+                         "tree, kpw_tpu/)")
+    ap.add_argument("--pass", dest="only", action="append", default=[],
+                    metavar="NAME", help="run only this pass (repeatable)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable findings")
+    ap.add_argument("--list", action="store_true",
+                    help="list registered passes and exit")
+    ap.add_argument("--hot-all", action="store_true",
+                    help="treat every scanned file as a hot module "
+                         "(fixture/test mode for the hot-imports pass)")
+    args = ap.parse_args(argv)
+
+    if args.list:
+        for name, mod in PASSES.items():
+            print(f"{name}: {mod.DESCRIPTION}")
+        return 0
+
+    for name in args.only:
+        if name not in PASSES:
+            print(f"unknown pass {name!r}; known: {', '.join(PASSES)}",
+                  file=sys.stderr)
+            return 2
+
+    try:
+        files = collect_files(args.paths or None)
+    except SyntaxError as e:
+        print(f"parse error: {e}", file=sys.stderr)
+        return 2
+    cfg = Config(full_repo=not args.paths, hot_all=args.hot_all)
+
+    findings = []
+    for name, mod in PASSES.items():
+        if args.only and name not in args.only:
+            continue
+        findings.extend(mod.run(files, cfg))
+    findings.sort(key=lambda f: (f.file, f.line, f.pass_name))
+
+    if args.json:
+        print(json.dumps([f.__dict__ for f in findings], indent=1))
+    else:
+        for f in findings:
+            print(f)
+        ran = args.only or list(PASSES)
+        print(f"tools.analyze: {len(findings)} finding(s) from "
+              f"{len(ran)} pass(es) over {len(files)} file(s)",
+              file=sys.stderr)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

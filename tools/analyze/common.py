@@ -1,0 +1,174 @@
+"""Shared infrastructure for the project lint passes (tools/analyze).
+
+Every pass consumes the same parsed-file map and emits :class:`Finding`s;
+the CLI (``python -m tools.analyze``) aggregates and exit-codes on them.
+Suppression is explicit and justified: a line-level annotation comment
+
+    # lint: <pass-name> ok — <one-line reason>
+
+on the flagged line (or the line directly above it) allowlists exactly
+that site for exactly that pass.  An annotation WITHOUT a reason is
+itself a finding — the allowlist policy (README "Correctness tooling")
+is that every exception carries its justification next to the code it
+excuses, so a reviewer never has to hunt for why a rule was waived.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# the production tree the full-repo run scans; tests/, tools/ and bench
+# scripts are not production code (they may import fault injection,
+# swallow exceptions in teardown, etc. by design)
+DEFAULT_ROOTS = ("kpw_tpu",)
+
+_ANNOTATION = re.compile(
+    r"#\s*lint:\s*(?P<passes>[a-z][a-z0-9-]*(?:\s*,\s*[a-z][a-z0-9-]*)*)"
+    r"\s+ok(?P<rest>.*)$")
+_REASON = re.compile(r"^\s*[—–-]+\s*(?P<reason>\S.*)$")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One lint verdict, stable-keyed for exact-match tests."""
+
+    pass_name: str
+    file: str       # repo-relative path
+    line: int
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.file}:{self.line}: [{self.pass_name}] {self.message}"
+
+
+@dataclass
+class ParsedFile:
+    """One source file, parsed once and shared by every pass."""
+
+    path: str           # repo-relative, '/'-separated
+    tree: ast.Module
+    lines: list[str]    # raw source lines (1-indexed via lines[i-1])
+
+    def annotation_for(self, pass_name: str, line: int):
+        """The annotation covering ``line`` for ``pass_name``: returns
+        (found, reason) — ``found`` True when an annotation names this
+        pass on the flagged line itself or anywhere in the contiguous
+        comment block directly above it (so a multi-line justification
+        reads naturally); ``reason`` is None when the annotation is
+        missing its justification."""
+        candidates = [line]
+        ln = line - 1
+        while (1 <= ln <= len(self.lines)
+               and self.lines[ln - 1].lstrip().startswith("#")
+               and line - ln <= 12):
+            candidates.append(ln)
+            ln -= 1
+        for ln in candidates:
+            if not 1 <= ln <= len(self.lines):
+                continue
+            m = _ANNOTATION.search(self.lines[ln - 1])
+            if m is None:
+                continue
+            passes = {p.strip() for p in m.group("passes").split(",")}
+            if pass_name not in passes:
+                continue
+            rm = _REASON.match(m.group("rest"))
+            return True, (rm.group("reason") if rm else None)
+        return False, None
+
+
+@dataclass
+class Config:
+    """Per-run knobs.  ``full_repo`` gates the bidirectional/completeness
+    checks (e.g. "every STAGE_NAMES entry must be used somewhere") that
+    are only meaningful when the whole production tree is in view —
+    running a single fixture file must not fail registry completeness.
+    ``hot_all`` (fixture/test mode) treats every scanned file as a
+    hot module for the import pass."""
+
+    full_repo: bool = True
+    hot_all: bool = False
+
+
+def rel(path: str) -> str:
+    p = os.path.abspath(path)
+    if p.startswith(REPO_ROOT):
+        p = p[len(REPO_ROOT):].lstrip(os.sep)
+    return p.replace(os.sep, "/")
+
+
+def collect_files(paths=None) -> dict[str, ParsedFile]:
+    """Parse every ``.py`` under ``paths`` (default: the production
+    roots).  A file that does not parse is reported by the CLI as its own
+    hard failure — the linter must never silently skip unparseable code."""
+    roots = [os.path.join(REPO_ROOT, r) for r in DEFAULT_ROOTS] \
+        if not paths else [os.path.abspath(p) for p in paths]
+    out: dict[str, ParsedFile] = {}
+    for root in roots:
+        if os.path.isfile(root):
+            _parse_into(out, root)
+            continue
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = [d for d in dirnames
+                           if d not in ("__pycache__", ".git")]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    _parse_into(out, os.path.join(dirpath, fn))
+    return out
+
+
+def _parse_into(out: dict, path: str) -> None:
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    tree = ast.parse(src, filename=path)
+    out[rel(path)] = ParsedFile(path=rel(path), tree=tree,
+                                lines=src.splitlines())
+
+
+def suppressed(pf: ParsedFile, pass_name: str, line: int,
+               findings: list, message_if_unjustified: str | None = None
+               ) -> bool:
+    """True when an annotation covers (pass, line).  A reason-less
+    annotation does NOT suppress — it appends its own finding instead,
+    so an empty waiver can never hide a defect."""
+    found, reason = pf.annotation_for(pass_name, line)
+    if not found:
+        return False
+    if reason is None:
+        findings.append(Finding(
+            pass_name, pf.path, line,
+            message_if_unjustified
+            or "allowlist annotation without a justification — write "
+               "`# lint: %s ok — <reason>`" % pass_name))
+        return True  # the site is annotated; the missing reason is the bug
+    return True
+
+
+def resolve_import(pf: ParsedFile, node: ast.ImportFrom) -> str:
+    """Absolute dotted module for a (possibly relative) from-import —
+    shared by every pass that reasons about imports, so hot-imports and
+    fault-isolation can never disagree on the same statement."""
+    if node.level == 0:
+        return node.module or ""
+    pkg_parts = pf.path.removesuffix(".py").split("/")[:-1]
+    # level 1 = current package, each extra level pops one package
+    base = pkg_parts[: len(pkg_parts) - (node.level - 1)]
+    return ".".join(base + ([node.module] if node.module else []))
+
+
+def dotted_name(node: ast.AST) -> str | None:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None

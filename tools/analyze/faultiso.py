@@ -1,0 +1,72 @@
+"""Fault-isolation pass: production code never imports fault injection
+or test code.
+
+The chaos/degrade machinery (``kpw_tpu/io/faults.py``,
+``kpw_tpu/ingest/faults.py``) is deliberately opt-in at the Builder
+seam — PR 3's contract is "zero production import", because a
+production worker that can reach injection code is one mis-wired flag
+away from injecting faults into real traffic.  Same for ``tests/``:
+production importing test helpers inverts the dependency arrow and
+quietly ships test doubles.
+
+The only sanctioned exceptions are the package ``__init__`` re-export
+lines (the public names tests/benchmarks import), each annotated
+inline with ``# lint: fault-isolation ok — <reason>``; the fault
+modules themselves (and ``faults`` importing ``faults``) are exempt by
+construction.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .common import (Config, Finding, ParsedFile, resolve_import,
+                     suppressed)
+
+PASS_NAME = "fault-isolation"
+DESCRIPTION = ("production modules never import io/ingest fault "
+               "injection or tests/")
+
+_FAULT_MODULES = ("kpw_tpu.io.faults", "kpw_tpu.ingest.faults")
+
+
+def _violation(mod: str) -> str | None:
+    if mod in _FAULT_MODULES or any(mod.startswith(f + ".")
+                                    for f in _FAULT_MODULES):
+        return f"fault-injection module {mod}"
+    if mod == "tests" or mod.startswith("tests."):
+        return f"test code {mod}"
+    return None
+
+
+def run(files: dict[str, ParsedFile], cfg: Config) -> list[Finding]:
+    findings: list[Finding] = []
+    for pf in files.values():
+        if pf.path.endswith("/faults.py"):
+            continue  # injection implementing itself is not a leak
+        for node in ast.walk(pf.tree):
+            mods: list[str] = []
+            if isinstance(node, ast.Import):
+                mods = [a.name for a in node.names]
+            elif isinstance(node, ast.ImportFrom):
+                # the base module plus each imported name as a possible
+                # submodule: catches `from .faults import X`,
+                # `from . import faults` AND `from kpw_tpu.io import
+                # faults` alike
+                base = resolve_import(pf, node)
+                mods = [base] if base else []
+                mods += [f"{base}.{a.name}" if base else a.name
+                         for a in node.names]
+            for mod in mods:
+                why = _violation(mod) if mod else None
+                if why is None:
+                    continue
+                if suppressed(pf, PASS_NAME, node.lineno, findings):
+                    continue
+                findings.append(Finding(
+                    PASS_NAME, pf.path, node.lineno,
+                    f"production module imports {why} — fault injection "
+                    f"and test helpers are opt-in at the Builder seam "
+                    f"only; if this is the public re-export seam, "
+                    f"annotate it with a justification"))
+    return findings

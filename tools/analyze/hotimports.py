@@ -1,0 +1,121 @@
+"""Hot-path import pass: no function-local imports in the declared hot
+modules.
+
+A function-local ``import`` costs a sys.modules dict probe plus binding
+work on EVERY call — measured twice in this repo's history (PR 2 hoisted
+``import random`` out of ``Histogram.update``; PR 6 hoisted the
+logging/time/tracing imports out of the fetch loop after they showed up
+in the e2e profile), and both times the import had crept back in by the
+next perf pass.  This pass mechanizes the rule for the modules whose
+functions sit on the per-record/per-batch path.
+
+The allowlist below holds the DELIBERATE exceptions: optional-dependency
+probes (jax backends, kafka, zstandard) that must fail lazily — an
+eager module-top import would make the whole package unimportable
+without the optional dep.  Every entry carries its one-line
+justification; ``python tools/check_docs.py`` verifies the entries
+README cites actually exist here.  One-off sites can alternatively be
+annotated inline with ``# lint: hot-imports ok — <reason>``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .common import (Config, Finding, ParsedFile, resolve_import,
+                     suppressed)
+
+PASS_NAME = "hot-imports"
+DESCRIPTION = ("no function-local imports in hot modules (consumer, "
+               "worker loop, row-group writer, pages, encodings)")
+
+# the per-record / per-batch / per-row-group path: one function-local
+# import here runs up to millions of times per second
+HOT_MODULES = frozenset({
+    "kpw_tpu/ingest/consumer.py",
+    "kpw_tpu/runtime/writer.py",
+    "kpw_tpu/core/writer.py",
+    "kpw_tpu/core/pages.py",
+    "kpw_tpu/core/encodings.py",
+})
+
+# (hot module, absolute imported module) -> one-line justification.
+# Policy (README "Correctness tooling"): entries are for optional
+# dependencies that must stay lazy — NOT for hot-loop convenience; a
+# justification that reads "called rarely" belongs on an inline
+# annotation at the call site instead, where the reviewer sees the loop.
+ALLOWLIST: dict[tuple[str, str], str] = {
+    ("kpw_tpu/runtime/writer.py", "kpw_tpu.ops.backend"):
+        "fail-fast probe for the optional jax TPU backend at writer "
+        "construction; an eager import would break CPU-only installs",
+    ("kpw_tpu/runtime/writer.py", "kpw_tpu.parallel.mesh_encoder"):
+        "fail-fast probe for the optional jax mesh backend at writer "
+        "construction; an eager import would break CPU-only installs",
+    ("kpw_tpu/runtime/writer.py", "kpw_tpu.runtime.select"):
+        "select imports the chosen backend's module tree (jax/native) on "
+        "use; deferred so cpu-backend writers never pay or require it",
+}
+
+
+def _import_candidates(pf: ParsedFile, node) -> list[list[str]]:
+    """Per imported alias, the dotted names it may denote, least to most
+    specific — ``from ..ops import backend`` can mean the module
+    ``kpw_tpu.ops.backend`` or a name inside ``kpw_tpu.ops``, and the
+    allowlist matches either."""
+    if isinstance(node, ast.Import):
+        return [[a.name] for a in node.names]
+    base = resolve_import(pf, node)
+    return [[base, f"{base}.{a.name}"] if base else [a.name]
+            for a in node.names]
+
+
+def run(files: dict[str, ParsedFile], cfg: Config) -> list[Finding]:
+    findings: list[Finding] = []
+    for pf in files.values():
+        if not (cfg.hot_all or pf.path in HOT_MODULES):
+            continue
+        top_level = set(pf.tree.body)
+        for node in ast.walk(pf.tree):
+            if not isinstance(node, (ast.Import, ast.ImportFrom)):
+                continue
+            if node in top_level:
+                continue
+            for cands in _import_candidates(pf, node):
+                if any((pf.path, c) in ALLOWLIST for c in cands):
+                    continue
+                if suppressed(pf, PASS_NAME, node.lineno, findings):
+                    continue
+                findings.append(Finding(
+                    PASS_NAME, pf.path, node.lineno,
+                    f"function-local import of {cands[-1]} in hot module "
+                    f"— hoist to module top, or add an ALLOWLIST entry "
+                    f"(tools/analyze/hotimports.py) with a justification "
+                    f"if it is a deliberate lazy optional-dependency "
+                    f"import"))
+    if cfg.full_repo:
+        # a stale allowlist is drift too: every entry must still point at
+        # a hot module that actually contains a local import of that
+        # module (otherwise the exception outlives the code it excused)
+        live: set[tuple[str, str]] = set()
+        for pf in files.values():
+            if pf.path not in HOT_MODULES:
+                continue
+            top_level = set(pf.tree.body)
+            for node in ast.walk(pf.tree):
+                if (isinstance(node, (ast.Import, ast.ImportFrom))
+                        and node not in top_level):
+                    for cands in _import_candidates(pf, node):
+                        live.update((pf.path, c) for c in cands)
+        for key, why in sorted(ALLOWLIST.items()):
+            if key not in live:
+                findings.append(Finding(
+                    PASS_NAME, key[0], 1,
+                    f"stale ALLOWLIST entry {key[1]!r}: no function-local "
+                    f"import of it remains — delete the entry "
+                    f"(justification was: {why})"))
+            if not why.strip():
+                findings.append(Finding(
+                    PASS_NAME, key[0], 1,
+                    f"ALLOWLIST entry {key[1]!r} has an empty "
+                    f"justification"))
+    return findings

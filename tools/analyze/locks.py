@@ -1,0 +1,255 @@
+"""Lock-discipline pass: no blocking calls under a held lock, and a
+cycle-free static lock-acquisition graph.
+
+Two rules, both learned the hard way in this repo (the PR-1
+``string_stats`` race was a missing lock; the concurrency surface is now
+~25 locks across ingest/core/io/runtime and growing toward the GIL-free
+host leg on the roadmap):
+
+1. **No blocking call while a lock is held.**  A ``threading.Lock`` /
+   ``RLock`` / ``Condition`` guard should bracket memory mutation, not
+   IO: a broker fetch/commit, a filesystem op, a queue put/get, a thread
+   join or a sleep executed under a lock turns every sibling of that
+   lock into a convoy behind the slowest IO — and under fault injection
+   (io/faults latency/hang rules) into a de facto deadlock.  Waiting on
+   the condition you HOLD is exempt (that is the release pattern).
+
+2. **The static lock-order graph must be acyclic.**  Every syntactic
+   ``with B:`` nested inside ``with A:`` records the edge A→B; a cycle
+   between two locks means two call paths can acquire them in opposite
+   orders — the classic inversion the runtime detector
+   (kpw_tpu/utils/lockcheck.py) catches live.  Static nesting only sees
+   one function at a time (no interprocedural inference — documented
+   limitation; the runtime detector covers the cross-function case).
+
+Lock-likeness is name-based: the context expression's last segment
+matching ``lock|mutex|cond`` (``self._lock``, ``_DISPATCH_LOCK``,
+``self._buf_cond``, ``log.lock``).  That convention is repo law — a lock
+named ``foo`` is invisible to this pass, so don't name locks ``foo``.
+
+Suppress one deliberate site with ``# lint: lock-discipline ok — <why>``.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .common import Config, Finding, ParsedFile, dotted_name, suppressed
+
+PASS_NAME = "lock-discipline"
+DESCRIPTION = ("blocking calls under held threading locks + "
+               "static lock-order cycle rejection")
+
+_LOCK_RE = re.compile(r"(lock|mutex|cond)", re.I)
+
+# attribute calls that block (or may block) by contract.  join is
+# narrowed to thread-shaped receivers/timeout calls because str.join and
+# os.path.join are ubiquitous; put/get are narrowed to queue/buffer-
+# shaped receivers because dict.get is ubiquitous.
+_BLOCKING_ATTRS = frozenset({
+    "sleep",                                   # time.sleep / _time.sleep
+    "fetch", "fetch_batch", "commit",          # broker IO
+    "open_read", "open_write", "open_append",  # filesystem ops
+    "rename", "durable_rename", "delete", "mkdirs", "list_files",
+    "sync", "sync_dir",
+})
+_QUEUEISH_RE = re.compile(r"(^|_)(q|queue|buf)$|queue$", re.I)
+_THREADISH_RE = re.compile(r"thread|proc|pool", re.I)
+
+
+def _last_segment(name: str) -> str:
+    return name.rsplit(".", 1)[-1]
+
+
+def _is_lock_expr(node: ast.AST) -> str | None:
+    name = dotted_name(node)
+    if name is not None and _LOCK_RE.search(_last_segment(name)):
+        return name
+    return None
+
+
+class _FunctionScanner(ast.NodeVisitor):
+    """Walks one function body tracking the syntactically-held lock
+    stack; records blocking-call findings and acquisition edges."""
+
+    def __init__(self, pf: ParsedFile, cls: str | None, edges: dict,
+                 findings: list) -> None:
+        self.pf = pf
+        self.cls = cls
+        self.edges = edges          # (src, dst) -> (file, line)
+        self.findings = findings
+        self.held: list[tuple[str, str]] = []  # (canon, source-expr name)
+
+    def _canon(self, name: str) -> str:
+        mod = self.pf.path.rsplit("/", 1)[-1].removesuffix(".py")
+        if name.startswith("self."):
+            owner = self.cls or mod
+            return f"{owner}.{name[len('self.'):]}"
+        return f"{mod}.{name}" if "." not in name else name
+
+    # nested defs get their own scanner (a closure's body does not run
+    # under the enclosing with)
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        _FunctionScanner(self.pf, self.cls, self.edges,
+                         self.findings).generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        _FunctionScanner(self.pf, self.cls, self.edges,
+                         self.findings).generic_visit(node)
+
+    def visit_With(self, node: ast.With) -> None:
+        n_acquired = 0
+        for item in node.items:
+            name = _is_lock_expr(item.context_expr)
+            if name is not None:
+                self._record_acquire(name, item.context_expr)
+                self.held.append((self._canon(name), name))
+                n_acquired += 1
+            else:
+                self.visit(item.context_expr)  # e.g. with fs.open_write(...)
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in range(n_acquired):
+            self.held.pop()
+
+    def _record_acquire(self, name: str, node: ast.AST) -> None:
+        canon = self._canon(name)
+        for held_canon, _src in self.held:
+            if held_canon != canon:
+                self.edges.setdefault(
+                    (held_canon, canon), (self.pf.path, node.lineno))
+
+    def visit_Call(self, node: ast.Call) -> None:
+        self.generic_visit(node)
+        if not self.held:
+            return
+        line = node.lineno
+        func = node.func
+        label = None
+        if isinstance(func, ast.Name):
+            if func.id == "sleep":
+                label = "sleep() (time.sleep)"
+            elif func.id == "open":
+                label = "builtin open()"
+        elif isinstance(func, ast.Attribute):
+            attr = func.attr
+            recv = dotted_name(func.value)
+            recv_seg = _last_segment(recv) if recv else ""
+            if attr == "acquire":
+                lock = _is_lock_expr(func.value)
+                if lock is not None:
+                    self._record_acquire(lock, node)
+                return
+            if attr in _BLOCKING_ATTRS:
+                label = f"{recv or '<expr>'}.{attr}()"
+            elif attr in ("wait", "wait_for"):
+                # waiting on the condition you hold releases it — the
+                # canonical producer/consumer pattern, never a finding;
+                # waiting on anything ELSE while a lock is held blocks
+                # with the lock still held
+                if recv is None or recv not in {src for _, src in self.held}:
+                    label = f"{recv or '<expr>'}.{attr}()"
+            elif attr in ("put", "get") and _QUEUEISH_RE.search(recv_seg):
+                label = f"{recv}.{attr}()"
+            elif attr == "join" and (
+                    _THREADISH_RE.search(recv_seg)
+                    or any(kw.arg == "timeout" for kw in node.keywords)):
+                label = f"{recv or '<expr>'}.join()"
+        if label is None:
+            return
+        held_names = ", ".join(c for c, _ in self.held)
+        if suppressed(self.pf, PASS_NAME, line, self.findings):
+            return
+        self.findings.append(Finding(
+            PASS_NAME, self.pf.path, line,
+            f"blocking call {label} while holding lock(s) {held_names} — "
+            f"move the call outside the guarded section or annotate the "
+            f"deliberate exception"))
+
+
+def _find_cycles(edges: dict) -> list[list[str]]:
+    """Every elementary cycle reachable in the edge set, deduplicated by
+    node membership (one report per inversion pair/ring)."""
+    graph: dict[str, list[str]] = {}
+    for (a, b) in edges:
+        graph.setdefault(a, []).append(b)
+    seen_cycles: set[frozenset] = set()
+    out: list[list[str]] = []
+    for start in sorted(graph):
+        stack = [(start, [start])]
+        while stack:
+            node, path = stack.pop()
+            for nxt in graph.get(node, ()):
+                if nxt == start and len(path) > 1:
+                    key = frozenset(path)
+                    if key not in seen_cycles:
+                        seen_cycles.add(key)
+                        out.append(path + [start])
+                elif nxt not in path and len(path) < 8:
+                    stack.append((nxt, path + [nxt]))
+    return out
+
+
+def run(files: dict[str, ParsedFile], cfg: Config) -> list[Finding]:
+    findings: list[Finding] = []
+    edges: dict[tuple[str, str], tuple[str, int]] = {}
+    for pf in files.values():
+        cls_of = _class_map(pf.tree)
+        nested = _nested_functions(pf.tree)
+        for node in ast.walk(pf.tree):
+            if (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and node not in nested):
+                # nested defs are scanned by their enclosing function's
+                # scanner (fresh held-stack) — scanning them again here
+                # would duplicate every finding inside them
+                scanner = _FunctionScanner(pf, cls_of.get(node), edges,
+                                           findings)
+                for stmt in node.body:
+                    scanner.visit(stmt)
+    for cycle in _find_cycles(edges):
+        sites = []
+        for a, b in zip(cycle, cycle[1:]):
+            f, ln = edges[(a, b)]
+            sites.append(f"{a}->{b} at {f}:{ln}")
+        f0, ln0 = edges[(cycle[0], cycle[1])]
+        findings.append(Finding(
+            PASS_NAME, f0, ln0,
+            "lock-order cycle: " + "; ".join(sites) + " — two call paths "
+            "can acquire these locks in opposite orders (deadlock risk); "
+            "pick one global order"))
+    return findings
+
+
+def _nested_functions(tree: ast.Module) -> set:
+    """Function nodes defined inside another function (closures, local
+    retry bodies) — owned by the enclosing function's scan."""
+    out: set = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            for sub in ast.walk(node):
+                if sub is not node and isinstance(
+                        sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    out.add(sub)
+    return out
+
+
+def _class_map(tree: ast.Module) -> dict:
+    """function node -> name of the innermost enclosing class."""
+    out: dict = {}
+
+    def walk(node: ast.AST, cls: str | None) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                walk(child, child.name)
+            else:
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    out[child] = cls
+                walk(child, cls)
+
+    walk(tree, None)
+    return out

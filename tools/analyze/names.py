@@ -1,0 +1,188 @@
+"""Canonical-name pass: stage/metric string literals ↔ the in-code
+registries, both directions.
+
+``tracing.STAGE_NAMES`` and ``metrics.METRIC_NAMES`` are the canonical
+registries the docs cite from (tools/check_docs.py reconciles doc
+claims against them since PR 2).  This pass closes the code side of the
+loop:
+
+* every string literal passed to ``stage(...)`` must be registered in
+  ``STAGE_NAMES`` (a typo'd stage name would otherwise record spans
+  under a name no dashboard/check knows);
+* every string literal passed to a ``meter(...)`` / ``gauge(...)`` /
+  ``histogram(...)`` constructor must be registered in ``METRIC_NAMES``;
+* **reverse direction** (full-repo runs only): every registered stage
+  name must actually be used by a ``stage(...)`` call, and every
+  registered metric name must be the value of a module-level constant
+  in ``runtime/metrics.py`` that production code references — a
+  registry entry nothing emits is a doc claim about a ghost.
+
+Metric names travel as constants (``M.WRITTEN_RECORDS_METER``), so the
+constant table in metrics.py is cross-checked against METRIC_NAMES
+exactly (same set, no orphans either way).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .common import Config, Finding, ParsedFile, suppressed
+
+PASS_NAME = "canonical-names"
+DESCRIPTION = ("stage()/meter/gauge literals registered in STAGE_NAMES/"
+               "METRIC_NAMES, and registries fully used (both directions)")
+
+_METRICS_MODULE = "kpw_tpu/runtime/metrics.py"
+_TRACING_MODULE = "kpw_tpu/utils/tracing.py"
+_METRIC_CTORS = ("meter", "gauge", "histogram")
+
+
+def _registry(files: dict, path: str, tuple_name: str) -> tuple[set, int]:
+    """The literal entries of ``tuple_name`` in ``path`` (empty when the
+    module is not in the scanned set — fixture runs)."""
+    pf = files.get(path)
+    if pf is None:
+        return set(), 0
+    for node in pf.tree.body:
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == tuple_name
+                and isinstance(node.value, (ast.Tuple, ast.List))):
+            vals = set()
+            for elt in node.value.elts:
+                if isinstance(elt, ast.Constant) and isinstance(elt.value,
+                                                                str):
+                    vals.add(elt.value)
+                elif isinstance(elt, ast.Name):
+                    # entries referencing the metric constants by name
+                    vals.add(("NAME", elt.id))
+            return vals, node.lineno
+    return set(), 0
+
+
+def _metric_constants(files: dict) -> dict[str, str]:
+    """metrics.py module-level ``UPPER = "dotted.name"`` constants."""
+    pf = files.get(_METRICS_MODULE)
+    if pf is None:
+        return {}
+    out: dict[str, str] = {}
+    for node in pf.tree.body:
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id.isupper()
+                and isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, str)
+                and "." in node.value.value):
+            out[node.targets[0].id] = node.value.value
+    return out
+
+
+def _imported_registries() -> tuple[set, set]:
+    """Fallback for partial scans (fixtures, single files): read the
+    live registries from the installed package so literal checks still
+    have something authoritative to check against."""
+    try:
+        from kpw_tpu.runtime.metrics import METRIC_NAMES
+        from kpw_tpu.utils.tracing import STAGE_NAMES
+        return set(STAGE_NAMES), set(METRIC_NAMES)
+    except ImportError:
+        return set(), set()
+
+
+def run(files: dict[str, ParsedFile], cfg: Config) -> list[Finding]:
+    findings: list[Finding] = []
+    stage_reg, _ = _registry(files, _TRACING_MODULE, "STAGE_NAMES")
+    metric_tuple, metric_line = _registry(files, _METRICS_MODULE,
+                                          "METRIC_NAMES")
+    constants = _metric_constants(files)
+    if not stage_reg or not metric_tuple:
+        imp_stages, imp_metrics = _imported_registries()
+        stage_reg = stage_reg or imp_stages
+        if not metric_tuple:
+            metric_tuple = imp_metrics
+    # METRIC_NAMES entries are constant references; resolve to values
+    metric_reg: set[str] = set()
+    named_constants: set[str] = set()
+    for entry in metric_tuple:
+        if isinstance(entry, tuple):
+            named_constants.add(entry[1])
+            if entry[1] in constants:
+                metric_reg.add(constants[entry[1]])
+        else:
+            metric_reg.add(entry)
+
+    stage_used: set[str] = set()
+    constants_used: set[str] = set()
+    metric_literals_used: set[str] = set()
+    for pf in files.values():
+        for node in ast.walk(pf.tree):
+            if isinstance(node, ast.Attribute) and pf.path != _METRICS_MODULE:
+                if node.attr in constants:
+                    constants_used.add(node.attr)
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            fname = (func.id if isinstance(func, ast.Name)
+                     else func.attr if isinstance(func, ast.Attribute)
+                     else None)
+            lit = (node.args[0].value
+                   if node.args and isinstance(node.args[0], ast.Constant)
+                   and isinstance(node.args[0].value, str) else None)
+            if fname == "stage" and lit is not None:
+                stage_used.add(lit)
+                if stage_reg and lit not in stage_reg:
+                    if not suppressed(pf, PASS_NAME, node.lineno, findings):
+                        findings.append(Finding(
+                            PASS_NAME, pf.path, node.lineno,
+                            f"stage({lit!r}) not registered in "
+                            f"tracing.STAGE_NAMES — register it (and "
+                            f"document it) or fix the typo"))
+            elif fname in _METRIC_CTORS and lit is not None:
+                # only registry-shaped constructors take a NAME first arg
+                # (MetricRegistry.meter/gauge/histogram); dotted-name shape
+                # keeps incidental .get("key")-style calls out
+                if "." not in lit:
+                    continue
+                metric_literals_used.add(lit)
+                if metric_reg and lit not in metric_reg:
+                    if not suppressed(pf, PASS_NAME, node.lineno, findings):
+                        findings.append(Finding(
+                            PASS_NAME, pf.path, node.lineno,
+                            f"{fname}({lit!r}) not registered in "
+                            f"metrics.METRIC_NAMES — register it (and "
+                            f"document it) or fix the typo"))
+
+    if not cfg.full_repo:
+        return findings
+
+    # reverse directions — registry completeness against actual use
+    for name in sorted(stage_reg - stage_used):
+        findings.append(Finding(
+            PASS_NAME, _TRACING_MODULE, 1,
+            f"STAGE_NAMES entry {name!r} is never used by any stage(...) "
+            f"call — dead registry entry (docs may cite it); remove or "
+            f"re-wire it"))
+    # constant table <-> METRIC_NAMES exact correspondence
+    for cname, value in sorted(constants.items()):
+        if value not in metric_reg:
+            findings.append(Finding(
+                PASS_NAME, _METRICS_MODULE, metric_line or 1,
+                f"metric constant {cname} = {value!r} missing from "
+                f"METRIC_NAMES — register it"))
+    by_value = {v: k for k, v in constants.items()}
+    for value in sorted(metric_reg):
+        if value not in by_value and value not in metric_literals_used:
+            findings.append(Finding(
+                PASS_NAME, _METRICS_MODULE, metric_line or 1,
+                f"METRIC_NAMES entry {value!r} has no backing constant in "
+                f"metrics.py and no literal constructor call — ghost "
+                f"metric"))
+    # every constant must be referenced by production code outside
+    # metrics.py (a registered-but-never-marked metric is a ghost too)
+    for cname in sorted(named_constants | set(constants)):
+        if cname in constants and cname not in constants_used:
+            findings.append(Finding(
+                PASS_NAME, _METRICS_MODULE, metric_line or 1,
+                f"metric constant {cname} ({constants[cname]!r}) is never "
+                f"referenced outside metrics.py — nothing emits it"))
+    return findings

@@ -1,0 +1,75 @@
+"""Swallowed-exception pass: no bare ``except:`` and no
+``except Exception: pass`` outside annotated seams.
+
+A handler that catches everything and does nothing erases the only
+evidence a failure ever happened — in this codebase that shape has
+twice hidden real bugs until a bench/number went wrong.  Specific
+exception types with a do-nothing body (``except queue.Full: pass``)
+are fine: the narrowness IS the handling.  What this pass rejects:
+
+* ``except:`` with no type anywhere (also catches SystemExit/
+  KeyboardInterrupt — never acceptable in production code);
+* ``except Exception`` / ``except BaseException`` whose body does
+  nothing (only ``pass`` / ``...`` / ``continue``) and logs nothing.
+
+Deliberate seams (a ``__del__`` GC safety net, best-effort cleanup on a
+path that already failed) are annotated inline:
+``# lint: swallowed-exceptions ok — <reason>``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .common import Config, Finding, ParsedFile, suppressed
+
+PASS_NAME = "swallowed-exceptions"
+DESCRIPTION = ("no bare except / no do-nothing except Exception outside "
+               "annotated seams")
+
+_BROAD = {"Exception", "BaseException"}
+
+
+def _is_broad(type_node: ast.AST | None) -> bool:
+    if type_node is None:
+        return True  # bare except: — always flagged, even with a body
+    if isinstance(type_node, ast.Name):
+        return type_node.id in _BROAD
+    if isinstance(type_node, ast.Tuple):
+        return any(_is_broad(e) for e in type_node.elts)
+    return False
+
+
+def _does_nothing(body: list[ast.stmt]) -> bool:
+    for stmt in body:
+        if isinstance(stmt, ast.Pass) or isinstance(stmt, ast.Continue):
+            continue
+        if (isinstance(stmt, ast.Expr)
+                and isinstance(stmt.value, ast.Constant)):
+            continue  # docstring / ellipsis
+        return False
+    return True
+
+
+def run(files: dict[str, ParsedFile], cfg: Config) -> list[Finding]:
+    findings: list[Finding] = []
+    for pf in files.values():
+        for node in ast.walk(pf.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            bare = node.type is None
+            if not _is_broad(node.type):
+                continue
+            if not bare and not _does_nothing(node.body):
+                continue  # broad catch WITH handling (log/fallback): ok
+            if suppressed(pf, PASS_NAME, node.lineno, findings):
+                continue
+            what = ("bare `except:`" if bare
+                    else "`except Exception`-class handler that does "
+                         "nothing")
+            findings.append(Finding(
+                PASS_NAME, pf.path, node.lineno,
+                f"{what} — narrow the type, handle (at least log) the "
+                f"failure, or annotate the deliberate seam with its "
+                f"justification"))
+    return findings

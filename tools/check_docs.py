@@ -240,6 +240,82 @@ def _canonical_names() -> set:
     return set(METRIC_NAMES) | set(STAGE_NAMES)
 
 
+# --- analyze: lint suite <-> docs reconciliation (ISSUE 7) -------------------
+# README's "Correctness tooling" section names the lint passes and the
+# hot-import allowlist entries.  Existence: every backticked kebab-case
+# pass name cited near the word "pass" must be registered in
+# tools/analyze (a renamed/removed pass must not survive in prose), and
+# every backticked dotted module cited near "allowlist" must be a live
+# ALLOWLIST key (a stale doc allowlist is a waiver nobody holds).
+# Completeness (the REVERSE of the PR-2 existence check): every
+# registered canonical metric/stage name must be documented —
+# check_cited_names only proves cited names exist; this proves existing
+# names are cited.
+
+_PASS_TOKEN = re.compile(r"`([a-z]+(?:-[a-z]+)+)`")
+_PASS_CUE = re.compile(r"\bpass\b|\blint\b", re.I)
+_ALLOW_CUE = re.compile(r"allowlist", re.I)
+_SECTION_RE = re.compile(
+    r"^##\s+Correctness tooling.*?(?=^##\s|\Z)", re.M | re.S)
+
+
+def _analyze_registry():
+    sys.path.insert(0, ROOT)
+    from tools.analyze import PASS_NAMES
+    from tools.analyze.hotimports import ALLOWLIST
+
+    return set(PASS_NAMES), {mod for (_path, mod) in ALLOWLIST}
+
+
+def check_analyze_docs(docs: dict) -> list[str]:
+    failures = []
+    m = _SECTION_RE.search(docs["README.md"])
+    if m is None:
+        return ["README.md: no '## Correctness tooling' section (the "
+                "lint suite must be documented)"]
+    section = m.group(0)
+    pass_names, allow_mods = _analyze_registry()
+    for tok_m in _PASS_TOKEN.finditer(section):
+        tok = tok_m.group(1)
+        window = section[max(0, tok_m.start() - _WINDOW_BEFORE):
+                         tok_m.end() + _WINDOW_AFTER]
+        if _PASS_CUE.search(window) and tok not in pass_names:
+            failures.append(
+                f"README.md: Correctness tooling cites lint pass `{tok}` "
+                f"not registered in tools/analyze")
+    for tok_m in _DOTTED_TOKEN.finditer(section):
+        tok = tok_m.group(1)
+        window = section[max(0, tok_m.start() - _WINDOW_BEFORE):
+                         tok_m.end() + _WINDOW_AFTER]
+        if (_ALLOW_CUE.search(window) and tok.startswith("kpw_tpu.")
+                and tok not in allow_mods):
+            failures.append(
+                f"README.md: Correctness tooling cites allowlist entry "
+                f"`{tok}` absent from tools/analyze/hotimports.ALLOWLIST")
+    # every registered pass must be documented in the section at all
+    for name in sorted(pass_names):
+        if f"`{name}`" not in section:
+            failures.append(
+                f"README.md: lint pass `{name}` is registered in "
+                f"tools/analyze but not documented in the Correctness "
+                f"tooling section")
+    return failures
+
+
+def check_name_completeness(docs: dict) -> list[str]:
+    """Every registered canonical metric/stage name must appear
+    (backticked) somewhere in README or PARITY — completeness, the
+    reverse direction of check_cited_names."""
+    names = _canonical_names()
+    text = "".join(docs[f] for f in NAME_DOCS)
+    return [
+        f"canonical name `{n}` (tracing.STAGE_NAMES / "
+        f"metrics.METRIC_NAMES) is documented nowhere in "
+        f"{'/'.join(NAME_DOCS)} — document it or unregister it"
+        for n in sorted(names) if f"`{n}`" not in text
+    ]
+
+
 def check_cited_names(docs: dict, names: set | None = None) -> list[str]:
     if names is None:
         names = _canonical_names()
@@ -322,6 +398,8 @@ def main() -> int:
     failures += check_cited_names(docs)
     failures += check_cited_tests(docs)
     failures += check_durability_claims(docs)
+    failures += check_analyze_docs(docs)
+    failures += check_name_completeness(docs)
     for fname, pattern, paths in CHECKS:
         m = re.search(pattern, docs[fname])
         if not m:

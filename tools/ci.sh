@@ -1,0 +1,66 @@
+#!/usr/bin/env bash
+# Single CI entry point for the correctness tooling (ISSUE 7, README
+# "Correctness tooling"): the four gates, in cheap-to-expensive order,
+# each failing fast and loudly.
+#
+#   1. lint suite        — python -m tools.analyze   (static analysis:
+#                          lock discipline, hot imports, canonical
+#                          names, fault isolation, swallowed exceptions)
+#   2. tier-1 pytest     — the fast suite (-m 'not slow'); compare the
+#                          passed count against the baseline in
+#                          CHANGES.md (this container carries ~31
+#                          pre-existing environmental failures: python
+#                          zstandard module + jax shard_map absent)
+#   3. doc reconciliation — python tools/check_docs.py (every doc-cited
+#                          number/name/test/pass exists and matches)
+#   4. sanitizer smoke   — bash tools/sanitize.sh --smoke (ASan/UBSan
+#                          native build + fuzz; prints a LOUD notice and
+#                          exits 0 when the toolchain is absent — never
+#                          a silent pass)
+#
+# Usage: bash tools/ci.sh        (exit 0 = all gates green)
+
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+
+fail=0
+step() { echo; echo "=== ci.sh [$1] $2 ==="; }
+
+step 1/4 "lint suite (python -m tools.analyze)"
+python -m tools.analyze || fail=1
+
+step 2/4 "tier-1 pytest (-m 'not slow')"
+# tier-1's exit code is nonzero on THIS container because of the known
+# environmental failures (python zstandard + jax shard_map absent — see
+# the CHANGES.md baseline), so the gate is mechanical instead of
+# exit-code-based: fail on any collection error, or on more failures
+# than the environmental ceiling (override with KPW_CI_MAX_FAILED).
+T1_LOG="$(mktemp)"
+JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider 2>&1 \
+    | tee "$T1_LOG" | tail -5
+t1_failed=$(grep -aoE '[0-9]+ failed' "$T1_LOG" | tail -1 | grep -oE '[0-9]+' || echo 0)
+t1_errors=$(grep -aoE '[0-9]+ error' "$T1_LOG" | tail -1 | grep -oE '[0-9]+' || echo 0)
+t1_passed=$(grep -aoE '[0-9]+ passed' "$T1_LOG" | tail -1 | grep -oE '[0-9]+' || echo 0)
+max_failed="${KPW_CI_MAX_FAILED:-31}"
+echo "tier-1: passed=$t1_passed failed=$t1_failed errors=$t1_errors (ceiling $max_failed)"
+if [ "$t1_errors" -gt 0 ] || [ "$t1_failed" -gt "$max_failed" ] \
+        || [ "$t1_passed" -eq 0 ]; then
+    echo "tier-1 gate FAILED (errors, zero passes, or failures above the"
+    echo "environmental ceiling — diff the failure list against CHANGES.md)"
+    fail=1
+fi
+rm -f "$T1_LOG"
+
+step 3/4 "doc reconciliation (tools/check_docs.py)"
+python tools/check_docs.py || fail=1
+
+step 4/4 "sanitizer smoke (tools/sanitize.sh --smoke)"
+bash tools/sanitize.sh --smoke || fail=1
+
+echo
+if [ "$fail" -ne 0 ]; then
+    echo "ci.sh: FAILED (one or more gates above)"
+    exit 1
+fi
+echo "ci.sh: all gates green (tier-1 failures must still be diffed against the CHANGES.md baseline)"

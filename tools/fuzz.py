@@ -1,0 +1,280 @@
+"""Seeded mutation-fuzz harness for the parsing/validation attack
+surface: every crash class PR 4 / PR 6 fixed by hand, as a standing
+regression net.
+
+History: the thrift ``CompactReader`` shipped with unbounded varints and
+bare IndexErrors until PR 4 hardened it against the corrupt-file corpus;
+``shred_flat_buf`` shipped with an end-points-only offset check until a
+malformed INTERIOR offset was found post-review in PR 6 to be an
+out-of-bounds C read.  Both were found by humans staring at code.  This
+harness makes the search mechanical and repeatable: seeded mutations
+(bit flips, truncations, splices, adversarial offset tables) over valid
+inputs, with a strict allowed-outcome contract per target —
+
+* ``thrift``  — ``CompactReader.read_struct`` over mutated footer bytes:
+  must return a dict or raise ``ThriftDecodeError``; an IndexError /
+  RecursionError / MemoryError / OverflowError is a crash.
+* ``verify``  — ``io.verify.verify_bytes`` over mutated whole files:
+  must RETURN a ``FileReport`` (ok or not), never raise.
+* ``offsets`` — ``ProtoColumnarizer.columnarize_buffer`` over a valid
+  payload buffer with mutated offset tables (and mutated payload bytes
+  under a valid table): must return a ColumnBatch or raise
+  ``ValueError`` / ``WireShredError``; anything else — in particular a
+  native OOB read, which the ASan build (tools/sanitize.sh) turns into
+  an abort — is a crash.
+
+Deterministic by construction: ``--seed`` fixes the whole run, and the
+committed regression configuration is seed=20260803 (tools/ci.sh runs
+it under the sanitizer build; tests/test_analyze.py runs a smaller
+count in tier-1).
+
+Run: ``python -m tools.fuzz [--seed N] [--iters N] [--target NAME]``
+Exit 0 = zero crashes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import io
+import random
+import sys
+
+import numpy as np
+
+
+def _make_parquet_bytes() -> bytes:
+    """One small valid parquet file (two row groups, CRCs on) — the
+    mutation substrate for the thrift/verify targets."""
+    from kpw_tpu.core.schema import (Field, PhysicalType, Repetition,
+                                     Schema)
+    from kpw_tpu.core.writer import (ParquetFileWriter, WriterProperties,
+                                     columns_from_arrays)
+
+    sch = Schema([
+        Field("a", Repetition.REQUIRED, physical_type=PhysicalType.INT64),
+        Field("s", Repetition.REQUIRED,
+              physical_type=PhysicalType.BYTE_ARRAY),
+        Field("o", Repetition.OPTIONAL, physical_type=PhysicalType.INT32),
+    ])
+    sink = io.BytesIO()
+    props = WriterProperties(row_group_size=8192, data_page_size=512,
+                             page_checksums=True)
+    w = ParquetFileWriter(sink, sch, props)
+    rng = np.random.default_rng(7)
+    rows = 600
+    for _ in range(2):
+        w.write_batch(columns_from_arrays(sch, {
+            "a": rng.integers(0, 50, rows),
+            "s": [f"v{i % 9}".encode() for i in range(rows)],
+            "o": (rng.integers(0, 9, rows).astype(np.int32),
+                  rng.random(rows) > 0.1),
+        }))
+        w.flush_row_group()
+    w.close()
+    return sink.getvalue()
+
+
+def _make_wire_batch():
+    """(columnarizer, payload buffer, valid offsets) for the offsets
+    target — a flat proto2 message batch, the ``RecordBatch`` handoff
+    shape ``columnarize_buffer`` consumes."""
+    from google.protobuf import (descriptor_pb2, descriptor_pool,
+                                 message_factory)
+
+    from kpw_tpu.models.proto_bridge import ProtoColumnarizer
+
+    F = descriptor_pb2.FieldDescriptorProto
+    pool = descriptor_pool.DescriptorPool()
+    fdp = descriptor_pb2.FileDescriptorProto(
+        name="fuzz_sample.proto", package="kpwfuzz", syntax="proto2")
+    m = fdp.message_type.add(name="FuzzMessage")
+    m.field.add(name="query", number=1, type=F.TYPE_STRING,
+                label=F.LABEL_REQUIRED)
+    m.field.add(name="timestamp", number=2, type=F.TYPE_INT64,
+                label=F.LABEL_REQUIRED)
+    m.field.add(name="page", number=3, type=F.TYPE_INT32,
+                label=F.LABEL_OPTIONAL)
+    fd = pool.Add(fdp)
+    cls = message_factory.GetMessageClass(
+        fd.message_types_by_name["FuzzMessage"])
+    payloads = []
+    for i in range(200):
+        msg = cls(query=f"q-{i}-" + "x" * (i % 17), timestamp=i)
+        if i % 3:
+            msg.page = i % 11
+        payloads.append(msg.SerializeToString())
+    offs = np.zeros(len(payloads) + 1, np.int64)
+    np.cumsum([len(p) for p in payloads], out=offs[1:])
+    buf = b"".join(payloads)
+    col = ProtoColumnarizer(cls)
+    assert col.wire_capable, "fuzz schema must be wire-shreddable"
+    return col, buf, offs
+
+
+def _mutate_bytes(rng: random.Random, data: bytes) -> bytes:
+    """One seeded structural mutation: bit flips, truncation, splice,
+    or a zero/0xFF run — the corruption shapes torn publishes and bad
+    media actually produce."""
+    b = bytearray(data)
+    kind = rng.randrange(5)
+    if kind == 0:      # flip 1..8 random bits
+        for _ in range(rng.randint(1, 8)):
+            i = rng.randrange(len(b))
+            b[i] ^= 1 << rng.randrange(8)
+    elif kind == 1:    # truncate
+        return bytes(b[: rng.randrange(len(b))])
+    elif kind == 2:    # splice a random window elsewhere
+        n = rng.randint(1, min(64, len(b)))
+        src = rng.randrange(len(b) - n + 1)
+        dst = rng.randrange(len(b) - n + 1)
+        b[dst: dst + n] = b[src: src + n]
+    elif kind == 3:    # overwrite a run with 0x00/0xFF
+        n = rng.randint(1, min(64, len(b)))
+        at = rng.randrange(len(b) - n + 1)
+        b[at: at + n] = bytes([rng.choice((0, 0xFF))]) * n
+    else:              # random garbage run
+        n = rng.randint(1, min(32, len(b)))
+        at = rng.randrange(len(b) - n + 1)
+        b[at: at + n] = bytes(rng.randrange(256) for _ in range(n))
+    return bytes(b)
+
+
+def _mutate_offsets(rng: random.Random, offs: np.ndarray,
+                    buf_len: int) -> np.ndarray:
+    """One adversarial offset table: the PR-6 crash class (a malformed
+    INTERIOR entry) plus the whole family around it."""
+    o = offs.copy()
+    kind = rng.randrange(6)
+    if kind == 0:      # corrupt one interior entry (the PR-6 OOB shape)
+        i = rng.randrange(1, len(o) - 1) if len(o) > 2 else 0
+        o[i] = rng.choice((-1, buf_len + rng.randint(1, 1 << 20),
+                           rng.randint(0, max(buf_len, 1)) * -1,
+                           (1 << 62)))
+    elif kind == 1:    # descending pair
+        i = rng.randrange(1, len(o))
+        o[i] = o[i - 1] - rng.randint(1, 100)
+    elif kind == 2:    # shift the whole window past the end
+        o += buf_len
+    elif kind == 3:    # random permutation of a slice
+        i = rng.randrange(len(o))
+        j = rng.randrange(len(o))
+        o[i], o[j] = o[j], o[i]
+    elif kind == 4:    # random table entirely, sorted or shuffled 50/50
+        vals = [rng.randrange(-buf_len, 2 * buf_len + 1)
+                for _ in range(len(o))]
+        if rng.random() < 0.5:
+            vals.sort()
+        o = np.array(vals, np.int64)
+    else:              # truncated / oversized table
+        n = rng.randrange(0, len(o) + 4)
+        o = np.resize(o, n)
+    return o
+
+
+def fuzz_thrift(seed: int, iters: int, report) -> int:
+    from kpw_tpu.core.thrift import CompactReader, ThriftDecodeError
+
+    data = _make_parquet_bytes()
+    footer_len = int.from_bytes(data[-8:-4], "little")
+    footer = data[len(data) - 8 - footer_len: len(data) - 8]
+    rng = random.Random(seed)
+    crashes = 0
+    for i in range(iters):
+        mutated = _mutate_bytes(rng, footer)
+        try:
+            CompactReader(mutated).read_struct()
+        except ThriftDecodeError:
+            pass                       # the designed outcome
+        except Exception as e:         # anything else is the crash class
+            crashes += 1
+            report("thrift", i, e)
+    return crashes
+
+
+def fuzz_verify(seed: int, iters: int, report) -> int:
+    from kpw_tpu.io.verify import FileReport, verify_bytes
+
+    data = _make_parquet_bytes()
+    rng = random.Random(seed + 1)
+    crashes = 0
+    for i in range(iters):
+        mutated = _mutate_bytes(rng, data)
+        try:
+            rep = verify_bytes(mutated, "<fuzz>")
+            if not isinstance(rep, FileReport):
+                raise TypeError(f"verify_bytes returned {type(rep)}")
+        except Exception as e:         # verify must never raise
+            crashes += 1
+            report("verify", i, e)
+    return crashes
+
+
+def fuzz_offsets(seed: int, iters: int, report) -> int:
+    from kpw_tpu.models.proto_bridge import WireShredError
+
+    col, buf, offs = _make_wire_batch()
+    rng = random.Random(seed + 2)
+    crashes = 0
+    for i in range(iters):
+        if i % 4 == 3:
+            # valid table, mutated PAYLOAD: the decoder itself must
+            # reject or fail soft, never walk out of the buffer
+            table, payload = offs, _mutate_bytes(rng, buf)
+            if len(payload) < len(buf):  # keep the table in-bounds
+                payload = payload + b"\0" * (len(buf) - len(payload))
+        else:
+            table, payload = _mutate_offsets(rng, offs, len(buf)), buf
+        try:
+            col.columnarize_buffer(payload, table)
+        except (ValueError, WireShredError):
+            pass                       # the designed outcomes
+        except Exception as e:
+            crashes += 1
+            report("offsets", i, e)
+    return crashes
+
+
+TARGETS = {"thrift": fuzz_thrift, "verify": fuzz_verify,
+           "offsets": fuzz_offsets}
+DEFAULT_SEED = 20260803
+
+
+def run(seed: int = DEFAULT_SEED, iters: int = 1000,
+        targets=tuple(TARGETS), verbose: bool = True) -> dict:
+    """Programmatic entry (tests use this): returns
+    {target: crash_count}; deterministic for a given (seed, iters)."""
+    results: dict[str, int] = {}
+
+    def report(target: str, i: int, e: BaseException) -> None:
+        if verbose:
+            print(f"CRASH {target}[iter {i}]: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+
+    for name in targets:
+        results[name] = TARGETS[name](seed, iters, report)
+    return results
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.fuzz",
+        description="seeded mutation fuzz over thrift/verify/offset "
+                    "validators (exit 0 = zero crashes)")
+    ap.add_argument("--seed", type=int, default=DEFAULT_SEED)
+    ap.add_argument("--iters", type=int, default=1000,
+                    help="iterations per target (default 1000)")
+    ap.add_argument("--target", choices=sorted(TARGETS), action="append",
+                    default=[], help="run only this target (repeatable)")
+    args = ap.parse_args(argv)
+    targets = args.target or sorted(TARGETS)
+    results = run(args.seed, args.iters, targets)
+    total = sum(results.values())
+    for name in targets:
+        print(f"fuzz {name}: {args.iters} iters, {results[name]} crash(es) "
+              f"[seed {args.seed}]")
+    print(f"tools.fuzz: {total} crash(es) total")
+    return 1 if total else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
