@@ -35,6 +35,14 @@ encode (NYC-taxi-shaped replay, one chip), printed as ONE JSON line
                ingest + autotune): headline records/s, p99 ack-lag,
                per-stage stall breakdown, worker scaling, and the
                batch-vs-Record-path A/B; writes BENCH_E2E_r10.json
+  --compact    partitioned run (Hive layout, LRU-bounded open partitions)
+               -> small-file explosion -> compaction service merges to
+               ~target size (verify-before-publish, tombstone retire) ->
+               kill -9 mid-compaction replay recovers with zero rows
+               lost; writes BENCH_COMPACT_r12.json.  With --smoke: a
+               reduced run that does NOT overwrite the committed
+               artifact and exits nonzero unless the invariant holds
+               (the tools/ci.sh gate)
   --cpu        force the virtual CPU platform (local smoke)
 
 Baseline for configs 1/2/3/5 is pyarrow's C++ parquet writer with matched
@@ -2534,6 +2542,233 @@ def degrade_probe(rows: int = 20_000, seed: int = 9) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# --compact: partitioned small-file explosion -> compaction -> crash replay
+# ---------------------------------------------------------------------------
+
+def compact_probe(rows: int = 24_000, seed: int = 12,
+                  smoke: bool = False) -> dict:
+    """``--compact`` mode: the partitioned-output + compaction subsystem's
+    committed evidence (ISSUE 8).
+
+    Part 1 — small-file explosion: a partitioned writer
+    (``partition_by`` over 4 keys, LRU bound 3 so eviction fires,
+    100 KiB rotation) drains ``rows`` records into a classic
+    rotation x partitions small-file blowup; every acked offset is
+    checked against the structurally verified published set BEFORE
+    compaction.
+
+    Part 2 — compaction: a ``Compactor`` (1 MiB target) runs synchronous
+    rounds to convergence; file count must drop >= 4x, every input must
+    be tombstoned under ``compacted/`` (never deleted), and every acked
+    offset must STILL be in a verified published file — now exactly once.
+
+    Part 3 — kill -9 mid-compaction replay: a fresh partitioned run is
+    compacted under an injected crash (retire renames fail after the
+    merged output published -> duplicate-published finals + a planted
+    half-written merged tmp), then recovered (``Compactor.recover()``);
+    zero rows lost, zero duplicates left, tmp swept.
+
+    ``invariant_holds`` is True only when all three parts hold.
+    """
+    from kpw_tpu import (Builder, Compactor, FakeBroker,
+                         FaultInjectingFileSystem, FaultSchedule,
+                         MemoryFileSystem, MetricRegistry, RetryPolicy)
+    import pyarrow.parquet as pq
+    from kpw_tpu.io.verify import summarize, verify_dir
+
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                    "tests"))
+    from proto_helpers import sample_message_class
+
+    if smoke:
+        rows = 8000
+    cls = sample_message_class()
+    parts = 2
+
+    def run_partitioned(fs, reg, target, group, n_rows):
+        broker = FakeBroker()
+        broker.create_topic("chaos", parts)
+        for i, p in enumerate(_chaos_messages(n_rows, pad=220)):
+            broker.produce("chaos", p, partition=i % parts)
+        w = (Builder().broker(broker).topic("chaos").proto_class(cls)
+             .target_dir(target).filesystem(fs).metric_registry(reg)
+             .instance_name("compactbench").group_id(group)
+             .batch_size(256)
+             .retry_policy(RetryPolicy(base_sleep=0.005, max_sleep=0.05))
+             .max_file_size(100 * 1024)
+             .max_file_open_duration_seconds(0.5)
+             .partition_by(lambda rec, msg: f"k={msg.timestamp % 4}",
+                           max_open_partitions=3))
+        w = w.build()
+        w.start()
+        deadline = time.time() + 180
+        drained = False
+        while time.time() < deadline:
+            if (sum(broker.committed(group, "chaos", p)
+                    for p in range(parts)) >= n_rows
+                    and w.ack_lag()["unacked_records"] == 0):
+                drained = True
+                break
+            time.sleep(0.01)
+        stats = w.stats()
+        w.close()
+        committed = [broker.committed(group, "chaos", p)
+                     for p in range(parts)]
+        return w, stats, committed, drained
+
+    def published_map(fs, target):
+        """(reports, {timestamp: count} over VERIFIED files, unverified
+        paths) — tmp/quarantine/compacted excluded by verify_dir."""
+        reports = verify_dir(fs, target)
+        got: dict = {}
+        unverified = []
+        for r in reports:
+            if not r.ok:
+                unverified.append(r.path)
+                continue
+            for row in pq.read_table(fs.open_read(r.path)).to_pylist():
+                got[row["timestamp"]] = got.get(row["timestamp"], 0) + 1
+        return reports, got, unverified
+
+    def missing_acked(got, committed):
+        missing = 0
+        for p in range(parts):
+            for off in range(committed[p]):
+                if got.get(off * parts + p, 0) < 1:
+                    missing += 1
+        return missing
+
+    # -- part 1: the small-file explosion, invariant BEFORE compaction ----
+    fs = MemoryFileSystem()
+    reg = MetricRegistry()
+    t0 = time.perf_counter()
+    w, stats, committed, drained = run_partitioned(
+        fs, reg, "/compact", "compact-run", rows)
+    write_s = time.perf_counter() - t0
+    before_reports, before_got, before_unv = published_map(fs, "/compact")
+    before_missing = missing_acked(before_got, committed)
+    file_count_before = len(before_reports)
+    print(f"[bench:compact] partitioned run: {rows} rows -> "
+          f"{file_count_before} published files across 4 partitions "
+          f"({stats['partitions']['evicted']} LRU evictions); "
+          f"{sum(committed)} acked offsets checked before compaction, "
+          f"{before_missing} missing", file=sys.stderr)
+
+    # -- part 2: compaction to convergence --------------------------------
+    comp = Compactor(fs, "/compact", cls, w.properties,
+                     target_size=1 << 20, min_files=2, registry=reg,
+                     instance_name="compactbench")
+    t0 = time.perf_counter()
+    rounds = 0
+    while True:
+        rounds += 1
+        if comp.compact_once()["merged"] == 0:
+            break
+    compact_s = time.perf_counter() - t0
+    cstats = comp.compactor_stats()
+    after_reports, after_got, after_unv = published_map(fs, "/compact")
+    after_missing = missing_acked(after_got, committed)
+    file_count_after = len(after_reports)
+    reduction = (file_count_before / file_count_after
+                 if file_count_after else 0.0)
+    tombstones = len(fs.list_files("/compact/compacted",
+                                   extension=".parquet"))
+    dup_after = sum(1 for v in after_got.values() if v > 1)
+    rollup = summarize(after_reports)
+    print(f"[bench:compact] compaction: {file_count_before} -> "
+          f"{file_count_after} files ({reduction:.2f}x) in {rounds} "
+          f"round(s), {cstats['bytes_rewritten']} bytes rewritten, "
+          f"{tombstones} inputs tombstoned; {after_missing} acked "
+          f"missing after, {dup_after} duplicates", file=sys.stderr)
+
+    # -- part 3: kill -9 mid-compaction replay ----------------------------
+    fs2 = MemoryFileSystem()
+    reg2 = MetricRegistry()
+    rows_c = max(2000, rows // 4)
+    _, _, committed2, drained2 = run_partitioned(
+        fs2, reg2, "/crashc", "compact-crash", rows_c)
+    # the kill windows: a half-written merged tmp from one dead merge,
+    # and retire renames failing right after a durable publish (the
+    # duplicate-published half-state the plan protocol must resolve)
+    fs2.mkdirs("/crashc/tmp")
+    with fs2.open_write("/crashc/tmp/compactbench_compact_99.tmp") as f:
+        f.write(b"half a merged row group")
+    sched = FaultSchedule(seed=seed).fail_nth("rename", 3, count=2)
+    crashing = Compactor(FaultInjectingFileSystem(fs2, sched), "/crashc",
+                         cls, w.properties, target_size=1 << 20,
+                         instance_name="compactbench")
+    crash_summary = crashing.compact_once()
+    _, mid_got, _ = published_map(fs2, "/crashc")
+    dup_mid = sum(1 for v in mid_got.values() if v > 1)
+    fresh = Compactor(fs2, "/crashc", cls, w.properties,
+                      target_size=1 << 20, instance_name="compactbench")
+    rec = fresh.recover()
+    # converge the remaining small files on the healed store
+    while fresh.compact_once()["merged"] > 0:
+        pass
+    rep_reports, rep_got, rep_unv = published_map(fs2, "/crashc")
+    rep_missing = missing_acked(rep_got, committed2)
+    dup_final = sum(1 for v in rep_got.values() if v > 1)
+    tmp_left = fs2.list_files("/crashc/tmp", extension=".tmp")
+    crash_replay = {
+        "rows": rows_c,
+        "merged_before_crash": crash_summary["merged"],
+        "duplicates_mid_crash": dup_mid,
+        "recover": rec,
+        "acked_offsets_checked": sum(committed2),
+        "acked_but_missing": rep_missing,
+        "duplicates_after_recovery": dup_final,
+        "unverifiable_published": len(rep_unv),
+        "tmp_files_left": len(tmp_left),
+        "invariant_holds": (drained2 and rep_missing == 0
+                            and dup_final == 0 and not rep_unv
+                            and not tmp_left and dup_mid > 0
+                            and rec["plans"] >= 1),
+    }
+    print(f"[bench:compact] crash replay: {dup_mid} duplicate rows "
+          f"mid-crash -> recover() resolved {rec['plans']} plan(s), "
+          f"{rep_missing} rows missing, {dup_final} duplicates left; "
+          f"invariant_holds={crash_replay['invariant_holds']}",
+          file=sys.stderr)
+
+    invariant = (drained and before_missing == 0 and not before_unv
+                 and after_missing == 0 and not after_unv
+                 and dup_after == 0 and rollup["failed"] == 0
+                 and reduction >= 4.0
+                 and tombstones == cstats["retired"]
+                 and crash_replay["invariant_holds"])
+    return {
+        "metric": "small_file_compaction",
+        "value": round(reduction, 2),
+        "unit": "x file-count reduction at 1 MiB target",
+        "seed": seed,
+        "smoke": smoke,
+        "rows": rows,
+        "write_seconds": round(write_s, 3),
+        "compact_seconds": round(compact_s, 3),
+        "compact_rounds": rounds,
+        "partitions": stats["partitions"],
+        "file_count_before": file_count_before,
+        "file_count_after": file_count_after,
+        "reduction_x": round(reduction, 2),
+        "bytes_rewritten": cstats["bytes_rewritten"],
+        "rows_rewritten": cstats["rows_rewritten"],
+        "merged_outputs": cstats["merged"],
+        "inputs_retired": cstats["retired"],
+        "tombstoned_files": tombstones,
+        "acked_offsets_checked": sum(committed),
+        "acked_but_missing_before": before_missing,
+        "acked_but_missing_after": after_missing,
+        "unverified_before": len(before_unv),
+        "unverified_after": len(after_unv),
+        "duplicates_after": dup_after,
+        "verify_summary_after": rollup,
+        "crash_replay": crash_replay,
+        "invariant_holds": invariant,
+    }
+
+
+# ---------------------------------------------------------------------------
 # --e2e: sustained-throughput saturation benchmark (ingest -> encode -> publish)
 # ---------------------------------------------------------------------------
 
@@ -3069,7 +3304,7 @@ def main() -> None:
     if not any(f in sys.argv
                for f in ("--all", "--rowgroup", "--hostasm", "--config",
                          "--obs", "--chaos", "--crash", "--degrade",
-                         "--e2e")):
+                         "--e2e", "--compact")):
         # default graded path: jax-free orchestrator (see _graded_main)
         _graded_main()
         return
@@ -3088,10 +3323,10 @@ def main() -> None:
     if ("--cpu" in sys.argv or "--hostasm" in sys.argv
             or "--obs" in sys.argv or "--chaos" in sys.argv
             or "--crash" in sys.argv or "--degrade" in sys.argv
-            or "--e2e" in sys.argv):
-        # --hostasm/--obs/--chaos/--crash/--degrade/--e2e measure HOST work
-        # only and must never grab the real chip; the switch must precede
-        # the first device use below
+            or "--e2e" in sys.argv or "--compact" in sys.argv):
+        # --hostasm/--obs/--chaos/--crash/--degrade/--e2e/--compact measure
+        # HOST work only and must never grab the real chip; the switch must
+        # precede the first device use below
         import jax
 
         jax.config.update("jax_platforms", "cpu")
@@ -3426,6 +3661,33 @@ def main() -> None:
                                 "workers_sweep", "autotune", "batch_ab",
                                 "scenario")}
         summary["batch_speedup_x"] = out["batch_ab"]["speedup_x"]
+        summary["artifact"] = os.path.basename(path)
+        print(json.dumps(summary))
+        return
+    if "--compact" in sys.argv:
+        smoke = "--smoke" in sys.argv
+        out = compact_probe(smoke=smoke)
+        if smoke:
+            # the CI gate: never overwrite the committed artifact, fail
+            # loudly when the invariant does not hold
+            print(json.dumps({k: out[k] for k in
+                              ("metric", "value", "invariant_holds",
+                               "file_count_before", "file_count_after",
+                               "smoke")}))
+            sys.exit(0 if out["invariant_holds"] else 4)
+        path = os.environ.get(
+            "KPW_COMPACT_PATH",
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "BENCH_COMPACT_r12.json"))
+        with open(path, "w") as f:
+            json.dump(out, f, indent=1)
+        print(f"[bench:compact] artifact written to {path}",
+              file=sys.stderr)
+        summary = {k: v for k, v in out.items()
+                   if k not in ("partitions", "verify_summary_after",
+                                "crash_replay")}
+        summary["crash_invariant_holds"] = out[
+            "crash_replay"]["invariant_holds"]
         summary["artifact"] = os.path.basename(path)
         print(json.dumps(summary))
         return

@@ -12,9 +12,13 @@ __version__ = "0.1.0"
 
 from .runtime import (  # noqa: E402,F401
     Builder,
+    CallablePartitioner,
+    EventTimePartitioner,
+    FieldPartitioner,
     Gauge,
     KafkaProtoParquetWriter,
     MetricRegistry,
+    Partitioner,
     PublishVerificationError,
     RetryBudgetExceeded,
     RetryPolicy,
@@ -22,6 +26,7 @@ from .runtime import (  # noqa: E402,F401
     registry_to_json,
     registry_to_prometheus,
 )
+from .io.compact import Compactor  # noqa: E402,F401
 from .ingest import (  # noqa: E402,F401
     FakeBroker,
     FaultInjectingBroker,

@@ -31,7 +31,10 @@ publish-vs-quarantine, and what the crash harness (tests/test_crash.py,
 ``bench.py --crash``) asserts for every acked offset's file.
 
 CLI: ``python -m kpw_tpu.io.verify <file-or-dir> [...]`` — exit 0 iff
-every file verifies; ``--json`` dumps the reports as one JSON array.
+every file verifies; ``--json`` dumps the reports as one JSON array;
+``--summary`` replaces the per-file report with ONE JSON rollup
+(files/rows/row groups/pages/failing paths) so a compaction run can
+assert directory-level integrity in a single call.
 """
 
 from __future__ import annotations
@@ -304,11 +307,13 @@ def verify_file(fs: FileSystem, path: str) -> FileReport:
 
 def verify_dir(fs: FileSystem, target_dir: str,
                extension: str = ".parquet",
-               exclude_dirs: tuple = ("tmp", "quarantine")) -> list[FileReport]:
+               exclude_dirs: tuple = ("tmp", "quarantine",
+                                      "compacted")) -> list[FileReport]:
     """Verify every published ``extension`` file under ``target_dir``,
     excluding the writer's working subtrees (``tmp/`` holds open files
     that are legitimately incomplete; ``quarantine/`` holds files already
-    condemned)."""
+    condemned; ``compacted/`` holds retired compaction inputs — tombstoned
+    duplicates whose rows live on in a merged published file)."""
     target = target_dir.rstrip("/")
     skips = tuple(f"{target}/{d}/" for d in exclude_dirs)
     out = []
@@ -319,12 +324,31 @@ def verify_dir(fs: FileSystem, target_dir: str,
     return out
 
 
+def summarize(reports: list[FileReport]) -> dict:
+    """Directory-level rollup of many reports: file/row/page totals plus
+    the failing paths — the one-call integrity verdict compaction runs
+    assert on (``--summary``)."""
+    bad = [r for r in reports if not r.ok]
+    return {
+        "files": len(reports),
+        "ok": len(reports) - len(bad),
+        "failed": len(bad),
+        "rows": sum(r.num_rows or 0 for r in reports if r.ok),
+        "row_groups": sum(r.row_groups for r in reports),
+        "pages": sum(r.pages for r in reports),
+        "pages_crc_checked": sum(r.pages_crc_checked for r in reports),
+        "bytes": sum(r.size for r in reports),
+        "failures": [r.path for r in bad],
+    }
+
+
 def main(argv: list[str] | None = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     as_json = "--json" in argv
-    paths = [a for a in argv if a != "--json"]
+    as_summary = "--summary" in argv
+    paths = [a for a in argv if a not in ("--json", "--summary")]
     if not paths:
-        print("usage: python -m kpw_tpu.io.verify [--json] "
+        print("usage: python -m kpw_tpu.io.verify [--json] [--summary] "
               "<file-or-dir> [...]", file=sys.stderr)
         return 2
     fs = LocalFileSystem()
@@ -334,7 +358,9 @@ def main(argv: list[str] | None = None) -> int:
             reports.extend(verify_dir(fs, p))
         else:
             reports.append(verify_file(fs, p))
-    if as_json:
+    if as_summary:
+        print(json.dumps(summarize(reports), indent=1))
+    elif as_json:
         print(json.dumps([r.to_dict() for r in reports], indent=1))
     else:
         for r in reports:

@@ -5,6 +5,12 @@ from .builder import Builder  # noqa: F401
 from .export import registry_to_json, registry_to_prometheus  # noqa: F401
 from .metrics import Gauge, MetricRegistry  # noqa: F401
 from .parquet_file import ParquetFile  # noqa: F401
+from .partition import (  # noqa: F401
+    CallablePartitioner,
+    EventTimePartitioner,
+    FieldPartitioner,
+    Partitioner,
+)
 from .retry import (  # noqa: F401
     RetryBudgetExceeded,
     RetryInterrupted,

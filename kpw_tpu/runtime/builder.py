@@ -106,6 +106,15 @@ class Builder:
         self._tracing = False
         self._trace_span_capacity = 65536
         self._trace_path: str | None = None
+        # partitioned output (opt-in; the reference emits one flat stream):
+        # record -> relative partition dir ahead of file assignment, with a
+        # bound on concurrently open partition files per worker (LRU
+        # close-and-publish eviction past it)
+        self._partitioner = None
+        self._max_open_partitions = 8
+        # small-file compaction service (opt-in): background merge of
+        # published under-size files into ~target-size files (io/compact.py)
+        self._compaction: dict | None = None
 
     # -- required ----------------------------------------------------------
     def broker(self, broker) -> "Builder":
@@ -496,6 +505,84 @@ class Builder:
         self._trace_path = path
         if path:
             self._tracing = True
+        return self
+
+    def partition_by(self, spec, *, time_pattern: str | None = None,
+                     time_unit: str = "s",
+                     max_open_partitions: int = 8) -> "Builder":
+        """Hive-style partitioned output: route each record into a
+        partition subdirectory of the target dir ahead of file
+        assignment, with per-partition open files and per-partition
+        size/time rotation accounting (``runtime/partition.py``).
+
+        ``spec`` is one of:
+
+        * a protobuf **field name** (or tuple of them) — Hive
+          ``{field}={value}`` segments from the parsed message; with
+          ``time_pattern`` the single named field is instead read as an
+          epoch (``time_unit``: ``s``/``ms``/``us``) and bucketed through
+          the strftime pattern in UTC (e.g. ``"dt=%Y%m%d/hour=%H"``),
+        * a **callable** ``(record, message) -> relative_path``,
+        * a prebuilt :class:`~kpw_tpu.runtime.partition.Partitioner`.
+
+        ``max_open_partitions`` bounds the partition files each worker
+        holds open at once; routing to a new partition past the bound
+        closes-and-publishes the least-recently-written one (metered as
+        ``parquet.writer.partitions.evicted``).  Ack granularity becomes
+        the checkpoint: offsets commit when every open partition file has
+        published (at the latest, each ``max_file_open_duration_seconds``
+        — a record's file must be durable before its offset is acked, and
+        one poll batch scatters across partitions).  A partitioner that
+        raises is handled under the :meth:`on_parse_error` policy.
+        Partitioning disqualifies the wire-shred fast path (routing needs
+        the parsed message)."""
+        from .partition import EventTimePartitioner, make_partitioner
+
+        if max_open_partitions < 1:
+            raise ValueError("max_open_partitions must be >= 1")
+        if time_pattern is not None:
+            if not isinstance(spec, str):
+                raise ValueError("time_pattern needs a single epoch field "
+                                 "name as the partition spec")
+            self._partitioner = EventTimePartitioner(
+                spec, pattern=time_pattern, unit=time_unit)
+        else:
+            self._partitioner = make_partitioner(spec)
+        self._max_open_partitions = max_open_partitions
+        return self
+
+    def compaction(self, target_size: int, *,
+                   scan_interval_seconds: float = 5.0,
+                   min_files: int = 2,
+                   small_file_ratio: float = 0.5) -> "Builder":
+        """Background small-file compaction (``kpw_tpu.io.compact``):
+        start() launches a :class:`~kpw_tpu.io.compact.Compactor` over the
+        target dir that merges published files smaller than
+        ``small_file_ratio * target_size`` (per partition directory, name
+        order, >= ``min_files`` per merge) into ~``target_size`` outputs —
+        rewritten through the writer's own encode machinery, structurally
+        verified BEFORE the ``durable_rename`` publish, inputs then
+        retired into the ``compacted/`` tombstone tree (moved, never
+        deleted) so a kill -9 at any instant leaves every row in at least
+        one verified published file.  Stats land in
+        ``stats()['compactor']``; meters are
+        ``parquet.compactor.merged|retired|failed``.  Off by default —
+        compaction is a second read+write of every small byte, a cost the
+        flat reference never pays."""
+        if target_size <= 0:
+            raise ValueError("target_size must be positive")
+        if scan_interval_seconds <= 0:
+            raise ValueError("scan_interval_seconds must be positive")
+        if min_files < 2:
+            raise ValueError("min_files must be >= 2")
+        if not 0.0 < small_file_ratio <= 1.0:
+            raise ValueError("small_file_ratio must be in (0, 1]")
+        self._compaction = {
+            "target_size": target_size,
+            "scan_interval_s": scan_interval_seconds,
+            "min_files": min_files,
+            "small_file_ratio": small_file_ratio,
+        }
         return self
 
     def on_parse_error(self, policy: str) -> "Builder":

@@ -350,6 +350,17 @@ PAUSED_GAUGE = "parquet.writer.paused"
 SPILLED_METER = "parquet.writer.spilled"
 RECONCILED_METER = "parquet.writer.reconciled"
 RECONCILE_FAILED_METER = "parquet.writer.reconcile.failed"
+# partitioned-output layer: partition files currently open across workers
+# (gauge, bounded by max_open_partitions per worker) and LRU
+# close-and-publish evictions of the least-recently-written partition
+PARTITIONS_OPEN_GAUGE = "parquet.writer.partitions.open"
+PARTITIONS_EVICTED_METER = "parquet.writer.partitions.evicted"
+# compaction layer (io/compact.py): merged counts published merge outputs,
+# retired counts input files tombstoned to {target_dir}/compacted/ (moved,
+# never deleted), failed counts verify failures + aborted merge rounds
+COMPACTOR_MERGED_METER = "parquet.compactor.merged"
+COMPACTOR_RETIRED_METER = "parquet.compactor.retired"
+COMPACTOR_FAILED_METER = "parquet.compactor.failed"
 
 # the canonical registry docs cite from (tools/check_docs.py verifies
 # every doc-cited metric name is listed here)
@@ -378,4 +389,9 @@ METRIC_NAMES = (
     SPILLED_METER,
     RECONCILED_METER,
     RECONCILE_FAILED_METER,
+    PARTITIONS_OPEN_GAUGE,
+    PARTITIONS_EVICTED_METER,
+    COMPACTOR_MERGED_METER,
+    COMPACTOR_RETIRED_METER,
+    COMPACTOR_FAILED_METER,
 )

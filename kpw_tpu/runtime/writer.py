@@ -28,6 +28,7 @@ import time
 from datetime import datetime
 
 from ..core.writer import PipelineError
+from ..io.compact import Compactor
 from ..io.verify import verify_dir, verify_file
 from ..ingest.autotune import IngestAutotuner
 from ..ingest.broker import RecordBatch
@@ -38,6 +39,7 @@ from ..utils import tracing
 from ..utils.tracing import stage
 from . import metrics as M
 from .parquet_file import ParquetFile
+from .partition import normalize_partition_path
 from .retry import RetryInterrupted, RetryPolicy
 from .watchdog import Heartbeat, Watchdog
 
@@ -163,6 +165,15 @@ class KafkaProtoParquetWriter:
         # degraded_mode, the paused gauge counts the live set)
         self._watchdog_obj: Watchdog | None = None
         self._stalled = reg.meter(M.STALLED_METER) if reg else M.Meter()
+        # partitioned output: records route to per-partition open files
+        # ahead of file assignment (runtime/partition.py); evictions count
+        # LRU close-and-publish past the open-partitions bound.  The
+        # compaction service (io/compact.py) is built at start() when
+        # Builder.compaction is configured.
+        self.partitioner = b._partitioner
+        self._partitions_evicted = (reg.meter(M.PARTITIONS_EVICTED_METER)
+                                    if reg else M.Meter())
+        self._compactor: Compactor | None = None
         self._paused: dict[int, dict] = {}
         self._pause_lock = threading.Lock()
         self._pause_count = 0
@@ -178,6 +189,8 @@ class KafkaProtoParquetWriter:
             reg.gauge(M.CONSUMER_QUEUE_DEPTH_GAUGE, self.consumer.queue_depth)
             reg.gauge(M.WORKERS_ALIVE_GAUGE,
                       lambda: sum(1 for w in self._workers if w.alive()))
+            reg.gauge(M.PARTITIONS_OPEN_GAUGE,
+                      lambda: sum(len(w._part_files) for w in self._workers))
         # tracing owned by this writer when the Builder asked for it
         # (installed at start(), uninstalled at close() iff still ours)
         self.stage_timer: tracing.StageTimer | None = None
@@ -245,6 +258,13 @@ class KafkaProtoParquetWriter:
                 poll_interval_s=self._b._watchdog_poll,
                 on_stall=self._on_watchdog_stall)
             self._watchdog_obj.start()
+        if self._b._compaction:
+            self._compactor = Compactor(
+                self.fs, self.target_dir, self._b._proto_class,
+                self.properties, registry=self._b._metric_registry,
+                instance_name=self._b._instance_name,
+                **self._b._compaction)
+            self._compactor.start()
 
     def _gc_abandoned_tmp(self) -> None:
         """Remove .tmp files left by a previous run of THIS instance name
@@ -259,8 +279,11 @@ class KafkaProtoParquetWriter:
         pat = re.compile(
             re.escape(self._b._instance_name) + r"_\d+_\d+\.tmp$")
         try:
+            # recursive: partitioned mode keeps its tmps under per-partition
+            # subdirs (tmp/{partition}/...); the basename pattern still
+            # scopes the sweep to THIS instance's worker files
             stale = [p for p in self.fs.list_files(tmp_dir, extension=".tmp",
-                                                   recursive=False)
+                                                   recursive=True)
                      if pat.fullmatch(p.rsplit("/", 1)[-1])]
         except FileNotFoundError:
             return
@@ -517,6 +540,10 @@ class KafkaProtoParquetWriter:
         self._close_event.set()
         if self._watchdog_obj is not None:
             self._watchdog_obj.close(timeout=rem(5))
+        if self._compactor is not None:
+            # pending merges are crash-recoverable by the plan protocol;
+            # nothing to flush here beyond stopping the scan loop
+            self._compactor.close(timeout=rem(5))
         if self._supervisor is not None:
             self._supervisor.join(timeout=rem(30))
         hung_workers: list[int] = []
@@ -638,6 +665,8 @@ class KafkaProtoParquetWriter:
                 M.VERIFY_FAILED_METER: self._verify_failed.snapshot(),
                 M.QUARANTINED_METER: self._quarantined.snapshot(),
                 M.STALLED_METER: self._stalled.snapshot(),
+                M.PARTITIONS_EVICTED_METER:
+                    self._partitions_evicted.snapshot(),
             },
             "file_size": self._file_size_histogram.snapshot(),
             "rotations": {
@@ -695,6 +724,18 @@ class KafkaProtoParquetWriter:
             out["watchdog"] = self._watchdog_obj.snapshot()
         if hasattr(self.fs, "failover_stats"):
             out["failover"] = self.fs.failover_stats()
+        # partitioned-output block always (like degraded: "not partitioned"
+        # is itself evidence); the compactor block only when the service
+        # is configured, mirroring watchdog/failover
+        out["partitions"] = {
+            "enabled": self.partitioner is not None,
+            "max_open_per_worker": b._max_open_partitions,
+            "open": sum(len(w._part_files) for w in self._workers),
+            "evicted": self._partitions_evicted.count,
+            "open_by_worker": [w.open_partitions() for w in self._workers],
+        }
+        if self._compactor is not None:
+            out["compactor"] = self._compactor.compactor_stats()
         # writer-OWNED tracing only: the process-global seam may hold a
         # different writer's (or the user's) instruments, and attributing
         # their timings to this writer would be misdirection — users who
@@ -748,6 +789,11 @@ class _Worker:
             daemon=True,
         )
         self.current_file: ParquetFile | None = None
+        # partitioned mode (Builder.partition_by): partition path -> open
+        # file, insertion order == LRU order (reinserted on every write);
+        # bounded by max_open_partitions with close-and-publish eviction.
+        # Mutated by this worker thread only; scrapes read it lock-free
+        self._part_files: dict[str, ParquetFile] = {}
         # death visibility (satellite: a dead worker must be observable
         # even without supervision): set in the _run except path before the
         # thread exits, read by healthy()/stats()/the supervisor
@@ -862,11 +908,13 @@ class _Worker:
         self._stop.set()
         self._thread.join(timeout=timeout)
         hung = self._thread.is_alive()
-        if self.current_file is not None and (abandon_if_hung or not hung):
-            self.current_file.rotation_reason = "close"
-            self.current_file.abandon()
-            self._fold_pipe_stats(self.current_file)
+        if abandon_if_hung or not hung:
+            for f in self._open_files():
+                f.rotation_reason = "close"
+                f.abandon()
+                self._fold_pipe_stats(f)
             self.current_file = None
+            self._part_files.clear()
         return not hung
 
     # -- loop (KPW.java:253-292) -------------------------------------------
@@ -883,8 +931,11 @@ class _Worker:
             # policies.  Only valid when the payload IS the serialized
             # message — a custom parser() transforms payloads, so it
             # disqualifies the raw-bytes path.
+            # partitioning also disqualifies the wire path: routing needs
+            # the parsed message, which the wire shredder never builds
             use_wire = (getattr(b, "_parser_is_default", False)
-                        and self.p.columnarizer.wire_capable)
+                        and self.p.columnarizer.wire_capable
+                        and self.p.partitioner is None)
             # batch-native poll: drain RecordBatch views (contiguous buffer
             # + offsets, no Record materialization) straight into the wire
             # shredder — only meaningful when the wire path is live, since
@@ -908,17 +959,11 @@ class _Worker:
         except Exception as e:
             self.exit_reason = repr(e)
             logger.exception("worker %d terminated", self.index)
-            # a dying worker must not leak its open file's pipeline threads
-            # or sink; the tmp stays on disk un-published (at-least-once:
-            # its offsets were never acked)
+            # a dying worker must not leak its open files' pipeline threads
+            # or sinks; the tmps stay on disk un-published (at-least-once:
+            # their offsets were never acked)
             try:
-                if self.current_file is not None:
-                    try:
-                        self.current_file.rotation_reason = "error"
-                        self.current_file.abandon()
-                    finally:
-                        self._fold_pipe_stats(self.current_file)
-                        self.current_file = None
+                self._abandon_open_files("error")
             finally:
                 # visibility LAST: `failed` flips only after cleanup, so
                 # the supervisor's join-then-read of held_runs() is safe.
@@ -931,25 +976,21 @@ class _Worker:
                     self.p._notify_worker_death()
         finally:
             # a condemned zombie that eventually escaped its hung call
-            # exits through here holding an open (unpublishable) file:
-            # free its pipeline threads and sink best-effort — the slot's
-            # replacement is long since running
-            if self.condemned and self.current_file is not None:
-                try:
-                    self.current_file.rotation_reason = "error"
-                    self.current_file.abandon()
-                except Exception:
-                    logger.exception("condemned worker %d: abandon failed "
-                                     "(ignored)", self.index)
-                self.current_file = None
+            # exits through here holding open (unpublishable) files:
+            # free their pipeline threads and sinks best-effort — the
+            # slot's replacement is long since running
+            if self.condemned:
+                self._abandon_open_files("error")
 
     def _loop_once(self, b, poll_batch_base: int, use_wire: bool,
                    use_batch: bool = False) -> None:
         """One poll→parse→write→rotate iteration (the body of the
         reference's worker loop, KPW.java:253-292), extracted so the
         degraded-mode pause seam can wrap exactly one iteration."""
+        if self.p.partitioner is not None:
+            return self._loop_once_partitioned(b, poll_batch_base)
         if (self.current_file is not None
-                and self._is_file_timed_out()):
+                and self._is_file_timed_out(self.current_file)):
             self._finalize_current_file("time")
         # batch granularity follows the LIVE bytes/record estimate,
         # not the static 64 B guess: small-record streams (nested
@@ -980,7 +1021,7 @@ class _Worker:
             if self._try_wire_items(items, runs):
                 self._inflight_runs = []
                 self._note_proc_rate(sum(c for _, _, c in runs), t0)
-                if self._is_file_full():
+                if self._is_file_full(self.current_file):
                     self._finalize_current_file()
                 return
             # wire fallback (a record the shredder could not prove clean):
@@ -1000,7 +1041,7 @@ class _Worker:
             if use_wire and self._try_wire_items([recs], runs):
                 self._inflight_runs = []
                 self._note_proc_rate(len(recs), t0)
-                if self._is_file_full():
+                if self._is_file_full(self.current_file):
                     self._finalize_current_file()
                 return
         parsed = []  # (record, message) — parsed in bulk so the
@@ -1012,29 +1053,7 @@ class _Worker:
                 parsed.append((rec, b._parser(rec.value)))
                 nbytes += len(rec.value)
             except Exception:
-                if b._on_parse_error == "dead_letter":
-                    logger.exception(
-                        "Dead-lettering unparseable record %s/%s",
-                        rec.partition, rec.offset)
-                    # durability first, like the main path: the raw
-                    # payload lands in the dead-letter file before ack
-                    self._retry(lambda: self._dead_letter(rec),
-                                "dead_letter")
-                    self.p.consumer.ack(
-                        PartitionOffset(rec.partition, rec.offset))
-                elif b._on_parse_error == "skip":
-                    logger.exception(
-                        "Skipping unparseable record %s/%s",
-                        rec.partition, rec.offset)
-                    # no durability dependency: ack now
-                    self.p.consumer.ack(
-                        PartitionOffset(rec.partition, rec.offset))
-                else:
-                    logger.exception(
-                        "Can not parse record; worker %d dies "
-                        "(reference poison-pill parity, "
-                        "KPW.java:271-275)", self.index)
-                    raise
+                self._handle_record_error(rec, "unparseable")
         if not parsed:
             self._inflight_runs = []  # every record was acked above
             return
@@ -1048,8 +1067,194 @@ class _Worker:
         self.p._written_records.mark(len(parsed))
         self.p._written_bytes.mark(nbytes)
         self._file_records += len(parsed)
-        if self._is_file_full():
+        if self._is_file_full(self.current_file):
             self._finalize_current_file()
+
+    def _handle_record_error(self, rec, what: str) -> None:
+        """One record the pipeline cannot place — unparseable bytes, or a
+        partitioner that raised/returned garbage — under the
+        ``on_parse_error`` policy (reference poison-pill parity,
+        KPW.java:271-275).  Call from inside the except handler: the
+        ``raise`` policy re-raises the active exception."""
+        b = self.p._b
+        if b._on_parse_error == "dead_letter":
+            logger.exception("Dead-lettering %s record %s/%s", what,
+                             rec.partition, rec.offset)
+            # durability first, like the main path: the raw payload lands
+            # in the dead-letter file before ack
+            self._retry(lambda: self._dead_letter(rec), "dead_letter")
+            self.p.consumer.ack(PartitionOffset(rec.partition, rec.offset))
+        elif b._on_parse_error == "skip":
+            logger.exception("Skipping %s record %s/%s", what,
+                             rec.partition, rec.offset)
+            # no durability dependency: ack now
+            self.p.consumer.ack(PartitionOffset(rec.partition, rec.offset))
+        else:
+            logger.exception(
+                "Can not place record; worker %d dies (reference "
+                "poison-pill parity, KPW.java:271-275)", self.index)
+            raise
+
+    # -- partitioned mode (Builder.partition_by) -----------------------------
+    def _loop_once_partitioned(self, b, poll_batch_base: int) -> None:
+        """One poll→parse→route→write→rotate iteration of the partitioned
+        mode: each record routes to its partition's open file, size
+        rotation is per partition, and time rotation is a CHECKPOINT —
+        the oldest open file crossing ``max_file_open_duration`` closes
+        every open partition file at once.  Per-file time rotation alone
+        could defer acks indefinitely under steady multi-partition
+        traffic (some open file always holds fresh records, and a poll
+        batch's offsets are only coverable by the union of the files it
+        scattered into); the checkpoint guarantees an ack point at least
+        once per duration window."""
+        if self._part_files and any(self._is_file_timed_out(f)
+                                    for f in self._part_files.values()):
+            self._finalize_partitions("time")
+        tuner = self.p.autotuner
+        if tuner is not None:
+            poll_batch_base = tuner.poll_batch(self._proc_rate)
+        poll_batch = min(poll_batch_base, _rotation_batch_cap(
+            b._max_file_size, max(8.0, self._carry_est)))
+        self._last_poll_batch = poll_batch
+        recs, runs = self.p.consumer.poll_many_runs(
+            self._poll_cap(poll_batch))
+        if not recs:
+            time.sleep(0.001)
+            return
+        t0 = time.perf_counter()
+        self._inflight_runs = runs
+        groups: dict[str, list] = {}
+        written = []
+        nbytes = 0
+        for rec in recs:
+            try:
+                msg = b._parser(rec.value)
+                pkey = normalize_partition_path(
+                    self.p.partitioner.partition_for(rec, msg))
+            except Exception:
+                self._handle_record_error(rec, "unroutable")
+                continue
+            groups.setdefault(pkey, []).append(msg)
+            written.append(rec)
+            nbytes += len(rec.value)
+        if not groups:
+            self._inflight_runs = []  # every record was acked above
+            return
+        for pkey, msgs in groups.items():
+            f = self._partition_file(pkey)
+            f.append_records(msgs)  # pure memory
+            self._retry(f.flush_if_full, "flush")
+        self._note_written(written)
+        self._inflight_runs = []
+        self.p._written_records.mark(len(written))
+        self.p._written_bytes.mark(nbytes)
+        self._note_proc_rate(len(written), t0)
+        for pkey in [k for k, f in self._part_files.items()
+                     if self._is_file_full(f)]:
+            self._finalize_partition(pkey, "size")
+
+    def _partition_file(self, pkey: str) -> ParquetFile:
+        """The open file for ``pkey``, moved to most-recently-written;
+        opening a NEW partition past the open-files bound first
+        closes-and-publishes the least-recently-written one (LRU
+        eviction, ``parquet.writer.partitions.evicted``)."""
+        f = self._part_files.pop(pkey, None)
+        if f is not None:
+            self._part_files[pkey] = f  # dict order == LRU order
+            return f
+        while len(self._part_files) >= self.p._b._max_open_partitions:
+            self._finalize_partition(next(iter(self._part_files)), "evict")
+        f = self._open_new_file(subdir=pkey)
+        self._part_files[pkey] = f
+        return f
+
+    def _finalize_partitions(self, reason: str) -> None:
+        for pkey in list(self._part_files):
+            self._finalize_partition(pkey, reason)
+
+    def _finalize_partition(self, pkey: str, reason: str) -> None:
+        """Close → publish one partition's open file (``size`` rotation,
+        ``time`` checkpoint, or LRU ``evict``), then ack via
+        :meth:`_maybe_ack_all`.  The file stays in ``_part_files`` until
+        the publish lands: a close/verify/publish failure propagates to
+        the worker's death path, whose ``_abandon_open_files`` must still
+        find the file to stop its pipeline threads and sink (the flat
+        path keeps ``current_file`` set for exactly the same reason)."""
+        f = self._part_files[pkey]
+        f.rotation_reason = reason
+        self._carry_est = f.est_record_bytes
+        if f.get_num_written_records() == 0:
+            # never publish empty files; just drop the tmp
+            self._retry(f.close, "close")
+            self._retry(lambda: self.p.fs.delete(f.path), "delete")
+            self._fold_pipe_stats(f)
+            del self._part_files[pkey]
+            return
+        self._retry(f.close, "close")
+        size = self.p.fs.size(f.path)
+        self.p._flushed_records.mark(f.get_num_written_records())
+        self.p._flushed_bytes.mark(size)
+        self.p._file_size_histogram.update(size)
+        if reason == "evict":
+            self.p._partitions_evicted.mark()
+        else:
+            (self.p._rotated_time if reason == "time"
+             else self.p._rotated_size).mark()
+        self._rename_and_move(f.path, subdir=pkey)
+        self._fold_pipe_stats(f)
+        del self._part_files[pkey]
+        # ack strictly after durable publish (KPW.java:347-350),
+        # generalized to scattered partitions by the checkpoint rule
+        self._maybe_ack_all()
+
+    def _maybe_ack_all(self) -> None:
+        """Commit the held offset runs iff NO open file still holds
+        unacked records: one poll batch scatters across partitions, so a
+        run is durably covered only by the union of the files it landed
+        in — all of them must have published."""
+        if any(f.get_num_written_records() > 0
+               for f in self._part_files.values()):
+            return
+        for partition, start, end in self._written_runs:
+            self.p.consumer.ack_run(partition, start, end - start)
+        self._written_runs.clear()
+        self._unacked_count = 0
+        self._oldest_unacked_ts = None
+
+    def open_partitions(self) -> list[str]:
+        """Scrape-safe snapshot of this worker's open partition keys."""
+        try:
+            return sorted(self._part_files)
+        # lint: swallowed-exceptions ok — lock-free scrape racing the
+        # worker thread's dict mutation; a dropped snapshot beats taking
+        # down the stats() scrape
+        except RuntimeError:
+            return []
+
+    def _open_files(self) -> list[ParquetFile]:
+        """Every open file this worker owns (flat current file and/or the
+        partitioned map) — the cleanup paths' iteration target."""
+        out = list(self._part_files.values())
+        if self.current_file is not None:
+            out.append(self.current_file)
+        return out
+
+    def _abandon_open_files(self, reason: str) -> None:
+        """Abandon every open file: pipeline threads stopped, sinks
+        closed, tmps left un-published and un-acked (swept + redelivered
+        later).  Never raises — callers are death/pause/zombie cleanup
+        paths that must complete."""
+        for f in self._open_files():
+            try:
+                f.rotation_reason = reason
+                f.abandon()
+            except Exception:
+                logger.exception("worker %d: abandon of %s failed "
+                                 "(ignored)", self.index, f.path)
+            finally:
+                self._fold_pipe_stats(f)
+        self.current_file = None
+        self._part_files.clear()
 
     # -- pause/resume (degraded_mode) ---------------------------------------
     def _pause_cause(self, e: BaseException):
@@ -1077,20 +1282,11 @@ class _Worker:
         space.  ``max_pause_seconds`` exceeded converts the pause into
         the normal fatal death (supervision semantics take over)."""
         b = self.p._b
-        if self.current_file is not None:
-            try:
-                self.current_file.rotation_reason = "error"
-                self.current_file.abandon()
-            except Exception:
-                # abandon flushes the sink and can hit the SAME full-disk
-                # condition that triggered the pause — swallowing it is the
-                # whole point of degraded_mode (the tmp is garbage either
-                # way; the sibling death/zombie cleanup paths guard too)
-                logger.exception("worker %d: abandon during pause entry "
-                                 "failed (ignored)", self.index)
-            finally:
-                self._fold_pipe_stats(self.current_file)
-                self.current_file = None
+        # abandon flushes the sinks and can hit the SAME full-disk
+        # condition that triggered the pause — the helper swallows that,
+        # which is the whole point of degraded_mode (the tmps are garbage
+        # either way)
+        self._abandon_open_files("error")
         held = self.held_runs()
         self._written_runs = []
         self._inflight_runs = []
@@ -1232,6 +1428,11 @@ class _Worker:
         reference's ~1% rotation overshoot (KafkaProtoParquetWriterTest.java:
         166-173) without giving up large batches far from the threshold."""
         f = self.current_file
+        if f is None and self._part_files:
+            # partitioned mode: cap against the FULLEST open partition
+            # file — the one that decides the next size rotation
+            f = max(self._part_files.values(),
+                    key=lambda x: x.get_data_size())
         if f is None:
             return base
         remaining = self.p._b._max_file_size - f.get_data_size()
@@ -1240,12 +1441,12 @@ class _Worker:
         est = max(f.est_record_bytes, 1.0)
         return max(1, min(base, int(remaining / est) + 1))
 
-    def _is_file_timed_out(self) -> bool:
-        return (time.time() - self.current_file.get_creation_time()
+    def _is_file_timed_out(self, f: ParquetFile) -> bool:
+        return (time.time() - f.get_creation_time()
                 >= self.p._b._max_file_open_duration)
 
-    def _is_file_full(self) -> bool:
-        return self.current_file.get_data_size() >= self.p._b._max_file_size
+    def _is_file_full(self, f: ParquetFile) -> bool:
+        return f.get_data_size() >= self.p._b._max_file_size
 
     def _dead_letter(self, rec) -> None:
         """Append the raw payload to this worker's dead-letter file:
@@ -1304,12 +1505,18 @@ class _Worker:
             "queues": {q: dict(v)
                        for q, v in self._pipe_totals["queues"].items()},
         }
-        f = self.current_file
-        if f is not None:
+        try:
+            open_files = self._open_files()
+        # lint: swallowed-exceptions ok — lock-free scrape racing the
+        # worker thread's partition-map mutation; a dropped snapshot
+        # beats taking down the stats() scrape
+        except RuntimeError:
+            open_files = []
+        for f in open_files:
             try:
                 self._fold_into(tot, f.pipeline_stats())
-            # lint: swallowed-exceptions ok — observability fold over a
-            # file that may be rotating away under us; a racing snapshot
+            # lint: swallowed-exceptions ok — observability fold over
+            # files that may be rotating away under us; a racing snapshot
             # is droppable, and raising would take down the stats() scrape
             except Exception:
                 pass  # file may be rotating away under us
@@ -1330,19 +1537,24 @@ class _Worker:
             "unacked_records": self._unacked_count,
             "oldest_unacked_age_s": (round(time.time() - ts, 6)
                                      if ts is not None else 0.0),
+            "open_partitions": self.open_partitions(),
             "proc_rate_rps": round(self._proc_rate, 1),
             "poll_batch": self._last_poll_batch,
             "pipeline": tot,
         }
 
     # -- file management ---------------------------------------------------
-    def _tmp_path(self) -> str:
-        # targetDir/tmp/{instance}_{idx}_{rand}.tmp (KPW.java:236-239)
+    def _tmp_path(self, subdir: str | None = None) -> str:
+        # targetDir/tmp/{instance}_{idx}_{rand}.tmp (KPW.java:236-239);
+        # partitioned files keep their tmp under tmp/{partition}/ so the
+        # sweep and a human ls can attribute debris to its partition
         rand = random.getrandbits(63)
-        return (f"{self.p.target_dir}/tmp/"
+        tmp_dir = f"{self.p.target_dir}/tmp" + (f"/{subdir}" if subdir
+                                                else "")
+        return (f"{tmp_dir}/"
                 f"{self.p._b._instance_name}_{self.index}_{rand}.tmp")
 
-    def _open_file(self) -> None:
+    def _open_new_file(self, subdir: str | None = None) -> ParquetFile:
         # flush-batch granularity follows the live bytes/record estimate,
         # same as the poll batch in _run (small-record streams would
         # otherwise split each poll batch into undersized encode batches)
@@ -1351,10 +1563,11 @@ class _Worker:
                                         max(8.0, self._carry_est)))
 
         def make() -> ParquetFile:
-            self.p.fs.mkdirs(f"{self.p.target_dir}/tmp")
+            self.p.fs.mkdirs(f"{self.p.target_dir}/tmp"
+                             + (f"/{subdir}" if subdir else ""))
             return ParquetFile(
                 self.p.fs,
-                self._tmp_path(),
+                self._tmp_path(subdir),
                 self.p.columnarizer,
                 self.p.properties,
                 batch_size=batch,
@@ -1365,7 +1578,10 @@ class _Worker:
                 heartbeat=self.heartbeat,
             )
 
-        self.current_file = self._retry(make, "open")
+        return self._retry(make, "open")
+
+    def _open_file(self) -> None:
+        self.current_file = self._open_new_file()
         self._file_records = 0
 
     def _new_file_name(self) -> str:
@@ -1407,13 +1623,16 @@ class _Worker:
         self._unacked_count = 0
         self._oldest_unacked_ts = None
 
-    def _rename_and_move(self, tmp_path: str) -> None:
+    def _rename_and_move(self, tmp_path: str,
+                         subdir: str | None = None) -> None:
         # (KPW.java:359-378); spanned as one publish stage so the e2e
-        # stall breakdown can attribute verify+rename time per file
+        # stall breakdown can attribute verify+rename time per file.
+        # ``subdir`` = the partition path in partitioned mode
         with stage("worker.publish"):
-            self._rename_and_move_inner(tmp_path)
+            self._rename_and_move_inner(tmp_path, subdir)
 
-    def _rename_and_move_inner(self, tmp_path: str) -> None:
+    def _rename_and_move_inner(self, tmp_path: str,
+                               subdir: str | None = None) -> None:
         if self.p._b._verify_on_publish:
             # independent read-back BEFORE the rename: a structurally
             # invalid tmp (bad encode, torn write a retry never healed)
@@ -1437,10 +1656,16 @@ class _Worker:
         # recomputing a fresh timestamped name would orphan the renamed
         # file and spin on the vanished tmp
         dest_dir = self.p.target_dir
+        if subdir:
+            # partition subtree first, then the optional date pattern —
+            # readers prune on the partition keys, so they must own the
+            # outer directory levels
+            dest_dir = f"{dest_dir}/{subdir}"
+            self._retry(lambda d=dest_dir: self.p.fs.mkdirs(d), "publish")
         pattern = self.p._b._directory_date_time_pattern
         if pattern:
             dest_dir = f"{dest_dir}/{_format_now(pattern)}"
-            self._retry(lambda: self.p.fs.mkdirs(dest_dir), "publish")
+            self._retry(lambda d=dest_dir: self.p.fs.mkdirs(d), "publish")
         name = self._new_file_name()
         dest = f"{dest_dir}/{name}"
         # millisecond timestamps can collide when one worker finalizes

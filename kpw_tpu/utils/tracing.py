@@ -36,6 +36,7 @@ from contextlib import contextmanager
 #   worker.*   — the per-worker poll loop (runtime/writer.py)
 #   rowgroup.* — the row-group pipeline stages (core/writer.py)
 #   encode.*   — the encoder's internal phases (ops/backend.py)
+#   compactor.* — the small-file compaction service (io/compact.py)
 STAGE_NAMES = (
     "consumer.fetch",
     "consumer.track",
@@ -49,6 +50,7 @@ STAGE_NAMES = (
     "encode.launch",
     "encode.bodies",
     "encode.assemble",
+    "compactor.merge",
 )
 
 

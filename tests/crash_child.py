@@ -121,12 +121,14 @@ def read_commit_frontiers(target_dir: str,
 
 
 def published_files(target_dir: str) -> list[str]:
-    """Published .parquet paths — tmp/ and quarantine/ excluded."""
+    """Published .parquet paths — tmp/, quarantine/ and compacted/
+    (retired compaction-input tombstones) excluded."""
     target = target_dir.rstrip("/")
     out = []
     for root, _dirs, files in os.walk(target):
         if (root.startswith(os.path.join(target, "tmp"))
-                or root.startswith(os.path.join(target, "quarantine"))):
+                or root.startswith(os.path.join(target, "quarantine"))
+                or root.startswith(os.path.join(target, "compacted"))):
             continue
         out.extend(os.path.join(root, f) for f in files
                    if f.endswith(".parquet"))

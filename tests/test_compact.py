@@ -1,0 +1,645 @@
+"""Partitioned output + small-file compaction: the subsystem tests.
+
+Partitioning seam (``runtime/partition.py`` + the worker's partitioned
+mode): Hive-style layout under the target dir, per-partition rotation,
+the open-partitions LRU bound with close-and-publish eviction, checkpoint
+ack granularity, and the poison-pill policy covering partitioner errors.
+
+Compaction service (``kpw_tpu/io/compact.py``): merge planning, the
+verify-BEFORE-publish discipline, tombstone-never-delete retirement, and
+the write-ahead-plan crash protocol (roll forward: a duplicate-published
+final never survives recovery; roll back: a torn output is quarantined
+and its retired inputs restored — no row lost at any interruption point).
+
+The whole module runs under the runtime lock-order detector, like the
+chaos/degrade suites: the compactor's background loop and the partitioned
+worker introduce new locks, and a new ordering cycle must fail loudly.
+"""
+
+import json
+import time
+
+import pyarrow.parquet as pq
+import pytest
+
+from kpw_tpu import (
+    Builder,
+    CallablePartitioner,
+    Compactor,
+    EventTimePartitioner,
+    FakeBroker,
+    FieldPartitioner,
+    LocalFileSystem,
+    MemoryFileSystem,
+    MetricRegistry,
+    FaultInjectingFileSystem,
+    FaultSchedule,
+    RetryPolicy,
+)
+from kpw_tpu.io.compact import row_to_message
+from kpw_tpu.io.verify import summarize, verify_dir
+from kpw_tpu.runtime import metrics as M
+from kpw_tpu.runtime.parquet_file import ParquetFile
+from kpw_tpu.runtime.partition import (
+    make_partitioner,
+    normalize_partition_path,
+)
+
+from proto_helpers import nested_message_classes, sample_message_class
+
+TOPIC = "pt"
+
+
+@pytest.fixture(autouse=True)
+def _lockcheck(lockcheck_detector):
+    # the compaction/partitioning suite runs under the runtime lock-order
+    # detector (ISSUE 8 satellite): the Compactor's loop and the
+    # partitioned worker must introduce no new ordering cycles and no
+    # blocking call under a held kpw_tpu lock
+    yield lockcheck_detector
+    assert not lockcheck_detector.violations, [
+        repr(v) for v in lockcheck_detector.violations]
+
+
+# -- partitioner units -------------------------------------------------------
+
+def test_field_partitioner_hive_paths():
+    cls = sample_message_class()
+    p = FieldPartitioner("page_number")
+    assert p.partition_for(None, cls(query="q", timestamp=1,
+                                     page_number=7)) == "page_number=7"
+    multi = FieldPartitioner(("page_number", "result_per_page"))
+    assert multi.partition_for(
+        None, cls(query="q", timestamp=1, page_number=7,
+                  result_per_page=3)) == "page_number=7/result_per_page=3"
+
+
+def test_field_partitioner_sanitizes_hostile_values():
+    cls = sample_message_class()
+    p = FieldPartitioner("query")
+    out = p.partition_for(None, cls(query="../etc/passwd x", timestamp=1))
+    assert "/" not in out.split("=", 1)[1]
+    assert normalize_partition_path(out) == out  # survives validation
+
+
+def test_event_time_partitioner_buckets_utc():
+    cls = sample_message_class()
+    p = EventTimePartitioner("timestamp", pattern="dt=%Y%m%d/hour=%H")
+    # 2026-08-03 14:30:00 UTC
+    msg = cls(query="q", timestamp=1785767400)
+    assert p.partition_for(None, msg) == "dt=20260803/hour=14"
+    ms = EventTimePartitioner("timestamp", pattern="dt=%Y%m%d", unit="ms")
+    assert ms.partition_for(
+        None, cls(query="q", timestamp=1785767400000)) == "dt=20260803"
+
+
+def test_normalize_partition_path_rejects_escapes():
+    assert normalize_partition_path("a/b") == "a/b"
+    assert normalize_partition_path("dt=20260803/") == "dt=20260803"
+    for bad in ("", "/abs", "a/../b", "a//b", ".", "..", "a\\b", 7,
+                # the writer's reserved working dirs: routing a record
+                # there would ack it into a tree nothing reads back
+                "tmp", "tmp/x", "quarantine", "compacted/k", "deadletter"):
+        with pytest.raises(ValueError):
+            normalize_partition_path(bad)
+    assert normalize_partition_path("a/tmp") == "a/tmp"  # only the FIRST
+    # segment is reserved; nested names are the user's namespace
+
+
+def test_make_partitioner_coercions():
+    assert isinstance(make_partitioner("f"), FieldPartitioner)
+    assert isinstance(make_partitioner(("a", "b")), FieldPartitioner)
+    fn = lambda rec, msg: "x"  # noqa: E731
+    assert isinstance(make_partitioner(fn), CallablePartitioner)
+    p = FieldPartitioner("f")
+    assert make_partitioner(p) is p
+    with pytest.raises(TypeError):
+        make_partitioner(42)
+
+
+# -- partitioned writer ------------------------------------------------------
+
+def _produce(broker, cls, rows, parts=2, pad=60):
+    broker.create_topic(TOPIC, parts)
+    for i in range(rows):
+        broker.produce(TOPIC, cls(query="q" * pad + str(i),
+                                  timestamp=i).SerializeToString(),
+                       partition=i % parts)
+
+
+def _build(broker, fs, cls, reg=None, **knobs):
+    b = (Builder().broker(broker).topic(TOPIC).proto_class(cls)
+         .target_dir("/out").filesystem(fs)
+         .instance_name("pt").group_id("g").batch_size(128)
+         .retry_policy(RetryPolicy(base_sleep=0.005, max_sleep=0.05))
+         .max_file_size(100 * 1024).max_file_open_duration_seconds(0.4))
+    if reg is not None:
+        b.metric_registry(reg)
+    for name, val in knobs.items():
+        if isinstance(val, tuple):
+            getattr(b, name)(*val)
+        elif isinstance(val, dict):
+            getattr(b, name)(**val)
+        else:
+            getattr(b, name)(val)
+    return b.build()
+
+
+def _drain(w, broker, rows, parts=2, deadline_s=60):
+    deadline = time.time() + deadline_s
+    while time.time() < deadline:
+        if (sum(broker.committed("g", TOPIC, p) for p in range(parts))
+                >= rows and w.ack_lag()["unacked_records"] == 0):
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def _published_rows(fs, target="/out"):
+    """{timestamp: count} over every structurally verified published file
+    (tmp/quarantine/compacted excluded by verify_dir)."""
+    got: dict[int, int] = {}
+    reports = verify_dir(fs, target)
+    assert all(r.ok for r in reports), [r.errors for r in reports
+                                        if not r.ok]
+    for r in reports:
+        for row in pq.read_table(fs.open_read(r.path)).to_pylist():
+            got[row["timestamp"]] = got.get(row["timestamp"], 0) + 1
+    return got
+
+
+def test_partitioned_writer_hive_layout_and_invariant():
+    cls = sample_message_class()
+    broker = FakeBroker()
+    rows = 3000
+    _produce(broker, cls, rows)
+    fs = MemoryFileSystem()
+    reg = MetricRegistry()
+    w = _build(broker, fs, cls, reg=reg, partition_by=(
+        {"spec": lambda rec, msg: f"k={msg.timestamp % 3}"}))
+    w.start()
+    assert _drain(w, broker, rows)
+    stats = w.stats()
+    w.close()
+    # layout: every published file under its k=<v> partition dir
+    finals = verify_dir(fs, "/out")
+    assert finals
+    for r in finals:
+        part_dir = r.path.rsplit("/", 2)[-2]
+        assert part_dir in ("k=0", "k=1", "k=2"), r.path
+    # at-least-once + exactly the produced set, each present
+    got = _published_rows(fs)
+    assert len(got) == rows
+    assert not [i for i in range(rows) if i not in got]
+    # stats block + canonical gauge registered
+    assert stats["partitions"]["enabled"] is True
+    assert stats["partitions"]["open"] <= stats["partitions"][
+        "max_open_per_worker"]
+    assert reg.get(M.PARTITIONS_OPEN_GAUGE) is not None
+    assert M.PARTITIONS_EVICTED_METER in stats["meters"]
+
+
+def test_partition_lru_eviction_bounds_open_files():
+    cls = sample_message_class()
+    broker = FakeBroker()
+    rows = 2400
+    _produce(broker, cls, rows)
+    fs = MemoryFileSystem()
+    reg = MetricRegistry()
+    w = _build(broker, fs, cls, reg=reg, partition_by=(
+        {"spec": lambda rec, msg: f"k={msg.timestamp % 4}",
+         "max_open_partitions": 2}))
+    w.start()
+    bound_ok = True
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        open_now = w.stats()["partitions"]["open"]
+        bound_ok = bound_ok and open_now <= 2
+        if (sum(broker.committed("g", TOPIC, p) for p in range(2)) >= rows
+                and w.ack_lag()["unacked_records"] == 0):
+            break
+        time.sleep(0.01)
+    stats = w.stats()
+    w.close()
+    # 4 live partitions through a 2-file bound: eviction did the routing
+    assert stats["partitions"]["evicted"] > 0
+    assert reg.get(M.PARTITIONS_EVICTED_METER).count > 0
+    assert bound_ok, "open partition files exceeded max_open_partitions"
+    got = _published_rows(fs)
+    assert len(got) == rows
+
+
+def test_partitioned_time_checkpoint_acks_drain():
+    """No size rotation (1 GiB threshold): acks can only flow through the
+    time checkpoint that closes EVERY open partition file — the held runs
+    must still drain to zero."""
+    cls = sample_message_class()
+    broker = FakeBroker()
+    rows = 1200
+    _produce(broker, cls, rows)
+    fs = MemoryFileSystem()
+    w = _build(broker, fs, cls,
+               max_file_size=1 << 30,
+               max_file_open_duration_seconds=0.3,
+               partition_by=(
+                   {"spec": lambda rec, msg: f"k={msg.timestamp % 3}"}))
+    w.start()
+    assert _drain(w, broker, rows)
+    stats = w.stats()
+    w.close()
+    assert stats["rotations"]["time"] >= 1
+    assert len(_published_rows(fs)) == rows
+
+
+def test_partitioner_error_follows_parse_error_policy():
+    """A partitioner that raises on one record is the same poison-pill
+    class as unparseable bytes: with ``skip`` the stream still drains and
+    only the poisoned record is missing from the published set."""
+    cls = sample_message_class()
+    broker = FakeBroker()
+    rows = 600
+    _produce(broker, cls, rows)
+
+    def part(rec, msg):
+        if msg.timestamp == 100:
+            raise ValueError("unroutable")
+        return f"k={msg.timestamp % 2}"
+
+    fs = MemoryFileSystem()
+    w = _build(broker, fs, cls, on_parse_error="skip",
+               partition_by={"spec": part})
+    w.start()
+    assert _drain(w, broker, rows)
+    w.close()
+    got = _published_rows(fs)
+    assert 100 not in got
+    assert len(got) == rows - 1
+
+
+# -- compactor ---------------------------------------------------------------
+
+def _props():
+    return Builder().proto_class(sample_message_class()).writer_properties()
+
+
+def _write_small_file(fs, path, cls, msgs):
+    pf = ParquetFile(fs, path + ".tmp", _COLZ(cls), _props(),
+                     batch_size=4096)
+    pf.append_records(msgs)
+    pf.close()
+    fs.mkdirs(path.rsplit("/", 1)[0])
+    fs.rename(path + ".tmp", path)
+
+
+class _COLZ:
+    """Columnarizer cache: ProtoColumnarizer construction per file is
+    pure overhead in tests."""
+    _cache: dict = {}
+
+    def __new__(cls, proto_cls):
+        from kpw_tpu.models.proto_bridge import ProtoColumnarizer
+        key = id(proto_cls)
+        if key not in cls._cache:
+            cls._cache[key] = ProtoColumnarizer(proto_cls)
+        return cls._cache[key]
+
+
+def _plant_partitioned_small_files(fs, cls, per_dir=4, rows_each=50,
+                                   dirs=("k=0", "k=1"), root="/out"):
+    """Direct small published files (no writer run): returns the total
+    row count; timestamps globally unique."""
+    ts = 0
+    for d in dirs:
+        fs.mkdirs(f"{root}/{d}")
+        for i in range(per_dir):
+            msgs = [cls(query=f"q-{ts + j}", timestamp=ts + j)
+                    for j in range(rows_each)]
+            _write_small_file(fs, f"{root}/{d}/2026_f{i}.parquet", cls, msgs)
+            ts += rows_each
+    return ts
+
+
+def test_compactor_merges_retires_and_preserves_rows():
+    cls = sample_message_class()
+    fs = MemoryFileSystem()
+    total = _plant_partitioned_small_files(fs, cls)
+    before = verify_dir(fs, "/out")
+    reg = MetricRegistry()
+    c = Compactor(fs, "/out", cls, _props(), target_size=1 << 20,
+                  registry=reg, instance_name="pt")
+    summary = c.compact_once()
+    assert summary["merged"] == 2 and summary["retired"] == 8
+    after = verify_dir(fs, "/out")
+    assert len(after) == 2 and all(r.ok for r in after)
+    assert len(before) / len(after) >= 4
+    got = _published_rows(fs)
+    assert len(got) == total
+    assert all(v == 1 for v in got.values())  # merged once, no dup, no loss
+    # inputs tombstoned under compacted/, never deleted
+    tombs = fs.list_files("/out/compacted", extension=".parquet")
+    assert len(tombs) == 8
+    assert reg.get(M.COMPACTOR_MERGED_METER).count == 2
+    assert reg.get(M.COMPACTOR_RETIRED_METER).count == 8
+    assert c.compactor_stats()["rows_rewritten"] == total
+
+
+def test_compactor_output_name_stable_across_remerges():
+    """Under ongoing ingest a merge output is re-merged with newer small
+    files round after round; the derived name must keep ONE ``-compacted``
+    tag (collision-suffixed), never accumulate them — unbounded
+    ``-compacted-compacted-…`` growth would eventually hit the
+    filesystem's name limit."""
+    cls = sample_message_class()
+    fs = MemoryFileSystem()
+    total = _plant_partitioned_small_files(fs, cls, per_dir=2,
+                                           dirs=("k=0",))
+    c = Compactor(fs, "/out", cls, _props(), target_size=1 << 20,
+                  instance_name="pt")
+    assert c.compact_once()["merged"] == 1
+    for round_no in range(3):  # keep feeding small files; re-merge
+        msgs = [cls(query=f"n{round_no}-{j}", timestamp=total + j)
+                for j in range(50)]
+        total += 50
+        _write_small_file(fs, f"/out/k=0/2027_n{round_no}.parquet", cls,
+                          msgs)
+        assert c.compact_once()["merged"] == 1
+    names = [r.path.rsplit("/", 1)[-1] for r in verify_dir(fs, "/out")]
+    assert len(names) == 1
+    assert "compacted-compacted" not in names[0], names[0]
+    assert len(_published_rows(fs)) == total
+
+
+def test_compactor_respects_min_files():
+    cls = sample_message_class()
+    fs = MemoryFileSystem()
+    _plant_partitioned_small_files(fs, cls, per_dir=1, dirs=("k=0",))
+    c = Compactor(fs, "/out", cls, _props(), target_size=1 << 20,
+                  min_files=2, instance_name="pt")
+    assert c.compact_once()["planned_groups"] == 0
+    assert len(verify_dir(fs, "/out")) == 1  # the lone small file stays
+
+
+def test_compactor_skips_unverifiable_input():
+    cls = sample_message_class()
+    fs = MemoryFileSystem()
+    _plant_partitioned_small_files(fs, cls, per_dir=3, dirs=("k=0",))
+    # tear one input: it must be neither merged nor retired nor deleted
+    with fs.open_read("/out/k=0/2026_f1.parquet") as f:
+        whole = f.read()
+    with fs.open_write("/out/k=0/2026_f1.parquet") as f:
+        f.write(whole[: len(whole) // 2])
+    c = Compactor(fs, "/out", cls, _props(), target_size=1 << 20,
+                  instance_name="pt")
+    summary = c.compact_once()
+    assert summary["merged"] == 1 and summary["retired"] == 2
+    assert fs.exists("/out/k=0/2026_f1.parquet")  # untouched
+
+
+def test_compactor_torn_rewrite_quarantined_inputs_untouched():
+    """A crash-window torn merged tmp (drop_writes) must never publish:
+    the tmp is quarantined, the failed meter marks, and every input stays
+    published — zero rows lost."""
+    cls = sample_message_class()
+    inner = MemoryFileSystem()
+    total = _plant_partitioned_small_files(inner, cls, per_dir=2,
+                                           dirs=("k=0",))
+    sched = FaultSchedule(seed=3).drop_writes_from(3)
+    fs = FaultInjectingFileSystem(inner, sched)
+    reg = MetricRegistry()
+    c = Compactor(fs, "/out", cls, _props(), target_size=1 << 20,
+                  registry=reg, instance_name="pt")
+    summary = c.compact_once()
+    assert summary["merged"] == 0 and summary["failed"] >= 1
+    assert reg.get(M.COMPACTOR_FAILED_METER).count >= 1
+    got = _published_rows(inner)
+    assert len(got) == total and all(v == 1 for v in got.values())
+    # the torn tmp was quarantined (moved, never deleted), never published
+    assert inner.list_files("/out/quarantine", extension=".tmp")
+    assert not inner.list_files("/out/tmp", extension=".tmp")
+
+
+def test_compactor_recover_rolls_forward_after_partial_retire():
+    """Publish landed, retire interrupted (injected rename failure):
+    duplicates exist until recovery — recover() must finish retiring so
+    no duplicate-published final survives, with zero rows lost."""
+    cls = sample_message_class()
+    inner = MemoryFileSystem()
+    total = _plant_partitioned_small_files(inner, cls, per_dir=2,
+                                           dirs=("k=0",))
+    # rename ordinals in one _execute: #1 plan publish, #2 output publish,
+    # #3/#4 the two retires — fail the FIRST retire
+    sched = FaultSchedule(seed=4).fail_nth("rename", 3)
+    c = Compactor(FaultInjectingFileSystem(inner, sched), "/out", cls,
+                  _props(), target_size=1 << 20, instance_name="pt")
+    summary = c.compact_once()
+    assert summary["merged"] == 1
+    # the un-retired input is a duplicate-published final right now
+    got = _published_rows(inner)
+    assert any(v > 1 for v in got.values())
+    assert inner.list_files("/out/compacted/.plans", extension=".plan.json")
+
+    c2 = Compactor(inner, "/out", cls, _props(), target_size=1 << 20,
+                   instance_name="pt")
+    rec = c2.recover()
+    assert rec["plans"] == 1 and rec["rolled_forward"] == 1
+    got = _published_rows(inner)
+    assert len(got) == total
+    assert all(v == 1 for v in got.values())  # duplicate retired
+    assert not inner.list_files("/out/compacted/.plans",
+                                extension=".plan.json")
+
+
+def test_compactor_recover_keeps_plan_when_retire_fails():
+    """A retire rename failing DURING recovery must keep the plan (review
+    finding): dropping it would make the duplicate-published input
+    permanent.  The next, healed round finishes the roll-forward."""
+    cls = sample_message_class()
+    inner = MemoryFileSystem()
+    total = _plant_partitioned_small_files(inner, cls, per_dir=2,
+                                           dirs=("k=0",))
+    sched = FaultSchedule(seed=5).fail_nth("rename", 3, count=2)
+    c = Compactor(FaultInjectingFileSystem(inner, sched), "/out", cls,
+                  _props(), target_size=1 << 20, instance_name="pt")
+    assert c.compact_once()["merged"] == 1  # published, nothing retired
+    # recovery itself hits a still-failing retire (rename #5, #6 ok —
+    # re-fail them so the roll-forward cannot complete)
+    sched2 = FaultSchedule(seed=5).fail_forever_from("rename", 1)
+    sick = Compactor(FaultInjectingFileSystem(inner, sched2), "/out", cls,
+                     _props(), target_size=1 << 20, instance_name="pt")
+    rec = sick.recover()
+    assert rec["rolled_forward"] == 1
+    # the plan SURVIVED the failed resolution
+    assert inner.list_files("/out/compacted/.plans",
+                            extension=".plan.json")
+    healed = Compactor(inner, "/out", cls, _props(), target_size=1 << 20,
+                       instance_name="pt")
+    healed.recover()
+    assert not inner.list_files("/out/compacted/.plans",
+                                extension=".plan.json")
+    got = _published_rows(inner)
+    assert len(got) == total and all(v == 1 for v in got.values())
+
+
+def test_row_to_message_preserves_empty_submessage_presence():
+    """An optional submessage that was SET but empty must survive the
+    rewrite as set (review finding): pyarrow reads it back as a dict of
+    Nones, and re-encoding it absent would silently change data."""
+    from proto_helpers import _F, build_classes
+
+    classes = build_classes("presence", {
+        "Inner": [_field_helper("x", 1, _F.TYPE_INT32)],
+        "Outer": [_field_helper("inner", 1, _F.TYPE_MESSAGE,
+                                type_name=".kpwtest.Inner")],
+    })
+    outer = classes["Outer"]
+    set_empty = row_to_message(outer, {"inner": {"x": None}})
+    assert set_empty.HasField("inner")
+    assert not set_empty.inner.HasField("x")
+    absent = row_to_message(outer, {"inner": None})
+    assert not absent.HasField("inner")
+
+
+def _field_helper(name, number, ftype, type_name=None):
+    from proto_helpers import _field
+    return _field(name, number, ftype, type_name=type_name)
+
+
+def test_compactor_recover_rolls_back_unpublished_plan():
+    """Crash between plan and publish: plan + half-written merged tmp,
+    output never landed.  recover() drops the plan and sweeps the tmp;
+    the inputs are the published truth throughout."""
+    cls = sample_message_class()
+    fs = MemoryFileSystem()
+    total = _plant_partitioned_small_files(fs, cls, per_dir=2,
+                                           dirs=("k=0",))
+    fs.mkdirs("/out/compacted/.plans")
+    plan = {"output": "/out/k=0/2026_f0-compacted.parquet",
+            "inputs": [{"path": f"/out/k=0/2026_f{i}.parquet",
+                        "tombstone": f"/out/compacted/k=0/2026_f{i}.parquet"}
+                       for i in range(2)],
+            "rows": total, "instance": "pt"}
+    with fs.open_write("/out/compacted/.plans/x.plan.json") as f:
+        f.write(json.dumps(plan).encode())
+    fs.mkdirs("/out/tmp")
+    with fs.open_write("/out/tmp/pt_compact_42.tmp") as f:
+        f.write(b"half a merged row group")
+    c = Compactor(fs, "/out", cls, _props(), target_size=1 << 20,
+                  instance_name="pt")
+    rec = c.recover()
+    assert rec == {"plans": 1, "rolled_forward": 0, "rolled_back": 1,
+                   "tmp_swept": 1}
+    got = _published_rows(fs)
+    assert len(got) == total and all(v == 1 for v in got.values())
+    assert not fs.list_files("/out/tmp", extension=".tmp")
+
+
+def test_compactor_recover_restores_inputs_under_torn_output():
+    """Worst case: the planned output exists but is TORN, and one input
+    was already tombstoned.  recover() quarantines the torn output and
+    restores the input from its tombstone — every row stays in a
+    verified published file."""
+    cls = sample_message_class()
+    fs = MemoryFileSystem()
+    total = _plant_partitioned_small_files(fs, cls, per_dir=2,
+                                           dirs=("k=0",))
+    out = "/out/k=0/2026_f0-compacted.parquet"
+    with fs.open_write(out) as f:
+        f.write(b"PAR1 torn garbage")
+    # input f0 already retired to its tombstone
+    fs.mkdirs("/out/compacted/k=0")
+    fs.rename("/out/k=0/2026_f0.parquet",
+              "/out/compacted/k=0/2026_f0.parquet")
+    fs.mkdirs("/out/compacted/.plans")
+    plan = {"output": out,
+            "inputs": [{"path": f"/out/k=0/2026_f{i}.parquet",
+                        "tombstone": f"/out/compacted/k=0/2026_f{i}.parquet"}
+                       for i in range(2)],
+            "rows": total, "instance": "pt"}
+    with fs.open_write("/out/compacted/.plans/x.plan.json") as f:
+        f.write(json.dumps(plan).encode())
+    c = Compactor(fs, "/out", cls, _props(), target_size=1 << 20,
+                  instance_name="pt")
+    rec = c.recover()
+    assert rec["rolled_back"] == 1
+    got = _published_rows(fs)  # asserts every published file verifies
+    assert len(got) == total and all(v == 1 for v in got.values())
+    assert fs.list_files("/out/quarantine", extension=".parquet")
+
+
+def test_writer_with_compaction_service_end_to_end():
+    """Builder-wired service: partitioned writer + background compactor in
+    one lifecycle — small files appear, merges land while the writer
+    lives, every row stays exactly-once in the verified published set."""
+    cls = sample_message_class()
+    broker = FakeBroker()
+    rows = 3000
+    _produce(broker, cls, rows)
+    fs = MemoryFileSystem()
+    reg = MetricRegistry()
+    w = _build(broker, fs, cls, reg=reg,
+               partition_by={"spec": lambda rec, msg:
+                             f"k={msg.timestamp % 2}"},
+               compaction={"target_size": 512 * 1024,
+                           "scan_interval_seconds": 0.1})
+    w.start()
+    assert _drain(w, broker, rows)
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        if w.stats()["compactor"]["merged"] >= 1:
+            break
+        time.sleep(0.05)
+    stats = w.stats()
+    w.close()
+    assert stats["compactor"]["merged"] >= 1
+    got = _published_rows(fs)
+    assert len(got) == rows
+    assert all(v == 1 for v in got.values())
+
+
+# -- row reconstruction + verify --summary ----------------------------------
+
+def test_row_to_message_nested_roundtrip():
+    order_cls = nested_message_classes()
+    msg = order_cls(order_id=7, note="n")
+    it = msg.items.add()
+    it.sku = "a"
+    it.qty = 2
+    it.tags.extend(["x", "y"])
+    row = {"order_id": 7, "note": "n",
+           "items": [{"sku": "a", "qty": 2, "tags": ["x", "y"]}]}
+    rebuilt = row_to_message(order_cls, row)
+    assert rebuilt == msg
+
+
+def test_verify_summary_cli(tmp_path, capsys):
+    from kpw_tpu.io import verify as verify_mod
+
+    cls = sample_message_class()
+    fs = LocalFileSystem()
+    d = str(tmp_path)
+    msgs = [cls(query=f"q{i}", timestamp=i) for i in range(40)]
+    _write_small_file(fs, f"{d}/a.parquet", cls, msgs[:20])
+    _write_small_file(fs, f"{d}/b.parquet", cls, msgs[20:])
+    rc = verify_mod.main(["--summary", d])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert out["files"] == 2 and out["ok"] == 2 and out["failed"] == 0
+    assert out["rows"] == 40 and out["failures"] == []
+    # one torn file flips the verdict and names the failure
+    with open(f"{d}/a.parquet", "r+b") as f:
+        f.truncate(30)
+    rc = verify_mod.main(["--summary", d])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1 and out["failed"] == 1
+    assert out["failures"] == [f"{d}/a.parquet"]
+    # the same rollup is importable for in-process use
+    assert summarize(verify_dir(fs, d))["failed"] == 1
+
+
+def test_partition_compaction_canonical_names_registered():
+    for name in (M.PARTITIONS_OPEN_GAUGE, M.PARTITIONS_EVICTED_METER,
+                 M.COMPACTOR_MERGED_METER, M.COMPACTOR_RETIRED_METER,
+                 M.COMPACTOR_FAILED_METER):
+        assert name in M.METRIC_NAMES
+    from kpw_tpu.utils.tracing import STAGE_NAMES
+    assert "compactor.merge" in STAGE_NAMES

@@ -13,6 +13,7 @@ import pytest
 
 from kpw_tpu import (
     Builder,
+    FailoverFileSystem,
     FakeBroker,
     FaultInjectingFileSystem,
     FaultSchedule,
@@ -281,6 +282,77 @@ def test_fault_wrapper_memory_local_parity(make_fs, tmp_path):
         assert rf.read() == b"AABB"
     assert [e["op"] for e in sched.fired()] == ["write", "rename"]
     assert fs.list_files(f"{root}/d") == [f"{root}/d/f2"]
+
+
+@pytest.mark.parametrize("make_fs", [
+    lambda tmp: (MemoryFileSystem(), "/p"),
+    lambda tmp: (LocalFileSystem(), str(tmp)),
+    lambda tmp: (FaultInjectingFileSystem(MemoryFileSystem(),
+                                          FaultSchedule(seed=0)), "/p"),
+], ids=["memory", "local", "fault-wrapped"])
+def test_list_files_recursive_nested_partition_parity(make_fs, tmp_path):
+    """Recursive/non-recursive ``list_files`` parity over a NESTED
+    Hive-partitioned tree (the PR-4 race fix only proved the flat case):
+    every implementation must agree on the relative result set, the
+    extension filter, the non-recursive top-level cut, and the empty
+    answer for a missing directory — partition-aware sweep/startup-verify
+    and the compactor's scan all build on exactly this contract."""
+    fs, root = make_fs(tmp_path)
+    layout = [
+        "a.parquet",
+        "dt=20260803/hour=14/x.parquet",
+        "dt=20260803/hour=14/y.parquet",
+        "dt=20260803/hour=15/z.parquet",
+        "dt=20260804/hour=00/w.parquet",
+        "dt=20260804/notes.txt",
+        "tmp/k=1/pt_0_7.tmp",
+    ]
+    for rel in layout:
+        d = rel.rsplit("/", 1)[0] if "/" in rel else ""
+        fs.mkdirs(f"{root}/{d}" if d else root)
+        with fs.open_write(f"{root}/{rel}") as f:
+            f.write(b"x")
+
+    def rel_set(paths):
+        return sorted(p[len(root) + 1:] for p in paths)
+
+    assert rel_set(fs.list_files(root, extension=".parquet")) == [
+        "a.parquet",
+        "dt=20260803/hour=14/x.parquet",
+        "dt=20260803/hour=14/y.parquet",
+        "dt=20260803/hour=15/z.parquet",
+        "dt=20260804/hour=00/w.parquet",
+    ]
+    assert rel_set(fs.list_files(root)) == sorted(layout)
+    # non-recursive: top level only, nested partitions invisible
+    assert rel_set(fs.list_files(root, extension=".parquet",
+                                 recursive=False)) == ["a.parquet"]
+    # subtree listing with the tmp shape the partitioned sweep walks
+    assert rel_set(fs.list_files(f"{root}/tmp", extension=".tmp")) == [
+        "tmp/k=1/pt_0_7.tmp"]
+    # a missing directory lists empty, never raises (the PR-4 contract)
+    assert fs.list_files(f"{root}/absent") == []
+
+
+def test_failover_list_files_unions_nested_trees():
+    """The failover composite's listing is the primary/fallback UNION on
+    nested partition trees too — reconciliation scans must see spilled
+    partition files wherever they landed."""
+    primary, fallback = MemoryFileSystem(), MemoryFileSystem()
+    for fs, rel in ((primary, "dt=1/a.parquet"), (fallback, "dt=1/b.parquet"),
+                    (fallback, "dt=2/hour=3/c.parquet")):
+        fs.mkdirs("/p/" + rel.rsplit("/", 1)[0])
+        with fs.open_write(f"/p/{rel}") as f:
+            f.write(b"x")
+    ffs = FailoverFileSystem(primary, fallback, probe_interval_s=60)
+    try:
+        assert ffs.list_files("/p", extension=".parquet") == [
+            "/p/dt=1/a.parquet", "/p/dt=1/b.parquet",
+            "/p/dt=2/hour=3/c.parquet"]
+        assert ffs.list_files("/p", extension=".parquet",
+                              recursive=False) == []
+    finally:
+        ffs.close()
 
 
 # ---------------------------------------------------------------------------

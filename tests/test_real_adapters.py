@@ -298,6 +298,48 @@ def test_hdfs_adapter_full_surface(hdfs):
         fs.size("out/nope")
 
 
+def test_hdfs_list_files_nested_partition_parity(hdfs):
+    """Recursive/non-recursive ``list_files`` parity over a NESTED
+    Hive-partitioned tree (ISSUE 8 satellite: the PR-4 race fix only
+    proved the flat case).  The HDFS adapter must agree with
+    Local/Memory (tests/test_faults.py's parity case) on the relative
+    result set, the extension filter, the non-recursive top-level cut,
+    and the empty answer for a missing directory — the partition-aware
+    tmp sweep and the compactor's scan all walk exactly this contract."""
+    fs = hdfs
+    layout = [
+        "a.parquet",
+        "dt=20260803/hour=14/x.parquet",
+        "dt=20260803/hour=14/y.parquet",
+        "dt=20260803/hour=15/z.parquet",
+        "dt=20260804/hour=00/w.parquet",
+        "dt=20260804/notes.txt",
+        "tmp/k=1/pt_0_7.tmp",
+    ]
+    for rel in layout:
+        d = rel.rsplit("/", 1)[0] if "/" in rel else ""
+        fs.mkdirs(f"p/{d}" if d else "p")
+        with fs.open_write(f"p/{rel}") as f:
+            f.write(b"x")
+
+    def rel_set(paths):
+        return sorted(p.split("p/", 1)[1] for p in paths)
+
+    assert rel_set(fs.list_files("p", extension=".parquet")) == [
+        "a.parquet",
+        "dt=20260803/hour=14/x.parquet",
+        "dt=20260803/hour=14/y.parquet",
+        "dt=20260803/hour=15/z.parquet",
+        "dt=20260804/hour=00/w.parquet",
+    ]
+    assert rel_set(fs.list_files("p")) == sorted(layout)
+    assert rel_set(fs.list_files("p", extension=".parquet",
+                                 recursive=False)) == ["a.parquet"]
+    assert rel_set(fs.list_files("p/tmp", extension=".tmp")) == [
+        "tmp/k=1/pt_0_7.tmp"]
+    assert fs.list_files("p/absent") == []
+
+
 def test_writer_black_box_over_hdfs_adapter(hdfs):
     """The reference's integration pattern (produce -> rotate -> read back
     with an independent reader) over the HDFS adapter surface."""

@@ -103,6 +103,21 @@ CHECKS = [
      ["e2e:batch_ab.speedup_x"]),
     ("PARITY.md", r"p99 ack-lag ([\d.]+)k records \(`ack_lag_p99_records`",
      [("e2e:ack_lag_p99_records", 1e3)]),
+    # partitioned-output/compaction PR: small-file reduction + invariant
+    # quotes reconcile against the compaction artifact (`compact:` prefix)
+    ("README.md", r"compacts \*\*(\d+)\*\* small files into \*\*(\d+)\*\* "
+                  r"merged files \(\*\*([\d.]+)x\*\*",
+     ["compact:file_count_before", "compact:file_count_after",
+      "compact:reduction_x"]),
+    ("README.md", r"all \*\*(\d+)\*\* acked offsets \(recorded as\s+"
+                  r"`acked_offsets_checked`\)",
+     ["compact:acked_offsets_checked"]),
+    ("PARITY.md", r"`file_count_before` (\d+) → `file_count_after` (\d+), "
+                  r"`reduction_x` \*\*([\d.]+)x\*\*",
+     ["compact:file_count_before", "compact:file_count_after",
+      "compact:reduction_x"]),
+    ("PARITY.md", r"compaction run's \*\*(\d+)\*\* acked offsets",
+     ["compact:acked_offsets_checked"]),
 ]
 
 
@@ -391,6 +406,12 @@ def main() -> int:
         "KPW_E2E_PATH", os.path.join(ROOT, "BENCH_E2E_r10.json"))
     if os.path.exists(e2e_path):
         key_record["e2e"] = json.load(open(e2e_path))
+    # the partitioned-output/compaction artifact (bench.py --compact) is
+    # the seventh
+    compact_path = os.environ.get(
+        "KPW_COMPACT_PATH", os.path.join(ROOT, "BENCH_COMPACT_r12.json"))
+    if os.path.exists(compact_path):
+        key_record["compact"] = json.load(open(compact_path))
     docs = {f: open(os.path.join(ROOT, f)).read()
             for f in ({c[0] for c in CHECKS} | set(KEY_DOCS)
                       | set(NAME_DOCS))}
@@ -416,6 +437,8 @@ def main() -> int:
                 root, spec = key_record.get("degrade", {}), spec[8:]
             elif spec.startswith("e2e:"):
                 root, spec = key_record.get("e2e", {}), spec[4:]
+            elif spec.startswith("compact:"):
+                root, spec = key_record.get("compact", {}), spec[8:]
             try:
                 expect = float(art(root, spec)) / scale
             except (KeyError, TypeError):

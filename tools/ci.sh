@@ -11,9 +11,13 @@
 #                          CHANGES.md (this container carries ~31
 #                          pre-existing environmental failures: python
 #                          zstandard module + jax shard_map absent)
-#   3. doc reconciliation — python tools/check_docs.py (every doc-cited
+#   3. compaction smoke  — python bench.py --compact --smoke (reduced
+#                          partitioned-run -> compaction -> crash-replay
+#                          invariant; exits nonzero unless it holds, and
+#                          never overwrites the committed artifact)
+#   4. doc reconciliation — python tools/check_docs.py (every doc-cited
 #                          number/name/test/pass exists and matches)
-#   4. sanitizer smoke   — bash tools/sanitize.sh --smoke (ASan/UBSan
+#   5. sanitizer smoke   — bash tools/sanitize.sh --smoke (ASan/UBSan
 #                          native build + fuzz; prints a LOUD notice and
 #                          exits 0 when the toolchain is absent — never
 #                          a silent pass)
@@ -26,10 +30,10 @@ cd "$(dirname "$0")/.."
 fail=0
 step() { echo; echo "=== ci.sh [$1] $2 ==="; }
 
-step 1/4 "lint suite (python -m tools.analyze)"
+step 1/5 "lint suite (python -m tools.analyze)"
 python -m tools.analyze || fail=1
 
-step 2/4 "tier-1 pytest (-m 'not slow')"
+step 2/5 "tier-1 pytest (-m 'not slow')"
 # tier-1's exit code is nonzero on THIS container because of the known
 # environmental failures (python zstandard + jax shard_map absent — see
 # the CHANGES.md baseline), so the gate is mechanical instead of
@@ -52,10 +56,13 @@ if [ "$t1_errors" -gt 0 ] || [ "$t1_failed" -gt "$max_failed" ] \
 fi
 rm -f "$T1_LOG"
 
-step 3/4 "doc reconciliation (tools/check_docs.py)"
+step 3/5 "compaction smoke (bench.py --compact --smoke)"
+JAX_PLATFORMS=cpu python bench.py --compact --smoke || fail=1
+
+step 4/5 "doc reconciliation (tools/check_docs.py)"
 python tools/check_docs.py || fail=1
 
-step 4/4 "sanitizer smoke (tools/sanitize.sh --smoke)"
+step 5/5 "sanitizer smoke (tools/sanitize.sh --smoke)"
 bash tools/sanitize.sh --smoke || fail=1
 
 echo
