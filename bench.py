@@ -43,6 +43,17 @@ encode (NYC-taxi-shaped replay, one chip), printed as ONE JSON line
                reduced run that does NOT overwrite the committed
                artifact and exits nonzero unless the invariant holds
                (the tools/ci.sh gate)
+  --scan       query-ready-files A/B (ISSUE 9): identical rows written
+               with page indexes + bloom filters + a declared sort vs
+               index-less; the page-index planner must skip >=50% of
+               data pages on a selective range (pyarrow cross-checks the
+               row sets), pyarrow fragment pushdown must prune row
+               groups, a guaranteed-miss bloom probe must be rejected
+               from the bloom section alone (every data-page byte
+               zeroed), sort-on-compact must publish a declared+verified
+               order; writes BENCH_SCAN_r13.json.  With --smoke: reduced
+               run, committed artifact untouched, nonzero exit unless
+               pruning is observed (the tools/ci.sh gate)
   --cpu        force the virtual CPU platform (local smoke)
 
 Baseline for configs 1/2/3/5 is pyarrow's C++ parquet writer with matched
@@ -2769,6 +2780,281 @@ def compact_probe(rows: int = 24_000, seed: int = 12,
 
 
 # ---------------------------------------------------------------------------
+# --scan: query-ready files (page index + bloom + sort order) A/B
+# ---------------------------------------------------------------------------
+
+def scan_probe(rows: int = 60_000, seed: int = 13, smoke: bool = False) -> dict:
+    """``--scan`` mode: the query-ready-files subsystem's committed
+    evidence (ISSUE 9).
+
+    Part 1 — the page-skip A/B: the SAME rows written twice (ascending
+    int64 ``ts`` + a 64-key string column, many row groups, small pages),
+    once with ColumnIndex/OffsetIndex + bloom filters + a declared sort,
+    once with the index off.  pyarrow predicate pushdown must return the
+    identical row set from both; fragment-level pushdown must prune row
+    groups; and the page-index scan planner (``core/index.py``
+    ``select_pages``) must skip >= 50% of data pages — and their bytes —
+    on a ~2% selective range, while covering every matching row.  On the
+    index-less control the planner has nothing to prune with: 0 skipped.
+
+    Part 2 — bloom short-circuit: every data-page byte of the indexed
+    file is ZEROED; present-key probes must still all hit and the
+    guaranteed-miss probe must be rejected, proving the answer comes from
+    the bloom section alone.  Observed FPP over absent probes is
+    recorded against the configured budget.
+
+    Part 3 — sort-on-compact: unsorted small files merged by a
+    ``sort_by`` Compactor must publish ONE output that is physically
+    sorted, DECLARES ``sorting_columns``, and passes the structural
+    verifier's order-vs-page-stats cross-check before publish.
+
+    ``invariant_holds`` is True only when all three parts hold and
+    ``io/verify.py`` validates every file this bench produced.
+    """
+    from kpw_tpu.core.index import (bloom_check, read_file_index,
+                                    read_sorting_columns, select_pages)
+    from kpw_tpu.core.schema import PhysicalType, Schema, leaf
+    from kpw_tpu.core.writer import (ParquetFileWriter, WriterProperties,
+                                     columns_from_arrays)
+    from kpw_tpu.io.verify import verify_bytes
+    import pyarrow.dataset as pa_ds
+    import pyarrow.parquet as pq
+
+    if smoke:
+        rows = 16_000
+    slices = 12
+    keys = 64
+    rng = np.random.default_rng(seed)
+    schema = Schema([leaf("ts", "int64"), leaf("k", "string")])
+    arrays = {
+        "ts": np.arange(rows, dtype=np.int64),
+        "k": np.array([b"key%05d" % v for v in
+                       rng.integers(0, keys, rows)], object),
+    }
+
+    def write(**props_kw):
+        props_kw.setdefault("data_page_size", 4096)
+        sink = io.BytesIO()
+        w = ParquetFileWriter(sink, schema, WriterProperties(**props_kw))
+        step = (rows + slices - 1) // slices
+        for at in range(0, rows, step):
+            w.write_batch(columns_from_arrays(
+                schema, {c: v[at: at + step] for c, v in arrays.items()}))
+            w.flush_row_group()
+        w.close()
+        return sink.getvalue(), w
+
+    t0 = time.perf_counter()
+    indexed, wi = write(bloom_columns=(),
+                        sorting_columns=(("ts", False, False),))
+    noindex, _ = write(write_page_index=False)
+    write_s = time.perf_counter() - t0
+
+    # -- part 1: identical rows, page + row-group pruning -----------------
+    lo = rows // 2
+    hi = lo + max(rows // 50, 64)  # ~2% of the keyspace
+    flt = [("ts", ">=", lo), ("ts", "<=", hi)]
+    t_idx = pq.read_table(io.BytesIO(indexed), filters=flt)
+    t_plain = pq.read_table(io.BytesIO(noindex), filters=flt)
+    rows_match = (t_idx.sort_by("ts").equals(t_plain.sort_by("ts"))
+                  and t_idx.num_rows == hi - lo + 1)
+
+    def planner_pages(data):
+        """(pages_total, pages_read, bytes_total, bytes_read, covered_ok)
+        for the ``ts`` column under [lo, hi], via the file's own page
+        index."""
+        md = pq.ParquetFile(io.BytesIO(data)).metadata
+        total = read = bytes_total = bytes_read = 0
+        covered = np.zeros(rows, bool)
+        row_base = 0
+        for rg_i, rg in enumerate(read_file_index(data)):
+            rg_rows = md.row_group(rg_i).num_rows
+            entry = rg[0]  # column "ts"
+            oi, ci = entry["offset_index"], entry["column_index"]
+            sel = select_pages(ci, PhysicalType.INT64, lo=lo, hi=hi)
+            total += len(oi)
+            read += len(sel)
+            bytes_total += sum(sz for _, sz, _ in oi)
+            bytes_read += sum(oi[p][1] for p in sel)
+            for p in sel:
+                first = oi[p][2]
+                last = oi[p + 1][2] if p + 1 < len(oi) else rg_rows
+                covered[row_base + first: row_base + last] = True
+            row_base += rg_rows
+        return total, read, bytes_total, bytes_read, bool(
+            covered[lo: hi + 1].all())
+
+    pt, pr, bt, br, covered_ok = planner_pages(indexed)
+    skipped_pct = round(100.0 * (pt - pr) / pt, 1) if pt else 0.0
+    bytes_skipped_pct = round(100.0 * (bt - br) / bt, 1) if bt else 0.0
+    # the index-less control: nothing for a planner to prune with — every
+    # chunk must be read whole (its page count via the verifier's walk)
+    control_unprunable = all(
+        e["column_index"] is None and e["offset_index"] is None
+        for rg in read_file_index(noindex) for e in rg)
+
+    import tempfile
+    with tempfile.TemporaryDirectory() as td:
+        p = os.path.join(td, "indexed.parquet")
+        with open(p, "wb") as f:
+            f.write(indexed)
+        frag = next(iter(pa_ds.dataset(p, format="parquet")
+                         .get_fragments()))
+        rgs_kept = len(frag.split_by_row_group(
+            (pa_ds.field("ts") >= lo) & (pa_ds.field("ts") <= hi)))
+    rgs_total = pq.ParquetFile(io.BytesIO(indexed)).metadata.num_row_groups
+
+    # -- part 2: bloom short-circuit off the gutted file ------------------
+    idx = read_file_index(indexed)
+    section_start = min(e["bloom_offset"] for rg in idx for e in rg
+                        if e["bloom_offset"] is not None)
+    gutted = b"PAR1" + b"\0" * (section_start - 4) + indexed[section_start:]
+    present = [b"key%05d" % v for v in range(keys)]
+    absent = [b"absent%05d" % v for v in range(1000)]
+    hits = sum(any(bloom_check(gutted, rg[1]["bloom_offset"], kb,
+                               PhysicalType.BYTE_ARRAY) for rg in idx)
+               for kb in present)
+    fps = sum(all(not bloom_check(gutted, rg[1]["bloom_offset"], kb,
+                                  PhysicalType.BYTE_ARRAY) for rg in idx)
+              for kb in absent)
+    miss_rejected = all(not bloom_check(gutted, rg[1]["bloom_offset"],
+                                        b"guaranteed-miss-probe",
+                                        PhysicalType.BYTE_ARRAY)
+                        for rg in idx)
+    info = wi.index_info()
+
+    # -- verify every bench output ----------------------------------------
+    rep_idx = verify_bytes(indexed, "bench-indexed")
+    rep_plain = verify_bytes(noindex, "bench-noindex")
+    all_verified = rep_idx.ok and rep_plain.ok
+    verify_counters = rep_idx.to_dict()
+
+    # -- part 3: sort-on-compact ------------------------------------------
+    from kpw_tpu import Builder, Compactor, MemoryFileSystem
+    from kpw_tpu.io.verify import verify_dir
+    from kpw_tpu.models.proto_bridge import ProtoColumnarizer
+    from kpw_tpu.runtime.parquet_file import ParquetFile
+
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                    "tests"))
+    from proto_helpers import sample_message_class
+
+    cls = sample_message_class()
+    fs = MemoryFileSystem()
+    import dataclasses
+    props = dataclasses.replace(
+        Builder().proto_class(cls).writer_properties(), data_page_size=1024)
+    colz = ProtoColumnarizer(cls)
+    fs.mkdirs("/scan")
+    n_inputs, rows_each = 4, 1500 if not smoke else 400
+    stamps = rng.permutation(n_inputs * rows_each)
+    for i in range(n_inputs):
+        path = f"/scan/in_{i}.parquet"
+        pf = ParquetFile(fs, path + ".tmp", colz, props, batch_size=4096)
+        pf.append_records([cls(query=f"q{int(t) % 9}", timestamp=int(t))
+                           for t in
+                           stamps[i * rows_each: (i + 1) * rows_each]])
+        pf.close()
+        fs.rename(path + ".tmp", path)
+    comp = Compactor(fs, "/scan", cls, props, target_size=32 << 20,
+                     min_files=2, sort_by="timestamp")
+    t0 = time.perf_counter()
+    summary = comp.compact_once()
+    compact_s = time.perf_counter() - t0
+    out_reports = verify_dir(fs, "/scan")
+    sorted_ok = declared = False
+    rows_out = 0
+    if len(out_reports) == 1 and out_reports[0].ok:
+        r = out_reports[0]
+        sorted_ok = r.sorted_row_groups == r.row_groups >= 1
+        with fs.open_read(r.path) as f:
+            out_bytes = f.read()
+        declared = all(d for d in read_sorting_columns(out_bytes))
+        got = pq.read_table(io.BytesIO(out_bytes))["timestamp"].to_numpy()
+        rows_out = len(got)
+        sorted_ok = sorted_ok and bool((np.diff(got) >= 0).all())
+    sort_leg = {
+        "inputs": n_inputs,
+        "rows_in": n_inputs * rows_each,
+        "merged": summary["merged"],
+        "failed": summary["failed"],
+        "compact_seconds": round(compact_s, 3),
+        "rows_out": rows_out,
+        "declared_sorting_columns": declared,
+        "physically_sorted_and_verified": sorted_ok,
+    }
+
+    invariant = (rows_match and covered_ok and skipped_pct >= 50.0
+                 and control_unprunable
+                 and rgs_kept < rgs_total
+                 and hits == len(present) and miss_rejected
+                 and all_verified
+                 and sort_leg["merged"] == 1 and sorted_ok and declared
+                 and rows_out == sort_leg["rows_in"])
+    print(f"[bench:scan] pages {pr}/{pt} read ({skipped_pct}% skipped, "
+          f"{bytes_skipped_pct}% of bytes); control unprunable="
+          f"{control_unprunable} ({rep_plain.pages} pages all read); "
+          f"row groups {rgs_kept}/{rgs_total} kept; bloom: {hits}/"
+          f"{len(present)} present hit, miss rejected={miss_rejected}, "
+          f"fpp {1 - fps / len(absent):.4f}; sort-on-compact "
+          f"sorted={sorted_ok} declared={declared}; verified="
+          f"{all_verified}; invariant_holds={invariant}", file=sys.stderr)
+    return {
+        "metric": "page_index_scan_selectivity",
+        "value": skipped_pct,
+        "unit": "% of data pages skipped on a ~2% selective range "
+                "(identical rows, page index on vs off)",
+        "seed": seed,
+        "smoke": smoke,
+        "rows": rows,
+        "row_groups": slices,
+        "write_seconds": round(write_s, 3),
+        "selective_range": [int(lo), int(hi)],
+        "rows_match_pyarrow_pushdown": rows_match,
+        "pages": {
+            "total": pt, "read": pr, "skipped": pt - pr,
+            "skipped_pct": skipped_pct,
+            "bytes_total": bt, "bytes_read": br,
+            "bytes_skipped_pct": bytes_skipped_pct,
+            "matching_rows_covered": covered_ok,
+        },
+        "pages_noindex_control": {
+            "pages": rep_plain.pages, "read": rep_plain.pages,
+            "skipped": 0, "unprunable": control_unprunable,
+        },
+        "row_groups_pushdown": {
+            "total": rgs_total, "kept": rgs_kept,
+            "pruned": rgs_total - rgs_kept,
+        },
+        "bloom": {
+            "filters": info["bloom_filters"],
+            "bytes": info["bloom_bytes"],
+            "present_probes": len(present),
+            "present_hits": hits,
+            "absent_probes": len(absent),
+            "absent_rejected": fps,
+            "observed_fpp": round(1.0 - fps / len(absent), 5),
+            "configured_fpp": 0.01,
+            "guaranteed_miss_rejected": miss_rejected,
+            "data_page_bytes_readable_during_probe": 0,
+        },
+        "index_bytes": info["index_bytes"],
+        "file_bytes": {
+            "indexed": len(indexed), "noindex": len(noindex),
+            "overhead_pct": round(100.0 * (len(indexed) - len(noindex))
+                                  / len(noindex), 2),
+        },
+        "verify": {
+            "indexed": verify_counters,
+            "noindex_ok": rep_plain.ok,
+            "all_verified": all_verified,
+        },
+        "sort_on_compact": sort_leg,
+        "invariant_holds": invariant,
+    }
+
+
+# ---------------------------------------------------------------------------
 # --e2e: sustained-throughput saturation benchmark (ingest -> encode -> publish)
 # ---------------------------------------------------------------------------
 
@@ -3304,7 +3590,7 @@ def main() -> None:
     if not any(f in sys.argv
                for f in ("--all", "--rowgroup", "--hostasm", "--config",
                          "--obs", "--chaos", "--crash", "--degrade",
-                         "--e2e", "--compact")):
+                         "--e2e", "--compact", "--scan")):
         # default graded path: jax-free orchestrator (see _graded_main)
         _graded_main()
         return
@@ -3323,10 +3609,11 @@ def main() -> None:
     if ("--cpu" in sys.argv or "--hostasm" in sys.argv
             or "--obs" in sys.argv or "--chaos" in sys.argv
             or "--crash" in sys.argv or "--degrade" in sys.argv
-            or "--e2e" in sys.argv or "--compact" in sys.argv):
-        # --hostasm/--obs/--chaos/--crash/--degrade/--e2e/--compact measure
-        # HOST work only and must never grab the real chip; the switch must
-        # precede the first device use below
+            or "--e2e" in sys.argv or "--compact" in sys.argv
+            or "--scan" in sys.argv):
+        # --hostasm/--obs/--chaos/--crash/--degrade/--e2e/--compact/--scan
+        # measure HOST work only and must never grab the real chip; the
+        # switch must precede the first device use below
         import jax
 
         jax.config.update("jax_platforms", "cpu")
@@ -3661,6 +3948,36 @@ def main() -> None:
                                 "workers_sweep", "autotune", "batch_ab",
                                 "scenario")}
         summary["batch_speedup_x"] = out["batch_ab"]["speedup_x"]
+        summary["artifact"] = os.path.basename(path)
+        print(json.dumps(summary))
+        return
+    if "--scan" in sys.argv:
+        smoke = "--smoke" in sys.argv
+        out = scan_probe(smoke=smoke)
+        if smoke:
+            # the CI gate: never overwrite the committed artifact, fail
+            # loudly unless pruning is actually observed
+            print(json.dumps({k: out[k] for k in
+                              ("metric", "value", "invariant_holds",
+                               "smoke")}
+                             | {"pages": out["pages"],
+                                "row_groups_pushdown":
+                                    out["row_groups_pushdown"]}))
+            sys.exit(0 if out["invariant_holds"] else 4)
+        path = os.environ.get(
+            "KPW_SCAN_PATH",
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "BENCH_SCAN_r13.json"))
+        with open(path, "w") as f:
+            json.dump(out, f, indent=1)
+        print(f"[bench:scan] artifact written to {path}", file=sys.stderr)
+        summary = {k: v for k, v in out.items()
+                   if k not in ("verify", "sort_on_compact", "bloom",
+                                "pages_noindex_control")}
+        summary["bloom_miss_rejected"] = out["bloom"][
+            "guaranteed_miss_rejected"]
+        summary["sort_on_compact_ok"] = out["sort_on_compact"][
+            "physically_sorted_and_verified"]
         summary["artifact"] = os.path.basename(path)
         print(json.dumps(summary))
         return

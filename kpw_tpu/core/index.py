@@ -1,0 +1,568 @@
+"""Query-ready file metadata: PARQUET-922 page indexes, split-block bloom
+filters, and the read-side tooling that proves they pay off.
+
+Files this writer publishes are written once and scanned forever, and scan
+cost downstream is dominated by how much a reader can SKIP ("An Empirical
+Evaluation of Columnar Storage Formats", PAPERS.md): page-level min/max
+lets a selective predicate prune pages without touching them, and a bloom
+filter rejects a point-lookup miss without reading any data page at all.
+This module owns the three byte formats plus their readers:
+
+* **ColumnIndex / OffsetIndex** (parquet.thrift, PARQUET-922): per-page
+  ``null_pages`` / ``min_values`` / ``max_values`` / ``boundary_order`` /
+  ``null_counts``, and per-page ``(offset, compressed_page_size,
+  first_row_index)`` locations.  Serialized thrift-compact via
+  ``core.thrift.CompactWriter``, laid out between the last row group and
+  the footer by ``core/writer.py``; the footer's ColumnChunk fields 4-7
+  point at them.
+* **Split-block bloom filters** (parquet.thrift BloomFilterHeader + the
+  SBBF bitset): xxhash64 of the value's plain-encoded bytes, 256-bit
+  blocks of 8 salted words.  The dictionary build already owns each
+  chunk's exact distinct set — on the device backends that set comes back
+  from the mesh/TPU build — so filter population is a hash pass over k
+  distinct values, not n rows.  ``bloom_filter_offset``/``length`` live in
+  ColumnMetaData fields 14/15.
+* **Readers** used by the scan planner (``bench.py --scan``), the
+  verifier's structural walk, and tests: footer index-section discovery,
+  ColumnIndex/OffsetIndex parse, page selection against a predicate, and
+  bloom probe.
+
+Nothing here imports jax: the module is pure numpy + the in-repo thrift
+codec, importable from the encode hot path and the jax-free tooling alike.
+"""
+
+from __future__ import annotations
+
+import math
+import struct
+from dataclasses import dataclass
+
+import numpy as np
+
+from .schema import PhysicalType
+from .thrift import (CT_BINARY, CT_I64, CT_STRUCT, CT_TRUE, CompactReader,
+                     CompactWriter, ThriftDecodeError)
+
+# BoundaryOrder (parquet.thrift)
+UNORDERED, ASCENDING, DESCENDING = 0, 1, 2
+
+# ColumnIndex field ids
+_CI_NULL_PAGES, _CI_MIN, _CI_MAX, _CI_ORDER, _CI_NULL_COUNTS = 1, 2, 3, 4, 5
+# OffsetIndex / PageLocation field ids
+_OI_LOCATIONS = 1
+_PL_OFFSET, _PL_SIZE, _PL_FIRST_ROW = 1, 2, 3
+
+
+# ---------------------------------------------------------------------------
+# per-page statistics (collected by the encoder while pages are assembled)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class PageStats:
+    """One data page's index ingredients, recorded by the encoder as the
+    page is assembled.  ``offset`` is relative to the chunk's first byte
+    (the writer only learns the chunk's absolute position at commit);
+    ``compressed_size`` includes the page header, per PageLocation's
+    contract.  ``min_key``/``max_key`` are python-comparable values (for
+    boundary-order computation); ``min_bytes``/``max_bytes`` are the
+    plain-encoded statistics bytes the ColumnIndex carries."""
+
+    first_row_index: int
+    offset: int
+    compressed_size: int
+    num_values: int
+    null_count: int
+    min_bytes: bytes | None = None
+    max_bytes: bytes | None = None
+    min_key: object = None
+    max_key: object = None
+
+    @property
+    def is_null_page(self) -> bool:
+        # a null PAGE is one whose every value is null — NOT one that
+        # merely lacks decodable stats (an all-NaN float page has no
+        # min/max but its rows are real; claiming null_pages=true there
+        # would let an index-aware reader prune live rows)
+        return self.num_values > 0 and self.null_count == self.num_values
+
+    @property
+    def has_stats(self) -> bool:
+        return self.min_bytes is not None
+
+
+def boundary_order(pages: list[PageStats]) -> int:
+    """BoundaryOrder of a chunk's non-null pages: ASCENDING when both the
+    min and max sequences are non-decreasing, DESCENDING when both are
+    non-increasing, else UNORDERED.  Null pages are skipped (the spec
+    excludes them from the ordering); zero or one comparable page is
+    trivially ASCENDING (parquet-mr does the same)."""
+    keys = [(p.min_key, p.max_key) for p in pages
+            if not p.is_null_page and p.has_stats]
+    if len(keys) <= 1:
+        return ASCENDING
+    asc = all(a[0] <= b[0] and a[1] <= b[1]
+              for a, b in zip(keys, keys[1:]))
+    if asc:
+        return ASCENDING
+    desc = all(a[0] >= b[0] and a[1] >= b[1]
+               for a, b in zip(keys, keys[1:]))
+    return DESCENDING if desc else UNORDERED
+
+
+def serialize_column_index(pages: list[PageStats]) -> bytes:
+    """ColumnIndex thrift-compact bytes for one column chunk.  Null pages
+    — and pages with no decodable stats, e.g. all-NaN floats — carry
+    empty min/max byte strings (the list fields are required; a reader
+    must not prune on an empty entry); ``null_counts`` is always written
+    — the encoder knows exact per-page null counts for every path it
+    indexes."""
+    w = CompactWriter()
+    w.struct_begin()
+    w.field_list_begin(_CI_NULL_PAGES, CT_TRUE, len(pages))
+    for p in pages:
+        w.list_bool(p.is_null_page)
+    w.field_list_begin(_CI_MIN, CT_BINARY, len(pages))
+    for p in pages:
+        w.list_binary(p.min_bytes or b"")
+    w.field_list_begin(_CI_MAX, CT_BINARY, len(pages))
+    for p in pages:
+        w.list_binary(p.max_bytes or b"")
+    w.field_i32(_CI_ORDER, boundary_order(pages))
+    w.field_list_begin(_CI_NULL_COUNTS, CT_I64, len(pages))
+    for p in pages:
+        w.list_i64(p.null_count)
+    w.struct_end()
+    return w.getvalue()
+
+
+def serialize_offset_index(pages: list[PageStats],
+                           chunk_file_offset: int) -> bytes:
+    """OffsetIndex thrift-compact bytes: page locations made absolute by
+    the chunk's final file offset (known only at footer time)."""
+    w = CompactWriter()
+    w.struct_begin()
+    w.field_list_begin(_OI_LOCATIONS, CT_STRUCT, len(pages))
+    for p in pages:
+        w.struct_begin()
+        w.field_i64(_PL_OFFSET, chunk_file_offset + p.offset)
+        w.field_i32(_PL_SIZE, p.compressed_size)
+        w.field_i64(_PL_FIRST_ROW, p.first_row_index)
+        w.struct_end()
+    w.struct_end()
+    return w.getvalue()
+
+
+def parse_column_index(data: bytes, offset: int, length: int) -> dict:
+    """Decode one ColumnIndex; raises ThriftDecodeError on garbage.
+    Returns {null_pages, min_values, max_values, boundary_order,
+    null_counts} with python types."""
+    r = CompactReader(data, offset, limit=offset + length)
+    d = r.read_struct()
+    out = {
+        "null_pages": d.get(_CI_NULL_PAGES),
+        "min_values": d.get(_CI_MIN),
+        "max_values": d.get(_CI_MAX),
+        "boundary_order": d.get(_CI_ORDER),
+        "null_counts": d.get(_CI_NULL_COUNTS),
+    }
+    if (not isinstance(out["null_pages"], list)
+            or not isinstance(out["min_values"], list)
+            or not isinstance(out["max_values"], list)):
+        raise ThriftDecodeError("ColumnIndex missing a required page list")
+    return out
+
+
+def parse_offset_index(data: bytes, offset: int,
+                       length: int) -> list[tuple[int, int, int]]:
+    """Decode one OffsetIndex into [(abs_offset, compressed_size,
+    first_row_index), ...]; raises ThriftDecodeError on garbage."""
+    r = CompactReader(data, offset, limit=offset + length)
+    d = r.read_struct()
+    locs = d.get(_OI_LOCATIONS)
+    if not isinstance(locs, list):
+        raise ThriftDecodeError("OffsetIndex has no page_locations list")
+    out = []
+    for loc in locs:
+        if not isinstance(loc, dict):
+            raise ThriftDecodeError("PageLocation is not a struct")
+        o, s, fr = (loc.get(_PL_OFFSET), loc.get(_PL_SIZE),
+                    loc.get(_PL_FIRST_ROW))
+        if not all(isinstance(v, int) and not isinstance(v, bool)
+                   for v in (o, s, fr)):
+            raise ThriftDecodeError("PageLocation fields not integers")
+        out.append((o, s, fr))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# typed min/max decoding + page selection (the scan planner)
+# ---------------------------------------------------------------------------
+
+_FIXED_FMT = {
+    PhysicalType.INT32: "<i", PhysicalType.INT64: "<q",
+    PhysicalType.FLOAT: "<f", PhysicalType.DOUBLE: "<d",
+}
+
+
+def decode_stat(value: bytes, physical_type: int):
+    """Plain-encoded statistics bytes -> python-comparable value (None for
+    an empty/undecodable value — null pages carry empty strings)."""
+    if not value:
+        return None
+    fmt = _FIXED_FMT.get(physical_type)
+    if fmt is None:
+        return bytes(value)  # BYTE_ARRAY/FLBA compare lexicographically
+    if len(value) != struct.calcsize(fmt):
+        return None
+    return struct.unpack(fmt, value)[0]
+
+
+def select_pages(column_index: dict, physical_type: int,
+                 lo=None, hi=None) -> list[int]:
+    """Page ordinals whose [min, max] MAY intersect [lo, hi] (either bound
+    None = unbounded).  Pages whose stats cannot be decoded are kept —
+    pruning must never be unsound.  This is the reader-side payoff the
+    bench measures: pages NOT in this list are never read."""
+    keep = []
+    null_pages = column_index["null_pages"]
+    for i, (pmin, pmax) in enumerate(zip(column_index["min_values"],
+                                         column_index["max_values"])):
+        if i < len(null_pages) and null_pages[i]:
+            continue  # only nulls: a value predicate cannot match
+        dmin = decode_stat(pmin, physical_type)
+        dmax = decode_stat(pmax, physical_type)
+        if dmin is None or dmax is None:
+            keep.append(i)  # undecodable stats: must read
+            continue
+        if lo is not None and dmax < lo:
+            continue
+        if hi is not None and dmin > hi:
+            continue
+        keep.append(i)
+    return keep
+
+
+# footer fids needed to discover index sections (parquet.thrift; the same
+# ids the metadata writer emits)
+_FMD_ROW_GROUPS = 4
+_RG_COLUMNS, _RG_SORTING = 1, 4
+_CC_OFF_IDX_OFF, _CC_OFF_IDX_LEN = 4, 5
+_CC_COL_IDX_OFF, _CC_COL_IDX_LEN = 6, 7
+_CC_META = 3
+_CM_TYPE = 1
+_CM_BLOOM_OFF, _CM_BLOOM_LEN = 14, 15
+
+
+def read_file_index(data: bytes) -> list[list[dict]]:
+    """All index sections of a serialized parquet file, per row group per
+    column: [{column_index, offset_index, bloom_offset, bloom_length,
+    physical_type}].  Entries are None-valued where a section is absent.
+    Raises ThriftDecodeError on a malformed footer — callers that must not
+    raise (the fuzz harness) catch it."""
+    if len(data) < 8 or data[-4:] != b"PAR1":
+        raise ThriftDecodeError("no trailing PAR1 magic")
+    footer_len = int.from_bytes(data[-8:-4], "little")
+    footer_start = len(data) - 8 - footer_len
+    if footer_len <= 0 or footer_start < 4:
+        raise ThriftDecodeError("footer length does not fit the file")
+    fmd = CompactReader(data, footer_start, limit=len(data) - 8).read_struct()
+    out: list[list[dict]] = []
+    for rg in fmd.get(_FMD_ROW_GROUPS) or []:
+        cols = []
+        if not isinstance(rg, dict):
+            raise ThriftDecodeError("row group is not a struct")
+        for cc in rg.get(_RG_COLUMNS) or []:
+            if not isinstance(cc, dict):
+                raise ThriftDecodeError("column chunk is not a struct")
+            meta = cc.get(_CC_META) if isinstance(cc.get(_CC_META),
+                                                  dict) else {}
+            # same int normalization as ci/oi below: a hostile footer can
+            # decode field 14/15 as any thrift type, and a non-int offset
+            # handed to bloom_check would TypeError instead of the
+            # documented ThriftDecodeError/None contract
+            b_off, b_len = meta.get(_CM_BLOOM_OFF), meta.get(_CM_BLOOM_LEN)
+            entry = {
+                "physical_type": meta.get(_CM_TYPE),
+                "column_index": None,
+                "offset_index": None,
+                "bloom_offset": b_off if isinstance(b_off, int)
+                and not isinstance(b_off, bool) else None,
+                "bloom_length": b_len if isinstance(b_len, int)
+                and not isinstance(b_len, bool) else None,
+            }
+            ci_off, ci_len = cc.get(_CC_COL_IDX_OFF), cc.get(_CC_COL_IDX_LEN)
+            if isinstance(ci_off, int) and isinstance(ci_len, int):
+                entry["column_index"] = parse_column_index(data, ci_off,
+                                                           ci_len)
+            oi_off, oi_len = cc.get(_CC_OFF_IDX_OFF), cc.get(_CC_OFF_IDX_LEN)
+            if isinstance(oi_off, int) and isinstance(oi_len, int):
+                entry["offset_index"] = parse_offset_index(data, oi_off,
+                                                           oi_len)
+            cols.append(entry)
+        out.append(cols)
+    return out
+
+
+def read_sorting_columns(data: bytes) -> list[list[tuple[int, bool, bool]]]:
+    """Declared ``sorting_columns`` per row group: [(column_idx,
+    descending, nulls_first), ...] (empty list where undeclared)."""
+    if len(data) < 8 or data[-4:] != b"PAR1":
+        raise ThriftDecodeError("no trailing PAR1 magic")
+    footer_len = int.from_bytes(data[-8:-4], "little")
+    footer_start = len(data) - 8 - footer_len
+    if footer_len <= 0 or footer_start < 4:
+        raise ThriftDecodeError("footer length does not fit the file")
+    fmd = CompactReader(data, footer_start, limit=len(data) - 8).read_struct()
+    out = []
+    for rg in fmd.get(_FMD_ROW_GROUPS) or []:
+        decl = []
+        for sc in (rg.get(_RG_SORTING) or []) if isinstance(rg, dict) else []:
+            if isinstance(sc, dict):
+                decl.append((sc.get(1), bool(sc.get(2)), bool(sc.get(3))))
+        out.append(decl)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# xxhash64 (the bloom filter's hash, parquet.thrift BloomFilterHash.XXHASH)
+# ---------------------------------------------------------------------------
+
+_P1 = 0x9E3779B185EBCA87
+_P2 = 0xC2B2AE3D27D4EB4F
+_P3 = 0x165667B19E3779F9
+_P4 = 0x85EBCA77C2B2AE63
+_P5 = 0x27D4EB2F165667C5
+_M64 = (1 << 64) - 1
+
+
+def _rotl(x: int, r: int) -> int:
+    return ((x << r) | (x >> (64 - r))) & _M64
+
+
+def xxh64(data: bytes, seed: int = 0) -> int:
+    """XXH64 of ``data`` — the parquet bloom hash (seed 0).  Pure python;
+    bloom population hashes a chunk's DISTINCT set (k values, not n rows),
+    and the fixed-width bulk path below covers numeric columns."""
+    n = len(data)
+    i = 0
+    if n >= 32:
+        v1 = (seed + _P1 + _P2) & _M64
+        v2 = (seed + _P2) & _M64
+        v3 = seed & _M64
+        v4 = (seed - _P1) & _M64
+        while i + 32 <= n:
+            k1, k2, k3, k4 = struct.unpack_from("<QQQQ", data, i)
+            v1 = (_rotl((v1 + k1 * _P2) & _M64, 31) * _P1) & _M64
+            v2 = (_rotl((v2 + k2 * _P2) & _M64, 31) * _P1) & _M64
+            v3 = (_rotl((v3 + k3 * _P2) & _M64, 31) * _P1) & _M64
+            v4 = (_rotl((v4 + k4 * _P2) & _M64, 31) * _P1) & _M64
+            i += 32
+        h = (_rotl(v1, 1) + _rotl(v2, 7) + _rotl(v3, 12)
+             + _rotl(v4, 18)) & _M64
+        for v in (v1, v2, v3, v4):
+            h = ((h ^ ((_rotl((v * _P2) & _M64, 31) * _P1) & _M64))
+                 * _P1 + _P4) & _M64
+    else:
+        h = (seed + _P5) & _M64
+    h = (h + n) & _M64
+    while i + 8 <= n:
+        k = struct.unpack_from("<Q", data, i)[0]
+        h = (h ^ ((_rotl((k * _P2) & _M64, 31) * _P1) & _M64)) & _M64
+        h = (_rotl(h, 27) * _P1 + _P4) & _M64
+        i += 8
+    if i + 4 <= n:
+        h = (h ^ (struct.unpack_from("<I", data, i)[0] * _P1)) & _M64
+        h = (_rotl(h, 23) * _P2 + _P3) & _M64
+        i += 4
+    while i < n:
+        h = (h ^ (data[i] * _P5)) & _M64
+        h = (_rotl(h, 11) * _P1) & _M64
+        i += 1
+    h ^= h >> 33
+    h = (h * _P2) & _M64
+    h ^= h >> 29
+    h = (h * _P3) & _M64
+    h ^= h >> 32
+    return h
+
+
+def _np_rotl(x: np.ndarray, r: int) -> np.ndarray:
+    return (x << np.uint64(r)) | (x >> np.uint64(64 - r))
+
+
+def xxh64_fixed(arr: np.ndarray) -> np.ndarray:
+    """Vectorized XXH64 over a fixed-width numeric array: each element is
+    hashed as its 4- or 8-byte plain encoding (exactly what the scalar
+    path would see), the whole column in a handful of numpy passes —
+    byte-identical to ``xxh64`` per element (pinned in tests)."""
+    itemsize = arr.dtype.itemsize
+    if itemsize == 8:
+        k = np.ascontiguousarray(arr).view(np.uint64)
+        with np.errstate(over="ignore"):
+            h = np.uint64((_P5 + 8) & _M64)
+            h = h ^ (_np_rotl(k * np.uint64(_P2), 31) * np.uint64(_P1))
+            h = _np_rotl(h, 27) * np.uint64(_P1) + np.uint64(_P4)
+    elif itemsize == 4:
+        k = np.ascontiguousarray(arr).view(np.uint32).astype(np.uint64)
+        with np.errstate(over="ignore"):
+            h = np.uint64((_P5 + 4) & _M64)
+            h = h ^ (k * np.uint64(_P1))
+            h = _np_rotl(h, 23) * np.uint64(_P2) + np.uint64(_P3)
+    else:
+        raise ValueError(f"xxh64_fixed needs 4/8-byte items, got {itemsize}")
+    with np.errstate(over="ignore"):
+        h ^= h >> np.uint64(33)
+        h *= np.uint64(_P2)
+        h ^= h >> np.uint64(29)
+        h *= np.uint64(_P3)
+        h ^= h >> np.uint64(32)
+    return h
+
+
+# ---------------------------------------------------------------------------
+# split-block bloom filter (SBBF)
+# ---------------------------------------------------------------------------
+
+_SALT = np.array([0x47B6137B, 0x44974D91, 0x8824AD5B, 0xA2B7289D,
+                  0x705495C7, 0x2DF1424B, 0x9EFC4947, 0x5C6BFB31],
+                 np.uint32)
+_MIN_BYTES = 32  # one 256-bit block
+# BloomFilterHeader field ids; algorithm/hash/compression are thrift
+# unions whose single set field (fid 1) names the variant
+_BFH_NUM_BYTES, _BFH_ALGO, _BFH_HASH, _BFH_COMP = 1, 2, 3, 4
+
+
+class SplitBlockBloomFilter:
+    """Parquet SBBF: ``num_bytes`` (any multiple of 32 >= 32 — this
+    writer always sizes a power of two, but a READER must accept every
+    spec-legal block count) of 256-bit blocks, 8 salted words each.
+    Insert/check follow the spec exactly: block = mulhi32(upper32(h),
+    num_blocks); within the block, word i gets bit
+    ``(lower32(h) * SALT[i]) >> 27``."""
+
+    def __init__(self, num_bytes: int) -> None:
+        if num_bytes < _MIN_BYTES or num_bytes % 32:
+            raise ValueError(
+                f"SBBF size must be a multiple of 32 >= {_MIN_BYTES} "
+                f"bytes (got {num_bytes})")
+        self.num_bytes = num_bytes
+        self._words = np.zeros(num_bytes // 4, np.uint32)
+
+    @classmethod
+    def for_ndv(cls, ndv: int, fpp: float = 0.01,
+                max_bytes: int = 128 * 1024) -> "SplitBlockBloomFilter":
+        """Size for ``ndv`` distinct values at false-positive rate ``fpp``
+        (parquet-mr's formula: bits = -8*ndv / ln(1 - fpp^(1/8))), rounded
+        up to a power of two and clamped to [32, max_bytes]."""
+        if not 0.0 < fpp < 1.0:
+            raise ValueError("fpp must be in (0, 1)")
+        bits = -8.0 * max(ndv, 1) / math.log(1.0 - fpp ** 0.125)
+        need = max(_MIN_BYTES, 1 << max(0, math.ceil(bits / 8) - 1)
+                   .bit_length())
+        cap = max(_MIN_BYTES, 1 << (int(max_bytes).bit_length() - 1))
+        return cls(min(need, cap))
+
+    @classmethod
+    def from_bitset(cls, bitset: bytes) -> "SplitBlockBloomFilter":
+        f = cls(len(bitset))
+        f._words = np.frombuffer(bitset, dtype="<u4").copy()
+        return f
+
+    def _block_word_base(self, h: int) -> int:
+        z = self.num_bytes // 32
+        return (((h >> 32) * z) >> 32) * 8
+
+    def insert_hash(self, h: int) -> None:
+        base = self._block_word_base(h)
+        x = np.uint32(h & 0xFFFFFFFF)
+        with np.errstate(over="ignore"):
+            bits = np.uint32(1) << ((x * _SALT) >> np.uint32(27))
+        self._words[base: base + 8] |= bits
+
+    def check_hash(self, h: int) -> bool:
+        base = self._block_word_base(h)
+        x = np.uint32(h & 0xFFFFFFFF)
+        with np.errstate(over="ignore"):
+            bits = np.uint32(1) << ((x * _SALT) >> np.uint32(27))
+        return bool(np.all(self._words[base: base + 8] & bits == bits))
+
+    def insert_hashes(self, hashes: np.ndarray) -> None:
+        """Bulk insert (uint64 hash array) — one vectorized pass per salt
+        word, the shape the fixed-width distinct-set population uses."""
+        z = np.uint64(self.num_bytes // 32)
+        with np.errstate(over="ignore"):
+            base = (((hashes >> np.uint64(32)) * z) >> np.uint64(32)) * \
+                np.uint64(8)
+            x = hashes.astype(np.uint32)
+            for i in range(8):
+                bits = np.uint32(1) << ((x * _SALT[i]) >> np.uint32(27))
+                np.bitwise_or.at(self._words, base + np.uint64(i), bits)
+
+    def add_values(self, values, physical_type: int) -> None:
+        """Hash + insert a set of values by their plain encoding: numeric
+        ndarrays ride the vectorized hash, byte values the scalar one."""
+        if isinstance(values, np.ndarray) and values.dtype.itemsize in (4, 8)\
+                and values.dtype.kind in "iuf":
+            self.insert_hashes(xxh64_fixed(values))
+            return
+        for v in values:
+            self.insert_hash(xxh64(bytes(v)))
+
+    def check_value(self, value, physical_type: int) -> bool:
+        return self.check_hash(xxh64(plain_value_bytes(value,
+                                                       physical_type)))
+
+    def serialize(self) -> bytes:
+        """BloomFilterHeader (thrift compact) + bitset, the on-file layout
+        ColumnMetaData.bloom_filter_offset points at."""
+        w = CompactWriter()
+        w.struct_begin()
+        w.field_i32(_BFH_NUM_BYTES, self.num_bytes)
+        for fid in (_BFH_ALGO, _BFH_HASH, _BFH_COMP):
+            w.field_struct_begin(fid)   # union wrapper ...
+            w.field_struct_begin(1)     # ... variant 1 = BLOCK/XXHASH/UNCOMP
+            w.struct_end()
+            w.struct_end()
+        w.struct_end()
+        return w.getvalue() + self._words.astype("<u4").tobytes()
+
+
+def plain_value_bytes(value, physical_type: int) -> bytes:
+    """One value's plain encoding — the bytes the bloom hash covers."""
+    fmt = _FIXED_FMT.get(physical_type)
+    if fmt is not None:
+        return struct.pack(fmt, value)
+    return bytes(value)
+
+
+def parse_bloom_header(data: bytes, offset: int,
+                       limit: int | None = None) -> tuple[int, int]:
+    """(num_bytes, bitset_offset) of a serialized bloom filter at
+    ``offset``.  Raises ThriftDecodeError when the header is garbage or
+    the unions don't carry a known variant."""
+    r = CompactReader(data, offset, limit=limit)
+    hdr = r.read_struct()
+    nb = hdr.get(_BFH_NUM_BYTES)
+    if not isinstance(nb, int) or isinstance(nb, bool) or nb < _MIN_BYTES \
+            or nb % 32:
+        raise ThriftDecodeError(
+            f"bloom header numBytes {nb!r} invalid (need a multiple of 32 "
+            f">= {_MIN_BYTES})")
+    for fid, what in ((_BFH_ALGO, "algorithm"), (_BFH_HASH, "hash"),
+                      (_BFH_COMP, "compression")):
+        union = hdr.get(fid)
+        if not isinstance(union, dict) or 1 not in union:
+            raise ThriftDecodeError(
+                f"bloom header {what} union missing variant 1")
+    return nb, r.pos
+
+
+def bloom_check(data: bytes, bloom_offset: int, value,
+                physical_type: int) -> bool:
+    """Probe a serialized bloom filter in ``data`` without touching any
+    data page: False = the value is DEFINITELY absent from the chunk."""
+    nb, bitset_off = parse_bloom_header(data, bloom_offset)
+    if bitset_off + nb > len(data):
+        raise ThriftDecodeError("bloom bitset overruns the file")
+    f = SplitBlockBloomFilter.from_bitset(data[bitset_off: bitset_off + nb])
+    return f.check_value(value, physical_type)

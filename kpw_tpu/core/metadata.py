@@ -193,6 +193,11 @@ class ColumnMetaData:
     data_page_offset: int
     dictionary_page_offset: int | None = None
     statistics: Statistics | None = None
+    # split-block bloom filter section (parquet.thrift fields 14/15),
+    # assigned at close() when the index sections land in the file —
+    # the query-ready-files layer (core/index.py)
+    bloom_filter_offset: int | None = None
+    bloom_filter_length: int | None = None
 
     def write(self, w: CompactWriter) -> None:
         w.struct_begin()
@@ -213,6 +218,29 @@ class ColumnMetaData:
         if self.statistics is not None:
             w._field_header(12, CT_STRUCT)
             self.statistics.write(w)
+        if self.bloom_filter_offset is not None:
+            w.field_i64(14, self.bloom_filter_offset)
+        if self.bloom_filter_length is not None:
+            w.field_i32(15, self.bloom_filter_length)
+        w.struct_end()
+
+
+@dataclass
+class SortingColumn:
+    """RowGroup ``sorting_columns`` entry (parquet.thrift SortingColumn):
+    a declaration that the row group's rows are sorted by the leaf at
+    ``column_idx`` — what readers need before they can binary-search or
+    merge files, and what sort-on-compact (io/compact.py) publishes."""
+
+    column_idx: int
+    descending: bool = False
+    nulls_first: bool = False
+
+    def write(self, w: CompactWriter) -> None:
+        w.struct_begin()
+        w.field_i32(1, self.column_idx)
+        w.field_bool(2, self.descending)
+        w.field_bool(3, self.nulls_first)
         w.struct_end()
 
 
@@ -220,12 +248,29 @@ class ColumnMetaData:
 class ColumnChunk:
     file_offset: int
     meta_data: ColumnMetaData
+    # PARQUET-922 page-index section pointers (parquet.thrift fields 4-7),
+    # assigned at close() once the serialized ColumnIndex/OffsetIndex land
+    offset_index_offset: int | None = None
+    offset_index_length: int | None = None
+    column_index_offset: int | None = None
+    column_index_length: int | None = None
+    # builder-side carriers, never serialized: the encoder's per-page
+    # stats (core.index.PageStats) and populated bloom filter ride the
+    # ColumnChunk from commit to close, where the sections are written
+    page_stats: list | None = field(default=None, repr=False, compare=False)
+    bloom: object = field(default=None, repr=False, compare=False)
 
     def write(self, w: CompactWriter) -> None:
         w.struct_begin()
         w.field_i64(2, self.file_offset)
         w._field_header(3, CT_STRUCT)
         self.meta_data.write(w)
+        if self.offset_index_offset is not None:
+            w.field_i64(4, self.offset_index_offset)
+            w.field_i32(5, self.offset_index_length)
+        if self.column_index_offset is not None:
+            w.field_i64(6, self.column_index_offset)
+            w.field_i32(7, self.column_index_length)
         w.struct_end()
 
 
@@ -288,6 +333,7 @@ def fast_column_chunk(cc: "ColumnChunk") -> bytes:
         last = 11
     if m.statistics is not None:
         o.append(((12 - last) << 4) | 12)  # .12 struct statistics
+        last = 12
         s = m.statistics
         slast = 0
         if s.null_count is not None:
@@ -308,7 +354,26 @@ def fast_column_chunk(cc: "ColumnChunk") -> bytes:
             _vu(o, len(s.min_value))
             o += s.min_value
         o.append(0)  # statistics stop
+    if m.bloom_filter_offset is not None:
+        o.append(((14 - last) << 4) | 6)  # .14 i64 bloom_filter_offset
+        _zzv(o, m.bloom_filter_offset)
+        last = 14
+        if m.bloom_filter_length is not None:
+            o.append(0x15)  # .15 i32 bloom_filter_length (delta 1)
+            _zzv(o, m.bloom_filter_length)
     o.append(0)  # ColumnMetaData stop
+    clast = 3  # ColumnChunk's own field cursor (2, 3 written above)
+    if cc.offset_index_offset is not None:
+        o.append(((4 - clast) << 4) | 6)  # .4 i64 offset_index_offset
+        _zzv(o, cc.offset_index_offset)
+        o.append(0x15)  # .5 i32 offset_index_length
+        _zzv(o, cc.offset_index_length)
+        clast = 5
+    if cc.column_index_offset is not None:
+        o.append(((6 - clast) << 4) | 6)  # .6 i64 column_index_offset
+        _zzv(o, cc.column_index_offset)
+        o.append(0x15)  # .7 i32 column_index_length
+        _zzv(o, cc.column_index_length)
     o.append(0)  # ColumnChunk stop
     return bytes(o)
 
@@ -318,6 +383,7 @@ class RowGroup:
     columns: list[ColumnChunk]
     total_byte_size: int
     num_rows: int
+    sorting_columns: list[SortingColumn] | None = None
     file_offset: int | None = None
     total_compressed_size: int | None = None
     ordinal: int | None = None
@@ -348,6 +414,10 @@ class RowGroup:
             w.append_raw(b)
         w.field_i64(2, self.total_byte_size)
         w.field_i64(3, self.num_rows)
+        if self.sorting_columns:
+            w.field_list_begin(4, CT_STRUCT, len(self.sorting_columns))
+            for sc in self.sorting_columns:
+                sc.write(w)
         if self.file_offset is not None:
             w.field_i64(5, self.file_offset)
         if self.total_compressed_size is not None:

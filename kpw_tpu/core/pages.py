@@ -20,6 +20,7 @@ import numpy as np
 from . import encodings as enc
 from .bytecol import ByteColumn
 from .compression import compress
+from .index import PageStats, SplitBlockBloomFilter, xxh64
 from .metadata import (
     ColumnChunk,
     ColumnMetaData,
@@ -30,6 +31,7 @@ from .metadata import (
     write_page_header,
 )
 from .schema import Codec, ColumnDescriptor, Encoding, PageType, PhysicalType
+from ..utils.tracing import stage
 
 
 @dataclass
@@ -77,23 +79,33 @@ class ColumnChunkData:
         return self._est_bytes
 
 def _min_max_bytes(values, physical_type: int):
+    lo, hi, _, _ = _min_max_typed(values, physical_type)
+    return lo, hi
+
+
+def _min_max_typed(values, physical_type: int):
+    """(min_bytes, max_bytes, min_key, max_key): the plain-encoded stats
+    bytes plus python-comparable keys — the page index needs both (the
+    bytes go in the ColumnIndex, the keys decide boundary order)."""
     if len(values) == 0:
-        return None, None
+        return None, None, None, None
     if physical_type in (PhysicalType.BYTE_ARRAY, PhysicalType.FIXED_LEN_BYTE_ARRAY):
-        return bytes(min(values)), bytes(max(values))
+        lo, hi = bytes(min(values)), bytes(max(values))
+        return lo, hi, lo, hi
     arr = np.asarray(values)
     if arr.dtype.kind == "f":
         mask = ~np.isnan(arr)
         if not mask.any():
-            return None, None
+            return None, None, None, None
         arr = arr[mask]
     dtype = enc._PLAIN_DTYPES.get(physical_type)
     if physical_type == PhysicalType.BOOLEAN:
         lo, hi = bool(arr.min()), bool(arr.max())
-        return bytes([lo]), bytes([hi])
-    lo = np.asarray(arr.min(), dtype).tobytes()
-    hi = np.asarray(arr.max(), dtype).tobytes()
-    return lo, hi
+        return bytes([lo]), bytes([hi]), lo, hi
+    lo_v, hi_v = arr.min(), arr.max()
+    lo = np.asarray(lo_v, dtype).tobytes()
+    hi = np.asarray(hi_v, dtype).tobytes()
+    return lo, hi, lo_v.item(), hi_v.item()
 
 
 class EncodedChunk:
@@ -106,10 +118,12 @@ class EncodedChunk:
     largest host-assembly slice at the 64-column uncompressed shape).
     ``blob`` joins lazily for callers that still want one buffer."""
 
-    __slots__ = ("parts", "length", "meta", "dictionary_page_len", "_blob")
+    __slots__ = ("parts", "length", "meta", "dictionary_page_len", "_blob",
+                 "pages", "bloom")
 
     def __init__(self, parts, meta: ColumnMetaData,
-                 dictionary_page_len: int, length: int | None = None) -> None:
+                 dictionary_page_len: int, length: int | None = None,
+                 pages: list | None = None, bloom=None) -> None:
         if isinstance(parts, (bytes, bytearray, memoryview)):
             parts = [parts]  # compat: single pre-joined blob
         self.parts = parts
@@ -118,6 +132,11 @@ class EncodedChunk:
         self.meta = meta
         self.dictionary_page_len = dictionary_page_len  # 0 if none
         self._blob: bytes | None = None
+        # query-ready-files carriers (core/index.py): per-data-page stats
+        # for the ColumnIndex/OffsetIndex, and the populated bloom filter
+        # (None when the respective feature is off for this chunk)
+        self.pages = pages
+        self.bloom = bloom
 
     @property
     def blob(self) -> bytes:
@@ -188,6 +207,18 @@ class EncoderOptions:
     # compression.  parquet-mr 1.10 doesn't write it; readers that verify
     # (pyarrow page_checksum_verification) detect torn/corrupt pages.
     page_checksums: bool = False
+    # Query-ready files (core/index.py): collect per-page min/max/null
+    # stats during page assembly and emit PARQUET-922 ColumnIndex/
+    # OffsetIndex sections at close (parquet-mr 1.11 writes them by
+    # default too).  Off = byte-identical pre-index output.
+    write_page_index: bool = True
+    # Split-block bloom filters, opt-in (they cost file bytes): None =
+    # disabled; () = auto — string columns plus any column whose chunk
+    # dictionary-encoded (the build's exact distinct set makes population
+    # a k-hash pass); a tuple of column names pins the set explicitly.
+    bloom_columns: tuple | None = None
+    bloom_fpp: float = 0.01
+    bloom_max_bytes: int = 128 * 1024
 
 
 class CpuChunkEncoder:
@@ -392,6 +423,56 @@ class CpuChunkEncoder:
             offset += e.length
         return encoded
 
+    # -- query-ready metadata (core/index.py) ------------------------------
+    def _bloom_on(self, col, pt: int, dict_accepted: bool) -> bool:
+        """Whether this chunk gets a bloom filter.  Explicit
+        ``bloom_columns`` pins the set; the auto mode ``()`` covers string
+        columns plus any column whose chunk actually dictionary-encoded
+        (``dict_accepted`` — the ratio/size gates passed, so cardinality
+        is low enough that a filter can prune and population is a k-hash
+        pass over the exact set).  Keying on acceptance, not on "a build
+        ran", keeps emission backend-identical: the CPU build never
+        ratio-aborts early while native/mesh do, but all backends agree
+        on what is *accepted*."""
+        cols = self.options.bloom_columns
+        if cols is None or pt == PhysicalType.BOOLEAN:
+            return False
+        if cols:
+            return col.name in cols or ".".join(col.path) in cols
+        return pt in (PhysicalType.BYTE_ARRAY,
+                      PhysicalType.FIXED_LEN_BYTE_ARRAY) or dict_accepted
+
+    def _bloom_wants_distinct(self, chunk: ColumnChunkData) -> bool:
+        """True when bloom filters are configured for this column, so a
+        backend's dictionary-build ratio/byte early-abort should hand back
+        the full distinct set anyway — the filter needs it, and a second
+        distinct pass would cost more than the completed build (the
+        native/mesh ``_try_dictionary`` overrides consult this).
+        ``dict_accepted=False``: whether the build will be accepted is
+        not knowable here, so only the unconditional selection terms
+        apply — auto-mode fixed-width blooms ride acceptance, which never
+        needs an abort waiver (an accepted build completed by definition)."""
+        return self._bloom_on(chunk.column, chunk.column.leaf.physical_type,
+                              False)
+
+    def _build_bloom(self, chunk: ColumnChunkData, pt: int, dict_values):
+        """Populate one chunk's SBBF: from the dictionary's exact distinct
+        set when a build ran (dictionary-encoded OR rejected — the set is
+        exact either way, and on the device backends it is the mesh-global
+        merged dictionary), else a host distinct pass over the present
+        values."""
+        opts = self.options
+        if dict_values is not None:
+            distinct = dict_values
+        elif isinstance(chunk.values, np.ndarray):
+            distinct = np.unique(chunk.values)
+        else:
+            distinct = {bytes(v) for v in chunk.values}
+        f = SplitBlockBloomFilter.for_ndv(len(distinct), opts.bloom_fpp,
+                                          opts.bloom_max_bytes)
+        f.add_values(distinct, pt)
+        return f
+
     # -- helpers -----------------------------------------------------------
     def _dictionary_viable(self, chunk: ColumnChunkData) -> bool:
         if not self.options.enable_dictionary:
@@ -507,6 +588,21 @@ class CpuChunkEncoder:
         if def_levels is not None:
             present = np.asarray(def_levels) == col.max_def
             value_offsets = np.concatenate([[0], np.cumsum(present)])
+        # Query-ready metadata (core/index.py): per-page stats for the
+        # ColumnIndex/OffsetIndex, collected as pages are laid out (page
+        # offsets relative to the chunk's first byte — made absolute at
+        # footer time), and the chunk's bloom filter.  The bloom populates
+        # from the dictionary build's exact distinct set whenever one ran
+        # (accepted OR ratio-rejected; on the device backends this is the
+        # mesh-global dictionary), so it costs k hashes, not n.
+        page_stats: list | None = [] if opts.write_page_index else None
+        record_starts = None
+        if page_stats is not None and chunk.rep_levels is not None:
+            record_starts = np.nonzero(np.asarray(chunk.rep_levels) == 0)[0]
+        bloom = None
+        if self._bloom_on(col, pt, use_dict):
+            with stage("encode.bloom", column=col.name):
+                bloom = self._build_bloom(chunk, pt, dict_values)
         if (opts.codec == Codec.UNCOMPRESSED and not opts.page_checksums
                 and col.max_def == 0 and col.max_rep == 0):
             # Tight loop for the hot shape (flat required column,
@@ -529,12 +625,22 @@ class CpuChunkEncoder:
                                                value_encoding)
                 if data_page_offset is None:
                     data_page_offset = base_offset + blob_len
+                page_off = blob_len
                 blob_parts.append(header)
                 blob_parts.extend(parts)
                 hl = len(header)
                 blob_len += hl + body_len
                 total_uncompressed += hl + body_len
                 total_compressed += hl + body_len
+                if page_stats is not None:
+                    # flat required column: slot == row, no nulls
+                    lo_b, hi_b, lo_k, hi_k = _min_max_typed(
+                        chunk.values[a:b], pt)
+                    page_stats.append(PageStats(
+                        first_row_index=a, offset=page_off,
+                        compressed_size=hl + body_len, num_values=b - a,
+                        null_count=0, min_bytes=lo_b, max_bytes=hi_b,
+                        min_key=lo_k, max_key=hi_k))
         else:
             for a, b in self._slot_ranges(chunk):
                 if def_levels is not None:
@@ -568,6 +674,7 @@ class CpuChunkEncoder:
                 )
                 if data_page_offset is None:
                     data_page_offset = base_offset + blob_len
+                page_off = blob_len
                 blob_parts.append(header)
                 if comp_buf is None:
                     blob_parts.extend(parts)  # uncompressed: verbatim
@@ -576,13 +683,39 @@ class CpuChunkEncoder:
                 blob_len += len(header) + comp_len
                 total_uncompressed += len(header) + body_len
                 total_compressed += len(header) + comp_len
+                if page_stats is not None:
+                    lo_b, hi_b, lo_k, hi_k = _min_max_typed(
+                        chunk.values[va:vb], pt)
+                    page_stats.append(PageStats(
+                        first_row_index=(a if record_starts is None
+                                         else int(np.searchsorted(
+                                             record_starts, a))),
+                        offset=page_off,
+                        compressed_size=len(header) + comp_len,
+                        num_values=b - a,
+                        null_count=((b - a) - (vb - va)
+                                    if def_levels is not None else 0),
+                        min_bytes=lo_b, max_bytes=hi_b,
+                        min_key=lo_k, max_key=hi_k))
 
         stats = None
         if opts.write_statistics:
-            # The dictionary is exactly the set of present values, so its
-            # min/max equals the column's — O(k) instead of O(n).
-            stat_src = dict_values if use_dict else chunk.values
-            lo, hi = self._stats_min_max(stat_src, pt)
+            if not use_dict and page_stats:
+                # the per-page min/max just collected covers every value
+                # in the chunk with the same plain encoding, so the chunk
+                # stats reduce over pages in O(pages) — not a second full
+                # O(n) scan of values the page-index pass already walked
+                mins = [(ps.min_key, ps.min_bytes) for ps in page_stats
+                        if ps.min_key is not None]
+                maxs = [(ps.max_key, ps.max_bytes) for ps in page_stats
+                        if ps.max_key is not None]
+                lo = min(mins, key=lambda t: t[0])[1] if mins else None
+                hi = max(maxs, key=lambda t: t[0])[1] if maxs else None
+            else:
+                # The dictionary is exactly the set of present values, so
+                # its min/max equals the column's — O(k) instead of O(n).
+                stat_src = dict_values if use_dict else chunk.values
+                lo, hi = self._stats_min_max(stat_src, pt)
             null_count = None
             if chunk.def_levels is not None:
                 null_count = int((chunk.def_levels < col.max_def).sum())
@@ -606,4 +739,5 @@ class CpuChunkEncoder:
         # No join: the parts list IS the output (writev-style gather all
         # the way to the sink) — the last whole-output-volume memcpy on
         # the assembly hot path, gone.
-        return EncodedChunk(blob_parts, meta, dict_page_len, length=blob_len)
+        return EncodedChunk(blob_parts, meta, dict_page_len, length=blob_len,
+                            pages=page_stats, bloom=bloom)

@@ -144,6 +144,11 @@ class CompactWriter:
     def list_i32(self, value: int) -> None:
         self._zigzag_varint(value)
 
+    def list_bool(self, value: bool) -> None:
+        # bools inside lists are the type byte itself (compact protocol);
+        # the read side mirrors this in CompactReader.read_value
+        self._buf.append(CT_TRUE if value else CT_FALSE)
+
     def list_i64(self, value: int) -> None:
         self._zigzag_varint(value)
 
@@ -196,9 +201,16 @@ class CompactReader:
 
     def __init__(self, data: bytes, pos: int = 0,
                  limit: int | None = None) -> None:
+        if pos < 0:
+            # a negative start would wrap around via python indexing and
+            # read tail bytes as a struct — corruption, not a window
+            raise ThriftDecodeError(f"negative read position {pos}")
         self.data = data
         self.pos = pos
-        self.limit = len(data) if limit is None else limit
+        # a caller-supplied limit comes from an untrusted length field
+        # (index/bloom section lengths): never let it exceed the buffer,
+        # or the _byte bounds check passes while data[pos] IndexErrors
+        self.limit = len(data) if limit is None else min(limit, len(data))
 
     def _byte(self) -> int:
         if self.pos >= self.limit:

@@ -20,7 +20,8 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from .bytecol import ByteColumn
-from .metadata import ColumnChunk, FileMetaData, RowGroup
+from .index import serialize_column_index, serialize_offset_index
+from .metadata import (ColumnChunk, FileMetaData, RowGroup, SortingColumn)
 from .pages import ColumnChunkData, CpuChunkEncoder, EncoderOptions
 from .schema import PhysicalType, Schema
 from ..utils.tracing import stage
@@ -117,6 +118,15 @@ class WriterProperties:
     encoder_threads: int = 0
     page_checksums: bool = False
     key_value_metadata: dict = field(default_factory=dict)
+    # query-ready files (core/index.py): PARQUET-922 page indexes on by
+    # default (parquet-mr 1.11 parity), bloom filters opt-in (None = off,
+    # () = auto: string/dictionary columns, tuple = explicit columns),
+    # sorting declarations as (column_name, descending, nulls_first)
+    write_page_index: bool = True
+    bloom_columns: tuple | None = None
+    bloom_fpp: float = 0.01
+    bloom_max_bytes: int = 128 * 1024
+    sorting_columns: tuple = ()
 
     def encoder_options(self) -> EncoderOptions:
         return EncoderOptions(
@@ -128,6 +138,10 @@ class WriterProperties:
             delta_fallback=self.delta_fallback,
             encoder_threads=self.encoder_threads,
             page_checksums=self.page_checksums,
+            write_page_index=self.write_page_index,
+            bloom_columns=self.bloom_columns,
+            bloom_fpp=self.bloom_fpp,
+            bloom_max_bytes=self.bloom_max_bytes,
         )
 
 
@@ -172,6 +186,19 @@ class ParquetFileWriter:
         # through this seam; None (the default) publishes nothing.
         self._heartbeat = heartbeat
         self._pos = 0
+        # query-ready-files state (core/index.py): resolved sorting
+        # declarations, whether footer fragments must be recomposed at
+        # close (index/bloom sections add ColumnChunk fields the
+        # commit-time precompute cannot know yet), the section anchor a
+        # retried close() overwrites instead of appending twice, and the
+        # counters index_info() reports
+        self._sorting = self._resolve_sorting(self.properties.sorting_columns)
+        self._defer_cc_bytes = (self.properties.write_page_index
+                                or self.properties.bloom_columns is not None)
+        self._index_section_start: int | None = None
+        self._index_counts = {"pages_indexed": 0, "column_indexes": 0,
+                              "index_bytes": 0, "bloom_filters": 0,
+                              "bloom_bytes": 0}
         self._row_groups: list[RowGroup] = []
         self._pending: list[ColumnChunkData] | None = None
         self._pending_rows = 0
@@ -213,6 +240,25 @@ class ParquetFileWriter:
         # and the runtime metrics surface without a global tracer
         self.stage_busy_s = {"dispatch": 0.0, "assemble": 0.0, "io": 0.0}
         self._write(MAGIC)
+
+    def _resolve_sorting(self, spec) -> list[SortingColumn]:
+        """(name, descending, nulls_first) declarations -> SortingColumn
+        entries with leaf ordinals; an unknown column name fails here, at
+        construction, not in a published footer."""
+        if not spec:
+            return []
+        cols = self.schema.columns
+        out = []
+        for name, descending, nulls_first in spec:
+            idx = next((i for i, c in enumerate(cols)
+                        if c.name == name or ".".join(c.path) == name), None)
+            if idx is None:
+                raise ValueError(
+                    f"sort_order column {name!r} is not a schema leaf "
+                    f"(have {[c.name for c in cols]})")
+            out.append(SortingColumn(idx, bool(descending),
+                                     bool(nulls_first)))
+        return out
 
     def _split_assembly_capable(self) -> bool:
         """True when the encoder can split a row group into launch_many
@@ -635,19 +681,25 @@ class ParquetFileWriter:
                 m.dictionary_page_offset += rg_start
             m.data_page_offset += rg_start
             columns.append(ColumnChunk(file_offset=m.data_page_offset,
-                                       meta_data=m))
+                                       meta_data=m,
+                                       page_stats=getattr(e, "pages", None),
+                                       bloom=getattr(e, "bloom", None)))
         rg = RowGroup(
             columns=columns,
             total_byte_size=total_byte_size,
             num_rows=num_rows,
+            sorting_columns=list(self._sorting) or None,
             file_offset=rg_start,
             total_compressed_size=total_compressed,
             ordinal=len(self._row_groups),
         )
         # offsets are absolute now: serialize the footer fragments here —
         # on the pipelined path this runs in the IO thread, overlapped
-        # with later row groups' encode, so close() only splices bytes
-        rg.precompute_column_bytes()
+        # with later row groups' encode, so close() only splices bytes.
+        # With index/bloom sections enabled the fragments gain fields only
+        # known at close (section offsets), so serialization defers there.
+        if not self._defer_cc_bytes:
+            rg.precompute_column_bytes()
         self._row_groups.append(rg)
         self._num_rows += num_rows
 
@@ -744,6 +796,67 @@ class ParquetFileWriter:
         self._pending_rows = 0
         self._pending_bytes = 0
 
+    def _write_index_sections(self) -> None:
+        """Query-ready footer sections (core/index.py), laid out between
+        the last row group and the footer: every chunk's bloom filter
+        (header + bitset), then all ColumnIndexes, then all OffsetIndexes
+        (the PARQUET-922 recommended grouping) — each section's offset and
+        length recorded into the footer fields that point at it.  Retry-
+        safe like the footer itself: the first call anchors the section
+        start, and a retried close() seeks back and overwrites rather than
+        appending a second copy."""
+        if self._index_section_start is None:
+            self._index_section_start = self._pos
+        else:
+            self._pos = self._index_section_start
+        counts = self._index_counts = {
+            "pages_indexed": 0, "column_indexes": 0, "index_bytes": 0,
+            "bloom_filters": 0, "bloom_bytes": 0}
+        with stage("encode.page_index", row_groups=len(self._row_groups)):
+            for rg in self._row_groups:
+                for cc in rg.columns:
+                    if cc.bloom is None:
+                        continue
+                    blob = cc.bloom.serialize()
+                    cc.meta_data.bloom_filter_offset = self._pos
+                    cc.meta_data.bloom_filter_length = len(blob)
+                    self._write(blob)
+                    counts["bloom_filters"] += 1
+                    counts["bloom_bytes"] += len(blob)
+            for rg in self._row_groups:
+                for cc in rg.columns:
+                    if not cc.page_stats:
+                        continue
+                    blob = serialize_column_index(cc.page_stats)
+                    cc.column_index_offset = self._pos
+                    cc.column_index_length = len(blob)
+                    self._write(blob)
+                    counts["column_indexes"] += 1
+                    counts["index_bytes"] += len(blob)
+            for rg in self._row_groups:
+                for cc in rg.columns:
+                    if not cc.page_stats:
+                        continue
+                    m = cc.meta_data
+                    chunk_start = (m.dictionary_page_offset
+                                   if m.dictionary_page_offset is not None
+                                   else m.data_page_offset)
+                    blob = serialize_offset_index(cc.page_stats, chunk_start)
+                    cc.offset_index_offset = self._pos
+                    cc.offset_index_length = len(blob)
+                    self._write(blob)
+                    counts["pages_indexed"] += len(cc.page_stats)
+                    counts["index_bytes"] += len(blob)
+
+    def index_info(self) -> dict:
+        """Counters of the query-ready sections this file carries (zeros
+        until close, and with the features off): pages indexed, column
+        indexes, index/bloom bytes, bloom filter count, plus the declared
+        sorting columns."""
+        return {**self._index_counts,
+                "sorting_columns": [(s.column_idx, s.descending,
+                                     s.nulls_first) for s in self._sorting]}
+
     def close(self) -> None:
         if self._closed:
             return
@@ -758,6 +871,8 @@ class ParquetFileWriter:
                 self.abandon()
                 raise
         self.flush_row_group()  # no-op unless something is still pending
+        if self._defer_cc_bytes and self._row_groups:
+            self._write_index_sections()
         meta = FileMetaData(
             schema_fields=self.schema.flatten(),
             num_rows=self._num_rows,

@@ -114,6 +114,17 @@ class Compactor:
         Optional ``MetricRegistry`` for the canonical compactor meters.
     instance_name:
         Scopes this service's tmp names and the stale-tmp sweep.
+    sort_by:
+        Sort-on-compact: ``None`` preserves input row order (name-order
+        concatenation); a field name — or ``(field_name, descending)`` —
+        physically re-sorts every merged output by that proto field and
+        declares it as ``sorting_columns`` row-group metadata
+        (core/metadata.py), so compaction is where streaming output
+        acquires the sort order selective readers exploit.  Null field
+        values sort last.  The merged tmp must then pass the structural
+        verifier's sort-vs-page-index consistency check AND declare every
+        row group sorted before it publishes — a buggy sort can never
+        reach readers.
     """
 
     def __init__(self, fs: FileSystem, target_dir: str, proto_class,
@@ -121,7 +132,8 @@ class Compactor:
                  small_file_ratio: float = 0.5, min_files: int = 2,
                  scan_interval_s: float = 5.0, registry=None,
                  instance_name: str = "compactor",
-                 batch_size: int = 4096) -> None:
+                 batch_size: int = 4096,
+                 sort_by=None) -> None:
         # runtime imports are deferred (the failover-module pattern):
         # io.compact is imported during kpw_tpu.io package init, while
         # kpw_tpu.runtime may still be mid-initialization
@@ -134,6 +146,54 @@ class Compactor:
             raise ValueError("small_file_ratio must be in (0, 1]")
         if target_size <= 0:
             raise ValueError("target_size must be positive")
+        # sort-on-compact: the merge rewrites through writer properties
+        # that DECLARE the order (core/metadata.py SortingColumn), so the
+        # merged footer carries sorting_columns and the verifier's
+        # boundary-order cross-check guards the publish
+        self._columnarizer = ProtoColumnarizer(proto_class)
+        self.sort_by: str | None = None
+        self.sort_descending = False
+        if sort_by is not None:
+            if isinstance(sort_by, (tuple, list)):
+                if not 1 <= len(sort_by) <= 2:
+                    raise ValueError(
+                        "sort_by tuple must be (field,) or "
+                        f"(field, descending), got {sort_by!r}")
+                self.sort_by = sort_by[0]
+                self.sort_descending = (bool(sort_by[1])
+                                        if len(sort_by) == 2 else False)
+            else:
+                self.sort_by = sort_by
+            # fail at construction, not inside every background merge
+            # round: an unknown name would otherwise raise from the
+            # rewrite's ParquetFile after the tmp sink is already open,
+            # and _run would log-and-retry it forever
+            leaf = next((c for c in self._columnarizer.schema.columns
+                         if c.name == self.sort_by
+                         or ".".join(c.path) == self.sort_by), None)
+            if leaf is None:
+                raise ValueError(
+                    f"sort_by column {self.sort_by!r} is not a schema "
+                    "leaf (have "
+                    f"{[c.name for c in self._columnarizer.schema.columns]})")
+            if leaf.max_rep > 0:
+                raise ValueError(
+                    f"sort_by column {self.sort_by!r} is repeated — a "
+                    "row has no single value to order by")
+            # the rewrite sorts pyarrow row dicts: a nested leaf lives at
+            # row[seg0][seg1]..., keyed by the declared dotted path
+            self._sort_path = tuple(leaf.path)
+            import dataclasses
+
+            # write_page_index is forced ON with the declaration: the
+            # verifier's declared-order-vs-page-stats cross-check only
+            # exists against a ColumnIndex, and without it the
+            # verify-before-publish sort gate would be vacuous
+            properties = dataclasses.replace(
+                properties,
+                write_page_index=True,
+                sorting_columns=((self.sort_by, self.sort_descending,
+                                  False),))
         self.fs = fs
         self.target_dir = target_dir.rstrip("/")
         self.proto_class = proto_class
@@ -144,7 +204,6 @@ class Compactor:
         self.scan_interval_s = scan_interval_s
         self.instance_name = instance_name
         self.batch_size = batch_size
-        self._columnarizer = ProtoColumnarizer(proto_class)
         self._merged_meter = (registry.meter(M.COMPACTOR_MERGED_METER)
                               if registry else M.Meter())
         self._retired_meter = (registry.meter(M.COMPACTOR_RETIRED_METER)
@@ -305,14 +364,22 @@ class Compactor:
         with stage("compactor.merge"):
             rows = self._rewrite(g.inputs, tmp)
         rep = verify_file(self.fs, tmp)
-        if not rep.ok or rep.num_rows != g.rows or rows != g.rows:
+        # sort-on-compact publishes only outputs whose EVERY row group
+        # both declares the order and survives the verifier's
+        # boundary-order cross-check (a silent sort bug must quarantine,
+        # not publish)
+        unsorted = (self.sort_by is not None
+                    and rep.sorted_row_groups != rep.row_groups)
+        if not rep.ok or rep.num_rows != g.rows or rows != g.rows \
+                or unsorted:
             self._failed_meter.mark()
             qpath = self._quarantine(tmp)
             logger.error(
                 "compactor: merged tmp for %s failed verification "
-                "(rows %s/%s vs %s expected, errors %s); quarantined to %s,"
-                " inputs untouched", g.dir, rep.num_rows, rows, g.rows,
-                rep.errors[:3], qpath)
+                "(rows %s/%s vs %s expected, sorted_rgs %s/%s, errors %s);"
+                " quarantined to %s, inputs untouched", g.dir,
+                rep.num_rows, rows, g.rows, rep.sorted_row_groups,
+                rep.row_groups, rep.errors[:3], qpath)
             return None
         dest = self._output_path(g)
         # tombstone destinations are fixed HERE and recorded in the plan:
@@ -341,7 +408,10 @@ class Compactor:
     def _rewrite(self, inputs: list[str], tmp_path: str) -> int:
         """Read every input row (pyarrow read-back — the reader dep lives
         here, off the writer hot path) and re-encode the union through the
-        writer's own machinery into ``tmp_path``.  Returns rows written."""
+        writer's own machinery into ``tmp_path``.  With ``sort_by`` the
+        union is materialized and sorted by the field first (nulls last) —
+        the group is bounded by ``target_size``, so the sort buffer is
+        too.  Returns rows written."""
         import pyarrow.parquet as pq
 
         from ..runtime.parquet_file import ParquetFile
@@ -350,14 +420,47 @@ class Compactor:
                          self.properties, batch_size=self.batch_size)
         rows = 0
         try:
-            for path in inputs:
-                with self.fs.open_read(path) as f:
-                    table = pq.read_table(f)
-                msgs = [row_to_message(self.proto_class, row)
-                        for row in table.to_pylist()]
-                pf.append_records(msgs)
-                pf.flush_if_full()
-                rows += len(msgs)
+            if self.sort_by is not None:
+                union: list[dict] = []
+                for path in inputs:
+                    with self.fs.open_read(path) as f:
+                        union.extend(pq.read_table(f).to_pylist())
+
+                # pyarrow rows are NESTED dicts: a dotted sort leaf lives
+                # at row[seg0][seg1]... (r.get("a.b") is always None).
+                # NaN keys bucket with the nulls: list.sort with NaN keys
+                # leaves non-NaN elements arbitrarily ordered (every
+                # comparison is False), which would publish-attempt an
+                # unsorted-but-declared output the verify gate quarantines
+                # on every re-planned round — and page-stat min/max mask
+                # NaNs anyway, so "last, with the nulls" is the one
+                # ordering the declaration can actually be checked against
+                def sort_value(r):
+                    for seg in self._sort_path:
+                        if not isinstance(r, dict):
+                            return None
+                        r = r.get(seg)
+                    if isinstance(r, float) and r != r:
+                        return None
+                    return r
+
+                present = [r for r in union if sort_value(r) is not None]
+                absent = [r for r in union if sort_value(r) is None]
+                present.sort(key=sort_value,
+                             reverse=self.sort_descending)
+                for row in present + absent:  # nulls last
+                    pf.append_record(row_to_message(self.proto_class, row))
+                    pf.flush_if_full()
+                    rows += 1
+            else:
+                for path in inputs:
+                    with self.fs.open_read(path) as f:
+                        table = pq.read_table(f)
+                    msgs = [row_to_message(self.proto_class, row)
+                            for row in table.to_pylist()]
+                    pf.append_records(msgs)
+                    pf.flush_if_full()
+                    rows += len(msgs)
             pf.close()
         except Exception:
             # free the sink on any failure; the torn tmp is swept by
@@ -578,6 +681,8 @@ class Compactor:
                 "small_file_threshold": int(self.target_size
                                             * self.small_file_ratio),
                 "min_files": self.min_files,
+                "sort_by": self.sort_by,
+                "sort_descending": self.sort_descending,
                 "scan_interval_s": self.scan_interval_s,
                 "rounds": self._rounds,
                 "merged": self._merged_meter.count,

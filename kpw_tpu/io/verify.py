@@ -22,7 +22,17 @@ from the bytes alone:
   ``Builder.page_checksums(True)`` checksums verified on read,
 * row/value-count consistency (row-group rows sum to the footer's
   ``num_rows``; each chunk's data-page values sum to its meta's
-  ``num_values``).
+  ``num_values``),
+* the query-ready footer sections (PARQUET-922 page indexes, split-block
+  bloom filters, ``sorting_columns`` — the write side is
+  ``core/index.py``): index offsets/lengths in-bounds and thrift-parsable,
+  OffsetIndex page locations matching the walked pages one for one,
+  ColumnIndex list lengths consistent with the page count and its
+  declared boundary order consistent with the page min/max stats, bloom
+  headers sane with in-bounds bitsets, and every declared sorting column
+  consistent with its column index's ordering (a file CLAIMING sortedness
+  its pages contradict fails verification — sort-on-compact publishes
+  through this check).
 
 It deliberately does NOT decode values: the contract is "structurally
 valid parquet whose every byte is where the footer says it is", which is
@@ -41,6 +51,7 @@ from __future__ import annotations
 
 import json
 import os
+import struct
 import sys
 import zlib
 from dataclasses import dataclass, field
@@ -54,17 +65,33 @@ MAGIC = b"PAR1"
 _TAIL = 8
 # FileMetaData field ids (parquet.thrift; mirrors core/metadata.py's writer)
 _FMD_VERSION, _FMD_SCHEMA, _FMD_NUM_ROWS, _FMD_ROW_GROUPS = 1, 2, 3, 4
+# SchemaElement
+_SE_TYPE, _SE_NUM_CHILDREN = 1, 5
 # RowGroup
-_RG_COLUMNS, _RG_NUM_ROWS = 1, 3
+_RG_COLUMNS, _RG_NUM_ROWS, _RG_SORTING = 1, 3, 4
+# SortingColumn
+_SC_COLUMN_IDX = 1
 # ColumnChunk / ColumnMetaData
 _CC_META = 3
+_CC_OI_OFF, _CC_OI_LEN, _CC_CI_OFF, _CC_CI_LEN = 4, 5, 6, 7
+_CM_TYPE = 1
 _CM_CODEC, _CM_NUM_VALUES = 4, 5
 _CM_TOTAL_COMPRESSED = 7
 _CM_DATA_PAGE_OFFSET, _CM_DICT_PAGE_OFFSET = 9, 11
+_CM_BLOOM_OFF, _CM_BLOOM_LEN = 14, 15
 # PageHeader
 _PH_TYPE, _PH_UNCOMPRESSED, _PH_COMPRESSED, _PH_CRC = 1, 2, 3, 4
 _PH_DATA_HEADER, _PH_DICT_HEADER, _PH_V2_HEADER = 5, 7, 8
 _DPH_NUM_VALUES = 1  # in both v1 and v2 data-page headers
+# ColumnIndex / OffsetIndex / PageLocation (PARQUET-922)
+_CI_NULL_PAGES, _CI_MIN, _CI_MAX, _CI_ORDER, _CI_NULL_COUNTS = 1, 2, 3, 4, 5
+_OI_LOCATIONS = 1
+_PL_OFFSET, _PL_SIZE, _PL_FIRST_ROW = 1, 2, 3
+_BO_UNORDERED, _BO_ASCENDING, _BO_DESCENDING = 0, 1, 2
+# BloomFilterHeader
+_BFH_NUM_BYTES, _BFH_ALGO, _BFH_HASH, _BFH_COMP = 1, 2, 3, 4
+# physical types whose stats decode to numbers (parquet.thrift Type)
+_PT_STRUCT_FMT = {1: "<i", 2: "<q", 4: "<f", 5: "<d"}
 
 
 @dataclass
@@ -84,6 +111,13 @@ class FileReport:
     pages: int = 0
     pages_crc_checked: int = 0
     footer_bytes: int = 0
+    # query-ready sections (core/index.py write side): structurally
+    # validated page-index/bloom/sorting counts
+    column_indexes: int = 0
+    offset_indexes: int = 0
+    pages_indexed: int = 0
+    bloom_filters: int = 0
+    sorted_row_groups: int = 0
 
     def to_dict(self) -> dict:
         return {
@@ -97,6 +131,11 @@ class FileReport:
             "pages": self.pages,
             "pages_crc_checked": self.pages_crc_checked,
             "footer_bytes": self.footer_bytes,
+            "column_indexes": self.column_indexes,
+            "offset_indexes": self.offset_indexes,
+            "pages_indexed": self.pages_indexed,
+            "bloom_filters": self.bloom_filters,
+            "sorted_row_groups": self.sorted_row_groups,
         }
 
 
@@ -110,11 +149,14 @@ def _require_int(report: FileReport, container: dict, fid: int,
 
 
 def _walk_chunk(data: bytes, report: FileReport, rg_i: int, col_i: int,
-                meta: dict, footer_start: int) -> None:
+                meta: dict, footer_start: int) -> list | None:
     """Page-header walk of one column chunk: every page header must parse,
     every body must lie inside the chunk, the bytes must account exactly
     for total_compressed_size, data-page values must sum to num_values,
-    and any page carrying a crc field must match its body's CRC-32."""
+    and any page carrying a crc field must match its body's CRC-32.
+    Returns the walked data pages as [(header_pos, total_size), ...] —
+    what the OffsetIndex cross-check matches location by location — or
+    None when the walk had to stop early."""
     where = f"row group {rg_i} column {col_i}"
     num_values = _require_int(report, meta, _CM_NUM_VALUES,
                               f"{where}: num_values")
@@ -123,7 +165,7 @@ def _walk_chunk(data: bytes, report: FileReport, rg_i: int, col_i: int,
     data_off = _require_int(report, meta, _CM_DATA_PAGE_OFFSET,
                             f"{where}: data_page_offset")
     if num_values is None or total is None or data_off is None:
-        return
+        return None
     dict_off = meta.get(_CM_DICT_PAGE_OFFSET)
     if dict_off is not None and (not isinstance(dict_off, int)
                                  or isinstance(dict_off, bool)):
@@ -132,24 +174,25 @@ def _walk_chunk(data: bytes, report: FileReport, rg_i: int, col_i: int,
         # not crash computing offsets with bytes
         report.errors.append(
             f"{where}: dictionary_page_offset is not an integer")
-        return
+        return None
     start = dict_off if dict_off is not None else data_off
     end = start + total
     if start < len(MAGIC) or total < 0 or end > footer_start:
         report.errors.append(
             f"{where}: chunk [{start}, {end}) outside data region "
             f"[{len(MAGIC)}, {footer_start})")
-        return
+        return None
     if not start <= data_off < end:
         report.errors.append(
             f"{where}: data_page_offset {data_off} outside chunk "
             f"[{start}, {end})")
-        return
+        return None
     codec = meta.get(_CM_CODEC, Codec.UNCOMPRESSED)
     pos = start
     values_seen = 0
     first = True
     first_data_pos = None
+    data_pages: list = []
     while pos < end:
         r = CompactReader(data, pos, limit=end)
         try:
@@ -157,7 +200,7 @@ def _walk_chunk(data: bytes, report: FileReport, rg_i: int, col_i: int,
         except ThriftDecodeError as e:
             report.errors.append(
                 f"{where}: page header at byte {pos} unreadable: {e}")
-            return
+            return None
         ptype = ph.get(_PH_TYPE)
         comp = ph.get(_PH_COMPRESSED)
         uncomp = ph.get(_PH_UNCOMPRESSED)
@@ -166,14 +209,14 @@ def _walk_chunk(data: bytes, report: FileReport, rg_i: int, col_i: int,
             report.errors.append(
                 f"{where}: page at byte {pos} has invalid sizes "
                 f"(compressed={comp!r}, uncompressed={uncomp!r})")
-            return
+            return None
         body_start = r.pos
         body_end = body_start + comp
         if body_end > end:
             report.errors.append(
                 f"{where}: page body [{body_start}, {body_end}) overruns "
                 f"chunk end {end} — torn page")
-            return
+            return None
         if ptype == PageType.DICTIONARY_PAGE:
             if not first or dict_off != pos:
                 report.errors.append(
@@ -190,12 +233,13 @@ def _walk_chunk(data: bytes, report: FileReport, rg_i: int, col_i: int,
                 report.errors.append(
                     f"{where}: data page at byte {pos} missing its "
                     f"num_values header")
-                return
+                return None
             values_seen += nv
+            data_pages.append((pos, body_end - pos))
         else:
             report.errors.append(
                 f"{where}: page at byte {pos} has unknown type {ptype!r}")
-            return
+            return None
         if codec == Codec.UNCOMPRESSED and comp != uncomp:
             report.errors.append(
                 f"{where}: uncompressed page at byte {pos} has "
@@ -223,6 +267,240 @@ def _walk_chunk(data: bytes, report: FileReport, rg_i: int, col_i: int,
         report.errors.append(
             f"{where}: data pages carry {values_seen} values, footer says "
             f"{num_values}")
+    return data_pages
+
+
+def _decode_stat(value, physical_type):
+    """Plain-encoded ColumnIndex min/max bytes -> comparable value, or
+    None when empty/undecodable (the verifier then skips the compare
+    rather than guessing)."""
+    if not isinstance(value, (bytes, bytearray)) or not value:
+        return None
+    fmt = _PT_STRUCT_FMT.get(physical_type)
+    if fmt is None:
+        return bytes(value)
+    if len(value) != struct.calcsize(fmt):
+        return None
+    return struct.unpack(fmt, value)[0]
+
+
+def _leaf_types(fmd: dict) -> list:
+    """Schema leaves' physical types, in column order (a SchemaElement
+    without num_children is a leaf; the writer mirrors this rule)."""
+    out = []
+    for el in (fmd.get(_FMD_SCHEMA) or [])[1:]:
+        if isinstance(el, dict) and not el.get(_SE_NUM_CHILDREN):
+            out.append(el.get(_SE_TYPE))
+    return out
+
+
+def _section_in_bounds(report: FileReport, where: str, what: str,
+                       off, length, footer_start: int) -> bool:
+    """Offset/length pair sanity for one index/bloom section: both ints,
+    non-negative, and the region inside the data area before the footer."""
+    if not isinstance(off, int) or isinstance(off, bool) \
+            or not isinstance(length, int) or isinstance(length, bool):
+        report.errors.append(f"{where}: {what} offset/length not integers")
+        return False
+    if off < len(MAGIC) or length <= 0 or off + length > footer_start:
+        report.errors.append(
+            f"{where}: {what} [{off}, {off + length}) outside data region "
+            f"[{len(MAGIC)}, {footer_start})")
+        return False
+    return True
+
+
+def _computed_orders(mins: list, maxs: list, null_pages: list,
+                     leaf_type) -> tuple[bool, bool]:
+    """(ascending_consistent, descending_consistent) of the non-null
+    pages' decoded min/max sequences — what a declared boundary order (or
+    a declared sorting column) is checked against."""
+    keys = []
+    for i, (lo, hi) in enumerate(zip(mins, maxs)):
+        if i < len(null_pages) and null_pages[i]:
+            continue
+        dlo, dhi = _decode_stat(lo, leaf_type), _decode_stat(hi, leaf_type)
+        if dlo is None or dhi is None:
+            continue  # undecodable entry: checked elsewhere, not here
+        keys.append((dlo, dhi))
+    asc = all(a[0] <= b[0] and a[1] <= b[1]
+              for a, b in zip(keys, keys[1:]))
+    desc = all(a[0] >= b[0] and a[1] >= b[1]
+               for a, b in zip(keys, keys[1:]))
+    return asc, desc
+
+
+def _walk_index_sections(data: bytes, report: FileReport, rg_i: int,
+                         col_i: int, cc: dict, meta: dict,
+                         footer_start: int, leaf_type,
+                         data_pages: list | None):
+    """Structural walk of one chunk's query-ready sections: OffsetIndex
+    locations must match the walked pages one for one, ColumnIndex lists
+    must be page-count-consistent with a boundary order the stats support,
+    and a bloom header must frame an in-bounds bitset.  Returns the
+    ColumnIndex's computed (asc_ok, desc_ok) for the sorting-declaration
+    cross-check, or None when no ColumnIndex parsed."""
+    where = f"row group {rg_i} column {col_i}"
+    orders = None
+    oi_off, oi_len = cc.get(_CC_OI_OFF), cc.get(_CC_OI_LEN)
+    ci_off, ci_len = cc.get(_CC_CI_OFF), cc.get(_CC_CI_LEN)
+    n_pages = None
+    if (oi_off is None) != (oi_len is None):
+        report.errors.append(
+            f"{where}: offset index offset/length must come as a pair")
+    elif oi_off is not None and _section_in_bounds(
+            report, where, "offset index", oi_off, oi_len, footer_start):
+        r = CompactReader(data, oi_off, limit=oi_off + oi_len)
+        try:
+            oi = r.read_struct()
+        except ThriftDecodeError as e:
+            report.errors.append(f"{where}: offset index unreadable: {e}")
+            oi = None
+        if oi is not None:
+            locs = oi.get(_OI_LOCATIONS)
+            if not isinstance(locs, list):
+                report.errors.append(
+                    f"{where}: offset index has no page_locations list")
+            else:
+                report.offset_indexes += 1
+                n_pages = len(locs)
+                report.pages_indexed += n_pages
+                last_row = -1
+                for p_i, loc in enumerate(locs):
+                    trip = (loc.get(_PL_OFFSET), loc.get(_PL_SIZE),
+                            loc.get(_PL_FIRST_ROW)) \
+                        if isinstance(loc, dict) else (None, None, None)
+                    if not all(isinstance(v, int) and not isinstance(v, bool)
+                               for v in trip):
+                        report.errors.append(
+                            f"{where}: page location {p_i} malformed")
+                        break
+                    off, size, first_row = trip
+                    if data_pages is not None:
+                        if p_i >= len(data_pages):
+                            report.errors.append(
+                                f"{where}: offset index lists {len(locs)} "
+                                f"pages, chunk walk found "
+                                f"{len(data_pages)}")
+                            break
+                        wpos, wsize = data_pages[p_i]
+                        if off != wpos or size != wsize:
+                            report.errors.append(
+                                f"{where}: page location {p_i} says "
+                                f"[{off}, +{size}), walked page at "
+                                f"[{wpos}, +{wsize})")
+                    if first_row <= last_row or (p_i == 0 and first_row):
+                        report.errors.append(
+                            f"{where}: page location {p_i} first_row_index "
+                            f"{first_row} not increasing from 0")
+                        break
+                    last_row = first_row
+                else:
+                    if data_pages is not None and len(locs) != len(
+                            data_pages):
+                        report.errors.append(
+                            f"{where}: offset index lists {len(locs)} "
+                            f"pages, chunk walk found {len(data_pages)}")
+    if (ci_off is None) != (ci_len is None):
+        report.errors.append(
+            f"{where}: column index offset/length must come as a pair")
+    elif ci_off is not None and _section_in_bounds(
+            report, where, "column index", ci_off, ci_len, footer_start):
+        r = CompactReader(data, ci_off, limit=ci_off + ci_len)
+        try:
+            ci = r.read_struct()
+        except ThriftDecodeError as e:
+            report.errors.append(f"{where}: column index unreadable: {e}")
+            ci = None
+        if ci is not None:
+            null_pages = ci.get(_CI_NULL_PAGES)
+            mins, maxs = ci.get(_CI_MIN), ci.get(_CI_MAX)
+            order = ci.get(_CI_ORDER)
+            null_counts = ci.get(_CI_NULL_COUNTS)
+            if not (isinstance(null_pages, list) and isinstance(mins, list)
+                    and isinstance(maxs, list)):
+                report.errors.append(
+                    f"{where}: column index missing a required page list")
+            elif not len(null_pages) == len(mins) == len(maxs):
+                report.errors.append(
+                    f"{where}: column index page lists disagree "
+                    f"({len(null_pages)}/{len(mins)}/{len(maxs)})")
+            elif n_pages is not None and len(mins) != n_pages:
+                report.errors.append(
+                    f"{where}: column index covers {len(mins)} pages, "
+                    f"offset index {n_pages}")
+            elif null_counts is not None and (
+                    not isinstance(null_counts, list)
+                    or len(null_counts) != len(mins)):
+                report.errors.append(
+                    f"{where}: column index null_counts length mismatch")
+            elif order not in (_BO_UNORDERED, _BO_ASCENDING,
+                               _BO_DESCENDING):
+                report.errors.append(
+                    f"{where}: column index boundary_order {order!r} "
+                    f"invalid")
+            elif isinstance(null_counts, list) and any(
+                    flag and isinstance(nc, int) and not isinstance(nc, bool)
+                    and nc == 0
+                    for flag, nc in zip(null_pages, null_counts)):
+                # null_pages=true claims EVERY value on the page is null;
+                # a zero null_count on the same page is a contradiction a
+                # pruning reader would act on
+                report.errors.append(
+                    f"{where}: column index declares a null page with "
+                    f"null_count 0")
+            else:
+                report.column_indexes += 1
+                orders = _computed_orders(mins, maxs, null_pages, leaf_type)
+                if ((order == _BO_ASCENDING and not orders[0])
+                        or (order == _BO_DESCENDING and not orders[1])):
+                    report.errors.append(
+                        f"{where}: boundary_order "
+                        f"{'ASCENDING' if order == _BO_ASCENDING else 'DESCENDING'}"
+                        f" contradicted by the page min/max stats")
+    bloom_off = meta.get(_CM_BLOOM_OFF)
+    if bloom_off is not None:
+        if not isinstance(bloom_off, int) or isinstance(bloom_off, bool) \
+                or not len(MAGIC) <= bloom_off < footer_start:
+            report.errors.append(
+                f"{where}: bloom_filter_offset {bloom_off!r} invalid")
+        else:
+            r = CompactReader(data, bloom_off, limit=footer_start)
+            try:
+                hdr = r.read_struct()
+            except ThriftDecodeError as e:
+                report.errors.append(
+                    f"{where}: bloom filter header unreadable: {e}")
+                hdr = None
+            if hdr is not None:
+                nb = hdr.get(_BFH_NUM_BYTES)
+                bad = None
+                if not isinstance(nb, int) or isinstance(nb, bool) \
+                        or nb < 32 or nb % 32:
+                    bad = f"numBytes {nb!r} (need a multiple of 32 >= 32)"
+                elif r.pos + nb > footer_start:
+                    bad = (f"bitset [{r.pos}, {r.pos + nb}) overruns the "
+                           f"data region")
+                else:
+                    for fid, what in ((_BFH_ALGO, "algorithm"),
+                                      (_BFH_HASH, "hash"),
+                                      (_BFH_COMP, "compression")):
+                        union = hdr.get(fid)
+                        if not isinstance(union, dict) or 1 not in union:
+                            bad = f"{what} union missing variant 1"
+                            break
+                bloom_len = meta.get(_CM_BLOOM_LEN)
+                if bad is None and isinstance(bloom_len, int) \
+                        and not isinstance(bloom_len, bool) \
+                        and bloom_len != (r.pos - bloom_off) + nb:
+                    bad = (f"bloom_filter_length {bloom_len} != header + "
+                           f"bitset {(r.pos - bloom_off) + nb}")
+                if bad is not None:
+                    report.errors.append(
+                        f"{where}: bloom filter header: {bad}")
+                else:
+                    report.bloom_filters += 1
+    return orders
 
 
 def verify_bytes(data: bytes, path: str = "<bytes>") -> FileReport:
@@ -264,6 +542,7 @@ def verify_bytes(data: bytes, path: str = "<bytes>") -> FileReport:
         report.errors.append("footer has no row-group list")
         return report
     report.row_groups = len(rgs)
+    leaf_types = _leaf_types(fmd)
     rows_sum = 0
     for rg_i, rg in enumerate(rgs):
         if not isinstance(rg, dict):
@@ -277,6 +556,7 @@ def verify_bytes(data: bytes, path: str = "<bytes>") -> FileReport:
         if not isinstance(cols, list) or not cols:
             report.errors.append(f"row group {rg_i} has no column chunks")
             continue
+        col_orders: dict[int, tuple] = {}
         for col_i, cc in enumerate(cols):
             meta = cc.get(_CC_META) if isinstance(cc, dict) else None
             if not isinstance(meta, dict):
@@ -284,7 +564,48 @@ def verify_bytes(data: bytes, path: str = "<bytes>") -> FileReport:
                     f"row group {rg_i} column {col_i} has no metadata")
                 continue
             report.columns += 1
-            _walk_chunk(data, report, rg_i, col_i, meta, footer_start)
+            pages = _walk_chunk(data, report, rg_i, col_i, meta,
+                                footer_start)
+            orders = _walk_index_sections(
+                data, report, rg_i, col_i, cc, meta, footer_start,
+                leaf_types[col_i] if col_i < len(leaf_types) else None,
+                pages)
+            if orders is not None:
+                col_orders[col_i] = orders
+        # sorting_columns declarations: structurally sane, and consistent
+        # with the declared column's page-index ordering when one exists
+        sorting = rg.get(_RG_SORTING)
+        if sorting is not None:
+            if not isinstance(sorting, list):
+                report.errors.append(
+                    f"row group {rg_i}: sorting_columns is not a list")
+            else:
+                ok = True
+                for s_i, sc in enumerate(sorting):
+                    idx = sc.get(_SC_COLUMN_IDX) if isinstance(sc, dict) \
+                        else None
+                    if not isinstance(idx, int) or isinstance(idx, bool) \
+                            or not 0 <= idx < len(cols):
+                        report.errors.append(
+                            f"row group {rg_i}: sorting column {s_i} "
+                            f"ordinal {idx!r} out of range")
+                        ok = False
+                        continue
+                    descending = bool(sc.get(2))
+                    orders = col_orders.get(idx)
+                    # only the PRIMARY sort key's page order is globally
+                    # implied by the declaration (secondary keys order
+                    # only within equal primary prefixes)
+                    if s_i == 0 and orders is not None and \
+                            not orders[1 if descending else 0]:
+                        report.errors.append(
+                            f"row group {rg_i}: declared "
+                            f"{'descending' if descending else 'ascending'}"
+                            f" sort on column {idx} contradicted by its "
+                            f"column index page stats")
+                        ok = False
+                if ok:
+                    report.sorted_row_groups += 1
     if num_rows is not None and rows_sum != num_rows:
         report.errors.append(
             f"row groups sum to {rows_sum} rows, footer says {num_rows}")
@@ -337,6 +658,14 @@ def summarize(reports: list[FileReport]) -> dict:
         "row_groups": sum(r.row_groups for r in reports),
         "pages": sum(r.pages for r in reports),
         "pages_crc_checked": sum(r.pages_crc_checked for r in reports),
+        # query-readiness counters: how much of the directory a selective
+        # reader can prune (pages under a validated page index), how many
+        # bloom filters were header-checked, and how many row groups
+        # declare a sort order the index stats support
+        "pages_indexed": sum(r.pages_indexed for r in reports),
+        "column_indexes": sum(r.column_indexes for r in reports),
+        "bloom_filters_checked": sum(r.bloom_filters for r in reports),
+        "sorted_row_groups": sum(r.sorted_row_groups for r in reports),
         "bytes": sum(r.size for r in reports),
         "failures": [r.path for r in bad],
     }
@@ -367,7 +696,9 @@ def main(argv: list[str] | None = None) -> int:
             if r.ok:
                 print(f"OK   {r.path}  rows={r.num_rows} "
                       f"row_groups={r.row_groups} pages={r.pages} "
-                      f"crc_checked={r.pages_crc_checked}")
+                      f"crc_checked={r.pages_crc_checked} "
+                      f"pages_indexed={r.pages_indexed} "
+                      f"bloom_filters={r.bloom_filters}")
             else:
                 print(f"FAIL {r.path}")
                 for e in r.errors:

@@ -108,15 +108,23 @@ class NativeChunkEncoder(CpuChunkEncoder):
     def _try_dictionary(self, chunk):
         values = chunk.values
         pt = chunk.column.leaf.physical_type
+        # a column with a bloom filter configured needs the exact distinct
+        # set regardless of the dictionary verdict (core/index.py
+        # population) — finishing the build is cheaper than a second
+        # distinct pass, so the ratio early-abort is waived
+        keep_distinct = self._bloom_wants_distinct(chunk)
         if self._bytes_native_ok(values, pt):
             # Early abort at the ratio bound (the byte-budget check needs the
             # built dictionary, so encode() still applies it afterwards).
-            max_k = max(1, int(len(values) * self.options.max_dictionary_ratio))
+            max_k = (None if keep_distinct else
+                     max(1, int(len(values)
+                                * self.options.max_dictionary_ratio)))
             return self._bytes_dictionary(values, max_k)
         if not self._native_ok(values, pt):
             return super()._try_dictionary(chunk)
         n = len(values)
-        max_k = self._fixed_width_max_k(n, values.dtype.itemsize)
+        max_k = (n if keep_distinct
+                 else self._fixed_width_max_k(n, values.dtype.itemsize))
         key = values.view(np.uint32 if values.dtype.itemsize == 4 else np.uint64)
         built = self._lib.dict_build(key, max_k=max_k)
         if built is None:

@@ -227,6 +227,13 @@ class MeshChunkEncoder(NativeChunkEncoder):
             # DCN-side analog of the ICI key merge
             max_k = max(1, int(len(values)
                                * self.options.max_dictionary_ratio))
+            if self._bloom_wants_distinct(chunk):
+                # bloom population (core/index.py) needs the exact
+                # distinct set whatever the dictionary verdict — and here
+                # the completed merge is the MESH-GLOBAL set, so the
+                # ratio abort is waived and the filter covers every
+                # shard's values for free
+                max_k = len(values)
             # returns None only on ratio overflow -> encode() falls back to
             # plain/delta, the same escape hatch as _bytes_dictionary
             return self._mesh_string_dictionary(values, max_k)

@@ -58,6 +58,14 @@ class Builder:
         self._delta_fallback = False  # BASELINE config 3 opt-in
         self._encoder_threads = 0  # native column-parallel encode (0 = auto)
         self._page_checksums = False  # parquet-mr 1.10 parity: no page CRCs
+        # query-ready files (core/index.py): PARQUET-922 page indexes on
+        # by default (parquet-mr 1.11 parity), bloom filters + sort-order
+        # declarations opt-in
+        self._page_index = True
+        self._bloom_columns: tuple | None = None
+        self._bloom_fpp = 0.01
+        self._bloom_max_bytes = 128 * 1024
+        self._sorting_columns: tuple = ()
         # reference default yyyyMMdd-HHmmssSSS (:486-487): %3f is this
         # framework's millisecond token (strftime has none; %f would be
         # 6-digit microseconds and change the file-name shape)
@@ -254,6 +262,56 @@ class Builder:
         default — parity with parquet-mr 1.10, which doesn't write page
         CRCs."""
         self._page_checksums = flag
+        return self
+
+    def page_index(self, flag: bool) -> "Builder":
+        """Emit PARQUET-922 ColumnIndex/OffsetIndex sections (per-page
+        min/max/null-count + page locations, ``core/index.py``) in every
+        published file, so selective readers prune pages without reading
+        them.  ON by default (parquet-mr 1.11 parity); off restores the
+        exact pre-index file bytes."""
+        self._page_index = flag
+        return self
+
+    def bloom_filters(self, columns=(), *, fpp: float = 0.01,
+                      max_bytes: int = 128 * 1024) -> "Builder":
+        """Split-block bloom filters (parquet SBBF, xxhash64) per column
+        chunk.  ``columns=()`` (the default when called) auto-selects
+        string columns plus any column whose chunk dictionary-encoded —
+        the dictionary build's exact distinct set makes population a
+        k-hash pass; a tuple of field names pins the set; ``None``
+        disables (the Builder default).  ``fpp`` sizes the filter
+        (parquet-mr's bits formula), ``max_bytes`` caps it (rounded down
+        to a power of two).  Off by default: filters cost file bytes and
+        the reference writes none."""
+        if columns is not None:
+            if isinstance(columns, str):
+                columns = (columns,)
+            columns = tuple(columns)
+            if not 0.0 < fpp < 1.0:
+                raise ValueError("fpp must be in (0, 1)")
+            if max_bytes < 32:
+                raise ValueError("max_bytes must be >= 32")
+        self._bloom_columns = columns
+        self._bloom_fpp = fpp
+        self._bloom_max_bytes = max_bytes
+        return self
+
+    def sort_order(self, *columns, descending: bool = False,
+                   nulls_first: bool = False) -> "Builder":
+        """Declare ``sorting_columns`` row-group metadata: every published
+        row group claims its rows are ordered by these schema leaves (in
+        the given precedence).  A DECLARATION, not a sort — the writer
+        streams records in arrival order, so use this when the upstream
+        feed is ordered (or let sort-on-compact, ``io/compact.py``,
+        physically sort and declare on merge).  The structural verifier
+        cross-checks the declaration against the page index's boundary
+        order, so a false claim fails verify-on-publish instead of
+        poisoning downstream readers."""
+        if not columns:
+            raise ValueError("sort_order needs at least one column name")
+        self._sorting_columns = tuple(
+            (c, descending, nulls_first) for c in columns)
         return self
 
     def delta_fallback(self, flag: bool) -> "Builder":
@@ -554,7 +612,8 @@ class Builder:
     def compaction(self, target_size: int, *,
                    scan_interval_seconds: float = 5.0,
                    min_files: int = 2,
-                   small_file_ratio: float = 0.5) -> "Builder":
+                   small_file_ratio: float = 0.5,
+                   sort_by=None) -> "Builder":
         """Background small-file compaction (``kpw_tpu.io.compact``):
         start() launches a :class:`~kpw_tpu.io.compact.Compactor` over the
         target dir that merges published files smaller than
@@ -564,8 +623,13 @@ class Builder:
         verified BEFORE the ``durable_rename`` publish, inputs then
         retired into the ``compacted/`` tombstone tree (moved, never
         deleted) so a kill -9 at any instant leaves every row in at least
-        one verified published file.  Stats land in
-        ``stats()['compactor']``; meters are
+        one verified published file.  ``sort_by`` (a proto field name, or
+        ``(field, descending)``) turns on sort-on-compact: merged outputs
+        are physically re-sorted by the field and declare
+        ``sorting_columns`` row-group metadata, verified against the page
+        index's boundary order before publish — streaming output acquires
+        its reader-exploitable sort order here, in the background tier.
+        Stats land in ``stats()['compactor']``; meters are
         ``parquet.compactor.merged|retired|failed``.  Off by default —
         compaction is a second read+write of every small byte, a cost the
         flat reference never pays."""
@@ -582,6 +646,7 @@ class Builder:
             "scan_interval_s": scan_interval_seconds,
             "min_files": min_files,
             "small_file_ratio": small_file_ratio,
+            "sort_by": sort_by,
         }
         return self
 
@@ -708,6 +773,27 @@ class Builder:
                 and getattr(self._parser, "__name__", None) == "FromString"))
         if self._parser is None:
             self._parser = self._proto_class.FromString
+        # resolve sort/bloom column names against the proto schema HERE:
+        # ParquetFileWriter._resolve_sorting would otherwise first raise
+        # inside every worker's background file-open (a supervised
+        # restart storm, not a config error), and a misspelled pinned
+        # bloom column would silently never match any chunk
+        if self._sorting_columns or self._bloom_columns:
+            from ..models.proto_bridge import proto_to_schema
+
+            cols = proto_to_schema(self._proto_class).columns
+            names = {c.name for c in cols} | {
+                ".".join(c.path) for c in cols}
+            for name, _, _ in (self._sorting_columns or ()):
+                if name not in names:
+                    raise ValueError(
+                        f"sort_order column {name!r} is not a schema "
+                        f"leaf (have {sorted(names)})")
+            for name in (self._bloom_columns or ()):
+                if name not in names:
+                    raise ValueError(
+                        f"bloom_filters column {name!r} is not a schema "
+                        f"leaf (have {sorted(names)})")
         if self._group_id is None:
             # reference default group id pattern (KPW.java:158)
             self._group_id = f"KafkaProtoParquetWriter-{self._instance_name}"
@@ -728,4 +814,9 @@ class Builder:
             delta_fallback=self._delta_fallback,
             encoder_threads=self._encoder_threads,
             page_checksums=self._page_checksums,
+            write_page_index=self._page_index,
+            bloom_columns=self._bloom_columns,
+            bloom_fpp=self._bloom_fpp,
+            bloom_max_bytes=self._bloom_max_bytes,
+            sorting_columns=self._sorting_columns,
         )

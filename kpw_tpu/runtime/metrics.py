@@ -361,6 +361,11 @@ PARTITIONS_EVICTED_METER = "parquet.writer.partitions.evicted"
 COMPACTOR_MERGED_METER = "parquet.compactor.merged"
 COMPACTOR_RETIRED_METER = "parquet.compactor.retired"
 COMPACTOR_FAILED_METER = "parquet.compactor.failed"
+# query-ready-files layer (core/index.py): indexed counts published files
+# carrying PARQUET-922 page-index sections; bloom.bytes counts serialized
+# split-block bloom filter bytes (header + bitset) landed in those files
+INDEXED_METER = "parquet.writer.indexed"
+BLOOM_BYTES_METER = "parquet.writer.bloom.bytes"
 
 # the canonical registry docs cite from (tools/check_docs.py verifies
 # every doc-cited metric name is listed here)
@@ -394,4 +399,6 @@ METRIC_NAMES = (
     COMPACTOR_MERGED_METER,
     COMPACTOR_RETIRED_METER,
     COMPACTOR_FAILED_METER,
+    INDEXED_METER,
+    BLOOM_BYTES_METER,
 )

@@ -155,6 +155,14 @@ class ParquetFile:
         poll cap reads this to stop polling just past the size threshold."""
         return self._est_record_bytes
 
+    def index_info(self) -> dict:
+        """Query-ready-section counters of the underlying writer (pages
+        indexed, index/bloom bytes, sorting declarations) — populated at
+        close; the worker's publish path reads this to mark the
+        ``parquet.writer.indexed`` / ``parquet.writer.bloom.bytes``
+        meters."""
+        return self._writer.index_info()
+
     def get_creation_time(self) -> float:
         return self._creation_time
 

@@ -152,6 +152,11 @@ class KafkaProtoParquetWriter:
         self._tmp_swept = reg.meter(M.TMP_SWEPT_METER) if reg else M.Meter()
         # durability meters + the recovery manifest (what the startup pass
         # verified/quarantined, surfaced verbatim in stats()["recovery"])
+        # query-ready-files meters: published files carrying page-index
+        # sections, and serialized bloom bytes landed in them
+        self._indexed = reg.meter(M.INDEXED_METER) if reg else M.Meter()
+        self._bloom_bytes_meter = (reg.meter(M.BLOOM_BYTES_METER)
+                                   if reg else M.Meter())
         self._verified = reg.meter(M.VERIFIED_METER) if reg else M.Meter()
         self._verify_failed = (reg.meter(M.VERIFY_FAILED_METER)
                                if reg else M.Meter())
@@ -667,6 +672,8 @@ class KafkaProtoParquetWriter:
                 M.STALLED_METER: self._stalled.snapshot(),
                 M.PARTITIONS_EVICTED_METER:
                     self._partitions_evicted.snapshot(),
+                M.INDEXED_METER: self._indexed.snapshot(),
+                M.BLOOM_BYTES_METER: self._bloom_bytes_meter.snapshot(),
             },
             "file_size": self._file_size_histogram.snapshot(),
             "rotations": {
@@ -727,6 +734,18 @@ class KafkaProtoParquetWriter:
         # partitioned-output block always (like degraded: "not partitioned"
         # is itself evidence); the compactor block only when the service
         # is configured, mirroring watchdog/failover
+        # query-ready-files block always (like partitions: "not indexed"
+        # is itself evidence an operator wants visible)
+        out["index"] = {
+            "page_index": self.properties.write_page_index,
+            "bloom_columns": (list(self.properties.bloom_columns)
+                              if self.properties.bloom_columns is not None
+                              else None),
+            "sorting_columns": [list(s) for s in
+                                self.properties.sorting_columns],
+            "files_indexed": self._indexed.count,
+            "bloom_bytes": self._bloom_bytes_meter.count,
+        }
         out["partitions"] = {
             "enabled": self.partitioner is not None,
             "max_open_per_worker": b._max_open_partitions,
@@ -1195,6 +1214,7 @@ class _Worker:
         self.p._flushed_records.mark(f.get_num_written_records())
         self.p._flushed_bytes.mark(size)
         self.p._file_size_histogram.update(size)
+        self._mark_index_meters(f)
         if reason == "evict":
             self.p._partitions_evicted.mark()
         else:
@@ -1206,6 +1226,16 @@ class _Worker:
         # ack strictly after durable publish (KPW.java:347-350),
         # generalized to scattered partitions by the checkpoint rule
         self._maybe_ack_all()
+
+    def _mark_index_meters(self, f: ParquetFile) -> None:
+        """Query-ready-files accounting for one closed file: mark
+        ``parquet.writer.indexed`` when it carries page-index sections and
+        ``parquet.writer.bloom.bytes`` by the bloom bytes it landed."""
+        info = f.index_info()
+        if info.get("pages_indexed"):
+            self.p._indexed.mark()
+        if info.get("bloom_bytes"):
+            self.p._bloom_bytes_meter.mark(info["bloom_bytes"])
 
     def _maybe_ack_all(self) -> None:
         """Commit the held offset runs iff NO open file still holds
@@ -1611,6 +1641,7 @@ class _Worker:
         self.p._flushed_records.mark(self._file_records)
         self.p._flushed_bytes.mark(size)
         self.p._file_size_histogram.update(size)
+        self._mark_index_meters(f)
         (self.p._rotated_time if reason == "time"
          else self.p._rotated_size).mark()
         self._rename_and_move(f.path)

@@ -50,6 +50,8 @@ STAGE_NAMES = (
     "encode.launch",
     "encode.bodies",
     "encode.assemble",
+    "encode.bloom",
+    "encode.page_index",
     "compactor.merge",
 )
 

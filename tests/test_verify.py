@@ -202,3 +202,130 @@ def test_corrupt_dictionary_offset_type_diagnosed():
     _walk_chunk(b"\x00" * 100, report, 0, 0, meta, footer_start=90)
     assert any("dictionary_page_offset is not an integer" in e
                for e in report.errors)
+
+
+# -- query-ready footer sections (ISSUE 9, core/index.py) --------------------
+
+def make_indexed_file(rows: int = 1200, row_groups: int = 2) -> bytes:
+    """The query-ready variant of make_file: page indexes + bloom filters
+    on every eligible column + a (true) declared sort order."""
+    sch = Schema([
+        Field("a", Repetition.REQUIRED, physical_type=PhysicalType.INT64),
+        Field("s", Repetition.REQUIRED, physical_type=PhysicalType.BYTE_ARRAY),
+        Field("o", Repetition.OPTIONAL, physical_type=PhysicalType.INT32),
+    ])
+    sink = io.BytesIO()
+    # blooms pinned explicitly: auto mode only covers strings + chunks
+    # that dictionary-encoded, and "a" is unique-per-row (ratio-rejected)
+    props = WriterProperties(row_group_size=8192, data_page_size=512,
+                             bloom_columns=("a", "s", "o"),
+                             sorting_columns=(("a", False, False),))
+    w = ParquetFileWriter(sink, sch, props)
+    rng = np.random.default_rng(7)
+    for g in range(row_groups):
+        w.write_batch(columns_from_arrays(sch, {
+            "a": np.arange(g * rows, (g + 1) * rows, dtype=np.int64),
+            "s": [f"v{i % 9}".encode() for i in range(rows)],
+            "o": (rng.integers(0, 9, rows).astype(np.int32),
+                  rng.random(rows) > 0.1),
+        }))
+        w.flush_row_group()
+    w.close()
+    return sink.getvalue()
+
+
+def index_section_offsets(data: bytes) -> dict:
+    """{'ci': ..., 'oi': ..., 'bloom': ...} — the first column chunk's
+    section offsets, walked with raw footer fids like the verifier."""
+    footer_len = int.from_bytes(data[-8:-4], "little")
+    fmd = CompactReader(data, len(data) - 8 - footer_len).read_struct()
+    cc = fmd[4][0][1][0]  # row_groups[0].columns[0]
+    return {"oi": cc[4], "ci": cc[6], "bloom": cc[3][14]}
+
+
+def test_clean_indexed_file_verifies_with_counters():
+    data = make_indexed_file()
+    rep = verify_bytes(data, "indexed")
+    assert rep.ok, rep.errors
+    assert rep.column_indexes == rep.offset_indexes == rep.columns == 6
+    # every data page is indexed; only dictionary pages (at most one per
+    # chunk) fall outside the OffsetIndex
+    assert 0 < rep.pages - rep.pages_indexed <= rep.columns
+    assert rep.bloom_filters >= 2
+    assert rep.sorted_row_groups == rep.row_groups == 2
+
+
+@pytest.mark.parametrize("section,needle", [
+    ("ci", "column index"),
+    ("oi", "offset index"),
+    ("bloom", "bloom filter"),
+])
+def test_corrupt_index_section_diagnosed_not_crashed(section, needle):
+    """Garbage at each section's first bytes must surface as a report
+    error naming the section — the verifier RETURNS, never raises."""
+    data = make_indexed_file()
+    off = index_section_offsets(data)[section]
+    corrupt = data[:off] + b"\xff\xff\xff\xff" + data[off + 4:]
+    rep = verify_bytes(corrupt, f"corrupt-{section}")
+    assert isinstance(rep, FileReport) and not rep.ok
+    assert any(needle in e for e in rep.errors), rep.errors[:4]
+
+
+def test_offset_index_page_location_mismatch_diagnosed():
+    """An OffsetIndex that still parses but disagrees with the walked
+    pages (one byte flipped inside the first PageLocation's varints, so
+    its offset/size no longer matches the real page header walk) must
+    fail the location-for-location cross-check."""
+    data = make_indexed_file()
+    oi = index_section_offsets(data)["oi"]
+    corrupt = bytearray(data)
+    corrupt[oi + 3] ^= 0x7F  # inside the first location's varints
+    rep = verify_bytes(bytes(corrupt), "oi-mismatch")
+    assert isinstance(rep, FileReport) and not rep.ok
+    assert any("offset index" in e or "page location" in e
+               for e in rep.errors), rep.errors[:4]
+
+
+def test_truncation_into_index_section_diagnosed():
+    """A file torn inside the index/bloom region (footer intact is
+    impossible then — the tail moves — so this goes through the torn-file
+    path): must return a report, never raise."""
+    data = make_indexed_file()
+    start = min(index_section_offsets(data).values())
+    torn = data[: start + 16]
+    rep = verify_bytes(torn, "torn-index")
+    assert isinstance(rep, FileReport) and not rep.ok
+
+
+def test_index_section_bounds_unit():
+    from kpw_tpu.io.verify import FileReport, _section_in_bounds
+
+    rep = FileReport(path="x", size=100)
+    assert not _section_in_bounds(rep, "rg 0 col 0", "column index",
+                                  None, 10, 90)
+    assert not _section_in_bounds(rep, "rg 0 col 0", "column index",
+                                  80, 40, 90)  # overruns footer_start
+    assert not _section_in_bounds(rep, "rg 0 col 0", "column index",
+                                  2, -1, 90)
+    assert _section_in_bounds(rep, "rg 0 col 0", "column index", 50, 10, 90)
+    assert len(rep.errors) == 3
+
+
+def test_sorting_ordinal_out_of_range_diagnosed():
+    """A declared sorting column pointing past the chunk list must be a
+    report error (the reader's binary-search would otherwise chase a
+    nonexistent column)."""
+    data = make_indexed_file()
+    # the sorting declaration for column 0 lives in each row group as
+    # field 4: [{1: 0, 2: False, 3: False}]; patch the ordinal varint.
+    # SortingColumn fid 1 (i32 zigzag): column 0 encodes as 0x00 — find
+    # the struct via a byte signature in the footer and bump it.
+    footer_len = int.from_bytes(data[-8:-4], "little")
+    footer_start = len(data) - 8 - footer_len
+    sig = bytes([0x15, 0x00, 0x12, 0x12, 0x00])  # i32 0, bool F, bool F, stop
+    at = data.find(sig, footer_start)
+    assert at != -1, "sorting-column signature not found in footer"
+    corrupt = data[:at] + bytes([0x15, 0x7E]) + data[at + 2:]  # ordinal 63
+    rep = verify_bytes(corrupt, "sort-ordinal")
+    assert isinstance(rep, FileReport) and not rep.ok
+    assert any("out of range" in e for e in rep.errors), rep.errors[:4]

@@ -118,6 +118,23 @@ CHECKS = [
       "compact:reduction_x"]),
     ("PARITY.md", r"compaction run's \*\*(\d+)\*\* acked offsets",
      ["compact:acked_offsets_checked"]),
+    # query-ready-files PR: page-skip / row-group-prune / bloom quotes
+    # reconcile against the scan artifact (`scan:` prefix)
+    ("README.md", r"planner skips\s+\*\*([\d.]+)%\*\* of data pages "
+                  r"\(\*\*(\d+)\*\* of \*\*(\d+)\*\*\)",
+     ["scan:pages.skipped_pct", "scan:pages.skipped", "scan:pages.total"]),
+    ("README.md", r"fragment pushdown prunes \*\*(\d+)\*\* of\s+\*\*(\d+)\*\* row groups",
+     ["scan:row_groups_pushdown.pruned", "scan:row_groups_pushdown.total"]),
+    ("README.md", r"observed\s+FPP ([\d.]+) against the 0.01 budget",
+     ["scan:bloom.observed_fpp"]),
+    ("README.md", r"bloom config costs \+([\d.]+)% file bytes",
+     ["scan:file_bytes.overhead_pct"]),
+    ("PARITY.md", r"`skipped_pct` \*\*([\d.]+)%\*\*, `bytes_skipped_pct` ([\d.]+)%",
+     ["scan:pages.skipped_pct", "scan:pages.bytes_skipped_pct"]),
+    ("PARITY.md", r"fragment pushdown pruned (\d+) of (\d+) row groups",
+     ["scan:row_groups_pushdown.pruned", "scan:row_groups_pushdown.total"]),
+    ("PARITY.md", r"`observed_fpp` ([\d.]+) \(budget ([\d.]+)\)",
+     ["scan:bloom.observed_fpp", "scan:bloom.configured_fpp"]),
 ]
 
 
@@ -412,6 +429,11 @@ def main() -> int:
         "KPW_COMPACT_PATH", os.path.join(ROOT, "BENCH_COMPACT_r12.json"))
     if os.path.exists(compact_path):
         key_record["compact"] = json.load(open(compact_path))
+    # the query-ready-files artifact (bench.py --scan) is the eighth
+    scan_path = os.environ.get(
+        "KPW_SCAN_PATH", os.path.join(ROOT, "BENCH_SCAN_r13.json"))
+    if os.path.exists(scan_path):
+        key_record["scan"] = json.load(open(scan_path))
     docs = {f: open(os.path.join(ROOT, f)).read()
             for f in ({c[0] for c in CHECKS} | set(KEY_DOCS)
                       | set(NAME_DOCS))}
@@ -439,6 +461,8 @@ def main() -> int:
                 root, spec = key_record.get("e2e", {}), spec[4:]
             elif spec.startswith("compact:"):
                 root, spec = key_record.get("compact", {}), spec[8:]
+            elif spec.startswith("scan:"):
+                root, spec = key_record.get("scan", {}), spec[5:]
             try:
                 expect = float(art(root, spec)) / scale
             except (KeyError, TypeError):

@@ -22,6 +22,14 @@ inputs, with a strict allowed-outcome contract per target —
   ``ValueError`` / ``WireShredError``; anything else — in particular a
   native OOB read, which the ASan build (tools/sanitize.sh) turns into
   an abort — is a crash.
+* ``index``   — the query-ready footer sections (ISSUE 9,
+  ``core/index.py``): mutations aimed at the ColumnIndex / OffsetIndex /
+  bloom-filter byte region of an indexed file.  ``verify_bytes`` must
+  RETURN a report (the corrupt sections surfaced as errors, never an
+  exception), and the reader stack (``read_file_index``,
+  ``read_sorting_columns``, ``bloom_check``) must return or raise
+  ``ThriftDecodeError`` — a scan planner fed a hostile file may refuse
+  it, never crash on it.
 
 Deterministic by construction: ``--seed`` fixes the whole run, and the
 committed regression configuration is seed=20260803 (tools/ci.sh runs
@@ -72,6 +80,67 @@ def _make_parquet_bytes() -> bytes:
         w.flush_row_group()
     w.close()
     return sink.getvalue()
+
+
+def _make_indexed_bytes() -> bytes:
+    """One valid QUERY-READY parquet file: page indexes, bloom filters on
+    every eligible column, and a declared (true) sort order — the
+    substrate whose index/bloom section the ``index`` target corrupts."""
+    from kpw_tpu.core.schema import (Field, PhysicalType, Repetition,
+                                     Schema)
+    from kpw_tpu.core.writer import (ParquetFileWriter, WriterProperties,
+                                     columns_from_arrays)
+
+    sch = Schema([
+        Field("a", Repetition.REQUIRED, physical_type=PhysicalType.INT64),
+        Field("s", Repetition.REQUIRED,
+              physical_type=PhysicalType.BYTE_ARRAY),
+        Field("o", Repetition.OPTIONAL, physical_type=PhysicalType.INT32),
+    ])
+    sink = io.BytesIO()
+    # blooms pinned on every column (auto mode would skip "a": unique
+    # per row, never dictionary-accepted) — the target wants the largest
+    # possible index/bloom section to corrupt
+    props = WriterProperties(row_group_size=8192, data_page_size=512,
+                             bloom_columns=("a", "s", "o"),
+                             sorting_columns=(("a", False, False),))
+    w = ParquetFileWriter(sink, sch, props)
+    rng = np.random.default_rng(7)
+    rows = 600
+    for g in range(2):
+        w.write_batch(columns_from_arrays(sch, {
+            "a": np.arange(g * rows, (g + 1) * rows, dtype=np.int64),
+            "s": [f"v{i % 9}".encode() for i in range(rows)],
+            "o": (rng.integers(0, 9, rows).astype(np.int32),
+                  rng.random(rows) > 0.1),
+        }))
+        w.flush_row_group()
+    w.close()
+    return sink.getvalue()
+
+
+def _index_section_span(data: bytes) -> tuple[int, int]:
+    """[start, end) of the file's index/bloom section: every bloom
+    filter, ColumnIndex and OffsetIndex the footer points at lies between
+    the last data-page byte and the footer.  Walked with raw footer fids
+    (like the verifier) so the fuzzer aims its mutations, instead of
+    spending most iterations on data-page bytes the verify target
+    already covers."""
+    from kpw_tpu.core.thrift import CompactReader
+
+    footer_len = int.from_bytes(data[-8:-4], "little")
+    footer_start = len(data) - 8 - footer_len
+    fmd = CompactReader(data, footer_start).read_struct()
+    offs = []
+    for rg in fmd[4]:
+        for cc in rg[1]:
+            meta = cc.get(3, {})
+            for holder, fid in ((cc, 4), (cc, 6), (meta, 14)):
+                if isinstance(holder.get(fid), int):
+                    offs.append(holder[fid])
+    if not offs:
+        raise AssertionError("index fuzz substrate carries no sections")
+    return min(offs), footer_start
 
 
 def _make_wire_batch():
@@ -234,8 +303,58 @@ def fuzz_offsets(seed: int, iters: int, report) -> int:
     return crashes
 
 
+def fuzz_index(seed: int, iters: int, report) -> int:
+    from kpw_tpu.core.index import (bloom_check, read_file_index,
+                                    read_sorting_columns)
+    from kpw_tpu.core.schema import PhysicalType
+    from kpw_tpu.core.thrift import ThriftDecodeError
+    from kpw_tpu.io.verify import FileReport, verify_bytes
+
+    data = _make_indexed_bytes()
+    sec_start, sec_end = _index_section_span(data)
+    rng = random.Random(seed + 3)
+    crashes = 0
+    for i in range(iters):
+        if i % 5 == 4:
+            # whole-file mutation: footer pointers INTO the section get
+            # corrupted too (offsets/lengths out of bounds, type flips)
+            mutated = _mutate_bytes(rng, data)
+        else:
+            # aimed mutation: corrupt only index/bloom section bytes, the
+            # footer still points at them confidently
+            section = _mutate_bytes(rng, data[sec_start:sec_end])
+            mutated = data[:sec_start] + section + data[sec_end:]
+        try:
+            rep = verify_bytes(mutated, "<fuzz>")
+            if not isinstance(rep, FileReport):
+                raise TypeError(f"verify_bytes returned {type(rep)}")
+        except Exception as e:         # verify must never raise
+            crashes += 1
+            report("index", i, e)
+        try:
+            idx = read_file_index(mutated)
+            read_sorting_columns(mutated)
+            for rg in idx:
+                for entry in rg:
+                    # no defensive guards here: read_file_index already
+                    # normalizes bloom_offset to int-or-None, and
+                    # bloom_check must answer any in-file int with a
+                    # result or ThriftDecodeError — pre-filtering would
+                    # mask the very contract this target pins
+                    off = entry.get("bloom_offset")
+                    if off is not None:
+                        bloom_check(mutated, off, b"probe",
+                                    PhysicalType.BYTE_ARRAY)
+        except ThriftDecodeError:
+            pass                       # the designed reader outcome
+        except Exception as e:
+            crashes += 1
+            report("index", i, e)
+    return crashes
+
+
 TARGETS = {"thrift": fuzz_thrift, "verify": fuzz_verify,
-           "offsets": fuzz_offsets}
+           "offsets": fuzz_offsets, "index": fuzz_index}
 DEFAULT_SEED = 20260803
 
 
