@@ -121,6 +121,42 @@ def fast_data_page_header(uncompressed_size: int, compressed_size: int,
     return bytes(o)
 
 
+# Header FRAGMENTS for the nogil assembly path (native/src/assemble.cc):
+# the C++ side emits ``prefix + zzvarint(uncompressed) + 0x15 +
+# zzvarint(compressed) [+ 0x15 + zzvarint(crc)] + suffix`` per page, so
+# everything except the two size varints (and the optional CRC, computed
+# after compression) is composed here.  Byte-identical to
+# :func:`write_page_header` for the v1 shapes (pinned in
+# tests/test_assemble.py over randomized values).
+DATA_PAGE_PREFIX = b"\x15\x00\x15"  # field1 i32 type=0(zz=0); field2 hdr
+DICT_PAGE_PREFIX = b"\x15\x04\x15"  # field1 i32 type=2(zz=4); field2 hdr
+
+
+def data_page_suffix(num_values: int, encoding: int,
+                     crc_on: bool = False) -> bytes:
+    """Everything after the compressed-size/CRC varints of a v1 DATA_PAGE
+    header: the DataPageHeader struct (field 5 — delta 1 after the CRC
+    field 4, delta 2 otherwise) with RLE level encodings."""
+    o = bytearray((0x1C if crc_on else 0x2C, 0x15))
+    _zzv(o, num_values)
+    o.append(0x15)  # .field 2 i32 encoding
+    _zzv(o, encoding)
+    o += b"\x15\x06\x15\x06\x00\x00"  # RLE/RLE + inner stop + outer stop
+    return bytes(o)
+
+
+def dict_page_suffix(num_values: int, encoding: int,
+                     crc_on: bool = False) -> bytes:
+    """DICTIONARY_PAGE counterpart of :func:`data_page_suffix` (field 7 —
+    delta 3 after the CRC field 4, delta 4 otherwise)."""
+    o = bytearray((0x3C if crc_on else 0x4C, 0x15))
+    _zzv(o, num_values)
+    o.append(0x15)  # .field 2 i32 encoding
+    _zzv(o, encoding)
+    o += b"\x00\x00"  # inner stop + outer stop
+    return bytes(o)
+
+
 def fast_dict_page_header(uncompressed_size: int, compressed_size: int,
                           num_values: int, encoding: int) -> bytes:
     """DICTIONARY_PAGE counterpart of :func:`fast_data_page_header`."""

@@ -22,11 +22,15 @@ from .bytecol import ByteColumn
 from .compression import compress
 from .index import PageStats, SplitBlockBloomFilter, xxh64
 from .metadata import (
+    DATA_PAGE_PREFIX,
+    DICT_PAGE_PREFIX,
     ColumnChunk,
     ColumnMetaData,
     DataPageHeader,
     DictionaryPageHeader,
     Statistics,
+    data_page_suffix,
+    dict_page_suffix,
     fast_data_page_header,
     write_page_header,
 )
@@ -152,6 +156,24 @@ class EncodedChunk:
 _POOL = None
 _POOL_LOCK = threading.Lock()
 
+# (num_values, encoding, crc_on) -> the constant data-page header suffix:
+# page geometries repeat across chunks/row groups, so the nogil lowering
+# reuses a handful of suffix fragments instead of composing one per page
+# (same idea as ops/backend.py's _BP_PREFIXES; benign data race — worst
+# case two threads build the same bytes once each)
+_SUFFIX_CACHE: dict = {}
+
+
+def _cached_data_suffix(num_values: int, encoding: int, crc_on: bool) -> bytes:
+    key = (num_values, encoding, crc_on)
+    s = _SUFFIX_CACHE.get(key)
+    if s is None:
+        if len(_SUFFIX_CACHE) > 4096:  # geometries are few; cap anyway
+            _SUFFIX_CACHE.clear()
+        s = _SUFFIX_CACHE[key] = data_page_suffix(num_values, encoding,
+                                                  crc_on)
+    return s
+
 
 def shared_assembly_pool():
     """One process-wide host-assembly pool (column-parallel page building,
@@ -219,6 +241,13 @@ class EncoderOptions:
     bloom_columns: tuple | None = None
     bloom_fpp: float = 0.01
     bloom_max_bytes: int = 128 * 1024
+    # Nogil batch page assembly (native/src/assemble.cc): the native/TPU
+    # backends lower each chunk's resolved page plan to a flat parts/op
+    # table and assemble (gather + RLE + compress + CRC + page stats) in
+    # ONE GIL-released native call per column, so the shared assembly
+    # pool shards columns across real cores.  False restores the pure
+    # Python page loop byte-identically (the numpy oracle always uses it).
+    native_assembly: bool = True
 
 
 class CpuChunkEncoder:
@@ -231,6 +260,12 @@ class CpuChunkEncoder:
 
     def __init__(self, options: EncoderOptions) -> None:
         self.options = options
+        # nogil-assembly accounting (chunks/pages that went through the
+        # native assemble_pages call) — read by the writer's stats/meters;
+        # the lock only guards the two increments (assembly pool threads)
+        self.native_asm_chunks = 0
+        self.native_asm_pages = 0
+        self._asm_count_lock = threading.Lock()
 
     # -- primitive ops (overridden by the TPU backend) ---------------------
     def _dictionary_build(self, values, pt: int):
@@ -309,6 +344,28 @@ class CpuChunkEncoder:
         if col.max_def > 0:
             blob += self._levels_body(chunk.def_levels[a:b], col.max_def)
         return blob
+
+    def _native_assembler(self):
+        """The nogil page-assembly extension module, or None to use the
+        Python page loops.  The numpy oracle stays pure Python — the
+        native/TPU backends override (gated on ``options.native_assembly``,
+        the loaded extension, and a codec the native path covers)."""
+        return None
+
+    def _planned_levels_blob(self, chunk: "ColumnChunkData", a: int,
+                             b: int) -> bytes | None:
+        """A pre-resolved rep+def level blob for slots [a, b), or None when
+        the native assembly lowering should RLE-encode the level streams
+        itself (the TPU backend overrides with its planner's blobs)."""
+        return None
+
+    def _page_stats_min_max(self, chunk: "ColumnChunkData", va: int, vb: int,
+                            pt: int):
+        """Per-page (min_bytes, max_bytes, min_key, max_key) over the
+        present-value range [va, vb) — the page-index stats boundary a
+        backend can override (the native encoder routes ByteColumn pages
+        through the C++ lexicographic scan)."""
+        return _min_max_typed(chunk.values[va:vb], pt)
 
     def _page_crc(self, parts: list) -> int | None:
         """Checksum of the on-wire page body (post-compression), streamed
@@ -389,9 +446,26 @@ class CpuChunkEncoder:
         the sequential path."""
         workers = self._assembly_workers(len(chunks))
         if workers > 1 and self._parallel_assembly_ok():
-            out = list(shared_assembly_pool().map(
-                lambda cp: self.encode(cp[0], 0, pre=cp[1]),
-                zip(chunks, prepared.pres)))
+            # Batched tasks (a few per worker, not one per column): every
+            # pool handoff is a GIL round trip whose reacquire can stall a
+            # full switch interval behind the other thread — at 64 columns
+            # the per-column submit/result churn measurably convoyed the
+            # 2-thread arm.  Sharded MANUALLY (one submitted callable
+            # encodes a slice of columns serially, order preserved):
+            # Executor.map's chunksize parameter is ignored by
+            # ThreadPoolExecutor, so passing it would batch nothing.
+            # 4 shards per worker keeps load balance without the
+            # per-column round trips.
+            pairs = list(zip(chunks, prepared.pres))
+            size = max(1, -(-len(pairs) // (4 * workers)))
+            shards = [pairs[i:i + size] for i in range(0, len(pairs), size)]
+
+            def encode_shard(shard: list) -> list:
+                return [self.encode(c, 0, pre=p) for c, p in shard]
+
+            out = [e for enc_shard in
+                   shared_assembly_pool().map(encode_shard, shards)
+                   for e in enc_shard]
             return self._shift_offsets(out, base_offset)
         out = []
         offset = base_offset
@@ -515,6 +589,276 @@ class CpuChunkEncoder:
         planner/encode passes that all need the same geometry."""
         return self._page_slot_ranges(chunk, chunk.estimated_bytes())
 
+    def _chunk_statistics(self, chunk: ColumnChunkData, pt: int,
+                          use_dict: bool, dict_values,
+                          page_stats: list | None) -> Statistics | None:
+        """Footer Statistics for one chunk — ONE definition shared by the
+        Python page loops and the native assembly path, so the two cannot
+        drift.  Reduces over the per-page min/max when the page-index pass
+        already walked every value (O(pages)); dictionary chunks reduce
+        over the distinct set (O(k)); otherwise one full value scan."""
+        if not self.options.write_statistics:
+            return None
+        col = chunk.column
+        if page_stats:
+            # the per-page min/max just collected covers every present
+            # value with the same plain encoding, so the chunk stats
+            # reduce over pages in O(pages) — not a second O(n) value
+            # scan (or O(k) dictionary scan, which is also a numpy GIL
+            # release/reacquire per chunk the 2-thread assembly pool
+            # pays for in handoff stalls)
+            mins = [(ps.min_key, ps.min_bytes) for ps in page_stats
+                    if ps.min_key is not None]
+            maxs = [(ps.max_key, ps.max_bytes) for ps in page_stats
+                    if ps.max_key is not None]
+            lo = min(mins, key=lambda t: t[0])[1] if mins else None
+            hi = max(maxs, key=lambda t: t[0])[1] if maxs else None
+        else:
+            # The dictionary is exactly the set of present values, so
+            # its min/max equals the column's — O(k) instead of O(n).
+            stat_src = dict_values if use_dict else chunk.values
+            lo, hi = self._stats_min_max(stat_src, pt)
+        null_count = None
+        if chunk.def_levels is not None:
+            null_count = int((chunk.def_levels < col.max_def).sum())
+        elif col.max_def == 0:
+            null_count = 0
+        if lo is not None or null_count is not None:
+            return Statistics(null_count=null_count, min_value=lo,
+                              max_value=hi)
+        return None
+
+    # numpy dtype -> native/src/assemble.cc StatsDtype code (0 = no native
+    # page stats; the lowering falls back to the per-page numpy oracle)
+    _STATS_DTYPES = {
+        np.dtype(np.int32): 1, np.dtype(np.int64): 2,
+        np.dtype(np.uint32): 3, np.dtype(np.uint64): 4,
+        np.dtype(np.float32): 5, np.dtype(np.float64): 6,
+        np.dtype(np.bool_): 7,
+    }
+
+    def _encode_native_chunk(self, chunk: ColumnChunkData, base_offset: int,
+                             *, use_dict, dict_values, indices, dict_plain,
+                             value_encoding, encodings, def_levels,
+                             value_offsets, record_starts, page_stats_on,
+                             bloom) -> EncodedChunk | None:
+        """Lower this chunk's fully resolved page plan to the flat page/op
+        tables of native/src/assemble.cc and assemble every page (gather +
+        RLE + compress + CRC + fixed-width page stats) in ONE GIL-released
+        native call.  Byte-identical to the Python page loops by
+        construction: bodies either come from the same planner/primitive
+        boundaries (RAW ops) or are RLE-encoded by the same object code the
+        ctypes path runs (RLE ops), and the header fragments compose
+        exactly :func:`write_page_header`'s v1 bytes (pinned in
+        tests/test_assemble.py)."""
+        asm = self._native_assembler()
+        opts = self.options
+        col = chunk.column
+        pt = col.leaf.physical_type
+        crc_on = opts.page_checksums
+        flags = 1 if crc_on else 0
+        values = chunk.values
+
+        buffers: list = []
+        ops: list = []      # kOpStride=5 slots per op
+        pages: list = []    # kPageStride=7 slots per page
+
+        def add_buf(obj) -> int:
+            buffers.append(obj)
+            return len(buffers) - 1
+
+        def add_raw(part) -> None:
+            if isinstance(part, (bytes, bytearray)):
+                n = len(part)
+            elif isinstance(part, np.ndarray):
+                if not part.flags.c_contiguous:
+                    part = np.ascontiguousarray(part)
+                n = part.nbytes
+            else:
+                n = memoryview(part).nbytes
+            ops.extend((0, add_buf(part), 0, n, 0))
+
+        # level streams as u32 once per chunk (the RLE ops slice them)
+        max_rep, max_def = col.max_rep, col.max_def
+        rep_buf = def_buf = -1
+        rep_aux = def_aux = 0
+        if max_rep > 0:
+            rep_buf = add_buf(np.ascontiguousarray(
+                np.asarray(chunk.rep_levels), np.uint32))
+            rep_aux = enc.bit_width(max_rep) | (2 << 8)  # kModeLen32
+        if max_def > 0:
+            def_buf = add_buf(np.ascontiguousarray(
+                np.asarray(def_levels), np.uint32))
+            def_aux = enc.bit_width(max_def) | (2 << 8)
+
+        if use_dict:
+            nd = len(dict_values)
+            dict_prefix = add_buf(DICT_PAGE_PREFIX)
+            dict_suffix = add_buf(dict_page_suffix(
+                nd, Encoding.PLAIN_DICTIONARY, crc_on))
+            op_start = len(ops) // 5
+            add_raw(dict_plain)
+            pages.extend((op_start, len(ops) // 5, dict_prefix, dict_suffix,
+                          flags, 0, 0))
+            idx_w = enc.bit_width(max(nd - 1, 0))
+            idx_buf = -1
+            if isinstance(indices, np.ndarray):
+                idx = indices
+                if idx.dtype != np.uint32 or not idx.flags.c_contiguous:
+                    idx = np.ascontiguousarray(idx, np.uint32)
+                idx_buf = add_buf(idx)
+            idx_aux = idx_w | (1 << 8)  # kModeWidthByte
+        else:
+            nd = 0
+            idx_buf = -1
+
+        # zero-copy PLAIN: the page body IS the contiguous value slice
+        contig_vals = None
+        if isinstance(values, np.ndarray):
+            contig_vals = (values if values.flags.c_contiguous
+                           else np.ascontiguousarray(values))
+        plain_raw = (not use_dict and value_encoding == Encoding.PLAIN
+                     and contig_vals is not None
+                     and values.dtype == enc._PLAIN_DTYPES.get(pt))
+        val_buf = add_buf(contig_vals) if plain_raw else -1
+        isz = values.dtype.itemsize if plain_raw else 0
+
+        sdt = 0
+        if page_stats_on and contig_vals is not None:
+            sdt = self._STATS_DTYPES.get(contig_vals.dtype, 0)
+
+        data_prefix = add_buf(DATA_PAGE_PREFIX)
+        suffixes: dict = {}  # num_values -> registered suffix buffer index
+        data_rows: list = []  # per data page: (a, b, va, vb)
+        for a, b in self._slot_ranges(chunk):
+            if def_levels is not None:
+                va, vb = int(value_offsets[a]), int(value_offsets[b])
+            else:
+                va, vb = a, b
+            op_start = len(ops) // 5
+            if max_rep > 0 or max_def > 0:
+                planned = self._planned_levels_blob(chunk, a, b)
+                if planned is not None:
+                    add_raw(planned)
+                else:
+                    if max_rep > 0:
+                        ops.extend((1, rep_buf, a, b, rep_aux))
+                    if max_def > 0:
+                        ops.extend((1, def_buf, a, b, def_aux))
+            if use_dict:
+                if idx_buf >= 0:
+                    ops.extend((1, idx_buf, va, vb, idx_aux))
+                else:
+                    # planner bodies (_PageBodies) / device indices: the
+                    # backend resolves them; bytes or a parts list
+                    body = self._indices_body(indices, va, vb, nd)
+                    if type(body) is list:
+                        for part in body:
+                            add_raw(part)
+                    else:
+                        add_raw(body)
+            elif plain_raw:
+                ops.extend((0, val_buf, va * isz, vb * isz, 0))
+            else:
+                for part in self._values_page_parts(chunk, va, vb, pt,
+                                                    value_encoding):
+                    add_raw(part)
+            suffix = suffixes.get(b - a)
+            if suffix is None:
+                suffix = suffixes[b - a] = add_buf(_cached_data_suffix(
+                    b - a, value_encoding, crc_on))
+            pages.extend((op_start, len(ops) // 5, data_prefix, suffix,
+                          flags, va, vb))
+            data_rows.append((a, b, va, vb))
+
+        n_pages = len(pages) // 7
+        out_meta = np.empty((n_pages, 3), np.int64)
+        if sdt:
+            out_stats = np.empty((n_pages, 2), contig_vals.dtype)
+            out_mask = np.empty(n_pages, np.uint8)
+        else:
+            out_stats = out_mask = None
+        level = opts.compression_level
+        if level is None:
+            level = 3  # zstd default (core/compression.py); others ignore
+        with stage("assemble.native", column=col.name):
+            blob = asm.assemble_pages(
+                tuple(buffers), np.array(pages, np.int64),
+                np.array(ops, np.int64), int(opts.codec), int(level),
+                contig_vals if sdt else None, sdt, out_meta, out_stats,
+                out_mask)
+
+        header_total = int(out_meta[:, 2].sum())
+        total_uncompressed = header_total + int(out_meta[:, 0].sum())
+        total_compressed = header_total + int(out_meta[:, 1].sum())
+        first_data = 1 if use_dict else 0
+        dict_page_len = 0
+        dictionary_page_offset = None
+        if use_dict:
+            dict_page_len = int(out_meta[0, 1] + out_meta[0, 2])
+            dictionary_page_offset = base_offset
+
+        page_stats = None
+        if page_stats_on:
+            page_stats = []
+            page_off = dict_page_len
+            plain_dtype = enc._PLAIN_DTYPES.get(pt)
+            for i, (a, b, va, vb) in enumerate(data_rows):
+                row = first_data + i
+                size = int(out_meta[row, 1] + out_meta[row, 2])
+                if sdt:
+                    m = int(out_mask[row])
+                    if m == 1:
+                        lo_v, hi_v = out_stats[row, 0], out_stats[row, 1]
+                        if pt == PhysicalType.BOOLEAN:
+                            lo_k, hi_k = bool(lo_v), bool(hi_v)
+                            lo_b, hi_b = bytes([lo_k]), bytes([hi_k])
+                        else:
+                            lo_b = np.asarray(lo_v, plain_dtype).tobytes()
+                            hi_b = np.asarray(hi_v, plain_dtype).tobytes()
+                            lo_k, hi_k = lo_v.item(), hi_v.item()
+                    elif m == 0:  # empty page / all-NaN
+                        lo_b = hi_b = lo_k = hi_k = None
+                    else:
+                        # ±0.0 tie on min or max: numpy's SIMD lane order
+                        # decides the winning sign — re-run the oracle so
+                        # the ColumnIndex bytes cannot drift from it
+                        lo_b, hi_b, lo_k, hi_k = self._page_stats_min_max(
+                            chunk, va, vb, pt)
+                else:
+                    lo_b, hi_b, lo_k, hi_k = self._page_stats_min_max(
+                        chunk, va, vb, pt)
+                page_stats.append(PageStats(
+                    first_row_index=(a if record_starts is None
+                                     else int(np.searchsorted(
+                                         record_starts, a))),
+                    offset=page_off, compressed_size=size, num_values=b - a,
+                    null_count=((b - a) - (vb - va)
+                                if def_levels is not None else 0),
+                    min_bytes=lo_b, max_bytes=hi_b,
+                    min_key=lo_k, max_key=hi_k))
+                page_off += size
+
+        stats = self._chunk_statistics(chunk, pt, use_dict, dict_values,
+                                       page_stats)
+        meta = ColumnMetaData(
+            type=pt,
+            encodings=sorted(encodings),
+            path_in_schema=list(col.path),
+            codec=opts.codec,
+            num_values=chunk.num_slots,
+            total_uncompressed_size=total_uncompressed,
+            total_compressed_size=total_compressed,
+            data_page_offset=base_offset + dict_page_len,
+            dictionary_page_offset=dictionary_page_offset,
+            statistics=stats,
+        )
+        with self._asm_count_lock:
+            self.native_asm_chunks += 1
+            self.native_asm_pages += n_pages
+        return EncodedChunk([blob], meta, dict_page_len, length=len(blob),
+                            pages=page_stats, bloom=bloom)
+
     def encode(self, chunk: ColumnChunkData, base_offset: int, pre=None) -> EncodedChunk:
         """Encode a chunk into pages.  ``base_offset`` is the absolute file
         offset where the blob will be written (for footer offsets).  ``pre``
@@ -539,6 +883,50 @@ class CpuChunkEncoder:
                     if len(dict_plain) <= opts.dictionary_page_size_limit:
                         use_dict = True
 
+        encodings = set()
+        if use_dict:
+            value_encoding = Encoding.PLAIN_DICTIONARY
+            encodings.update([Encoding.PLAIN_DICTIONARY, Encoding.RLE])
+        else:
+            value_encoding = self._fallback_encoding(pt)
+            encodings.add(value_encoding)
+        if col.max_def > 0 or col.max_rep > 0:
+            encodings.add(Encoding.RLE)
+
+        # Map slots -> present-value offsets for page slicing.
+        def_levels = chunk.def_levels
+        value_offsets = None
+        if def_levels is not None:
+            present = np.asarray(def_levels) == col.max_def
+            value_offsets = np.concatenate([[0], np.cumsum(present)])
+        # Query-ready metadata (core/index.py): per-page stats for the
+        # ColumnIndex/OffsetIndex, collected as pages are laid out (page
+        # offsets relative to the chunk's first byte — made absolute at
+        # footer time), and the chunk's bloom filter.  The bloom populates
+        # from the dictionary build's exact distinct set whenever one ran
+        # (accepted OR ratio-rejected; on the device backends this is the
+        # mesh-global dictionary), so it costs k hashes, not n.
+        page_stats: list | None = [] if opts.write_page_index else None
+        record_starts = None
+        if page_stats is not None and chunk.rep_levels is not None:
+            record_starts = np.nonzero(np.asarray(chunk.rep_levels) == 0)[0]
+        bloom = None
+        if self._bloom_on(col, pt, use_dict):
+            with stage("encode.bloom", column=col.name):
+                bloom = self._build_bloom(chunk, pt, dict_values)
+        if self._native_assembler() is not None:
+            out = self._encode_native_chunk(
+                chunk, base_offset,
+                use_dict=use_dict, dict_values=dict_values, indices=indices,
+                dict_plain=dict_plain if use_dict else None,
+                value_encoding=value_encoding, encodings=encodings,
+                def_levels=def_levels, value_offsets=value_offsets,
+                record_starts=record_starts,
+                page_stats_on=page_stats is not None, bloom=bloom)
+            if out is not None:
+                return out
+
+        # -- Python page loops (the oracle, and the native fallback) -------
         # Pages accumulate as a PARTS LIST handed to the writer verbatim
         # (EncodedChunk.parts): no bytearray doubling, no bytes() bounce,
         # and since the writer gathers parts straight into the sink, no
@@ -546,7 +934,6 @@ class CpuChunkEncoder:
         # sink write itself.
         blob_parts: list = []
         blob_len = 0
-        encodings = set()
         dict_page_len = 0
         total_uncompressed = 0
         total_compressed = 0
@@ -575,34 +962,7 @@ class CpuChunkEncoder:
             dict_page_len = len(header) + comp_len
             total_uncompressed += len(header) + len(dict_plain)
             total_compressed += len(header) + comp_len
-            value_encoding = Encoding.PLAIN_DICTIONARY
-            encodings.update([Encoding.PLAIN_DICTIONARY, Encoding.RLE])
-        else:
-            value_encoding = self._fallback_encoding(pt)
-            encodings.add(value_encoding)
-        if col.max_def > 0 or col.max_rep > 0:
-            encodings.add(Encoding.RLE)
 
-        # Map slots -> present-value offsets for page slicing.
-        def_levels = chunk.def_levels
-        if def_levels is not None:
-            present = np.asarray(def_levels) == col.max_def
-            value_offsets = np.concatenate([[0], np.cumsum(present)])
-        # Query-ready metadata (core/index.py): per-page stats for the
-        # ColumnIndex/OffsetIndex, collected as pages are laid out (page
-        # offsets relative to the chunk's first byte — made absolute at
-        # footer time), and the chunk's bloom filter.  The bloom populates
-        # from the dictionary build's exact distinct set whenever one ran
-        # (accepted OR ratio-rejected; on the device backends this is the
-        # mesh-global dictionary), so it costs k hashes, not n.
-        page_stats: list | None = [] if opts.write_page_index else None
-        record_starts = None
-        if page_stats is not None and chunk.rep_levels is not None:
-            record_starts = np.nonzero(np.asarray(chunk.rep_levels) == 0)[0]
-        bloom = None
-        if self._bloom_on(col, pt, use_dict):
-            with stage("encode.bloom", column=col.name):
-                bloom = self._build_bloom(chunk, pt, dict_values)
         if (opts.codec == Codec.UNCOMPRESSED and not opts.page_checksums
                 and col.max_def == 0 and col.max_rep == 0):
             # Tight loop for the hot shape (flat required column,
@@ -634,8 +994,8 @@ class CpuChunkEncoder:
                 total_compressed += hl + body_len
                 if page_stats is not None:
                     # flat required column: slot == row, no nulls
-                    lo_b, hi_b, lo_k, hi_k = _min_max_typed(
-                        chunk.values[a:b], pt)
+                    lo_b, hi_b, lo_k, hi_k = self._page_stats_min_max(
+                        chunk, a, b, pt)
                     page_stats.append(PageStats(
                         first_row_index=a, offset=page_off,
                         compressed_size=hl + body_len, num_values=b - a,
@@ -684,8 +1044,8 @@ class CpuChunkEncoder:
                 total_uncompressed += len(header) + body_len
                 total_compressed += len(header) + comp_len
                 if page_stats is not None:
-                    lo_b, hi_b, lo_k, hi_k = _min_max_typed(
-                        chunk.values[va:vb], pt)
+                    lo_b, hi_b, lo_k, hi_k = self._page_stats_min_max(
+                        chunk, va, vb, pt)
                     page_stats.append(PageStats(
                         first_row_index=(a if record_starts is None
                                          else int(np.searchsorted(
@@ -698,31 +1058,8 @@ class CpuChunkEncoder:
                         min_bytes=lo_b, max_bytes=hi_b,
                         min_key=lo_k, max_key=hi_k))
 
-        stats = None
-        if opts.write_statistics:
-            if not use_dict and page_stats:
-                # the per-page min/max just collected covers every value
-                # in the chunk with the same plain encoding, so the chunk
-                # stats reduce over pages in O(pages) — not a second full
-                # O(n) scan of values the page-index pass already walked
-                mins = [(ps.min_key, ps.min_bytes) for ps in page_stats
-                        if ps.min_key is not None]
-                maxs = [(ps.max_key, ps.max_bytes) for ps in page_stats
-                        if ps.max_key is not None]
-                lo = min(mins, key=lambda t: t[0])[1] if mins else None
-                hi = max(maxs, key=lambda t: t[0])[1] if maxs else None
-            else:
-                # The dictionary is exactly the set of present values, so
-                # its min/max equals the column's — O(k) instead of O(n).
-                stat_src = dict_values if use_dict else chunk.values
-                lo, hi = self._stats_min_max(stat_src, pt)
-            null_count = None
-            if chunk.def_levels is not None:
-                null_count = int((chunk.def_levels < col.max_def).sum())
-            elif col.max_def == 0:
-                null_count = 0
-            if lo is not None or null_count is not None:
-                stats = Statistics(null_count=null_count, min_value=lo, max_value=hi)
+        stats = self._chunk_statistics(chunk, pt, use_dict, dict_values,
+                                       page_stats)
 
         meta = ColumnMetaData(
             type=pt,

@@ -127,6 +127,10 @@ class WriterProperties:
     bloom_fpp: float = 0.01
     bloom_max_bytes: int = 128 * 1024
     sorting_columns: tuple = ()
+    # nogil batch page assembly (native/src/assemble.cc): on by default
+    # where a backend supports it; False restores the pure-Python page
+    # loops byte-identically (tests/test_assemble.py pins the identity)
+    native_assembly: bool = True
 
     def encoder_options(self) -> EncoderOptions:
         return EncoderOptions(
@@ -142,6 +146,7 @@ class WriterProperties:
             bloom_columns=self.bloom_columns,
             bloom_fpp=self.bloom_fpp,
             bloom_max_bytes=self.bloom_max_bytes,
+            native_assembly=self.native_assembly,
         )
 
 
@@ -856,6 +861,15 @@ class ParquetFileWriter:
         return {**self._index_counts,
                 "sorting_columns": [(s.column_idx, s.descending,
                                      s.nulls_first) for s in self._sorting]}
+
+    def assembly_info(self) -> dict:
+        """Nogil-assembly accounting of this file's encoder: column chunks
+        and pages whose assembly ran as one GIL-released native call
+        (native/src/assemble.cc) instead of the Python page loops.  Zeros
+        for backends without the extension (and with the knob off)."""
+        e = self.encoder
+        return {"native_chunks": getattr(e, "native_asm_chunks", 0),
+                "native_pages": getattr(e, "native_asm_pages", 0)}
 
     def close(self) -> None:
         if self._closed:

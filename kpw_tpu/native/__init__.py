@@ -13,6 +13,8 @@ _lib = None
 _tried = False
 _pyshred = None
 _pyshred_tried = False
+_assemble = None
+_assemble_tried = False
 
 
 def lib():
@@ -57,3 +59,27 @@ def pyshred():
                       "using the ctypes shred path")
         _pyshred = None
     return _pyshred
+
+
+def assemble():
+    """The nogil batch page-assembly extension (src/assemble.cc), or None —
+    callers must fall back to the pure-Python page loop
+    (kpw_tpu.core.pages.CpuChunkEncoder.encode)."""
+    global _assemble, _assemble_tried
+    if _assemble_tried:
+        return _assemble
+    _assemble_tried = True
+    try:
+        from .build import load_assemble
+
+        _assemble = load_assemble()
+    except Exception as e:
+        import os
+        import warnings
+
+        if os.environ.get("KPW_TPU_NATIVE_REQUIRE"):
+            raise
+        warnings.warn(f"kpw_tpu assemble extension unavailable ({e!r}); "
+                      "using the Python page-assembly loop")
+        _assemble = None
+    return _assemble

@@ -748,3 +748,83 @@ def load_pyshred():
     mod = importlib.util.module_from_spec(spec)
     loader.exec_module(mod)
     return mod
+
+
+# -- nogil batch page-assembly extension -------------------------------------
+_ASSEMBLE_SRCS = [os.path.join(_SRC_DIR, "src", "assemble.cc"),
+                  os.path.join(_SRC_DIR, "src", "encode.cc"),
+                  os.path.join(_SRC_DIR, "src", "codecs.cc")]
+_ASSEMBLE_SO = os.path.join(_SRC_DIR, "_kpw_assemble.so")
+
+
+def _assemble_tag() -> str:
+    """Cache tag for the assemble extension: the host tag PLUS the CPython
+    ABI tag — unlike the ctypes-only .so files (pure C ABI), this one is
+    compiled against Python.h, so loading a cached build from a different
+    interpreter would be undefined behavior, not a graceful fallback."""
+    import sys
+
+    return f"{_host_tag()}:{sys.implementation.cache_tag}"
+
+
+def _build_assemble() -> str:
+    """Compile the _kpw_assemble extension (assemble.cc + encode.cc +
+    codecs.cc — the RLE/bit-pack encoder and the page codecs compile into
+    this .so from the same sources as the ctypes library, so the two paths
+    cannot drift).  Same cache/hosttag discipline as _build including the
+    no-zstd fallback chain, and the same KPW_NATIVE_SANITIZE=1 ASan/UBSan
+    mode (distinct cache); the tag additionally pins the Python ABI."""
+    so = _so_path(_ASSEMBLE_SO)
+    tag = so + ".hosttag"
+    if (os.path.exists(so)
+            and all(os.path.getmtime(so) >= os.path.getmtime(s)
+                    for s in _ASSEMBLE_SRCS)
+            and os.path.exists(tag)
+            and open(tag).read() == _assemble_tag()):
+        return so
+    import sysconfig
+
+    inc = sysconfig.get_paths()["include"]
+    fast = ["-O3", "-march=native", "-funroll-loops"]
+    plain = ["-O3"]
+    if _sanitize_mode():
+        fast = plain = list(_SAN_FLAGS)
+    tail = ["-fPIC", "-shared", "-std=c++17", f"-I{inc}", "-o"]
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=_SRC_DIR)
+    os.close(fd)
+    try:
+        last_err = b""
+        for cflags, zstd in ((fast, True), (plain, True),
+                             (fast, False), (plain, False)):
+            args = (["g++"] + cflags + tail + [tmp] + _ASSEMBLE_SRCS
+                    + (["-lzstd", "-ldl"] if zstd
+                       else ["-DKPW_NO_ZSTD", "-ldl"]))
+            try:
+                subprocess.run(args, check=True, capture_output=True)
+                break
+            except subprocess.CalledProcessError as e:
+                last_err = e.stderr or b""
+                continue
+        else:
+            raise RuntimeError("assemble build failed:\n"
+                               + last_err.decode(errors="replace"))
+        os.replace(tmp, so)
+        with open(tag, "w") as f:
+            f.write(_assemble_tag())
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+    return so
+
+
+def load_assemble():
+    import importlib.machinery
+    import importlib.util
+
+    path = _build_assemble()
+    loader = importlib.machinery.ExtensionFileLoader("_kpw_assemble", path)
+    spec = importlib.util.spec_from_loader("_kpw_assemble", loader,
+                                           origin=path)
+    mod = importlib.util.module_from_spec(spec)
+    loader.exec_module(mod)
+    return mod

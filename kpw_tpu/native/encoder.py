@@ -24,7 +24,7 @@ from ..core.bytecol import ByteColumn
 from ..core.bytecol import lens_and_payload
 from ..core.pages import CpuChunkEncoder, EncoderOptions, shared_assembly_pool
 from ..core.schema import Codec, Encoding, PhysicalType
-from . import lib
+from . import assemble, lib
 
 # compat alias: the shared host-assembly pool moved to core.pages so the
 # split launch||assemble pipeline can use it without importing native
@@ -44,10 +44,44 @@ class NativeChunkEncoder(CpuChunkEncoder):
     def __init__(self, options: EncoderOptions) -> None:
         super().__init__(options)
         self._lib = lib()
+        self._asm = assemble() if options.native_assembly else None
         self._tl = threading.local()  # per-thread compression scratch
 
     def _parallel_assembly_ok(self) -> bool:
         return self._lib is not None
+
+    def _native_assembler(self):
+        """The nogil assemble_pages extension when this encoder's codec is
+        covered by it, else None (Python page loops).  SNAPPY additionally
+        requires the ctypes lib so the fallback path compresses through the
+        same snappy_compress_parts object code (identical frames); ZSTD
+        requires zstd on BOTH .so builds for the same reason.  Codecs the
+        extension doesn't implement (gzip/brotli/lz4) always take the
+        Python loops."""
+        asm = self._asm
+        if asm is None or not self.options.native_assembly:
+            return None
+        codec = self.options.codec
+        if codec == Codec.UNCOMPRESSED:
+            return asm
+        if codec == Codec.SNAPPY and self._lib is not None:
+            return asm
+        if (codec == Codec.ZSTD and asm.HAS_ZSTD
+                and self._lib is not None and self._lib.has_zstd):
+            return asm
+        return None
+
+    def _page_stats_min_max(self, chunk, va: int, vb: int, pt: int):
+        """ByteColumn page stats through the C++ lexicographic scan (the
+        same kpw_bytes_min_max the chunk-level _stats_min_max override
+        uses) instead of a per-page Python min/max over bytes objects."""
+        v = chunk.values
+        if self._lib is not None and isinstance(v, ByteColumn) and vb > va:
+            sub = v[va:vb]
+            mn, mx = self._lib.bytes_min_max(sub.data, sub.offsets)
+            lo, hi = bytes(sub[mn]), bytes(sub[mx])
+            return lo, hi, lo, hi
+        return super()._page_stats_min_max(chunk, va, vb, pt)
 
     @staticmethod
     def _fixed_width_ok(values, pt: int) -> bool:

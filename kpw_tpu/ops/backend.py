@@ -787,14 +787,22 @@ class TpuChunkEncoder(NativeChunkEncoder):
             return [body]
         return super()._values_page_parts(chunk, va, vb, pt, encoding)
 
-    def _levels_page_blob(self, chunk, a: int, b: int) -> bytes:
+    def _planned_levels_blob(self, chunk, a: int, b: int) -> bytes | None:
+        """The planner's device-encoded rep+def blob for slots [a, b) when
+        one exists — consulted by both the Python page loop (via
+        _levels_page_blob) and the native assembly lowering (as a RAW op
+        instead of re-RLE-encoding the streams)."""
         plans = getattr(self, "_level_plans", None)
         if plans:
             hit = plans.get(id(chunk))
             if hit is not None and hit[0] is chunk:  # guard against id() reuse
-                body = hit[1].get((a, b))
-                if body is not None:
-                    return body
+                return hit[1].get((a, b))
+        return None
+
+    def _levels_page_blob(self, chunk, a: int, b: int) -> bytes:
+        body = self._planned_levels_blob(chunk, a, b)
+        if body is not None:
+            return body
         return super()._levels_page_blob(chunk, a, b)
 
     def _dictionary_build(self, values, pt: int):

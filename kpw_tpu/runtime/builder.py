@@ -62,6 +62,7 @@ class Builder:
         # by default (parquet-mr 1.11 parity), bloom filters + sort-order
         # declarations opt-in
         self._page_index = True
+        self._native_assembly = True  # nogil page assembly (native builds)
         self._bloom_columns: tuple | None = None
         self._bloom_fpp = 0.01
         self._bloom_max_bytes = 128 * 1024
@@ -271,6 +272,19 @@ class Builder:
         them.  ON by default (parquet-mr 1.11 parity); off restores the
         exact pre-index file bytes."""
         self._page_index = flag
+        return self
+
+    def native_assembly(self, flag: bool) -> "Builder":
+        """Nogil batch page assembly (native/src/assemble.cc): the native
+        and TPU backends lower each chunk's resolved page plan to flat
+        tables and assemble (gather + RLE + compress + CRC + page stats)
+        in ONE GIL-released native call per column, so the shared assembly
+        pool and worker threads scale across real cores.  ON by default
+        wherever the extension loads and the codec is covered
+        (uncompressed / snappy / zstd); ``False`` opts out, restoring the
+        pure-Python page loops byte-identically (the output file bytes are
+        pinned equal either way)."""
+        self._native_assembly = flag
         return self
 
     def bloom_filters(self, columns=(), *, fpp: float = 0.01,
@@ -815,6 +829,7 @@ class Builder:
             encoder_threads=self._encoder_threads,
             page_checksums=self._page_checksums,
             write_page_index=self._page_index,
+            native_assembly=self._native_assembly,
             bloom_columns=self._bloom_columns,
             bloom_fpp=self._bloom_fpp,
             bloom_max_bytes=self._bloom_max_bytes,

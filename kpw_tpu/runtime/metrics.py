@@ -366,6 +366,13 @@ COMPACTOR_FAILED_METER = "parquet.compactor.failed"
 # split-block bloom filter bytes (header + bitset) landed in those files
 INDEXED_METER = "parquet.writer.indexed"
 BLOOM_BYTES_METER = "parquet.writer.bloom.bytes"
+# nogil-assembly layer (native/src/assemble.cc): column chunks and pages
+# whose page assembly ran as one GIL-released native call instead of the
+# Python page loops — the evidence the assembly pool actually shards
+# columns across cores (zero on backends without the extension or with
+# Builder.native_assembly(False))
+NATIVE_ASM_CHUNKS_METER = "parquet.writer.assembly.native.chunks"
+NATIVE_ASM_PAGES_METER = "parquet.writer.assembly.native.pages"
 
 # the canonical registry docs cite from (tools/check_docs.py verifies
 # every doc-cited metric name is listed here)
@@ -401,4 +408,6 @@ METRIC_NAMES = (
     COMPACTOR_FAILED_METER,
     INDEXED_METER,
     BLOOM_BYTES_METER,
+    NATIVE_ASM_CHUNKS_METER,
+    NATIVE_ASM_PAGES_METER,
 )

@@ -49,6 +49,9 @@ class ParquetFile:
         # file's measured estimate carries into its successor (tight
         # size-based rotation needs a warm estimate from record one)
         self._est_record_bytes = float(est_record_bytes)
+        # snapshot for assembly_info()'s per-FILE delta (the encoder may
+        # be shared across rotated files by a custom Builder backend)
+        self._asm_baseline = self._writer.assembly_info()
         self._creation_time = time.time()
         self._closed = False
         # why this file left service: "size" (crossed max_file_size),
@@ -162,6 +165,17 @@ class ParquetFile:
         ``parquet.writer.indexed`` / ``parquet.writer.bloom.bytes``
         meters."""
         return self._writer.index_info()
+
+    def assembly_info(self) -> dict:
+        """Nogil-assembly counters for THIS file (chunks/pages assembled
+        by the GIL-released native call) — the worker's publish path reads
+        this to mark the ``parquet.writer.assembly.native.chunks`` /
+        ``.pages`` meters.  Reported as the delta from the counters at
+        open: encoder counters are per-encoder-lifetime, and a custom
+        Builder backend hands the SAME encoder object to every rotated
+        file (cumulative readings would double-count across rotations)."""
+        now = self._writer.assembly_info()
+        return {k: now[k] - self._asm_baseline.get(k, 0) for k in now}
 
     def get_creation_time(self) -> float:
         return self._creation_time

@@ -157,6 +157,12 @@ class KafkaProtoParquetWriter:
         self._indexed = reg.meter(M.INDEXED_METER) if reg else M.Meter()
         self._bloom_bytes_meter = (reg.meter(M.BLOOM_BYTES_METER)
                                    if reg else M.Meter())
+        # nogil-assembly meters: chunks/pages assembled by the GIL-released
+        # native call (native/src/assemble.cc) across published files
+        self._native_asm_chunks = (reg.meter(M.NATIVE_ASM_CHUNKS_METER)
+                                   if reg else M.Meter())
+        self._native_asm_pages = (reg.meter(M.NATIVE_ASM_PAGES_METER)
+                                  if reg else M.Meter())
         self._verified = reg.meter(M.VERIFIED_METER) if reg else M.Meter()
         self._verify_failed = (reg.meter(M.VERIFY_FAILED_METER)
                                if reg else M.Meter())
@@ -674,6 +680,10 @@ class KafkaProtoParquetWriter:
                     self._partitions_evicted.snapshot(),
                 M.INDEXED_METER: self._indexed.snapshot(),
                 M.BLOOM_BYTES_METER: self._bloom_bytes_meter.snapshot(),
+                M.NATIVE_ASM_CHUNKS_METER:
+                    self._native_asm_chunks.snapshot(),
+                M.NATIVE_ASM_PAGES_METER:
+                    self._native_asm_pages.snapshot(),
             },
             "file_size": self._file_size_histogram.snapshot(),
             "rotations": {
@@ -736,6 +746,14 @@ class KafkaProtoParquetWriter:
         # is configured, mirroring watchdog/failover
         # query-ready-files block always (like partitions: "not indexed"
         # is itself evidence an operator wants visible)
+        # nogil-assembly block always (same rationale: "assembly stayed in
+        # Python" is itself evidence — e.g. an unsupported codec or a
+        # missing extension on a box expected to have it)
+        out["assembly"] = {
+            "native_enabled": self.properties.native_assembly,
+            "native_chunks": self._native_asm_chunks.count,
+            "native_pages": self._native_asm_pages.count,
+        }
         out["index"] = {
             "page_index": self.properties.write_page_index,
             "bloom_columns": (list(self.properties.bloom_columns)
@@ -1228,14 +1246,19 @@ class _Worker:
         self._maybe_ack_all()
 
     def _mark_index_meters(self, f: ParquetFile) -> None:
-        """Query-ready-files accounting for one closed file: mark
-        ``parquet.writer.indexed`` when it carries page-index sections and
-        ``parquet.writer.bloom.bytes`` by the bloom bytes it landed."""
+        """Per-closed-file accounting: mark ``parquet.writer.indexed``
+        when it carries page-index sections, ``parquet.writer.bloom.bytes``
+        by the bloom bytes it landed, and the nogil-assembly chunk/page
+        meters by what its encoder assembled natively."""
         info = f.index_info()
         if info.get("pages_indexed"):
             self.p._indexed.mark()
         if info.get("bloom_bytes"):
             self.p._bloom_bytes_meter.mark(info["bloom_bytes"])
+        asm = f.assembly_info()
+        if asm.get("native_chunks"):
+            self.p._native_asm_chunks.mark(asm["native_chunks"])
+            self.p._native_asm_pages.mark(asm["native_pages"])
 
     def _maybe_ack_all(self) -> None:
         """Commit the held offset runs iff NO open file still holds

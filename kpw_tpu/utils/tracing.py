@@ -50,6 +50,7 @@ STAGE_NAMES = (
     "encode.launch",
     "encode.bodies",
     "encode.assemble",
+    "assemble.native",
     "encode.bloom",
     "encode.page_index",
     "compactor.merge",

@@ -1,0 +1,357 @@
+"""Nogil batch page assembly (native/src/assemble.cc): byte-identity pins.
+
+The native `assemble_pages` call must be invisible in the output: every
+file written with the lowered GIL-released path must equal the pure-Python
+page loops byte for byte — across the committed bench shapes (cfg2 taxi
+dictionary-heavy, cfg6 delta/string streaming), compression on/off, CRC
+on/off, encoder_threads ∈ {1, 2}, pipeline on/off — extending the
+`test_batch_and_record_paths_byte_identical` convention to the assembly
+boundary.  Plus the malformed-table ValueError contract the fuzz target
+(tools/fuzz.py `assemble`) leans on.
+"""
+
+import io
+
+import numpy as np
+import pyarrow.parquet as pq
+import pytest
+
+from kpw_tpu.core import (
+    ParquetFileWriter,
+    Repetition,
+    Schema,
+    WriterProperties,
+    columns_from_arrays,
+    leaf,
+)
+from kpw_tpu.core.metadata import (
+    DATA_PAGE_PREFIX,
+    DICT_PAGE_PREFIX,
+    DataPageHeader,
+    DictionaryPageHeader,
+    data_page_suffix,
+    dict_page_suffix,
+    write_page_header,
+)
+from kpw_tpu.core.pages import CpuChunkEncoder, EncoderOptions
+from kpw_tpu.core.schema import Codec, Encoding, PageType
+from kpw_tpu.native import assemble
+from kpw_tpu.native.encoder import NativeChunkEncoder
+
+
+@pytest.fixture(scope="module")
+def asm():
+    mod = assemble()
+    assert mod is not None, "assemble extension must build in this env"
+    return mod
+
+
+def _zzv(n: int) -> bytes:
+    o = bytearray()
+    n = (n << 1) ^ (n >> 63) if n < 0 else n << 1
+    while n >= 0x80:
+        o.append((n & 0x7F) | 0x80)
+        n >>= 7
+    o.append(n)
+    return bytes(o)
+
+
+# ---------------------------------------------------------------------------
+# header fragments: prefix + varints + suffix == write_page_header
+# ---------------------------------------------------------------------------
+
+def test_page_header_fragments_byte_identical():
+    """The fragment composition the C++ side emits (prefix ..
+    zzv(uncompressed) 0x15 zzv(compressed) [0x15 zzv(crc)] .. suffix) must
+    equal write_page_header for every randomized v1 shape."""
+    rng = np.random.default_rng(20260803)
+    for _ in range(200):
+        unc = int(rng.integers(0, 1 << 28))
+        comp = int(rng.integers(0, unc + 1))
+        nv = int(rng.integers(0, 1 << 24))
+        encoding = int(rng.choice([Encoding.PLAIN, Encoding.PLAIN_DICTIONARY,
+                                   Encoding.DELTA_BINARY_PACKED]))
+        crc = (None if rng.random() < 0.5
+               else int(rng.integers(-(1 << 31), 1 << 31)))
+        composed = (DATA_PAGE_PREFIX + _zzv(unc) + b"\x15" + _zzv(comp)
+                    + (b"\x15" + _zzv(crc) if crc is not None else b"")
+                    + data_page_suffix(nv, encoding, crc is not None))
+        want = write_page_header(
+            PageType.DATA_PAGE, unc, comp,
+            data_header=DataPageHeader(
+                num_values=nv, encoding=encoding,
+                definition_level_encoding=Encoding.RLE,
+                repetition_level_encoding=Encoding.RLE),
+            crc=crc)
+        assert composed == want
+        composed_d = (DICT_PAGE_PREFIX + _zzv(unc) + b"\x15" + _zzv(comp)
+                      + (b"\x15" + _zzv(crc) if crc is not None else b"")
+                      + dict_page_suffix(nv, encoding, crc is not None))
+        want_d = write_page_header(
+            PageType.DICTIONARY_PAGE, unc, comp,
+            dict_header=DictionaryPageHeader(nv, encoding), crc=crc)
+        assert composed_d == want_d
+
+
+# ---------------------------------------------------------------------------
+# full-file byte identity: native assembly on vs off
+# ---------------------------------------------------------------------------
+
+def _cfg2_batch(rows=9000, cols=12, seed=0):
+    rng = np.random.default_rng(seed)
+    arrays = {}
+    for i in range(cols):
+        kind = i % 4
+        if kind == 0:
+            arrays[f"c{i:02d}"] = rng.integers(0, 8, rows).astype(np.int64)
+        elif kind == 1:
+            arrays[f"c{i:02d}"] = rng.integers(1, 266, rows).astype(np.int32)
+        elif kind == 2:
+            arrays[f"c{i:02d}"] = (rng.integers(0, 5000, rows)
+                                   * 25).astype(np.int64)
+        else:
+            arrays[f"c{i:02d}"] = (rng.integers(0, 3000, rows)
+                                   / 100.0).astype(np.float64)
+    tm = {"int64": "int64", "int32": "int32", "float64": "double"}
+    schema = Schema([leaf(n, tm[str(v.dtype)]) for n, v in arrays.items()])
+    return schema, arrays
+
+
+def _cfg6_batch(rows=6000, seed=3):
+    rng = np.random.default_rng(seed)
+    base = 1_700_000_000_000
+    arrays = {}
+    for i in range(3):
+        arrays[f"ts{i}"] = (base + np.cumsum(rng.integers(0, 50, rows))
+                            + rng.integers(0, 5, rows)).astype(np.int64)
+    for i in range(2):
+        arrays[f"u{i}"] = [f"{v:032x}".encode()
+                           for v in rng.integers(0, 1 << 62, rows)]
+    schema = Schema([leaf(f"ts{i}", "int64") for i in range(3)]
+                    + [leaf(f"u{i}", "string") for i in range(2)])
+    return schema, arrays
+
+
+def _write_file(schema, arrays, props, encoder, pipeline):
+    sink = io.BytesIO()
+    w = ParquetFileWriter(sink, schema, props, encoder=encoder,
+                          pipeline=pipeline)
+    batch = columns_from_arrays(schema, arrays)
+    w.append_batch(batch)
+    w.close()
+    return sink.getvalue()
+
+
+def _props(**kw):
+    base = dict(row_group_size=96 * 1024, data_page_size=16 * 1024)
+    base.update(kw)
+    return WriterProperties(**base)
+
+
+@pytest.mark.parametrize("shape", ["cfg2", "cfg6"])
+@pytest.mark.parametrize("codec", [Codec.UNCOMPRESSED, Codec.SNAPPY])
+@pytest.mark.parametrize("threads", [1, 2])
+def test_native_assembly_byte_identical(shape, codec, threads):
+    """Native-assembled vs Python-assembled files identical across the
+    committed shapes × compression × assembly threads (the pinned matrix
+    from ISSUE satellite 1; pipeline on/off pinned separately below)."""
+    schema, arrays = _cfg2_batch() if shape == "cfg2" else _cfg6_batch()
+    kw = dict(codec=codec, encoder_threads=threads,
+              delta_fallback=(shape == "cfg6"),
+              enable_dictionary=(shape == "cfg2"))
+    on = _write_file(schema, arrays, _props(**kw),
+                     NativeChunkEncoder(EncoderOptions(
+                         native_assembly=True, data_page_size=16 * 1024,
+                         **kw)), pipeline=False)
+    off = _write_file(schema, arrays, _props(**kw),
+                      NativeChunkEncoder(EncoderOptions(
+                          native_assembly=False, data_page_size=16 * 1024,
+                          **kw)), pipeline=False)
+    assert on == off
+    assert len(on) > 1000
+    table = pq.read_table(io.BytesIO(on))
+    assert table.num_rows == len(next(iter(arrays.values())))
+
+
+@pytest.mark.parametrize("pipeline", [False, True])
+@pytest.mark.parametrize("crc", [False, True])
+def test_native_assembly_pipeline_and_crc_byte_identical(pipeline, crc):
+    """Pipeline on/off and page CRCs on/off: native on == native off, and
+    both equal the pure-numpy oracle."""
+    schema, arrays = _cfg2_batch(rows=6000, cols=8, seed=1)
+    kw = dict(codec=Codec.SNAPPY, page_checksums=crc)
+    props = _props(**kw)
+    opts = dict(data_page_size=16 * 1024, **kw)
+    on = _write_file(schema, arrays, props,
+                     NativeChunkEncoder(EncoderOptions(
+                         native_assembly=True, **opts)), pipeline)
+    off = _write_file(schema, arrays, props,
+                      NativeChunkEncoder(EncoderOptions(
+                          native_assembly=False, **opts)), pipeline)
+    oracle = _write_file(schema, arrays, props,
+                         CpuChunkEncoder(EncoderOptions(**opts)), pipeline)
+    assert on == off == oracle
+    if crc:
+        # CRCs must actually verify (C++ CRC-32 == zlib.crc32 on the wire)
+        pq.read_table(io.BytesIO(on), page_checksum_verification=True)
+
+
+def test_native_assembly_nullable_and_repeated_levels():
+    """Optional columns (def levels) and float edge values (NaN, ±0.0 —
+    the ambiguous-zero stats fallback) stay byte-identical, page index
+    included."""
+    rng = np.random.default_rng(9)
+    n = 7000
+    z = rng.choice([0.0, -0.0, 1.5, -2.5], n)
+    z[rng.random(n) < 0.02] = np.nan
+    schema = Schema([
+        leaf("opt", "int64", repetition=Repetition.OPTIONAL),
+        leaf("zeros", "double"),
+        leaf("s", "string", repetition=Repetition.OPTIONAL),
+    ])
+    arrays = {
+        "opt": (rng.integers(0, 50, n).astype(np.int64), rng.random(n) > .2),
+        "zeros": z,
+        "s": ([b"v%d" % (i % 19) for i in range(n)], rng.random(n) > .1),
+    }
+    on = _write_file(schema, arrays, _props(),
+                     NativeChunkEncoder(EncoderOptions(
+                         native_assembly=True, data_page_size=16 * 1024)),
+                     pipeline=False)
+    off = _write_file(schema, arrays, _props(),
+                      NativeChunkEncoder(EncoderOptions(
+                          native_assembly=False, data_page_size=16 * 1024)),
+                      pipeline=False)
+    assert on == off
+    md = pq.read_metadata(io.BytesIO(on))
+    assert md.row_group(0).column(0).has_column_index
+
+
+def test_native_assembly_engages_and_counts():
+    """The counters prove the native path actually ran (a silently-skipped
+    lowering would make every identity test above vacuous)."""
+    schema, arrays = _cfg2_batch(rows=4000, cols=4)
+    enc = NativeChunkEncoder(EncoderOptions(data_page_size=16 * 1024))
+    if enc._native_assembler() is None:
+        pytest.skip("assemble extension unavailable")
+    _write_file(schema, arrays, _props(), enc, pipeline=False)
+    assert enc.native_asm_chunks > 0
+    assert enc.native_asm_pages >= enc.native_asm_chunks
+
+
+def test_builder_native_assembly_opt_out_byte_identical():
+    """Builder.native_assembly(False) — the documented fallback knob —
+    publishes byte-identical files to the default-on path, and the
+    stats()["assembly"] block + canonical meters report the difference."""
+    import sys as _sys
+    import os as _os
+    import time
+    _sys.path.insert(0, _os.path.dirname(_os.path.abspath(__file__)))
+    from test_writer_integration import (make_writer_builder, produce_samples,
+                                         wait_for_files, TOPIC)
+    from proto_helpers import sample_message_class
+    from kpw_tpu import FakeBroker, MemoryFileSystem
+
+    outs = {}
+    for native in (True, False):
+        broker = FakeBroker()
+        broker.create_topic(TOPIC, 1)
+        fs = MemoryFileSystem()
+        cls = sample_message_class()
+        produce_samples(broker, cls, 2500)
+        b = make_writer_builder(broker, fs, cls,
+                                max_file_open_duration_seconds=0.4,
+                                encoder_backend="native")
+        w = b.native_assembly(native).build()
+        with w:
+            wait_for_files(fs, "/out", ".parquet", 1, timeout=15)
+            time.sleep(0.3)
+            st = w.stats()
+        assert st["assembly"]["native_enabled"] is native
+        chunks = st["meters"]["parquet.writer.assembly.native.chunks"]["count"]
+        if native:
+            assert st["assembly"]["native_chunks"] > 0
+            assert chunks > 0
+        else:
+            assert st["assembly"]["native_chunks"] == 0
+            assert chunks == 0
+        files = sorted(fs.list_files("/out", extension=".parquet"))
+        with fs.open_read(files[0]) as f:
+            outs[native] = f.read()
+    assert outs[True] == outs[False]
+
+
+# ---------------------------------------------------------------------------
+# malformed-table contract (the fuzz target's allowed-outcome set)
+# ---------------------------------------------------------------------------
+
+def _valid_plan(asm):
+    """A minimal valid plan: one data page, one RAW op over a tiny body."""
+    body = b"\x03" + bytes(10)
+    buffers = (body, DATA_PAGE_PREFIX, data_page_suffix(8, 0))
+    pages = np.array([[0, 1, 1, 2, 0, 0, 0]], np.int64)
+    ops = np.array([[0, 0, 0, len(body), 0]], np.int64)
+    meta = np.zeros((1, 3), np.int64)
+    return buffers, pages, ops, meta
+
+
+def test_assemble_valid_plan_roundtrip(asm):
+    buffers, pages, ops, meta = _valid_plan(asm)
+    out = asm.assemble_pages(buffers, pages, ops, 0, 3, None, 0, meta,
+                             None, None)
+    body = buffers[0]
+    want = (DATA_PAGE_PREFIX + _zzv(len(body)) + b"\x15" + _zzv(len(body))
+            + data_page_suffix(8, 0) + body)
+    assert out == want
+    assert meta[0, 0] == meta[0, 1] == len(body)
+    assert meta[0, 2] == len(out) - len(body)
+
+
+@pytest.mark.parametrize("mutate", [
+    pytest.param(lambda p, o: p.__setitem__((0, 0), -1), id="op-start-neg"),
+    pytest.param(lambda p, o: p.__setitem__((0, 1), 99), id="op-end-oob"),
+    pytest.param(lambda p, o: p.__setitem__((0, 2), 7), id="prefix-oob"),
+    pytest.param(lambda p, o: p.__setitem__((0, 3), -2), id="suffix-neg"),
+    pytest.param(lambda p, o: p.__setitem__((0, 4), 4), id="bad-flags"),
+    pytest.param(lambda p, o: o.__setitem__((0, 0), 9), id="bad-op-kind"),
+    pytest.param(lambda p, o: o.__setitem__((0, 1), 50), id="op-buf-oob"),
+    pytest.param(lambda p, o: o.__setitem__((0, 3), 1 << 40), id="raw-oob"),
+    pytest.param(lambda p, o: (o.__setitem__((0, 0), 1),
+                               o.__setitem__((0, 4), 77)), id="rle-width-oob"),
+    pytest.param(lambda p, o: (o.__setitem__((0, 0), 1),
+                               o.__setitem__((0, 4), 8 | (9 << 8))),
+                 id="rle-bad-mode"),
+], )
+def test_assemble_malformed_tables_raise_valueerror(asm, mutate):
+    """Every malformed page/op table is a ValueError BEFORE the GIL is
+    released — never an out-of-bounds read (the ASan build re-runs these
+    via tools/sanitize.sh; tools/fuzz.py hammers the same contract)."""
+    buffers, pages, ops, meta = _valid_plan(asm)
+    mutate(pages, ops)
+    with pytest.raises(ValueError):
+        asm.assemble_pages(buffers, pages, ops, 0, 3, None, 0, meta,
+                           None, None)
+
+
+def test_assemble_stats_require_buffers(asm):
+    buffers, pages, ops, meta = _valid_plan(asm)
+    with pytest.raises(ValueError):
+        # stats dtype set but no values buffer
+        asm.assemble_pages(buffers, pages, ops, 0, 3, None, 2, meta,
+                           None, None)
+    vals = np.arange(16, dtype=np.int64)
+    with pytest.raises(ValueError):
+        # stats range past the values buffer
+        bad = pages.copy()
+        bad[0, 5], bad[0, 6] = 0, 17
+        stats = np.zeros((1, 2), np.int64)
+        mask = np.zeros(1, np.uint8)
+        asm.assemble_pages(buffers, bad, ops, 0, 3, vals, 2, meta,
+                           stats, mask)
+
+
+def test_assemble_unsupported_codec_rejected(asm):
+    buffers, pages, ops, meta = _valid_plan(asm)
+    with pytest.raises(ValueError):
+        asm.assemble_pages(buffers, pages, ops, 2, 3, None, 0, meta,
+                           None, None)  # gzip: not a native codec

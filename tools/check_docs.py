@@ -103,6 +103,16 @@ CHECKS = [
      ["e2e:batch_ab.speedup_x"]),
     ("PARITY.md", r"p99 ack-lag ([\d.]+)k records \(`ack_lag_p99_records`",
      [("e2e:ack_lag_p99_records", 1e3)]),
+    # nogil-assembly PR: the assembly-pool scaling A/B quotes (native vs
+    # pure-Python arm, cfg2 shape) reconcile against the e2e artifact
+    ("README.md", r"native path at \*\*([\d.]+)x\*\* with the pre-PR "
+                  r"pure-Python loops at\s+\*\*([\d.]+)x\*\*",
+     ["e2e:assembly_scaling.native.speedup_x",
+      "e2e:assembly_scaling.python_fallback.speedup_x"]),
+    ("PARITY.md", r"native path \*\*([\d.]+)x\*\* vs the\s+pre-PR "
+                  r"pure-Python loops \*\*([\d.]+)x\*\*",
+     ["e2e:assembly_scaling.native.speedup_x",
+      "e2e:assembly_scaling.python_fallback.speedup_x"]),
     # partitioned-output/compaction PR: small-file reduction + invariant
     # quotes reconcile against the compaction artifact (`compact:` prefix)
     ("README.md", r"compacts \*\*(\d+)\*\* small files into \*\*(\d+)\*\* "
@@ -420,7 +430,7 @@ def main() -> int:
         key_record["degrade"] = json.load(open(degrade_path))
     # the sustained-throughput artifact (bench.py --e2e) is the sixth
     e2e_path = os.environ.get(
-        "KPW_E2E_PATH", os.path.join(ROOT, "BENCH_E2E_r10.json"))
+        "KPW_E2E_PATH", os.path.join(ROOT, "BENCH_E2E_r14.json"))
     if os.path.exists(e2e_path):
         key_record["e2e"] = json.load(open(e2e_path))
     # the partitioned-output/compaction artifact (bench.py --compact) is

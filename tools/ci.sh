@@ -20,9 +20,15 @@
 #                          observed, bloom miss rejected, sort-on-compact
 #                          verified; exits nonzero otherwise, committed
 #                          artifact never overwritten)
-#   5. doc reconciliation — python tools/check_docs.py (every doc-cited
+#   5. e2e smoke         — python bench.py --e2e --smoke (reduced
+#                          saturation replay through the full
+#                          poll->shred->encode->publish->ack leg on the
+#                          nogil assembly path; exits nonzero unless
+#                          ack-lag drains to exactly 0, committed
+#                          artifact never overwritten)
+#   6. doc reconciliation — python tools/check_docs.py (every doc-cited
 #                          number/name/test/pass exists and matches)
-#   6. sanitizer smoke   — bash tools/sanitize.sh --smoke (ASan/UBSan
+#   7. sanitizer smoke   — bash tools/sanitize.sh --smoke (ASan/UBSan
 #                          native build + fuzz; prints a LOUD notice and
 #                          exits 0 when the toolchain is absent — never
 #                          a silent pass)
@@ -35,10 +41,10 @@ cd "$(dirname "$0")/.."
 fail=0
 step() { echo; echo "=== ci.sh [$1] $2 ==="; }
 
-step 1/6 "lint suite (python -m tools.analyze)"
+step 1/7 "lint suite (python -m tools.analyze)"
 python -m tools.analyze || fail=1
 
-step 2/6 "tier-1 pytest (-m 'not slow')"
+step 2/7 "tier-1 pytest (-m 'not slow')"
 # tier-1's exit code is nonzero on THIS container because of the known
 # environmental failures (python zstandard + jax shard_map absent — see
 # the CHANGES.md baseline), so the gate is mechanical instead of
@@ -61,16 +67,19 @@ if [ "$t1_errors" -gt 0 ] || [ "$t1_failed" -gt "$max_failed" ] \
 fi
 rm -f "$T1_LOG"
 
-step 3/6 "compaction smoke (bench.py --compact --smoke)"
+step 3/7 "compaction smoke (bench.py --compact --smoke)"
 JAX_PLATFORMS=cpu python bench.py --compact --smoke || fail=1
 
-step 4/6 "scan smoke (bench.py --scan --smoke)"
+step 4/7 "scan smoke (bench.py --scan --smoke)"
 JAX_PLATFORMS=cpu python bench.py --scan --smoke || fail=1
 
-step 5/6 "doc reconciliation (tools/check_docs.py)"
+step 5/7 "e2e smoke (bench.py --e2e --smoke)"
+JAX_PLATFORMS=cpu python bench.py --e2e --smoke || fail=1
+
+step 6/7 "doc reconciliation (tools/check_docs.py)"
 python tools/check_docs.py || fail=1
 
-step 6/6 "sanitizer smoke (tools/sanitize.sh --smoke)"
+step 7/7 "sanitizer smoke (tools/sanitize.sh --smoke)"
 bash tools/sanitize.sh --smoke || fail=1
 
 echo

@@ -353,8 +353,103 @@ def fuzz_index(seed: int, iters: int, report) -> int:
     return crashes
 
 
+def _make_assemble_plan():
+    """(extension, buffers, page_tab, op_tab, values) — one valid lowered
+    plan shaped like a real chunk (RAW body parts + RLE level/index ops +
+    CRC flags + native stats), the mutation substrate for the ``assemble``
+    target."""
+    from kpw_tpu.core.metadata import (DATA_PAGE_PREFIX, DICT_PAGE_PREFIX,
+                                       data_page_suffix, dict_page_suffix)
+    from kpw_tpu.native import assemble
+
+    asm = assemble()
+    if asm is None:
+        raise AssertionError("assemble extension must build for fuzzing")
+    rng2 = np.random.default_rng(11)
+    values = np.ascontiguousarray(rng2.integers(0, 1000, 512), np.int64)
+    idx = np.ascontiguousarray(rng2.integers(0, 16, 512), np.uint32)
+    levels = np.ascontiguousarray(rng2.integers(0, 2, 512), np.uint32)
+    raw = bytes(rng2.integers(0, 256, 700, dtype=np.uint8))
+    buffers = (raw, idx, levels, values.view(np.uint8).tobytes(),
+               DATA_PAGE_PREFIX, DICT_PAGE_PREFIX,
+               data_page_suffix(256, 0, True), dict_page_suffix(16, 2, True))
+    ops = np.array([
+        [0, 0, 0, 700, 0],            # RAW whole buffer
+        [1, 2, 0, 256, 1 | (2 << 8)],  # RLE levels, len32 mode
+        [1, 1, 0, 256, 4 | (1 << 8)],  # RLE indices, width-byte mode
+        [0, 3, 0, 2048, 0],           # RAW values-as-bytes slice
+        [1, 1, 256, 512, 4 | (0 << 8)],  # RLE bare
+    ], np.int64)
+    pages = np.array([
+        [0, 1, 5, 7, 1, 0, 0],    # dict-ish page: RAW body, CRC on
+        [1, 3, 4, 6, 1, 0, 256],  # data page: levels+indices, stats range
+        [3, 5, 4, 6, 0, 256, 512],
+    ], np.int64)
+    return asm, buffers, pages, ops, values
+
+
+def fuzz_assemble(seed: int, iters: int, report) -> int:
+    """Malformed page/op tables into the nogil assembler: the entry must
+    return bytes or raise ValueError (every index validated BEFORE the
+    GIL is released) — any other exception, or an OOB read the ASan
+    build aborts on, is a crash.  Same contract PR 6 established for
+    ``shred_flat_buf``."""
+    asm, buffers, pages, ops, values = _make_assemble_plan()
+    rng = random.Random(seed + 4)
+    adversarial = (-1, 0, 1, -(1 << 62), (1 << 62), (1 << 40), 255, 256,
+                   701, -700, 2 ** 31, -(2 ** 31))
+    crashes = 0
+    for i in range(iters):
+        p = pages.copy()
+        o = ops.copy()
+        kind = rng.randrange(6)
+        if kind == 0:      # scatter adversarial int64s into the page table
+            for _ in range(rng.randint(1, 4)):
+                p[rng.randrange(p.shape[0]), rng.randrange(7)] = rng.choice(
+                    adversarial)
+        elif kind == 1:    # scatter into the op table
+            for _ in range(rng.randint(1, 4)):
+                o[rng.randrange(o.shape[0]), rng.randrange(5)] = rng.choice(
+                    adversarial)
+        elif kind == 2:    # truncate/extend a table (stride misalignment)
+            if rng.random() < 0.5:
+                p = np.resize(p.reshape(-1), rng.randrange(0, p.size + 5))
+            else:
+                o = np.resize(o.reshape(-1), rng.randrange(0, o.size + 5))
+        elif kind == 3:    # fully random small tables
+            p = np.array([[rng.choice(adversarial) for _ in range(7)]
+                          for _ in range(rng.randint(1, 4))], np.int64)
+        elif kind == 4:    # random op kinds/aux over valid ranges
+            for r in range(o.shape[0]):
+                o[r, 0] = rng.randrange(-2, 4)
+                o[r, 4] = rng.choice(adversarial)
+        else:              # both tables perturbed
+            p[rng.randrange(p.shape[0]), rng.randrange(7)] = rng.choice(
+                adversarial)
+            o[rng.randrange(o.shape[0]), rng.randrange(5)] = rng.choice(
+                adversarial)
+        n_pages = p.size // 7
+        meta = np.zeros((max(n_pages, 1), 3), np.int64)
+        stats = np.zeros((max(n_pages, 1), 2), np.int64)
+        mask = np.zeros(max(n_pages, 1), np.uint8)
+        use_stats = rng.random() < 0.5
+        try:
+            asm.assemble_pages(buffers, p, o, rng.choice((0, 0, 1, 6, 9)),
+                               3, values if use_stats else None,
+                               2 if use_stats else 0, meta,
+                               stats if use_stats else None,
+                               mask if use_stats else None)
+        except ValueError:
+            pass                       # the designed outcome
+        except Exception as e:
+            crashes += 1
+            report("assemble", i, e)
+    return crashes
+
+
 TARGETS = {"thrift": fuzz_thrift, "verify": fuzz_verify,
-           "offsets": fuzz_offsets, "index": fuzz_index}
+           "offsets": fuzz_offsets, "index": fuzz_index,
+           "assemble": fuzz_assemble}
 DEFAULT_SEED = 20260803
 
 

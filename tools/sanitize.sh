@@ -76,6 +76,14 @@ python -m pytest tests/test_batch_ingest.py \
     -k "columnarize_buffer or byte_identical" \
     -q -p no:cacheprovider || rc=1
 
+# nogil page-assembly subset (ISSUE 10): the lowered-table validation
+# contract + byte-identity pins run against the SANITIZED _kpw_assemble
+# build, so a table the validator wrongly admits traps as an ASan abort
+# instead of a silent OOB gather
+python -m pytest tests/test_assemble.py \
+    -k "malformed or valid_plan or stats_require or unsupported or byte_identical" \
+    -q -p no:cacheprovider || rc=1
+
 # seeded mutation fuzz: thrift reader, verifier page walk, offset-table
 # validator — zero crashes/sanitizer findings required
 python -m tools.fuzz --seed "$SEED" --iters "$FUZZ_ITERS" || rc=1
