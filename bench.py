@@ -3467,6 +3467,199 @@ def e2e_probe(rows: int = 400_000, parts: int = 4, ab_pairs: int = 5,
     return out
 
 
+def procs_probe(rows: int = 400_000, parts: int = 4, pairs: int = 3,
+                smoke: bool = False) -> dict:
+    """``--e2e --procs``: the process-parallel workers sweep (ISSUE 11).
+
+    Same cfg6-shaped saturation replay as :func:`e2e_probe`, but the
+    workers are **spawned subprocesses** fed through the shared-memory
+    batch ring (``Builder.process_workers``), publishing to a real
+    on-disk LocalFileSystem (the only sink that crosses a process
+    boundary).  Sweep: 1 vs 2 worker processes, interleaved alternating
+    pairs, min-of-3 per arm per pair, speedup = ratio of arm medians
+    (repo A/B convention), bracketed by the PR-10 ``cpu_capacity_x``
+    two-process capacity probes — on this cpu-shares-capped box the
+    parallelism actually available moves with host contention, and every
+    number must say what ceiling it ran under.  Timing is
+    **steady-state**: per run we record time-to-all-written from the
+    first written record, excluding the one-time child spawn+import cost
+    (~1-2 s/child, reported separately), which is amortized to nothing
+    in any long-running deployment.
+
+    ``smoke=True`` (the tools/ci.sh gate): one reduced replay through 2
+    worker processes; reports whether ack-lag drained to exactly 0 and
+    never touches the committed artifact."""
+    import shutil
+    import tempfile
+
+    from kpw_tpu import Builder, FakeBroker, LocalFileSystem
+    from kpw_tpu.runtime.select import choose_backend
+
+    if smoke:
+        rows = 30_000
+    Msg, payloads = _e2e_message_payloads(rows)
+    payload_bytes = sum(len(p) for p in payloads)
+    broker = FakeBroker()
+    broker.create_topic("e2e", parts)
+    broker.produce_many("e2e", payloads)
+    backend = choose_backend()
+    print(f"[bench:procs] backend {backend}; {rows} records, "
+          f"{payload_bytes / 1e6:.1f} MB on the wire, {parts} partitions, "
+          f"spawn workers", file=sys.stderr)
+    run_id = 0
+
+    def one_run(procs: int, threads: int | None = None):
+        """(steady-state seconds to all-written, spawn/ramp seconds,
+        full drain seconds).  ``threads`` switches to thread-mode
+        workers (the context baseline arm)."""
+        nonlocal run_id
+        run_id += 1
+        target = tempfile.mkdtemp(prefix=f"kpw_procs_{run_id}_")
+        # autotune stays OFF in every arm: the tuner's fetch sizing
+        # models thread workers (poll batches coalesce fetch slices in
+        # the consumer queue), and in process mode a tuned-down fetch
+        # starves the dispatcher's slot packing
+        b = (Builder().broker(broker).topic("e2e").proto_class(Msg)
+             .target_dir(target).filesystem(LocalFileSystem())
+             .instance_name(f"procs{run_id}").group_id(f"procs-{run_id}")
+             .encoder_backend(backend).compression("snappy")
+             .fetch_max_records(4000)
+             .max_file_size(4 * 1024 * 1024).block_size(2 * 1024 * 1024)
+             .max_file_open_duration_seconds(0.5))
+        if threads is not None:
+            b.thread_count(threads)
+        else:
+            b.process_workers(procs)
+        w = b.build()
+        group = f"procs-{run_id}"
+        t0 = time.perf_counter()
+        w.start()
+        t_first = None
+        t_written = None
+        deadline = time.time() + 240
+        try:
+            while time.time() < deadline:
+                n = w.total_written_records
+                if t_first is None and n > 0:
+                    t_first = time.perf_counter() - t0
+                if n >= rows:
+                    t_written = time.perf_counter() - t0
+                    break
+                time.sleep(0.002)
+            while time.time() < deadline:
+                if (sum(broker.committed(group, "e2e", p)
+                        for p in range(parts)) >= rows
+                        and w.ack_lag()["unacked_records"] == 0):
+                    break
+                time.sleep(0.01)
+            else:
+                raise RuntimeError(
+                    f"procs replay never drained (lag {w.ack_lag()})")
+            if t_written is None or t_first is None:
+                raise RuntimeError("procs replay never finished writing")
+            t_drain = time.perf_counter() - t0
+            lag = w.ack_lag()
+        finally:
+            w.close()
+            shutil.rmtree(target, ignore_errors=True)
+        return t_written - t_first, t_first, t_drain, lag
+
+    if smoke:
+        steady, ramp, drain_s, lag = one_run(2)
+        # smoke rate = post-spawn drain rate: the tiny reduced shape can
+        # be fully in flight before the first written record lands, which
+        # makes the steady-window rate degenerate; the smoke only GATES
+        # on ack-lag draining to exactly 0 anyway
+        out = {
+            "metric": "e2e_proc_records_per_sec",
+            "value": round(rows / max(1e-9, drain_s - ramp), 1),
+            "rows": rows,
+            "worker_processes": 2,
+            "steady_seconds": round(steady, 3),
+            "spawn_ramp_seconds": round(ramp, 3),
+            "drain_seconds": round(drain_s, 3),
+            "final_ack_lag": lag,
+            "ack_lag_zero": lag["unacked_records"] == 0,
+            "smoke": True,
+        }
+        print(f"[bench:procs] smoke: {out['value']:,.0f} rec/s through 2 "
+              f"worker processes; final lag {lag['unacked_records']}",
+              file=sys.stderr)
+        return out
+
+    cap_before = _cpu_capacity_probe()
+    one_run(2)  # warm: page cache, spawn machinery, broker read path
+    p1, p2, ratios, ramps = [], [], [], []
+    for i in range(pairs):
+        order = (1, 2) if i % 2 == 0 else (2, 1)
+        pair = {}
+        for procs in order:
+            best = None
+            for _ in range(3):
+                steady, ramp, _, _ = one_run(procs)
+                ramps.append(ramp)
+                best = steady if best is None else min(best, steady)
+            pair[procs] = best
+        p1.append(pair[1])
+        p2.append(pair[2])
+        ratios.append(round(pair[1] / pair[2], 2))
+        print(f"[bench:procs] pair {i}: 1-proc {pair[1]:.3f}s vs 2-proc "
+              f"{pair[2]:.3f}s -> {ratios[-1]:.2f}x", file=sys.stderr)
+    cap_after = _cpu_capacity_probe()
+    # thread-mode context arm: same shape, 1 thread worker, local fs
+    t_threads = [one_run(0, threads=1)[0] for _ in range(3)]
+    m1, m2 = _median(p1), _median(p2)
+    cap_min = min(cap_before, cap_after)
+    speedup = round(m1 / m2, 2)
+    out = {
+        "metric": "e2e_proc_workers_speedup_x",
+        "value": speedup,
+        "rows": rows,
+        "partitions": parts,
+        "payload_bytes": payload_bytes,
+        "procs_sweep": {
+            "1": {"records_per_sec_median": round(rows / m1, 1),
+                  "steady_seconds": [round(t, 3) for t in p1]},
+            "2": {"records_per_sec_median": round(rows / m2, 1),
+                  "steady_seconds": [round(t, 3) for t in p2]},
+            "speedup_x": speedup,
+            "pair_ratios_x": ratios,
+            "pairs": pairs,
+            "policy": ("interleaved 1v2 pairs (order alternating), "
+                       "min-of-3 per arm per pair, speedup = ratio of "
+                       "arm medians on steady-state time-to-all-written "
+                       "(first written record -> all written; child "
+                       "spawn+import excluded, reported as "
+                       "spawn_ramp_seconds_median)"),
+        },
+        "thread_baseline_records_per_sec": round(
+            rows / _median(t_threads), 1),
+        "spawn_ramp_seconds_median": round(_median(ramps), 3),
+        "cpu_capacity_x": {"before": cap_before, "after": cap_after},
+        "capacity_gated": cap_min < 1.7,
+        "capacity_note": (
+            "cpu_capacity_x = aggregate 2-process spin throughput / "
+            "1-process, bracketing the sweep: the parallel CPU this "
+            "cpu-shares-capped box actually offered.  When the bracket "
+            "reads under ~1.7 of 2 cores the sweep is capacity-gated — "
+            "the 2-process arm cannot exceed what the box gives; re-run "
+            "on an idle >=2-core box for the absolute number."),
+        "scenario": ("FakeBroker primed via produce_many; spawned worker "
+                     "processes fed zero-copy through the shared-memory "
+                     "ring; full poll->dispatch->shred->encode->publish->"
+                     "ack drain to committed==rows AND ack-lag==0 per "
+                     "run; snappy, 4 MiB size rotation, 0.5 s time "
+                     "rotation, LocalFileSystem sink (cfg6 shape)"),
+    }
+    print(f"[bench:procs] 2-process speedup {speedup:.2f}x "
+          f"(1p {rows / m1:,.0f} vs 2p {rows / m2:,.0f} rec/s; thread "
+          f"baseline {out['thread_baseline_records_per_sec']:,.0f}); "
+          f"capacity bracket {cap_before}-{cap_after} "
+          f"{'(CAPACITY-GATED)' if out['capacity_gated'] else ''}",
+          file=sys.stderr)
+    return out
+
+
 # ---------------------------------------------------------------------------
 # config 7: nested streaming replay (cfg5 shape through the FULL writer)
 # ---------------------------------------------------------------------------
@@ -3754,7 +3947,7 @@ def main() -> None:
     if not any(f in sys.argv
                for f in ("--all", "--rowgroup", "--hostasm", "--config",
                          "--obs", "--chaos", "--crash", "--degrade",
-                         "--e2e", "--compact", "--scan")):
+                         "--e2e", "--compact", "--scan", "--procs")):
         # default graded path: jax-free orchestrator (see _graded_main)
         _graded_main()
         return
@@ -3774,7 +3967,7 @@ def main() -> None:
             or "--obs" in sys.argv or "--chaos" in sys.argv
             or "--crash" in sys.argv or "--degrade" in sys.argv
             or "--e2e" in sys.argv or "--compact" in sys.argv
-            or "--scan" in sys.argv):
+            or "--scan" in sys.argv or "--procs" in sys.argv):
         # --hostasm/--obs/--chaos/--crash/--degrade/--e2e/--compact/--scan
         # measure HOST work only and must never grab the real chip; the
         # switch must precede the first device use below
@@ -4094,6 +4287,31 @@ def main() -> None:
         summary = {k: v for k, v in out.items()
                    if k not in ("outcome",)}
         summary["invariant_holds"] = out["outcome"]["invariant_holds"]
+        summary["artifact"] = os.path.basename(path)
+        print(json.dumps(summary))
+        return
+    if "--procs" in sys.argv:
+        # the --e2e bench's process-workers sweep (usable as `--e2e
+        # --procs` or bare `--procs`): own artifact (BENCH_E2E_r15.json),
+        # never touches the r14 thread-mode artifact
+        if "--smoke" in sys.argv:
+            # the CI gate: reduced replay through >=2 worker processes,
+            # never writes the artifact, exits nonzero unless ack-lag
+            # drained to exactly 0
+            out = procs_probe(smoke=True)
+            print(json.dumps(out))
+            sys.exit(0 if out["ack_lag_zero"] else 5)
+        out = procs_probe()
+        path = os.environ.get(
+            "KPW_PROCS_PATH",
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "BENCH_E2E_r15.json"))
+        with open(path, "w") as f:
+            json.dump(out, f, indent=1)
+        print(f"[bench:procs] artifact written to {path}", file=sys.stderr)
+        summary = {k: v for k, v in out.items()
+                   if k not in ("procs_sweep", "scenario", "capacity_note")}
+        summary["procs_speedup_x"] = out["procs_sweep"]["speedup_x"]
         summary["artifact"] = os.path.basename(path)
         print(json.dumps(summary))
         return

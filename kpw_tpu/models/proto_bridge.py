@@ -637,7 +637,6 @@ class ProtoColumnarizer:
         :meth:`columnarize_payloads`."""
         if not self.wire_capable:
             raise ValueError("schema is not wire-shreddable")
-        buf = bytes(buf)  # no-op for bytes; one copy for memoryview input
         offs = np.ascontiguousarray(offsets, np.int64)
         n = len(offs) - 1
         # validate the caller-supplied offset table before any decoder
@@ -648,13 +647,11 @@ class ProtoColumnarizer:
             raise ValueError(
                 "offsets must be ascending and within the buffer")
         if self._wire is None:
-            return self._shred_nested(buf, offs)
+            return self._shred_nested(bytes(buf), offs)
         plan: _WirePlan = self._wire
         from ..native import lib as _native_lib, pyshred as _pyshred
 
         L = _native_lib()
-        out_vals, out_pos, out_len, out_pres = \
-            self._alloc_flat_outputs(plan, n)
         # prefer the C-extension entry (shred_flat_buf/gather_buf): decode
         # and gather run with the GIL RELEASED, so the encode pipeline
         # thread overlaps them — the ctypes route's per-call marshalling
@@ -662,6 +659,13 @@ class ProtoColumnarizer:
         pys = _pyshred()
         shred_buf = getattr(pys, "shred_flat_buf", None)
         gather_buf = getattr(pys, "gather_buf", None)
+        if shred_buf is None or gather_buf is None:
+            # ctypes fallback route needs real bytes; the C entries take
+            # any buffer (a memoryview of a shared-memory ring slot stays
+            # zero-copy — the process-workers handoff depends on it)
+            buf = bytes(buf)
+        out_vals, out_pos, out_len, out_pres = \
+            self._alloc_flat_outputs(plan, n)
         if shred_buf is not None:
             if not plan._cont:
                 plan._cont = (np.ascontiguousarray(plan.fnum, np.uint32),

@@ -124,6 +124,13 @@ class Builder:
         # small-file compaction service (opt-in): background merge of
         # published under-size files into ~target-size files (io/compact.py)
         self._compaction: dict | None = None
+        # process-parallel workers (opt-in): N spawned worker subprocesses
+        # fed batches zero-copy through a shared-memory ring
+        # (runtime/procworkers.py); 0 = thread workers (thread_count)
+        self._proc_workers = 0
+        self._proc_ring_slots = 16
+        self._proc_slot_bytes = 1 << 20
+        self._proc_max_inflight = 8
 
     # -- required ----------------------------------------------------------
     def broker(self, broker) -> "Builder":
@@ -664,6 +671,46 @@ class Builder:
         }
         return self
 
+    def process_workers(self, n: int, *, ring_slots: int = 16,
+                        slot_bytes: int = 1 << 20,
+                        max_inflight_units: int = 8) -> "Builder":
+        """Process-parallel workers (``runtime/procworkers.py``): run the
+        shred → encode → assemble → publish leg in ``n`` **spawned**
+        subprocesses instead of ``thread_count`` threads, escaping the
+        single-interpreter GIL ceiling.  Batches cross the process
+        boundary zero-copy through a ``multiprocessing.shared_memory``
+        ring of ``ring_slots`` × ``slot_bytes`` batch slots (parent
+        stages the poll batch with one memcpy — the same single copy the
+        thread path pays out of the broker log — and the child shreds the
+        slot's buffer in place); offsets stay tracked and acked in the
+        parent, committed only on the child's published-file
+        acknowledgment, so at-least-once is unchanged.  The supervisor
+        (``supervise``), watchdog (``watchdog`` — a condemned child is
+        SIGKILLed and its slot restarted) and ``stats()`` operate on
+        process slots exactly as on threads; per-child rss / ring
+        occupancy / restart counts land in ``stats()['procs']``.
+
+        ``max_inflight_units`` bounds un-acked dispatched units per child
+        (bounds redelivery work after a kill).  Constraints (validated at
+        ``build()``): spawn start method only (fork with live jax threads
+        deadlocks), a ``LocalFileSystem`` sink, a protobuf message class
+        (children rebuild it from its descriptor), no ``partition_by``,
+        and a cpu/native/auto encoder backend.  ``n=0`` restores thread
+        workers."""
+        if n < 0:
+            raise ValueError("process_workers must be >= 0")
+        if ring_slots < 2:
+            raise ValueError("ring_slots must be >= 2")
+        if slot_bytes < 4096:
+            raise ValueError("slot_bytes must be >= 4096")
+        if max_inflight_units < 1:
+            raise ValueError("max_inflight_units must be >= 1")
+        self._proc_workers = n
+        self._proc_ring_slots = ring_slots
+        self._proc_slot_bytes = slot_bytes
+        self._proc_max_inflight = max_inflight_units
+        return self
+
     def on_parse_error(self, policy: str) -> "Builder":
         """'raise' (reference parity: poison pill kills the worker,
         KPW.java:271-275), 'skip' (log + ack), or 'dead_letter' (raw payload
@@ -813,6 +860,32 @@ class Builder:
             self._group_id = f"KafkaProtoParquetWriter-{self._instance_name}"
         if self._filesystem is None:
             self._filesystem = LocalFileSystem()
+        if self._proc_workers:
+            # process mode crosses an interpreter boundary: everything a
+            # child needs must be reconstructible from picklable config.
+            # Fail here, at build(), not inside a spawned child.
+            if type(self._filesystem) is not LocalFileSystem:
+                raise ValueError(
+                    "process_workers requires a plain LocalFileSystem sink "
+                    "(children open their own file handles; in-memory and "
+                    "composite filesystems do not cross a process boundary)")
+            if self._partitioner is not None:
+                raise ValueError(
+                    "process_workers does not support partition_by yet "
+                    "(routing needs the parsed message in the parent)")
+            if self._backend not in (None, "cpu", "native", "auto"):
+                raise ValueError(
+                    f"process_workers supports cpu/native/auto encoder "
+                    f"backends, not {self._backend!r}")
+            if not self._parser_is_default:
+                raise ValueError(
+                    "process_workers does not support a custom parser(): "
+                    "spawned children decode payloads with the wire "
+                    "shredder / proto_class.FromString, so a transforming "
+                    "parser would be silently ignored")
+            from .procworkers import _proto_spec
+
+            _proto_spec(self._proto_class)  # raises if not descriptor-backed
 
         from .writer import KafkaProtoParquetWriter
 

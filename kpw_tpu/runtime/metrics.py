@@ -373,6 +373,15 @@ BLOOM_BYTES_METER = "parquet.writer.bloom.bytes"
 # Builder.native_assembly(False))
 NATIVE_ASM_CHUNKS_METER = "parquet.writer.assembly.native.chunks"
 NATIVE_ASM_PAGES_METER = "parquet.writer.assembly.native.pages"
+# process-parallel-workers layer (runtime/procworkers.py): the
+# shared-memory batch ring's slot count and live free slots, records
+# dispatched-but-unacked across children, aggregate child rss, and live
+# child process count — registered when Builder.process_workers is on
+PROC_RING_SLOTS_GAUGE = "worker.proc.ring.slots"
+PROC_RING_FREE_GAUGE = "worker.proc.ring.free"
+PROC_INFLIGHT_GAUGE = "worker.proc.inflight.records"
+PROC_RSS_GAUGE = "worker.proc.rss.bytes"
+PROC_ALIVE_GAUGE = "worker.proc.alive"
 
 # the canonical registry docs cite from (tools/check_docs.py verifies
 # every doc-cited metric name is listed here)
@@ -410,4 +419,9 @@ METRIC_NAMES = (
     BLOOM_BYTES_METER,
     NATIVE_ASM_CHUNKS_METER,
     NATIVE_ASM_PAGES_METER,
+    PROC_RING_SLOTS_GAUGE,
+    PROC_RING_FREE_GAUGE,
+    PROC_INFLIGHT_GAUGE,
+    PROC_RSS_GAUGE,
+    PROC_ALIVE_GAUGE,
 )

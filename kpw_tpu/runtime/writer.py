@@ -40,6 +40,7 @@ from ..utils.tracing import stage
 from . import metrics as M
 from .parquet_file import ParquetFile
 from .partition import normalize_partition_path
+from .procworkers import ProcessWorkerPool
 from .retry import RetryInterrupted, RetryPolicy
 from .watchdog import Heartbeat, Watchdog
 
@@ -70,6 +71,48 @@ def _format_now(pattern: str) -> str:
     if "%3f" in pattern:
         pattern = pattern.replace("%3f", f"{now.microsecond // 1000:03d}")
     return now.strftime(pattern)
+
+
+def publish_rename(fs, retried, tmp_path: str, dest_dir: str, name: str,
+                   durable: bool) -> str:
+    """The publish tail shared by the thread worker and the process-mode
+    child (procworkers._ChildWorker) so the protocol cannot drift:
+
+    * millisecond timestamps can collide when one worker finalizes twice
+      in the same tick; rename overwrites (os.replace / HDFS-adapter
+      replace), which would silently destroy an already-acked published
+      file — disambiguate with a numeric suffix instead (the suffix only
+      ever appears under collision);
+    * the destination is computed ONCE, outside the retried closure: a
+      durable publish can fail AFTER its rename landed (the trailing dir
+      fsync), and the retry must resume the SAME (src, dst) pair —
+      recomputing a fresh timestamped name would orphan the renamed file
+      and spin on the vanished tmp.
+
+    ``retried(fn, label)`` is the caller's retry seam.  Returns the
+    published destination path."""
+    dest = f"{dest_dir}/{name}"
+    seq = 0
+    while fs.exists(dest):
+        seq += 1
+        stem, ext = (name.rsplit(".", 1) + [""])[:2]
+        dest = (f"{dest_dir}/{stem}-{seq}.{ext}" if ext
+                else f"{dest_dir}/{stem}-{seq}")
+
+    def do() -> None:
+        if durable:
+            # fsync tmp -> atomic rename -> fsync dest dir: after this
+            # the publish survives power loss, so the ack that follows
+            # can never point at a file the disk forgot.  Retry-safe:
+            # durable_rename resumes at the dir fsync when the rename
+            # already landed on a previous attempt
+            fs.durable_rename(tmp_path, dest)
+        else:
+            fs.rename(tmp_path, dest)
+        logger.info("Published %s", dest)
+
+    retried(do, "publish")
+    return dest
 
 
 def _rotation_batch_cap(max_file_size: int,
@@ -116,13 +159,19 @@ class KafkaProtoParquetWriter:
             autotuner=self.autotuner,
         )
         self.consumer.subscribe(b._topic)
-        self._workers: list[_Worker] = []
+        self._workers: list = []
         self._started = False
         self._closed = False
+        # process-parallel mode (Builder.process_workers): the pool owns
+        # the shared-memory ring + dispatcher/collector threads; its
+        # slots ARE self._workers, so supervision/watchdog/stats operate
+        # on process slots through the same surface as threads
+        self._procpool: ProcessWorkerPool | None = None
         # supervision state: restart counts per worker index (kept across
         # replacements), the death-notice the supervisor sleeps on, and the
         # terminal verdict once every restart budget is exhausted
-        self._restart_counts: list[int] = [0] * b._thread_count
+        self._restart_counts: list[int] = (
+            [0] * (b._proc_workers or b._thread_count))
         self._dead_notice = threading.Event()
         self._close_event = threading.Event()
         self._supervisor: threading.Thread | None = None
@@ -252,10 +301,27 @@ class KafkaProtoParquetWriter:
         if self._b._verify_on_startup:
             self._verify_published()
         self.consumer.start()
-        for i in range(self._b._thread_count):
-            w = _Worker(self, i)
-            self._workers.append(w)
-            w.start()
+        if self._b._proc_workers:
+            self._procpool = ProcessWorkerPool(self)
+            self._workers = self._procpool.slots
+            self._procpool.start()
+            reg = self._b._metric_registry
+            if reg:
+                pool = self._procpool
+                reg.gauge(M.PROC_RING_SLOTS_GAUGE, lambda: pool.ring.slots)
+                reg.gauge(M.PROC_RING_FREE_GAUGE, pool.ring_free)
+                reg.gauge(M.PROC_INFLIGHT_GAUGE,
+                          lambda: sum(s.inflight_units()
+                                      for s in pool.slots))
+                reg.gauge(M.PROC_RSS_GAUGE,
+                          lambda: sum(s.rss_bytes() for s in pool.slots))
+                reg.gauge(M.PROC_ALIVE_GAUGE,
+                          lambda: sum(1 for s in pool.slots if s.alive()))
+        else:
+            for i in range(self._b._thread_count):
+                w = _Worker(self, i)
+                self._workers.append(w)
+                w.start()
         if self._b._supervise:
             self._supervisor = threading.Thread(
                 target=self._supervise_loop,
@@ -423,6 +489,17 @@ class KafkaProtoParquetWriter:
     def _notify_worker_death(self) -> None:
         self._dead_notice.set()
 
+    def _make_worker(self, i: int):
+        """Replace worker slot ``i`` with a fresh (not yet started) one —
+        a thread ``_Worker`` or, in process mode, a respawned
+        ``_ProcWorkerSlot`` (the pool reclaims the dead child's un-drained
+        ring slots first).  Both land in ``self._workers[i]``."""
+        if self._procpool is not None:
+            return self._procpool.respawn_slot(i)
+        nw = _Worker(self, i)
+        self._workers[i] = nw
+        return nw
+
     def _supervise_loop(self) -> None:
         """Detect dead workers and restart them with capped restarts +
         exponential backoff.  A restarted worker's held (unacked) offsets
@@ -452,12 +529,14 @@ class KafkaProtoParquetWriter:
                 if self._restart_counts[i] >= b._max_worker_restarts:
                     self._check_terminal()
                     continue
-                # let the dying thread finish its cleanup (file abandon)
+                # let the dying worker finish its cleanup (file abandon)
                 # before reading its held runs — unless it is HUNG in an
                 # IO call that may never return (watchdog condemnation):
                 # waiting 10 s per restart would serialize recovery behind
-                # the very stall being recovered from
-                w._thread.join(timeout=0.2 if w.condemned else 10)
+                # the very stall being recovered from.  Process slots join
+                # the child process; a condemned one was SIGKILLed, so the
+                # short join suffices either way.
+                w.join(timeout=0.2 if w.condemned else 10)
                 delay = min(b._restart_backoff
                             * (2 ** self._restart_counts[i]), 5.0)
                 if self._close_event.wait(delay):
@@ -468,8 +547,7 @@ class KafkaProtoParquetWriter:
                 # on the bounded queue when it is full, and with
                 # thread_count=1 the replacement is the only consumer that
                 # can make space — the reverse order deadlocks
-                nw = _Worker(self, i)
-                self._workers[i] = nw
+                nw = self._make_worker(i)
                 nw.start()
                 try:
                     for part, start, end in w.held_runs():
@@ -511,6 +589,8 @@ class KafkaProtoParquetWriter:
         if self._watchdog_obj is not None and self._watchdog_obj.any_stalled():
             return False
         if self._paused:
+            return False
+        if self._procpool is not None and not self._procpool.healthy():
             return False
         return (all(w.alive() and not w.failed for w in self._workers)
                 and self.consumer.fetcher_alive())
@@ -557,6 +637,10 @@ class KafkaProtoParquetWriter:
             self._compactor.close(timeout=rem(5))
         if self._supervisor is not None:
             self._supervisor.join(timeout=rem(30))
+        if self._procpool is not None:
+            # stop dispatch FIRST: no new units reach the ring while the
+            # children drain their queues and exit on poison
+            self._procpool.close(timeout=rem(10))
         hung_workers: list[int] = []
         for w in self._workers:
             # deadline mode never abandons a file whose (possibly hung)
@@ -566,6 +650,10 @@ class KafkaProtoParquetWriter:
                             abandon_if_hung=(deadline is None))
             if not clean:
                 hung_workers.append(w.index)
+        if self._procpool is not None:
+            # children are joined (or killed): drain the last acks, stop
+            # the collector, unlink the shared-memory ring
+            self._procpool.finalize(timeout=rem(5))
         self.consumer.close(timeout=rem(10))
         report = {
             "deadline_s": deadline,
@@ -773,6 +861,11 @@ class KafkaProtoParquetWriter:
         }
         if self._compactor is not None:
             out["compactor"] = self._compactor.compactor_stats()
+        # process-mode block only when the pool exists (mirrors
+        # watchdog/failover/compactor): ring occupancy, per-child rss +
+        # in-flight units + restart counts, dispatcher/collector counters
+        if self._procpool is not None:
+            out["procs"] = self._procpool.snapshot()
         # writer-OWNED tracing only: the process-global seam may hold a
         # different writer's (or the user's) instruments, and attributing
         # their timings to this writer would be misdirection — users who
@@ -885,6 +978,11 @@ class _Worker:
 
     def alive(self) -> bool:
         return self._thread.is_alive()
+
+    def join(self, timeout: float | None = None) -> None:
+        """Common slot surface with the process-mode worker: the
+        supervisor joins a dead slot before reading its held runs."""
+        self._thread.join(timeout)
 
     def held_runs(self) -> list[tuple[int, int, int]]:
         """Every offset run this worker consumed but never acked, as
@@ -1704,11 +1802,6 @@ class _Worker:
                     f"tmp file failed structural verification and was "
                     f"quarantined to {qpath}: {rep.errors[:3]}")
 
-        # the destination is computed ONCE, outside the retried closure: a
-        # durable publish can fail AFTER its rename landed (the trailing
-        # dir fsync), and the retry must resume the SAME (src, dst) pair —
-        # recomputing a fresh timestamped name would orphan the renamed
-        # file and spin on the vanished tmp
         dest_dir = self.p.target_dir
         if subdir:
             # partition subtree first, then the optional date pattern —
@@ -1720,30 +1813,5 @@ class _Worker:
         if pattern:
             dest_dir = f"{dest_dir}/{_format_now(pattern)}"
             self._retry(lambda d=dest_dir: self.p.fs.mkdirs(d), "publish")
-        name = self._new_file_name()
-        dest = f"{dest_dir}/{name}"
-        # millisecond timestamps can collide when one worker finalizes
-        # twice in the same tick; rename here overwrites (os.replace /
-        # HDFS-adapter replace), which would silently destroy an
-        # already-acked published file — disambiguate instead (the
-        # suffix only ever appears under collision)
-        seq = 0
-        while self.p.fs.exists(dest):
-            seq += 1
-            stem, ext = (name.rsplit(".", 1) + [""])[:2]
-            dest = (f"{dest_dir}/{stem}-{seq}.{ext}" if ext
-                    else f"{dest_dir}/{stem}-{seq}")
-
-        def do() -> None:
-            if self.p._b._durable_publish:
-                # fsync tmp -> atomic rename -> fsync dest dir: after this
-                # the publish survives power loss, so the ack that follows
-                # can never point at a file the disk forgot.  Retry-safe:
-                # durable_rename resumes at the dir fsync when the rename
-                # already landed on a previous attempt
-                self.p.fs.durable_rename(tmp_path, dest)
-            else:
-                self.p.fs.rename(tmp_path, dest)
-            logger.info("Published %s", dest)
-
-        self._retry(do, "publish")
+        publish_rename(self.p.fs, self._retry, tmp_path, dest_dir,
+                       self._new_file_name(), self.p._b._durable_publish)

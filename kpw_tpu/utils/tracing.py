@@ -43,6 +43,8 @@ STAGE_NAMES = (
     "worker.shred",
     "worker.append",
     "worker.publish",
+    "worker.proc.dispatch",
+    "worker.proc.ack",
     "rowgroup.encode",
     "rowgroup.launch",
     "rowgroup.assemble",

@@ -1,0 +1,14 @@
+"""Fixture for the spawn-safety pass: multiprocessing without the spawn
+start method pinned — the fork-with-live-jax-threads deadlock class.
+Must trip spawn-safety and ONLY spawn-safety."""
+
+import multiprocessing
+
+
+def launch(payload):
+    # default context = fork on Linux: the deadlock class
+    p = multiprocessing.Process(target=print, args=(payload,))
+    p.start()
+    # an explicit fork context is just as bad
+    ctx = multiprocessing.get_context("fork")
+    return p, ctx

@@ -26,6 +26,7 @@ FIXTURE_EXPECT = {
     "unregistered_name.py": "canonical-names",
     "fault_import.py": "fault-isolation",
     "swallowed.py": "swallowed-exceptions",
+    "spawn_unpinned.py": "spawn-safety",
 }
 
 
@@ -116,7 +117,7 @@ def test_pass_registry_matches_modules():
     # the names check_docs reconciles README against
     assert set(PASS_NAMES) == {
         "lock-discipline", "hot-imports", "canonical-names",
-        "fault-isolation", "swallowed-exceptions"}
+        "fault-isolation", "swallowed-exceptions", "spawn-safety"}
 
 
 def test_hotimport_allowlist_entries_all_justified():

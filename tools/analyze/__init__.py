@@ -22,6 +22,8 @@ Passes (see each module's docstring for the rule and its history):
   tests/ (tools/analyze/faultiso.py)
 * ``swallowed-exceptions`` — no bare/do-nothing broad handlers
   (tools/analyze/swallow.py)
+* ``spawn-safety`` — multiprocessing must pin the spawn start method;
+  no fork-after-jax-import (tools/analyze/spawnsafety.py)
 
 Suppression is per-site and justified: ``# lint: <pass> ok — <reason>``
 on the flagged line or the line above.  A reason-less annotation is
@@ -31,7 +33,7 @@ live interleaving exposes) is ``kpw_tpu/utils/lockcheck.py``.
 
 from __future__ import annotations
 
-from . import faultiso, hotimports, locks, names, swallow
+from . import faultiso, hotimports, locks, names, spawnsafety, swallow
 
 # registration order = report order
 PASSES = {
@@ -40,6 +42,7 @@ PASSES = {
     names.PASS_NAME: names,
     faultiso.PASS_NAME: faultiso,
     swallow.PASS_NAME: swallow,
+    spawnsafety.PASS_NAME: spawnsafety,
 }
 
 PASS_NAMES = tuple(PASSES)

@@ -1,0 +1,141 @@
+"""Spawn-safety pass: every ``multiprocessing`` use must pin the spawn
+start method; fork is banned outright.
+
+The process-workers PR (runtime/procworkers.py) crosses an interpreter
+boundary, and the repo's recorded gotcha is hard-won: **fork with live
+jax/XLA threads deadlocks the child** (the forked interpreter inherits a
+mutex snapshot whose owners no longer exist; bench.py's capacity probe
+hit exactly this before pinning spawn).  The platform default start
+method is fork on Linux, so any ``multiprocessing`` construction that
+does NOT go through ``get_context("spawn")`` silently inherits the
+deadlock.  This pass mechanizes the rule for the production tree:
+
+* constructing start-method-sensitive objects (``Process``, ``Pool``,
+  ``Queue``, ``Manager``, shared ctypes, ...) directly off the
+  ``multiprocessing`` module — or importing those names from it — is a
+  finding: route them through a ``get_context("spawn")`` context object;
+* ``get_context()`` with no argument, a non-literal argument, or any
+  method other than ``"spawn"`` is a finding;
+* ``set_start_method`` with anything but ``"spawn"`` is a finding
+  (``"spawn"`` itself is allowed but the context-object form is
+  preferred: it cannot be clobbered by a library race);
+* ``os.fork`` / ``os.forkpty`` anywhere in ``kpw_tpu/`` is a finding —
+  the fork-after-jax-import pattern has no safe call site in a package
+  that imports jax.
+
+``multiprocessing.shared_memory`` carries no start method and is exempt.
+Suppression: ``# lint: spawn-safety ok — <reason>`` per site.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .common import Config, Finding, ParsedFile, suppressed
+
+PASS_NAME = "spawn-safety"
+DESCRIPTION = ("multiprocessing must pin the spawn start method "
+               "(fork with live jax threads deadlocks); no os.fork")
+
+# names whose construction binds a start method; reaching them through
+# the module object (default context = fork on Linux) is the bug class
+_SENSITIVE = frozenset({
+    "Process", "Pool", "Queue", "SimpleQueue", "JoinableQueue", "Pipe",
+    "Manager", "Value", "Array", "Event", "Lock", "RLock", "Semaphore",
+    "BoundedSemaphore", "Condition", "Barrier",
+})
+
+
+def _mp_aliases(tree: ast.Module) -> tuple[set[str], list]:
+    """(names bound to the multiprocessing module, findings-worthy
+    ``from multiprocessing import <sensitive>`` nodes)."""
+    aliases: set[str] = set()
+    bad_froms: list = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "multiprocessing":
+                    aliases.add(a.asname or "multiprocessing")
+        elif isinstance(node, ast.ImportFrom) and node.level == 0:
+            if node.module == "multiprocessing":
+                for a in node.names:
+                    if a.name in _SENSITIVE:
+                        bad_froms.append((node, a.name))
+    return aliases, bad_froms
+
+
+def _literal_arg(call: ast.Call):
+    if call.args and isinstance(call.args[0], ast.Constant):
+        return call.args[0].value
+    for kw in call.keywords:
+        if kw.arg == "method" and isinstance(kw.value, ast.Constant):
+            return kw.value.value
+    return None
+
+
+def run(files: dict[str, ParsedFile], cfg: Config) -> list[Finding]:
+    findings: list[Finding] = []
+    for pf in files.values():
+        aliases, bad_froms = _mp_aliases(pf.tree)
+        for node, name in bad_froms:
+            if suppressed(pf, PASS_NAME, node.lineno, findings):
+                continue
+            findings.append(Finding(
+                PASS_NAME, pf.path, node.lineno,
+                f"`from multiprocessing import {name}` binds the platform "
+                f"default start method (fork on Linux — deadlocks with "
+                f"live jax threads); construct it off "
+                f"get_context(\"spawn\") instead"))
+        for node in ast.walk(pf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            # <mp-alias>.<Sensitive>(...) — default-context construction
+            if (isinstance(func, ast.Attribute)
+                    and isinstance(func.value, ast.Name)
+                    and func.value.id in aliases
+                    and func.attr in _SENSITIVE):
+                if not suppressed(pf, PASS_NAME, node.lineno, findings):
+                    findings.append(Finding(
+                        PASS_NAME, pf.path, node.lineno,
+                        f"multiprocessing.{func.attr}(...) uses the "
+                        f"platform default start method (fork on Linux — "
+                        f"deadlocks with live jax threads); go through "
+                        f"get_context(\"spawn\")"))
+                continue
+            fname = (func.attr if isinstance(func, ast.Attribute)
+                     else func.id if isinstance(func, ast.Name) else None)
+            if fname == "get_context":
+                # only multiprocessing's get_context (module attr, or a
+                # bare name imported from multiprocessing / used in a
+                # module that imports it) — decimal.getcontext etc. don't
+                # match this spelling
+                method = _literal_arg(node)
+                if method != "spawn":
+                    if not suppressed(pf, PASS_NAME, node.lineno, findings):
+                        findings.append(Finding(
+                            PASS_NAME, pf.path, node.lineno,
+                            f"get_context({method!r}) does not pin the "
+                            f"spawn start method — fork with live jax "
+                            f"threads deadlocks; use "
+                            f"get_context(\"spawn\")"))
+            elif fname == "set_start_method":
+                method = _literal_arg(node)
+                if method != "spawn":
+                    if not suppressed(pf, PASS_NAME, node.lineno, findings):
+                        findings.append(Finding(
+                            PASS_NAME, pf.path, node.lineno,
+                            f"set_start_method({method!r}) — only "
+                            f"\"spawn\" is safe in a package with live "
+                            f"jax threads"))
+            elif (isinstance(func, ast.Attribute)
+                  and isinstance(func.value, ast.Name)
+                  and func.value.id == "os"
+                  and func.attr in ("fork", "forkpty")):
+                if not suppressed(pf, PASS_NAME, node.lineno, findings):
+                    findings.append(Finding(
+                        PASS_NAME, pf.path, node.lineno,
+                        f"os.{func.attr}() in the production tree: the "
+                        f"fork-after-jax-import pattern deadlocks the "
+                        f"child; spawn a fresh interpreter instead"))
+    return findings

@@ -145,6 +145,33 @@ CHECKS = [
      ["scan:row_groups_pushdown.pruned", "scan:row_groups_pushdown.total"]),
     ("PARITY.md", r"`observed_fpp` ([\d.]+) \(budget ([\d.]+)\)",
      ["scan:bloom.observed_fpp", "scan:bloom.configured_fpp"]),
+    # process-parallel-workers PR: the 1v2 process sweep, its capacity
+    # bracket, and the thread-mode context arm reconcile against the
+    # procs artifact (`procs:` prefix, BENCH_E2E_r15.json); the r14
+    # thread-sweep contrast quote reconciles against the e2e artifact
+    ("README.md", r"records\s+`speedup_x` \*\*([\d.]+)x\*\* at 2 worker "
+                  r"processes \(1 process \*\*([\d.]+)k\*\* vs 2\s+"
+                  r"processes \*\*([\d.]+)k\*\*",
+     ["procs:procs_sweep.speedup_x",
+      ("procs:procs_sweep.1.records_per_sec_median", 1e3),
+      ("procs:procs_sweep.2.records_per_sec_median", 1e3)]),
+    ("README.md", r"`cpu_capacity_x` probes read\s+\*\*([\d.]+)\*\*–"
+                  r"\*\*([\d.]+)\*\* of this box's 2 cores",
+     ["procs:cpu_capacity_x.before", "procs:cpu_capacity_x.after"]),
+    ("README.md", r"thread-mode context arm measured \*\*([\d.]+)k\*\*\s+"
+                  r"records/s",
+     [("procs:thread_baseline_records_per_sec", 1e3)]),
+    ("README.md", r"r14 THREAD sweep measured 1→2 workers at "
+                  r"\*\*([\d.]+)x\*\*",
+     ["e2e:workers_sweep.speedup_x"]),
+    ("PARITY.md", r"sweep records `speedup_x` \*\*([\d.]+)x\*\* at 2 "
+                  r"worker processes",
+     ["procs:procs_sweep.speedup_x"]),
+    ("PARITY.md", r"reading\s+\*\*([\d.]+)\*\*–\*\*([\d.]+)\*\* of this "
+                  r"box's 2 cores, `capacity_gated` true",
+     ["procs:cpu_capacity_x.before", "procs:cpu_capacity_x.after"]),
+    ("PARITY.md", r"r14 thread sweep's \*\*([\d.]+)x\*\* at 1→2 workers",
+     ["e2e:workers_sweep.speedup_x"]),
 ]
 
 
@@ -444,6 +471,12 @@ def main() -> int:
         "KPW_SCAN_PATH", os.path.join(ROOT, "BENCH_SCAN_r13.json"))
     if os.path.exists(scan_path):
         key_record["scan"] = json.load(open(scan_path))
+    # the process-parallel-workers artifact (bench.py --procs) is the
+    # ninth
+    procs_path = os.environ.get(
+        "KPW_PROCS_PATH", os.path.join(ROOT, "BENCH_E2E_r15.json"))
+    if os.path.exists(procs_path):
+        key_record["procs"] = json.load(open(procs_path))
     docs = {f: open(os.path.join(ROOT, f)).read()
             for f in ({c[0] for c in CHECKS} | set(KEY_DOCS)
                       | set(NAME_DOCS))}
@@ -473,6 +506,8 @@ def main() -> int:
                 root, spec = key_record.get("compact", {}), spec[8:]
             elif spec.startswith("scan:"):
                 root, spec = key_record.get("scan", {}), spec[5:]
+            elif spec.startswith("procs:"):
+                root, spec = key_record.get("procs", {}), spec[6:]
             try:
                 expect = float(art(root, spec)) / scale
             except (KeyError, TypeError):

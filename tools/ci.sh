@@ -26,9 +26,14 @@
 #                          nogil assembly path; exits nonzero unless
 #                          ack-lag drains to exactly 0, committed
 #                          artifact never overwritten)
-#   6. doc reconciliation — python tools/check_docs.py (every doc-cited
+#   6. process-mode smoke — python bench.py --procs --smoke (reduced
+#                          replay through >=2 spawned worker processes
+#                          fed via the shared-memory ring; exits nonzero
+#                          unless ack-lag drains to exactly 0, committed
+#                          artifact never overwritten)
+#   7. doc reconciliation — python tools/check_docs.py (every doc-cited
 #                          number/name/test/pass exists and matches)
-#   7. sanitizer smoke   — bash tools/sanitize.sh --smoke (ASan/UBSan
+#   8. sanitizer smoke   — bash tools/sanitize.sh --smoke (ASan/UBSan
 #                          native build + fuzz; prints a LOUD notice and
 #                          exits 0 when the toolchain is absent — never
 #                          a silent pass)
@@ -41,10 +46,10 @@ cd "$(dirname "$0")/.."
 fail=0
 step() { echo; echo "=== ci.sh [$1] $2 ==="; }
 
-step 1/7 "lint suite (python -m tools.analyze)"
+step 1/8 "lint suite (python -m tools.analyze)"
 python -m tools.analyze || fail=1
 
-step 2/7 "tier-1 pytest (-m 'not slow')"
+step 2/8 "tier-1 pytest (-m 'not slow')"
 # tier-1's exit code is nonzero on THIS container because of the known
 # environmental failures (python zstandard + jax shard_map absent — see
 # the CHANGES.md baseline), so the gate is mechanical instead of
@@ -67,19 +72,22 @@ if [ "$t1_errors" -gt 0 ] || [ "$t1_failed" -gt "$max_failed" ] \
 fi
 rm -f "$T1_LOG"
 
-step 3/7 "compaction smoke (bench.py --compact --smoke)"
+step 3/8 "compaction smoke (bench.py --compact --smoke)"
 JAX_PLATFORMS=cpu python bench.py --compact --smoke || fail=1
 
-step 4/7 "scan smoke (bench.py --scan --smoke)"
+step 4/8 "scan smoke (bench.py --scan --smoke)"
 JAX_PLATFORMS=cpu python bench.py --scan --smoke || fail=1
 
-step 5/7 "e2e smoke (bench.py --e2e --smoke)"
+step 5/8 "e2e smoke (bench.py --e2e --smoke)"
 JAX_PLATFORMS=cpu python bench.py --e2e --smoke || fail=1
 
-step 6/7 "doc reconciliation (tools/check_docs.py)"
+step 6/8 "process-mode smoke (bench.py --procs --smoke)"
+JAX_PLATFORMS=cpu python bench.py --procs --smoke || fail=1
+
+step 7/8 "doc reconciliation (tools/check_docs.py)"
 python tools/check_docs.py || fail=1
 
-step 7/7 "sanitizer smoke (tools/sanitize.sh --smoke)"
+step 8/8 "sanitizer smoke (tools/sanitize.sh --smoke)"
 bash tools/sanitize.sh --smoke || fail=1
 
 echo
