@@ -4,8 +4,10 @@ Built from scratch (JAX/XLA/Pallas for the encode path, C++ for host codecs)
 with the capability surface of the reference Java library
 ``sahabpardaz/kafka-parquet-writer`` (see SURVEY.md): smart-commit Kafka
 consumption with at-least-once delivery, multi-worker parquet writing with
-size/time rotation and atomic tmp→rename publish, and a pluggable
-EncoderBackend (CPU numpy reference vs vmapped TPU kernels).
+size/time rotation and atomic publish — tmp→rename on rename-capable
+sinks, multipart-complete on object stores (the publish protocol is a
+capability of the target FileSystem, io/fs.py ``publish_file``) — and a
+pluggable EncoderBackend (CPU numpy reference vs vmapped TPU kernels).
 """
 
 __version__ = "0.1.0"
@@ -36,6 +38,8 @@ from .ingest import (  # noqa: E402,F401
     SmartCommitConsumer,
 )
 from .io import (  # noqa: E402,F401
+    BandwidthBudget,
+    EmulatedObjectStore,
     FailoverFileSystem,
     FaultInjectingFileSystem,
     FaultSchedule,
@@ -43,4 +47,6 @@ from .io import (  # noqa: E402,F401
     InjectedFault,
     LocalFileSystem,
     MemoryFileSystem,
+    ObjectStoreFileSystem,
+    objectstore_persona,
 )

@@ -12,8 +12,15 @@ from .faults import (  # noqa: F401
     FaultInjectingFileSystem,
     FaultSchedule,
     InjectedFault,
+    objectstore_persona,
 )
 from .failover import FailoverFileSystem  # noqa: F401
+from .objectstore import (  # noqa: F401
+    BandwidthBudget,
+    BandwidthBudgetedFileSystem,
+    EmulatedObjectStore,
+    ObjectStoreFileSystem,
+)
 # NOTE: .verify is deliberately NOT imported here — it is a runnable module
 # (`python -m kpw_tpu.io.verify <file-or-dir>`), and a package-level import
 # would make runpy warn about the double import.  Import it directly:

@@ -53,7 +53,7 @@ import re
 import threading
 import time
 
-from .fs import FileSystem
+from .fs import FileSystem, publish_file
 from .verify import verify_file
 
 logger = logging.getLogger(__name__)
@@ -125,6 +125,18 @@ class Compactor:
         verifier's sort-vs-page-index consistency check AND declare every
         row group sorted before it publishes — a buggy sort can never
         reach readers.
+    bandwidth_bytes_per_s / request_budget_per_round / partition_quota:
+        The REMOTE tier (object-store targets, where compaction traffic
+        shares the fleet's network and every request is billed):
+        ``bandwidth_bytes_per_s`` throttles merge READS and merge-output
+        WRITES through one shared token bucket
+        (``io/objectstore.py`` :class:`BandwidthBudget` — observed
+        throughput stays <= budget); ``request_budget_per_round`` defers
+        further merge groups once a round has issued that many
+        filesystem requests; ``partition_quota`` caps merge groups
+        executed per partition directory per round so one hot partition
+        cannot monopolize the round.  All None by default (local tier:
+        no throttling, no accounting wrapper on the hot path).
     """
 
     def __init__(self, fs: FileSystem, target_dir: str, proto_class,
@@ -133,7 +145,10 @@ class Compactor:
                  scan_interval_s: float = 5.0, registry=None,
                  instance_name: str = "compactor",
                  batch_size: int = 4096,
-                 sort_by=None) -> None:
+                 sort_by=None,
+                 bandwidth_bytes_per_s: float | None = None,
+                 request_budget_per_round: int | None = None,
+                 partition_quota: int | None = None) -> None:
         # runtime imports are deferred (the failover-module pattern):
         # io.compact is imported during kpw_tpu.io package init, while
         # kpw_tpu.runtime may still be mid-initialization
@@ -194,6 +209,27 @@ class Compactor:
                 write_page_index=True,
                 sorting_columns=((self.sort_by, self.sort_descending,
                                   False),))
+        if bandwidth_bytes_per_s is not None and bandwidth_bytes_per_s <= 0:
+            raise ValueError("bandwidth_bytes_per_s must be positive")
+        if (request_budget_per_round is not None
+                and request_budget_per_round < 1):
+            raise ValueError("request_budget_per_round must be >= 1")
+        if partition_quota is not None and partition_quota < 1:
+            raise ValueError("partition_quota must be >= 1")
+        self.bandwidth_bytes_per_s = bandwidth_bytes_per_s
+        self.request_budget_per_round = request_budget_per_round
+        self.partition_quota = partition_quota
+        self._budget = None
+        if bandwidth_bytes_per_s is not None or request_budget_per_round:
+            # remote tier: wrap the sink in the byte-throttling +
+            # request-counting composite (reads and writes draw from ONE
+            # token bucket, so total traffic stays under the budget)
+            from .objectstore import (BandwidthBudget,
+                                      BandwidthBudgetedFileSystem)
+
+            if bandwidth_bytes_per_s is not None:
+                self._budget = BandwidthBudget(bandwidth_bytes_per_s)
+            fs = BandwidthBudgetedFileSystem(fs, self._budget)
         self.fs = fs
         self.target_dir = target_dir.rstrip("/")
         self.proto_class = proto_class
@@ -325,10 +361,28 @@ class Compactor:
         off."""
         groups = self.plan()
         summary = {"planned_groups": len(groups), "merged": 0, "retired": 0,
-                   "failed": 0, "rows": 0, "bytes_in": 0}
+                   "failed": 0, "rows": 0, "bytes_in": 0,
+                   "deferred_quota": 0, "deferred_requests": 0}
+        req0 = (self.fs.requests_total()
+                if hasattr(self.fs, "requests_total") else 0)
+        per_dir: dict[str, int] = {}
         for g in groups:
             if self._closed.is_set():
                 break
+            # remote-tier gates: per-partition quota (one hot partition
+            # must not monopolize the round) and the per-round request
+            # budget (deferred groups re-plan next round — the inputs
+            # are untouched, so deferral is always safe)
+            if (self.partition_quota is not None
+                    and per_dir.get(g.dir, 0) >= self.partition_quota):
+                summary["deferred_quota"] += 1
+                continue
+            if (self.request_budget_per_round is not None
+                    and (self.fs.requests_total() - req0
+                         >= self.request_budget_per_round)):
+                summary["deferred_requests"] += 1
+                continue
+            per_dir[g.dir] = per_dir.get(g.dir, 0) + 1
             try:
                 retired = self._execute(g)
                 if retired is None:
@@ -344,6 +398,8 @@ class Compactor:
                 logger.warning("compactor: merge round aborted on %r; "
                                "plans recover next round", e)
                 break
+        if hasattr(self.fs, "requests_total"):
+            summary["requests_used"] = self.fs.requests_total() - req0
         with self._mu:
             self._rounds += 1
             self._last_round = dict(summary)
@@ -383,10 +439,15 @@ class Compactor:
             return None
         dest = self._output_path(g)
         # tombstone destinations are fixed HERE and recorded in the plan:
-        # retire and crash-rollback must agree on where each input went
+        # retire and crash-rollback must agree on where each input went.
+        # The plan also records the merged TMP: on an object-store target
+        # that is the staging key of an uncompleted multipart upload, and
+        # recovery must be able to abort it deterministically
         pairs = [(p, self._tombstone_path(p)) for p in g.inputs]
-        self._write_plan(dest, g, pairs)
-        self.fs.durable_rename(tmp, dest)
+        self._write_plan(dest, g, pairs, tmp)
+        # the one publish decision point (io/fs.py): durable_rename on
+        # rename-capable sinks, multipart-complete on object stores
+        publish_file(self.fs, tmp, dest)
         self._merged_meter.mark()
         retired = self._retire(pairs)
         if retired == len(pairs):
@@ -543,10 +604,14 @@ class Compactor:
         return f"{self._plans_dir()}/{rel.replace('/', '__')}.plan.json"
 
     def _write_plan(self, dest: str, g: MergeGroup,
-                    pairs: list[tuple[str, str]]) -> None:
+                    pairs: list[tuple[str, str]],
+                    merge_tmp: str | None = None) -> None:
         """Durably record the merge BEFORE its publish: a crash after the
         publish can then always finish retiring the inputs instead of
-        leaving duplicate-published finals forever."""
+        leaving duplicate-published finals forever.  ``merge_tmp`` (the
+        staged merge output) rides along so a crash BETWEEN parts and
+        complete on an object-store target resolves deterministically:
+        rollback aborts exactly the upload the plan names."""
         self.fs.mkdirs(self._plans_dir())
         path = self._plan_path(dest)
         tmp = f"{path}.tmp"
@@ -555,9 +620,10 @@ class Compactor:
                 "output": dest,
                 "inputs": [{"path": p, "tombstone": t} for p, t in pairs],
                 "rows": g.rows,
+                "tmp": merge_tmp,
                 "instance": self.instance_name,
             }).encode())
-        self.fs.durable_rename(tmp, path)
+        publish_file(self.fs, tmp, path)
 
     def _drop_plan(self, dest: str) -> None:
         try:
@@ -623,13 +689,36 @@ class Compactor:
         means a retire/restore rename failed and the plan must be KEPT so
         the next round retries — idempotent in both directions (the
         quarantine of a torn output happens at most once; remaining
-        retires/restores are re-derived from what still exists)."""
+        retires/restores are re-derived from what still exists).
+
+        The multipart crash window (object-store targets): a crash
+        BETWEEN parts and complete leaves the plan, no output, and an
+        orphaned multipart upload at the plan's recorded ``tmp`` key —
+        rolled BACK deterministically (the upload is aborted via the
+        fs delete seam, the inputs were never retired); a crash AFTER
+        complete rolls FORWARD exactly like the rename protocol (the
+        output verifies, retiring finishes).  Aborted-or-completed, from
+        the write-ahead plan alone."""
         output = plan["output"]
         if self.fs.exists(output) and verify_file(self.fs, output).ok:
             pending = [(inp["path"], inp["tombstone"])
                        for inp in plan["inputs"]
                        if self.fs.exists(inp["path"])]
             return True, self._retire(pending) == len(pending)
+        merge_tmp = plan.get("tmp")
+        if merge_tmp and self.fs.exists(merge_tmp):
+            # the staged merge output the publish never completed: on an
+            # object store this ABORTS the orphaned multipart upload (and
+            # on a posix sink it sweeps the torn tmp) — the inputs are
+            # still published, so dropping the stage loses nothing
+            try:
+                self.fs.delete(merge_tmp)
+                logger.info("compactor: aborted orphaned merge stage %s "
+                            "from its write-ahead plan", merge_tmp)
+            except OSError as e:
+                logger.warning("compactor: could not abort orphaned merge "
+                               "stage %s (%r); the scoped tmp sweep "
+                               "retries", merge_tmp, e)
         if self.fs.exists(output):
             # torn publish: condemned, never deleted
             self._failed_meter.mark()
@@ -673,8 +762,23 @@ class Compactor:
 
     # -- observability -------------------------------------------------------
     def compactor_stats(self) -> dict:
+        remote = None
+        if (self.bandwidth_bytes_per_s is not None
+                or self.request_budget_per_round is not None
+                or self.partition_quota is not None):
+            remote = {
+                "bandwidth_bytes_per_s": self.bandwidth_bytes_per_s,
+                "request_budget_per_round": self.request_budget_per_round,
+                "partition_quota": self.partition_quota,
+                "requests_total": (self.fs.requests_total()
+                                   if hasattr(self.fs, "requests_total")
+                                   else None),
+            }
+            if self._budget is not None:
+                remote["budget"] = self._budget.observed()
         with self._mu:
             return {
+                "remote": remote,
                 "running": (self._thread is not None
                             and self._thread.is_alive()),
                 "target_size": self.target_size,

@@ -125,6 +125,19 @@ class FailoverFileSystem(FileSystem):
         from ..runtime import metrics as M
         from ..runtime.retry import FATAL_ERRNOS
 
+        # capability guard: the spill/reconcile protocol is built on the
+        # RENAME publish discipline (durable_rename onto the fallback,
+        # salvage renames, migrate-then-rename reconciliation).  A
+        # rename-less side (an object-store adapter) would silently fall
+        # back to non-atomic copy+delete mid-protocol — reject loudly at
+        # construction instead of drifting at the first degraded publish
+        for side, fs in (("primary", primary), ("fallback", fallback)):
+            if not getattr(fs, "supports_rename", True):
+                raise ValueError(
+                    f"FailoverFileSystem requires rename-capable "
+                    f"filesystems; the {side} is a rename-less "
+                    f"(object-store) sink — the failover tier does not "
+                    f"support the multipart publish protocol yet")
         self.primary = primary
         self.fallback = fallback
         self.probe_interval_s = probe_interval_s

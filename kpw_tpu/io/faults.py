@@ -21,6 +21,17 @@ durable publish), ``delete``, ``mkdirs``, ``list``.
 The broker-side counterpart (``fetch`` / ``commit`` / forced ``rebalance``)
 lives in :mod:`kpw_tpu.ingest.faults` and shares the same schedule object,
 so one seed drives the whole chaos run.
+
+The OBJECT-STORE persona (``io/objectstore.py``): an
+:class:`~kpw_tpu.io.objectstore.EmulatedObjectStore` constructed with a
+schedule consults it once per request under op names
+``objstore.put|get|head|delete|copy|list|create_multipart|upload_part|
+complete|abort``.  The store-shaped failure modes compose from the same
+rule builders — a 503/SlowDown throttle is ``fail_nth("objstore.
+upload_part", n, err=errno.EAGAIN)`` (EAGAIN classifies retried-not-fatal
+under the default RetryPolicy, exactly like a real throttle response), a
+slow part is ``delay_nth``, a failed commit is ``fail_nth("objstore.
+complete", ...)`` — or ready-made via :func:`objectstore_persona`.
 """
 
 from __future__ import annotations
@@ -345,6 +356,31 @@ class _FaultFile:
         return getattr(self._inner, name)
 
 
+def objectstore_persona(seed: int = 0, *, n_throttles: int = 4,
+                        window: int = 200, slow_part_nth: int = 3,
+                        slow_parts: int = 2, slow_s: float = 0.05,
+                        complete_fail_nth: int | None = 1) -> FaultSchedule:
+    """The object-store failure persona, ready-made: ``n_throttles``
+    503/SlowDown responses (EAGAIN — retried, never fatal) scattered over
+    the first ``window`` part uploads, ``slow_parts`` slow parts from
+    ordinal ``slow_part_nth``, and (unless None) one failed
+    multipart-complete at ordinal ``complete_fail_nth`` — the crash
+    window between parts and complete.  Feed the returned schedule to
+    ``EmulatedObjectStore(schedule=...)``; the chaos invariants re-prove
+    against it mechanically (bench.py --objstore)."""
+    sched = FaultSchedule(seed)
+    if n_throttles:
+        sched.fail_random("objstore.upload_part", n_throttles, window,
+                          err=_errno.EAGAIN)
+    if slow_parts:
+        sched.delay_nth("objstore.upload_part", slow_part_nth, slow_s,
+                        count=slow_parts)
+    if complete_fail_nth is not None:
+        sched.fail_nth("objstore.complete", complete_fail_nth,
+                       err=_errno.EAGAIN)
+    return sched
+
+
 class FaultInjectingFileSystem(FileSystem):
     """Schedule-consulting wrapper over any FileSystem.  Read-only probes
     (``exists``/``size``) pass through unchecked — they are rotation/ack
@@ -353,6 +389,28 @@ class FaultInjectingFileSystem(FileSystem):
     def __init__(self, inner: FileSystem, schedule: FaultSchedule) -> None:
         self.inner = inner
         self.schedule = schedule
+
+    @property
+    def supports_rename(self) -> bool:
+        # capability pass-through: wrapping an object-store sink must not
+        # silently flip its publish protocol back to rename
+        return getattr(self.inner, "supports_rename", True)
+
+    def publish_commit(self, src: str, dst: str) -> None:
+        # the multipart publish is the rename protocol's analog: consult
+        # the same op name so existing publish-fault rules translate
+        self.schedule.check("rename")
+        self.inner.publish_commit(src, dst)
+
+    def __getattr__(self, name):
+        # observability/extra-surface pass-through (bind_registry,
+        # objectstore_stats, failover_stats, declare_primary_down, ...):
+        # the writer gates those wirings on hasattr(fs, ...), and a
+        # fault wrapper must not hide the inner sink's surfaces — only
+        # the explicitly-defined IO ops above consult the schedule
+        if name == "inner":  # uninitialized instance: no self-recursion
+            raise AttributeError(name)
+        return getattr(self.inner, name)
 
     def mkdirs(self, path: str) -> None:
         self.schedule.check("mkdirs")

@@ -17,8 +17,30 @@ import threading
 
 
 class FileSystem:
+    # capability seam: rename-capable filesystems (posix, HDFS, the
+    # in-memory analog) publish via (durable_)rename; an object-store
+    # sink has no rename — it flips this False and implements
+    # publish_commit (multipart-complete / atomic PUT at the destination
+    # key).  publish_file() below is the ONE decision point every
+    # publish path (worker, process child, compactor) routes through.
+    supports_rename = True
+
     def mkdirs(self, path: str) -> None:
         raise NotImplementedError
+
+    def publish_commit(self, src: str, dst: str) -> None:
+        """Atomic publish for rename-less filesystems (object stores):
+        make the staged file at ``src`` visible at ``dst`` in one store
+        operation.  Only meaningful when ``supports_rename`` is False —
+        NOT an abstract member of the surface: rename-capable
+        filesystems never implement it (publish_file routes them through
+        the rename protocol), so calling it on one is a caller bug, not
+        a missing override.  Deliberately not an OSError: the retry
+        layer must never spin on a protocol-dispatch mistake."""
+        raise TypeError(
+            "this filesystem publishes by rename (supports_rename=True); "
+            "publish via io.fs.publish_file, which dispatches on the "
+            "capability")
 
     def open_write(self, path: str):
         """Create (overwrite) a file for binary writing."""
@@ -84,6 +106,33 @@ class FileSystem:
     def list_files(self, path: str, extension: str | None = None,
                    recursive: bool = True) -> list[str]:
         raise NotImplementedError
+
+
+def publish_file(fs: FileSystem, src: str, dst: str,
+                 durable: bool = True) -> None:
+    """THE publish decision point (ISSUE 12 capability seam): every
+    publish path — thread worker, process-mode child, compactor merge,
+    compactor write-ahead plan — calls this, so the protocol choice
+    cannot drift between them.
+
+    * ``fs.supports_rename`` (posix/HDFS/memory): the historical
+      tmp→rename protocol — ``durable_rename`` (fsync + rename + dir
+      fsync) when ``durable``, plain atomic ``rename`` otherwise.
+    * object-store sinks (``supports_rename = False``): multipart
+      ``publish_commit`` — visibility flips when the store completes the
+      staged upload at the destination key; there is no fsync to issue,
+      so ``durable`` is moot (complete IS the durability point).
+
+    Both branches are retry-safe for the same (src, dst) pair: the
+    rename branch resumes at the pending dir fsync, the commit branch
+    returns when the destination already materialized."""
+    if getattr(fs, "supports_rename", True):
+        if durable:
+            fs.durable_rename(src, dst)
+        else:
+            fs.rename(src, dst)
+    else:
+        fs.publish_commit(src, dst)
 
 
 class LocalFileSystem(FileSystem):

@@ -18,6 +18,7 @@ import socket
 from ..core.compression import codec_from_name
 from ..core.writer import WriterProperties
 from ..io.fs import FileSystem, LocalFileSystem
+from ..io.objectstore import ObjectStoreFileSystem
 
 MIN_MAX_FILE_SIZE = 100 * 1024  # reference MIN_MAX_FILE_SIZE (KPW.java:453)
 
@@ -397,6 +398,26 @@ class Builder:
         self._filesystem = fs
         return self
 
+    def object_store(self, store, bucket: str = "kpw", *,
+                     part_size: int = 8 * 1024 * 1024,
+                     pipeline_uploads: bool = True) -> "Builder":
+        """Publish to an S3/GCS-class object store (``io/objectstore.py``):
+        the sink becomes an :class:`~kpw_tpu.io.objectstore.
+        ObjectStoreFileSystem` over ``store``/``bucket``, whose atomic
+        publish is multipart-complete instead of ``durable_rename`` (the
+        capability seam — no rename, no fsync on an object store).
+        Encoded row groups stream to the store as ``part_size`` parts
+        *while each file is still open* (``pipeline_uploads``; upload
+        hides under encode — overlap surfaced in
+        ``stats()['objectstore']``), so closing a file costs one tail
+        part and the publish is one ``complete`` call.  Request/byte
+        accounting and the observed-bandwidth gauge ride the canonical
+        ``parquet.writer.objstore.*`` names."""
+        self._filesystem = ObjectStoreFileSystem(
+            store, bucket, part_size=part_size,
+            pipeline_uploads=pipeline_uploads)
+        return self
+
     def encoder_backend(self, backend) -> "Builder":
         """'cpu' | 'native' | 'tpu' | 'auto' | 'mesh' (multi-chip
         mesh-global dictionary merge, parallel/mesh_encoder.py), or an
@@ -634,7 +655,10 @@ class Builder:
                    scan_interval_seconds: float = 5.0,
                    min_files: int = 2,
                    small_file_ratio: float = 0.5,
-                   sort_by=None) -> "Builder":
+                   sort_by=None,
+                   bandwidth_bytes_per_s: float | None = None,
+                   request_budget_per_round: int | None = None,
+                   partition_quota: int | None = None) -> "Builder":
         """Background small-file compaction (``kpw_tpu.io.compact``):
         start() launches a :class:`~kpw_tpu.io.compact.Compactor` over the
         target dir that merges published files smaller than
@@ -653,7 +677,15 @@ class Builder:
         Stats land in ``stats()['compactor']``; meters are
         ``parquet.compactor.merged|retired|failed``.  Off by default —
         compaction is a second read+write of every small byte, a cost the
-        flat reference never pays."""
+        flat reference never pays.
+
+        The REMOTE tier (object-store targets): ``bandwidth_bytes_per_s``
+        throttles merge reads and merge-output writes through one shared
+        token bucket so the compactor's traffic stays under the budget;
+        ``request_budget_per_round`` defers further merges once a round
+        issued that many filesystem requests (per-request cost control);
+        ``partition_quota`` caps merges per partition directory per round
+        (per-partition fairness).  All None by default (local tier)."""
         if target_size <= 0:
             raise ValueError("target_size must be positive")
         if scan_interval_seconds <= 0:
@@ -662,12 +694,22 @@ class Builder:
             raise ValueError("min_files must be >= 2")
         if not 0.0 < small_file_ratio <= 1.0:
             raise ValueError("small_file_ratio must be in (0, 1]")
+        if bandwidth_bytes_per_s is not None and bandwidth_bytes_per_s <= 0:
+            raise ValueError("bandwidth_bytes_per_s must be positive")
+        if (request_budget_per_round is not None
+                and request_budget_per_round < 1):
+            raise ValueError("request_budget_per_round must be >= 1")
+        if partition_quota is not None and partition_quota < 1:
+            raise ValueError("partition_quota must be >= 1")
         self._compaction = {
             "target_size": target_size,
             "scan_interval_s": scan_interval_seconds,
             "min_files": min_files,
             "small_file_ratio": small_file_ratio,
             "sort_by": sort_by,
+            "bandwidth_bytes_per_s": bandwidth_bytes_per_s,
+            "request_budget_per_round": request_budget_per_round,
+            "partition_quota": partition_quota,
         }
         return self
 
@@ -864,6 +906,14 @@ class Builder:
             # process mode crosses an interpreter boundary: everything a
             # child needs must be reconstructible from picklable config.
             # Fail here, at build(), not inside a spawned child.
+            if isinstance(self._filesystem, ObjectStoreFileSystem):
+                raise ValueError(
+                    "process_workers does not support an object-store "
+                    "target yet: the multipart upload handle (the staged "
+                    "pending uploads + part-uploader thread) lives in the "
+                    "parent's adapter and cannot cross the spawn boundary "
+                    "— each child would need its own upload session per "
+                    "file.  Use thread workers for object-store sinks.")
             if type(self._filesystem) is not LocalFileSystem:
                 raise ValueError(
                     "process_workers requires a plain LocalFileSystem sink "

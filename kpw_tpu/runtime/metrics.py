@@ -373,6 +373,17 @@ BLOOM_BYTES_METER = "parquet.writer.bloom.bytes"
 # Builder.native_assembly(False))
 NATIVE_ASM_CHUNKS_METER = "parquet.writer.assembly.native.chunks"
 NATIVE_ASM_PAGES_METER = "parquet.writer.assembly.native.pages"
+# object-store layer (io/objectstore.py): every store request the sink
+# served (create/put/get/head/list/copy/delete + the multipart trio),
+# bytes moved in+out across them, multipart parts uploaded (the
+# upload-hidden-under-encode pipeline's unit), multipart uploads aborted
+# (orphan recovery + staged-tmp sweeps), and the store's observed rolling
+# bandwidth in bytes/s (gauge, 5 s trailing window)
+OBJSTORE_REQUESTS_METER = "parquet.writer.objstore.requests"
+OBJSTORE_BYTES_METER = "parquet.writer.objstore.bytes"
+OBJSTORE_PARTS_METER = "parquet.writer.objstore.parts"
+OBJSTORE_ABORTED_METER = "parquet.writer.objstore.aborted"
+OBJSTORE_BANDWIDTH_GAUGE = "parquet.writer.objstore.bandwidth"
 # process-parallel-workers layer (runtime/procworkers.py): the
 # shared-memory batch ring's slot count and live free slots, records
 # dispatched-but-unacked across children, aggregate child rss, and live
@@ -419,6 +430,11 @@ METRIC_NAMES = (
     BLOOM_BYTES_METER,
     NATIVE_ASM_CHUNKS_METER,
     NATIVE_ASM_PAGES_METER,
+    OBJSTORE_REQUESTS_METER,
+    OBJSTORE_BYTES_METER,
+    OBJSTORE_PARTS_METER,
+    OBJSTORE_ABORTED_METER,
+    OBJSTORE_BANDWIDTH_GAUGE,
     PROC_RING_SLOTS_GAUGE,
     PROC_RING_FREE_GAUGE,
     PROC_INFLIGHT_GAUGE,

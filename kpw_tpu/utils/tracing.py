@@ -37,6 +37,7 @@ from contextlib import contextmanager
 #   rowgroup.* — the row-group pipeline stages (core/writer.py)
 #   encode.*   — the encoder's internal phases (ops/backend.py)
 #   compactor.* — the small-file compaction service (io/compact.py)
+#   upload.*   — the object-store part uploader (io/objectstore.py)
 STAGE_NAMES = (
     "consumer.fetch",
     "consumer.track",
@@ -56,6 +57,7 @@ STAGE_NAMES = (
     "encode.bloom",
     "encode.page_index",
     "compactor.merge",
+    "upload.part",
 )
 
 

@@ -172,6 +172,30 @@ CHECKS = [
      ["procs:cpu_capacity_x.before", "procs:cpu_capacity_x.after"]),
     ("PARITY.md", r"r14 thread sweep's \*\*([\d.]+)x\*\* at 1→2 workers",
      ["e2e:workers_sweep.speedup_x"]),
+    # object-store-tier PR: overlap / bandwidth-cap / crash-replay quotes
+    # reconcile against the objstore artifact (`objstore:` prefix)
+    ("README.md", r"hides \*\*([\d.]+)%\*\* of part-upload time under\s+"
+                  r"encode",
+     ["objstore:overlap.overlap_pct"]),
+    ("README.md", r"at \*\*([\d.]+) MiB/s\*\* observed against a\s+"
+                  r"\*\*([\d.]+) MiB/s\*\* budget",
+     [("objstore:remote_compaction.observed_bytes_per_s", 1 << 20),
+      ("objstore:remote_compaction.budget_bytes_per_s", 1 << 20)]),
+    ("README.md", r"merges\s+\*\*(\d+)\*\* small objects into \*\*(\d+)\*\*",
+     ["objstore:remote_compaction.file_count_before",
+      "objstore:remote_compaction.file_count_after"]),
+    ("README.md", r"all \*\*(\d+)\*\* acked offsets of the\s+"
+                  r"mid-multipart\s+crash replay",
+     ["objstore:crash_replay.acked_offsets_checked"]),
+    ("PARITY.md", r"`overlap_pct` \*\*([\d.]+)%\*\*",
+     ["objstore:overlap.overlap_pct"]),
+    ("PARITY.md", r"`observed_bytes_per_s`\s+\*\*([\d.]+) MiB/s\*\* "
+                  r"against the \*\*([\d.]+) MiB/s\*\* budget",
+     [("objstore:remote_compaction.observed_bytes_per_s", 1 << 20),
+      ("objstore:remote_compaction.budget_bytes_per_s", 1 << 20)]),
+    ("PARITY.md", r"mid-multipart crash replay's \*\*(\d+)\*\* acked\s+"
+                  r"offsets",
+     ["objstore:crash_replay.acked_offsets_checked"]),
 ]
 
 
@@ -477,6 +501,11 @@ def main() -> int:
         "KPW_PROCS_PATH", os.path.join(ROOT, "BENCH_E2E_r15.json"))
     if os.path.exists(procs_path):
         key_record["procs"] = json.load(open(procs_path))
+    # the object-store-tier artifact (bench.py --objstore) is the tenth
+    objstore_path = os.environ.get(
+        "KPW_OBJSTORE_PATH", os.path.join(ROOT, "BENCH_OBJSTORE_r16.json"))
+    if os.path.exists(objstore_path):
+        key_record["objstore"] = json.load(open(objstore_path))
     docs = {f: open(os.path.join(ROOT, f)).read()
             for f in ({c[0] for c in CHECKS} | set(KEY_DOCS)
                       | set(NAME_DOCS))}
@@ -508,6 +537,8 @@ def main() -> int:
                 root, spec = key_record.get("scan", {}), spec[5:]
             elif spec.startswith("procs:"):
                 root, spec = key_record.get("procs", {}), spec[6:]
+            elif spec.startswith("objstore:"):
+                root, spec = key_record.get("objstore", {}), spec[9:]
             try:
                 expect = float(art(root, spec)) / scale
             except (KeyError, TypeError):

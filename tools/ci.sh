@@ -31,9 +31,16 @@
 #                          fed via the shared-memory ring; exits nonzero
 #                          unless ack-lag drains to exactly 0, committed
 #                          artifact never overwritten)
-#   7. doc reconciliation — python tools/check_docs.py (every doc-cited
+#   7. object-store smoke — python bench.py --objstore --smoke (reduced
+#                          replay into the emulated object store:
+#                          upload-hidden-under-encode overlap observed,
+#                          remote compaction under the bandwidth budget,
+#                          mid-multipart crash replay recovers; exits
+#                          nonzero unless the invariant holds, committed
+#                          artifact never overwritten)
+#   8. doc reconciliation — python tools/check_docs.py (every doc-cited
 #                          number/name/test/pass exists and matches)
-#   8. sanitizer smoke   — bash tools/sanitize.sh --smoke (ASan/UBSan
+#   9. sanitizer smoke   — bash tools/sanitize.sh --smoke (ASan/UBSan
 #                          native build + fuzz; prints a LOUD notice and
 #                          exits 0 when the toolchain is absent — never
 #                          a silent pass)
@@ -46,10 +53,10 @@ cd "$(dirname "$0")/.."
 fail=0
 step() { echo; echo "=== ci.sh [$1] $2 ==="; }
 
-step 1/8 "lint suite (python -m tools.analyze)"
+step 1/9 "lint suite (python -m tools.analyze)"
 python -m tools.analyze || fail=1
 
-step 2/8 "tier-1 pytest (-m 'not slow')"
+step 2/9 "tier-1 pytest (-m 'not slow')"
 # tier-1's exit code is nonzero on THIS container because of the known
 # environmental failures (python zstandard + jax shard_map absent — see
 # the CHANGES.md baseline), so the gate is mechanical instead of
@@ -72,22 +79,25 @@ if [ "$t1_errors" -gt 0 ] || [ "$t1_failed" -gt "$max_failed" ] \
 fi
 rm -f "$T1_LOG"
 
-step 3/8 "compaction smoke (bench.py --compact --smoke)"
+step 3/9 "compaction smoke (bench.py --compact --smoke)"
 JAX_PLATFORMS=cpu python bench.py --compact --smoke || fail=1
 
-step 4/8 "scan smoke (bench.py --scan --smoke)"
+step 4/9 "scan smoke (bench.py --scan --smoke)"
 JAX_PLATFORMS=cpu python bench.py --scan --smoke || fail=1
 
-step 5/8 "e2e smoke (bench.py --e2e --smoke)"
+step 5/9 "e2e smoke (bench.py --e2e --smoke)"
 JAX_PLATFORMS=cpu python bench.py --e2e --smoke || fail=1
 
-step 6/8 "process-mode smoke (bench.py --procs --smoke)"
+step 6/9 "process-mode smoke (bench.py --procs --smoke)"
 JAX_PLATFORMS=cpu python bench.py --procs --smoke || fail=1
 
-step 7/8 "doc reconciliation (tools/check_docs.py)"
+step 7/9 "object-store smoke (bench.py --objstore --smoke)"
+JAX_PLATFORMS=cpu python bench.py --objstore --smoke || fail=1
+
+step 8/9 "doc reconciliation (tools/check_docs.py)"
 python tools/check_docs.py || fail=1
 
-step 8/8 "sanitizer smoke (tools/sanitize.sh --smoke)"
+step 9/9 "sanitizer smoke (tools/sanitize.sh --smoke)"
 bash tools/sanitize.sh --smoke || fail=1
 
 echo
