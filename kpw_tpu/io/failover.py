@@ -97,6 +97,11 @@ class _PrimaryWriteObserver:
         return getattr(self._inner, name)
 
 
+# lint: protocol-exhaustiveness ok — rename-based by contract: the
+# constructor REJECTS rename-less sides (supports_rename False raises
+# ValueError below), so the inherited supports_rename=True /
+# publish_commit TypeError defaults are correct for every constructible
+# instance; the spill/reconcile protocol itself is rename-based
 class FailoverFileSystem(FileSystem):
     """Primary/fallback composite with background reconciliation.
 

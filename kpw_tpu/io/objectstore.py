@@ -55,6 +55,7 @@ import threading
 import time
 from collections import deque
 
+from ..utils import schedcheck
 from ..utils.tracing import stage
 from .fs import FileSystem
 
@@ -594,6 +595,11 @@ class ObjectStoreFileSystem(FileSystem):
     def _attach_observer(self) -> None:
         if not self._observer_attached:
             self._observer_attached = True
+            # lint: resource-pairing ok — observers are deliberately not
+            # removable; attachment is once per adapter (gated by
+            # _observer_attached) and only for registry-bound adapters
+            # (the PR-12 dead-observer fix), so recovery/verify flows
+            # building short-lived adapters attach nothing
             self.store.add_observer(self._on_store_request)
 
     def bind_registry(self, registry) -> None:
@@ -640,6 +646,10 @@ class ObjectStoreFileSystem(FileSystem):
         self._q.put((p, part_number, data))
 
     def _ensure_uploader(self) -> None:
+        # schedule-explorer edge: the concurrent-first-part spawn race
+        # lives between this check and the start below — the singleton
+        # probe on the spawn proves the lock closes the window
+        schedcheck.point("objstore.uploader.ensure")
         with self._mu:
             if self._uploader is not None:
                 return  # the loop never exits (daemon; no poison is sent)
@@ -652,6 +662,7 @@ class ObjectStoreFileSystem(FileSystem):
             # concurrent first-part submitter observe is_alive() False
             # and spawn a second loop on the same queue — two drainers
             # reorder a dirty re-upload behind its stale original
+            schedcheck.note_uploader_spawn(id(self))
             t.start()
 
     def _uploader_loop(self) -> None:

@@ -19,26 +19,49 @@ _SRCS = [os.path.join(_SRC_DIR, "src", "codecs.cc"),
          os.path.join(_SRC_DIR, "src", "shred_nested.cc")]
 
 
-def _sanitize_mode() -> bool:
-    """ASan+UBSan build mode (KPW_NATIVE_SANITIZE=1): every native
-    entry point — the wire shredders, codecs, thrift-adjacent buffer
-    walks — compiles with -fsanitize=address,undefined so the fuzz
-    harness (tools/fuzz.py) and the shred/verify test subsets run with
-    out-of-bounds reads and UB trapping instead of silently reading
-    garbage (the PR-6 ``shred_flat_buf`` malformed-offset OOB class).
-    Sanitized artifacts cache under distinct names so the normal build
-    is never polluted; the host python is uninstrumented, so the runner
-    (tools/sanitize.sh) must LD_PRELOAD libasan/libubsan."""
-    return os.environ.get("KPW_NATIVE_SANITIZE", "") == "1"
+def _sanitize_mode() -> str:
+    """Sanitizer build modes, selected by KPW_NATIVE_SANITIZE:
+
+    * ``1`` / ``asan`` — ASan+UBSan: every native entry point — the wire
+      shredders, codecs, thrift-adjacent buffer walks — compiles with
+      -fsanitize=address,undefined so the fuzz harness (tools/fuzz.py)
+      and the shred/verify test subsets run with out-of-bounds reads and
+      UB trapping instead of silently reading garbage (the PR-6
+      ``shred_flat_buf`` malformed-offset OOB class).
+    * ``tsan`` — ThreadSanitizer: the GIL-released entries
+      (``shred_flat_buf``/``gather_buf``/``assemble_pages``) genuinely
+      run concurrently from multiple Python threads (PR 6/10), so a data
+      race in the native code is a real race no Python-level tool can
+      see; ``tools/sanitize.sh --tsan`` drives them concurrently via
+      ``tools/tsan_stress.py``.
+
+    Each mode caches under a distinct artifact name (``_san.so`` /
+    ``_tsan.so``) so the normal build is never polluted; the host python
+    is uninstrumented, so the runner (tools/sanitize.sh) must LD_PRELOAD
+    the matching sanitizer runtime."""
+    v = os.environ.get("KPW_NATIVE_SANITIZE", "")
+    if v in ("1", "asan"):
+        return "asan"
+    if v == "tsan":
+        return "tsan"
+    return ""
 
 
-_SAN_FLAGS = ["-fsanitize=address,undefined", "-fno-sanitize-recover=all",
-              "-fno-omit-frame-pointer", "-g", "-O1"]
+_ASAN_FLAGS = ["-fsanitize=address,undefined", "-fno-sanitize-recover=all",
+               "-fno-omit-frame-pointer", "-g", "-O1"]
+_TSAN_FLAGS = ["-fsanitize=thread", "-fno-omit-frame-pointer", "-g", "-O1"]
+
+
+def _san_flags() -> list:
+    return list(_TSAN_FLAGS if _sanitize_mode() == "tsan" else _ASAN_FLAGS)
 
 
 def _so_path(base: str) -> str:
-    if _sanitize_mode():
+    mode = _sanitize_mode()
+    if mode == "asan":
         return base.replace(".so", "_san.so")
+    if mode == "tsan":
+        return base.replace(".so", "_tsan.so")
     return base
 
 
@@ -83,9 +106,10 @@ def _build() -> str:
     fast = ["-O3", "-march=native", "-funroll-loops"]
     plain = ["-O3"]
     if _sanitize_mode():
-        # sanitized artifacts trade speed for trap-on-UB/OOB; one flag
-        # level (plus the no-zstd fallback) keeps failure modes obvious
-        fast = plain = list(_SAN_FLAGS)
+        # sanitized artifacts trade speed for trap-on-UB/OOB/races; one
+        # flag level (plus the no-zstd fallback) keeps failure modes
+        # obvious
+        fast = plain = _san_flags()
     tail = ["-fPIC", "-shared", "-std=c++17", "-o"]
     # build into a temp file then atomic-rename (parallel test runners)
     fd, tmp = tempfile.mkstemp(suffix=".so", dir=_SRC_DIR)
@@ -696,7 +720,7 @@ def _build_pyshred() -> str:
     """Compile the _kpw_pyshred extension (pyshred.cc + shred.cc — the
     decoder compiles into both .so files from the same source, so the two
     paths cannot drift).  Same cache/hosttag discipline as _build, and
-    the same KPW_NATIVE_SANITIZE=1 ASan/UBSan mode (distinct cache)."""
+    the same KPW_NATIVE_SANITIZE asan/tsan modes (distinct caches)."""
     so = _so_path(_PYSHRED_SO)
     tag = so + ".hosttag"
     if (os.path.exists(so)
@@ -711,7 +735,7 @@ def _build_pyshred() -> str:
     fast = ["-O3", "-march=native", "-funroll-loops"]
     plain = ["-O3"]
     if _sanitize_mode():
-        fast = plain = list(_SAN_FLAGS)
+        fast = plain = _san_flags()
     tail = ["-fPIC", "-shared", "-std=c++17", f"-I{inc}", "-o"]
     fd, tmp = tempfile.mkstemp(suffix=".so", dir=_SRC_DIR)
     os.close(fd)
@@ -772,8 +796,8 @@ def _build_assemble() -> str:
     codecs.cc — the RLE/bit-pack encoder and the page codecs compile into
     this .so from the same sources as the ctypes library, so the two paths
     cannot drift).  Same cache/hosttag discipline as _build including the
-    no-zstd fallback chain, and the same KPW_NATIVE_SANITIZE=1 ASan/UBSan
-    mode (distinct cache); the tag additionally pins the Python ABI."""
+    no-zstd fallback chain, and the same KPW_NATIVE_SANITIZE asan/tsan
+    modes (distinct caches); the tag additionally pins the Python ABI."""
     so = _so_path(_ASSEMBLE_SO)
     tag = so + ".hosttag"
     if (os.path.exists(so)
@@ -788,7 +812,7 @@ def _build_assemble() -> str:
     fast = ["-O3", "-march=native", "-funroll-loops"]
     plain = ["-O3"]
     if _sanitize_mode():
-        fast = plain = list(_SAN_FLAGS)
+        fast = plain = _san_flags()
     tail = ["-fPIC", "-shared", "-std=c++17", f"-I{inc}", "-o"]
     fd, tmp = tempfile.mkstemp(suffix=".so", dir=_SRC_DIR)
     os.close(fd)

@@ -51,6 +51,7 @@ import time
 import numpy as np
 
 from ..ingest.broker import RecordBatch
+from ..utils import schedcheck
 from ..utils.tracing import stage
 from .retry import RetryInterrupted
 
@@ -203,12 +204,18 @@ class ShmBatchRing:
         healthy child condemned)."""
         if self._hb_i is None:  # ring already closed (exit race)
             return
+        schedcheck.note_hb_write(widx)
         if pending:
             self._hb_i[widx, 0] = label_code
             self._hb_f[widx, 2] = started_at
+            # schedule-explorer edge: the ordering above (payload fields
+            # BEFORE the pending flip) is exactly what the torn-read
+            # probe in _ProcHeartbeat.stall verifies under perturbation
+            schedcheck.point("proc.hb.publish")
             self._hb_i[widx, 1] = 1
         else:
             self._hb_i[widx, 1] = 0
+            schedcheck.point("proc.hb.publish")
             self._hb_i[widx, 0] = label_code
             self._hb_f[widx, 2] = started_at
         self._hb_f[widx, 3] = time.monotonic()
@@ -259,6 +266,14 @@ class _ProcHeartbeat:
         # real op stamps a live monotonic clock) — never a stall
         if not pending or started_at == 0.0:
             return 0.0, None
+        # invariant probe (schedule explorer): a stall age is about to
+        # be computed — the clock it is computed from must be a live
+        # stamp.  pending with a cleared/garbage started_at here is the
+        # PR-11 torn-read shape that condemned a healthy child; the
+        # hb_publish write ordering plus the guard above must make this
+        # unreachable under ANY interleaving (the legacy shapes in
+        # tools/schedx reach it)
+        schedcheck.note_hb_sample(self._widx, True, started_at)
         label = (_HB_LABELS[code - 1]
                  if 1 <= code <= len(_HB_LABELS) else "io")
         return max(0.0, time.monotonic() - started_at), label
@@ -557,6 +572,11 @@ class _ChildWorker:
 
     def _maybe_time_rotate(self) -> None:
         f = self.current_file
+        # lint: clock-discipline ok — wall-clock file-age rotation
+        # mirrors thread mode exactly (ParquetFile.get_creation_time is
+        # wall time); rotation is a naming/policy deadline, never a
+        # liveness verdict — a clock step rotates a file early, it
+        # cannot condemn a worker
         if (f is not None and time.time() - f.get_creation_time()
                 >= self.cfg.max_file_open_duration):
             self._finalize("time")
@@ -758,6 +778,7 @@ class _ProcWorkerSlot:
         enter the free pool twice and two units would be staged into the
         same shared memory concurrently.  Held runs stay in the ledger
         for the supervisor's redelivery."""
+        schedcheck.point("proc.slot.drain")
         with self._mu:
             out = [e["slot"] for e in self._ledger.values()
                    if not e["freed"]]
@@ -773,6 +794,9 @@ class _ProcWorkerSlot:
                                  "bytes": nbytes, "slot": slot_idx,
                                  "freed": False}
             if self._oldest_unacked_ts is None:
+                # lint: clock-discipline ok — operator-facing ack-age
+                # observability matches thread-mode stats() (wall
+                # timestamps); never consulted by watchdog/condemn logic
                 self._oldest_unacked_ts = time.time()
             self._unacked_count += count
 
@@ -782,6 +806,7 @@ class _ProcWorkerSlot:
         (0, 0) when the entry is unknown OR already freed (a stale ack
         from a dead child whose slots ``drain_unfreed_slots`` reclaimed:
         recycling again would double-free the ring slot)."""
+        schedcheck.point("proc.slot.note_free")
         with self._mu:
             e = self._ledger.get(seq)
             if e is None or e["freed"]:
@@ -839,6 +864,8 @@ class _ProcWorkerSlot:
             "retry_backoff_s": round(self.backoff_s, 6),
             "last_error": self.last_error,
             "unacked_records": self._unacked_count,
+            # lint: clock-discipline ok — observability age over the
+            # wall timestamp recorded above; stats()-only, not liveness
             "oldest_unacked_age_s": (round(time.time() - ts, 6)
                                      if ts is not None else 0.0),
             "open_partitions": [],
@@ -876,6 +903,8 @@ class ProcessWorkerPool:
         self.slots: list[_ProcWorkerSlot] = [
             _ProcWorkerSlot(self, i) for i in range(self.n_workers)]
         self._free: pyqueue.Queue = pyqueue.Queue()
+        self._pool_key = id(self)
+        schedcheck.note_pool_reset(self._pool_key, b._proc_ring_slots)
         for i in range(b._proc_ring_slots):
             self._free.put(i)
         self._stop = threading.Event()
@@ -911,9 +940,10 @@ class ProcessWorkerPool:
         reclaimed (the process is joined-dead, it cannot be mid-read) and
         a fresh process takes the index.  Held-run redelivery stays the
         supervisor's job, same as thread mode."""
+        schedcheck.point("proc.pool.respawn")
         old = self.slots[index]
         for ring_idx in old.drain_unfreed_slots():
-            self._free.put(ring_idx)
+            self._recycle_slot(ring_idx)
         old.work_q.close()
         # a child killed MID-IO leaves pending=1 in its heartbeat cell;
         # left stale, the watchdog would age it through the replacement's
@@ -939,6 +969,15 @@ class ProcessWorkerPool:
         self._collector.join(timeout=timeout)
         self.ring.close()
         self.ring.unlink()
+
+    def _recycle_slot(self, ring_idx: int) -> None:
+        """THE re-entry point to the ring free pool — every recycler
+        (collector free ack, respawn reclaim, dispatch backout) routes
+        through here so the schedule explorer's double-recycle probe
+        guards all of them: a slot entering the pool while already free
+        is the PR-11 double-free, whichever interleaving produced it."""
+        schedcheck.note_slot_recycled(self._pool_key, ring_idx)
+        self._free.put(ring_idx)
 
     # -- stats ------------------------------------------------------------------
     def ring_free(self) -> int:
@@ -1175,17 +1214,22 @@ class ProcessWorkerPool:
         slot_idx = self._get_free_slot()
         if slot_idx is None:
             return False
+        schedcheck.point("proc.ring.stage")
         self.ring.write_slot_parts(slot_idx, partition, start_offset,
                                    parts)
         target = self._pick_child()
         if target is None:
-            self._free.put(slot_idx)
+            self._recycle_slot(slot_idx)
             return False
         self._seq += 1
         seq = self._seq
         target.note_dispatch(seq, [tuple(r) for r in runs], count, nbytes,
                              slot_idx)
         try:
+            # lint: protocol-exhaustiveness ok — the work queue is
+            # single-tag by design: the child unpacks ("unit", seq,
+            # slot) positionally and poison is the bare None, so there
+            # is no receiving dispatch table to drift against
             target.work_q.put(("unit", seq, slot_idx))
         except (OSError, ValueError):
             # the child died between pick and put: the ledger entry makes
@@ -1197,9 +1241,11 @@ class ProcessWorkerPool:
     def _get_free_slot(self):
         while not self._stop.is_set():
             try:
-                return self._free.get(timeout=0.1)
+                idx = self._free.get(timeout=0.1)
             except pyqueue.Empty:
                 continue
+            schedcheck.note_slot_taken(self._pool_key, idx)
+            return idx
         return None
 
     def _pick_child(self):
@@ -1245,6 +1291,7 @@ class ProcessWorkerPool:
         kind = msg[0]
         if kind == "free":
             _, widx, ring_idx, seq = msg
+            schedcheck.point("proc.collector.free")
             count, nbytes = self.slots[widx].note_free(seq)
             if count:
                 self.w._written_records.mark(count)
@@ -1254,7 +1301,7 @@ class ProcessWorkerPool:
                 # respawn_slot already reclaimed its un-drained slots,
                 # and honoring it would double-free the ring slot (two
                 # concurrent units staged into the same memory)
-                self._free.put(ring_idx)
+                self._recycle_slot(ring_idx)
         elif kind == "published":
             _, widx, seqs, file_info, retry_stats = msg
             slot = self.slots[widx]
@@ -1284,11 +1331,14 @@ class ProcessWorkerPool:
                     self.w._native_asm_pages.mark(asm["native_pages"])
         elif kind == "died":
             _, widx, pid, reason = msg
+            schedcheck.point("proc.collector.died")
             slot = self.slots[widx]
             # pid-check: a delayed death notice from the PREVIOUS
             # occupant of this index must not condemn its replacement
-            if (slot.pid == pid and not slot.failed
-                    and not slot.condemned):
+            acted = (slot.pid == pid and not slot.failed
+                     and not slot.condemned)
+            schedcheck.note_death_notice(slot.pid, pid, acted)
+            if acted:
                 slot.exit_reason = reason
                 slot.failed = True
                 self.w._failed.mark()

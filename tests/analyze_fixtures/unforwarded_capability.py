@@ -1,0 +1,45 @@
+"""Fixture: trips protocol-exhaustiveness ONLY — a wrapper filesystem
+that forwards operations but not the publish capabilities: the base
+class defaults shadow __getattr__, so wrapping a rename-less sink would
+silently flip its publish protocol back to rename (the PR-12
+FaultInjectingFileSystem bug class)."""
+
+
+class FileSystem:
+    supports_rename = True
+
+    def publish_commit(self, src, dst):
+        raise TypeError("rename-capable filesystems publish by rename")
+
+    def mkdirs(self, path):
+        raise NotImplementedError
+
+    def rename(self, src, dst):
+        raise NotImplementedError
+
+    def delete(self, path):
+        raise NotImplementedError
+
+
+class MeteredFileSystem(FileSystem):
+    """Counts operations; forgets to forward supports_rename /
+    publish_commit to the wrapped sink."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.ops = 0
+
+    def mkdirs(self, path):
+        self.ops += 1
+        return self.inner.mkdirs(path)
+
+    def rename(self, src, dst):
+        self.ops += 1
+        return self.inner.rename(src, dst)
+
+    def delete(self, path):
+        self.ops += 1
+        return self.inner.delete(path)
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)

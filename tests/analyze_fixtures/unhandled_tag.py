@@ -1,0 +1,15 @@
+"""Fixture: trips protocol-exhaustiveness ONLY — the "retired" descriptor
+tag is sent across the queue but the receiving dispatch has no arm for
+it, so the receiver drops it silently."""
+
+
+def sender(ack_q):
+    ack_q.put(("free", 1, 2))
+    ack_q.put(("retired", 3))
+
+
+def receiver(msgs, on_free):
+    for msg in msgs:
+        kind = msg[0]
+        if kind == "free":
+            on_free(msg)

@@ -1,0 +1,8 @@
+"""Fixture: trips resource-pairing ONLY — a multipart upload is created
+but this module contains no abort_multipart/complete_multipart call, so
+a crash/early-exit path orphans it (the PR-12 orphan-upload class)."""
+
+
+def begin_upload(store, bucket, key):
+    upload_id = store.create_multipart(bucket, key)
+    return upload_id

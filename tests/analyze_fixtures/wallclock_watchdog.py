@@ -1,0 +1,9 @@
+"""Fixture: trips clock-discipline ONLY — watchdog-shaped code computing
+a stall deadline from the wall clock; an NTP step would condemn a
+healthy worker."""
+
+import time
+
+
+def stalled(started_at, deadline_s):
+    return time.time() - started_at > deadline_s

@@ -54,6 +54,52 @@ def lockcheck_detector():
         lockcheck.uninstall()
 
 
+@pytest.fixture
+def schedcheck_checker():
+    """Opt-in schedule-explorer instrumentation
+    (kpw_tpu/utils/schedcheck.py): arms the seeded preemption points and
+    the invariant probes (ring double-recycle, heartbeat torn-read,
+    uploader singleton, death-notice pid check) for one test — the
+    production code under test runs with its racy edges perturbed and
+    its protocol invariants live.  The cross-process suites
+    (procworkers, objectstore, chaos) pull this in via module-local
+    autouse fixtures and assert zero violations; ``KPW_SCHEDCHECK=1``
+    force-installs it for EVERY test instead.  Delays are kept tiny
+    (2 ms cap) so suite assertions and timeouts are untouched."""
+    from kpw_tpu.utils import schedcheck
+
+    checker = schedcheck.install(seed=0, delay_prob=0.25,
+                                 max_delay_s=0.002)
+    try:
+        yield checker
+    finally:
+        schedcheck.uninstall()
+
+
+@pytest.fixture(autouse=True)
+def _schedcheck_from_env(request):
+    """KPW_SCHEDCHECK=1 runs the whole suite under the explorer's probes
+    (skipped for tests that already pull schedcheck_checker in, and for
+    the explorer's own suite — its scenarios install per-seed)."""
+    if (os.environ.get("KPW_SCHEDCHECK") != "1"
+            or "schedcheck_checker" in request.fixturenames
+            or "test_schedx" in str(request.node.fspath)):
+        yield
+        return
+    from kpw_tpu.utils import schedcheck
+
+    checker = schedcheck.install(seed=0, delay_prob=0.25,
+                                 max_delay_s=0.002)
+    try:
+        yield
+    finally:
+        schedcheck.uninstall()
+        if checker.violations:
+            raise AssertionError(
+                f"schedcheck recorded {len(checker.violations)} "
+                f"violation(s): {[repr(v) for v in checker.violations]}")
+
+
 @pytest.fixture(autouse=True)
 def _lockcheck_from_env(request):
     """KPW_LOCKCHECK=1 runs the whole suite under the detector (skipped

@@ -27,6 +27,10 @@ FIXTURE_EXPECT = {
     "fault_import.py": "fault-isolation",
     "swallowed.py": "swallowed-exceptions",
     "spawn_unpinned.py": "spawn-safety",
+    "unpaired_resource.py": "resource-pairing",
+    "unhandled_tag.py": "protocol-exhaustiveness",
+    "unforwarded_capability.py": "protocol-exhaustiveness",
+    "wallclock_watchdog.py": "clock-discipline",
 }
 
 
@@ -117,7 +121,9 @@ def test_pass_registry_matches_modules():
     # the names check_docs reconciles README against
     assert set(PASS_NAMES) == {
         "lock-discipline", "hot-imports", "canonical-names",
-        "fault-isolation", "swallowed-exceptions", "spawn-safety"}
+        "fault-isolation", "swallowed-exceptions", "spawn-safety",
+        "resource-pairing", "protocol-exhaustiveness",
+        "clock-discipline"}
 
 
 def test_hotimport_allowlist_entries_all_justified():

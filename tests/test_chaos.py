@@ -55,6 +55,17 @@ def _lockcheck(lockcheck_detector):
         repr(v) for v in lockcheck_detector.violations]
 
 
+@pytest.fixture(autouse=True)
+def _schedcheck(schedcheck_checker):
+    # the chaos suite also runs under the schedule explorer's invariant
+    # probes (kpw_tpu/utils/schedcheck.py) with tiny seeded jitter at
+    # the instrumented preemption points — same pattern as lockcheck:
+    # assertions unchanged, zero violations required (ISSUE 13)
+    yield schedcheck_checker
+    assert not schedcheck_checker.violations, [
+        repr(v) for v in schedcheck_checker.violations]
+
+
 def produce_indexed(broker, cls, rows, parts, pad=0):
     """Produce ``rows`` records round-robin over ``parts`` partitions;
     returns {(partition, offset): timestamp} — the identity map the

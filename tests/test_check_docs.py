@@ -225,3 +225,53 @@ def test_name_completeness_passes_on_committed_docs():
     docs = {f: open(os.path.join(HERE, os.pardir, f)).read()
             for f in check_docs.NAME_DOCS}
     assert check_docs.check_name_completeness(docs) == []
+
+
+# --- schedule-explorer / tsan claim reconciliation (ISSUE 13) ---------------
+
+_SCENARIOS = {"a": {"seeds": [0, 1, 2], "refind_seeds": [1]},
+              "b": {"seeds": [0, 1], "refind_seeds": [0]}}
+
+
+def _schedx_failures(text, scenarios=_SCENARIOS, tsan=(200, 4)):
+    return check_docs.check_schedx_claims({"README.md": text},
+                                          scenarios=scenarios, tsan=tsan)
+
+
+def test_schedx_matching_counts_pass():
+    text = ("**5** committed seeds across **2** scenarios; "
+            "**200** iterations per thread across **4** threads")
+    assert _schedx_failures(text) == []
+
+
+def test_schedx_drifted_seed_count_flagged():
+    text = ("**9** committed seeds across **2** scenarios; "
+            "**200** iterations per thread across **4** threads")
+    out = _schedx_failures(text)
+    assert len(out) == 1 and "seeds.json commits 5 / 2" in out[0]
+
+
+def test_schedx_missing_anchor_flagged():
+    out = _schedx_failures("no claims here at all")
+    assert len(out) == 2  # both anchors missing
+
+
+def test_schedx_scenario_without_refind_seeds_flagged():
+    bad = {"a": {"seeds": [0], "refind_seeds": []}}
+    text = ("**1** committed seeds across **1** scenarios; "
+            "**200** iterations per thread across **4** threads")
+    out = _schedx_failures(text, scenarios=bad)
+    assert len(out) == 1 and "negative control" in out[0]
+
+
+def test_tsan_drifted_iteration_count_flagged():
+    text = ("**5** committed seeds across **2** scenarios; "
+            "**999** iterations per thread across **4** threads")
+    out = _schedx_failures(text)
+    assert len(out) == 1 and "sanitize.sh commits 200 x 4" in out[0]
+
+
+def test_schedx_committed_docs_reconcile():
+    docs = {"README.md": open(os.path.join(
+        HERE, os.pardir, "README.md")).read()}
+    assert check_docs.check_schedx_claims(docs) == []

@@ -29,6 +29,18 @@ from proto_helpers import sample_message_class
 TOPIC = "procs"
 
 
+@pytest.fixture(autouse=True)
+def _schedcheck(schedcheck_checker):
+    """Module autouse: every process-mode test runs with the schedule
+    explorer's invariant probes live in the parent (ring double-recycle,
+    heartbeat torn-read, death-notice pid check) and tiny seeded jitter
+    at the dispatcher/collector preemption points — assertions below run
+    unchanged, and any probe violation fails the test here."""
+    yield schedcheck_checker
+    assert not schedcheck_checker.violations, [
+        repr(v) for v in schedcheck_checker.violations]
+
+
 def produce_indexed(broker, cls, rows, parts, pad=0):
     identity = {}
     filler = "x" * pad

@@ -1,0 +1,168 @@
+"""Tests for the deterministic concurrency-schedule explorer
+(kpw_tpu/utils/schedcheck.py + tools/schedx): the current tree runs
+CLEAN across the committed seed set, the negative controls re-find the
+PR-11/12 historical races from committed seeds with each fix reverted
+test-locally, and every violation report carries a replayable seed plus
+both participating stacks."""
+
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from kpw_tpu.utils import schedcheck  # noqa: E402
+from tools.schedx import SCENARIOS, load_seeds  # noqa: E402
+
+SEEDS = load_seeds()
+
+
+def test_committed_seed_file_matches_scenario_registry():
+    """seeds.json and the SCENARIOS registry must agree exactly: a stale
+    extra seed entry would inflate the doc-reconciled seed counts while
+    never being explored; a missing one would skip a scenario."""
+    assert set(SEEDS) == set(SCENARIOS)
+
+
+# -- probe units (no threads) -------------------------------------------------
+
+def test_probes_noop_when_uninstalled():
+    assert schedcheck.active() is None
+    schedcheck.point("anything")
+    schedcheck.note_slot_recycled(1, 2)
+    schedcheck.note_hb_sample(0, True, 0.0)
+    schedcheck.note_uploader_spawn(9)
+    schedcheck.note_death_notice(1, 2, True)  # all no-ops, no state
+
+
+def test_double_recycle_probe_fires_with_both_stacks():
+    c = schedcheck.install(seed=7)
+    try:
+        c.note_pool_reset(1, 4)
+        c.note_slot_taken(1, 2)
+        c.note_slot_recycled(1, 2)
+        with pytest.raises(schedcheck.DoubleRecycleError) as ei:
+            c.note_slot_recycled(1, 2)
+        msg = str(ei.value)
+        assert "seed 7" in msg
+        assert "this observation" in msg and "first participant" in msg
+        # both sections carry real stack frames, not placeholders
+        assert msg.count("test_schedx.py") >= 2
+        assert c.violations and c.violations[0] is ei.value
+    finally:
+        schedcheck.uninstall()
+
+
+def test_hb_probe_guards_the_age_computation():
+    c = schedcheck.install(seed=0)
+    try:
+        import time
+
+        c.note_hb_write(3)
+        c.note_hb_sample(3, True, time.monotonic())  # live stamp: fine
+        with pytest.raises(schedcheck.HeartbeatTornReadError):
+            c.note_hb_sample(3, True, 0.0)
+    finally:
+        schedcheck.uninstall()
+
+
+def test_seeded_coins_are_deterministic_per_label():
+    a = schedcheck.SchedCheck(seed=5)
+    b = schedcheck.SchedCheck(seed=5)
+    seq_a = [a._coin("x") for _ in range(8)] + [a._coin("y")]
+    seq_b = [b._coin("x") for _ in range(8)] + [b._coin("y")]
+    assert seq_a == seq_b
+    c = schedcheck.SchedCheck(seed=6)
+    assert [c._coin("x") for _ in range(8)] != seq_a[:8]
+
+
+# -- the committed seed set runs clean on the current tree --------------------
+
+@pytest.mark.parametrize("scenario", sorted(SCENARIOS))
+def test_current_tree_clean_across_committed_seeds(scenario):
+    """The acceptance gate: 0 violations on the current tree across the
+    committed seed set — a new finding here is a real schedule bug (the
+    report carries its replay seed)."""
+    for seed in SEEDS[scenario]["seeds"]:
+        checker = SCENARIOS[scenario](seed)
+        assert not checker.violations, (
+            scenario, seed, [str(v) for v in checker.violations])
+
+
+# -- negative controls: reverted fixes must be re-found -----------------------
+
+def _refound(scenario: str, exc_type) -> list:
+    """Seeds (of the committed refind set) that re-find the historical
+    race under the reverted fix; one retry per seed absorbs a box-load
+    spike descheduling the racing party past even the widened margins."""
+    hits = []
+    for seed in SEEDS[scenario]["refind_seeds"]:
+        for _attempt in range(2):
+            checker = SCENARIOS[scenario](seed, revert=True)
+            if checker.violations:
+                assert isinstance(checker.violations[0], exc_type), \
+                    checker.violations[0]
+                hits.append((seed, checker.violations[0]))
+                break
+    return hits
+
+
+def test_refinds_pr11_ring_double_free_with_fix_reverted():
+    """Negative control #1: with drain_unfreed_slots reverted to its
+    pre-fix shape (returns un-freed slots without marking them), the
+    committed seeds re-find the stale-free/respawn double recycle."""
+    hits = _refound("ring-free-respawn", schedcheck.DoubleRecycleError)
+    assert len(hits) >= 2, "reverted double-free fix was not re-found"
+    seed, v = hits[0]
+    assert f"seed {seed}" in str(v)
+    assert "this observation" in str(v) and "first participant" in str(v)
+
+
+def test_refinds_pr11_heartbeat_torn_read_with_fix_reverted():
+    """Negative control #2: with hb_publish's write ordering AND the
+    stall() started_at guard reverted, the committed seeds re-find the
+    pending-without-start torn read."""
+    hits = _refound("heartbeat-torn-read", schedcheck.HeartbeatTornReadError)
+    assert len(hits) >= 2, "reverted torn-read fix was not re-found"
+    _seed, v = hits[0]
+    assert "condemn a healthy child" in str(v)
+
+
+def test_refinds_pr12_uploader_spawn_race_with_fix_reverted():
+    hits = _refound("uploader-spawn-race", schedcheck.UploaderDuplicateError)
+    assert len(hits) >= 2, "reverted uploader spawn fix was not re-found"
+
+
+def test_refinds_pr11_stale_death_notice_with_fix_reverted():
+    hits = _refound("stale-death-notice", schedcheck.StaleDeathNoticeError)
+    assert len(hits) >= 2, "reverted death-notice pid check was not re-found"
+
+
+# -- CLI ----------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_cli_smoke_exits_zero_on_clean_tree():
+    """Duplicates ci.sh gate 8 exactly (a fresh-subprocess run of the
+    committed smoke subset), so it is excluded from tier-1 — the in-
+    process clean-sweep test above already covers the full seed set."""
+    import subprocess
+
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.schedx", "--smoke"], cwd=REPO,
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "all explored schedules clean" in proc.stdout
+
+
+def test_cli_lists_scenarios():
+    import subprocess
+
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.schedx", "--list"], cwd=REPO,
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0
+    for name in SCENARIOS:
+        assert name in proc.stdout

@@ -24,16 +24,29 @@ Passes (see each module's docstring for the rule and its history):
   (tools/analyze/swallow.py)
 * ``spawn-safety`` — multiprocessing must pin the spawn start method;
   no fork-after-jax-import (tools/analyze/spawnsafety.py)
+* ``resource-pairing`` — acquire-shaped calls (SharedMemory create,
+  multipart create, ring staging, observer attach, heartbeat tokens)
+  need a reachable release or a justified annotation
+  (tools/analyze/respair.py)
+* ``protocol-exhaustiveness`` — queue descriptor tags matched
+  send↔handle both directions; wrapper filesystems forward every
+  publish capability explicitly (tools/analyze/protocol.py)
+* ``clock-discipline`` — heartbeat/watchdog/deadline code uses
+  time.monotonic, never the wall clock (tools/analyze/clocks.py)
 
 Suppression is per-site and justified: ``# lint: <pass> ok — <reason>``
 on the flagged line or the line above.  A reason-less annotation is
-itself a finding.  The runtime complement (lock-order inversions only a
-live interleaving exposes) is ``kpw_tpu/utils/lockcheck.py``.
+itself a finding.  The runtime complements are
+``kpw_tpu/utils/lockcheck.py`` (lock-order inversions only a live
+interleaving exposes) and ``kpw_tpu/utils/schedcheck.py`` + tools/schedx
+(cross-process schedule exploration over the same protocol surfaces the
+static passes lint).
 """
 
 from __future__ import annotations
 
-from . import faultiso, hotimports, locks, names, spawnsafety, swallow
+from . import (clocks, faultiso, hotimports, locks, names, protocol,
+               respair, spawnsafety, swallow)
 
 # registration order = report order
 PASSES = {
@@ -43,6 +56,9 @@ PASSES = {
     faultiso.PASS_NAME: faultiso,
     swallow.PASS_NAME: swallow,
     spawnsafety.PASS_NAME: spawnsafety,
+    respair.PASS_NAME: respair,
+    protocol.PASS_NAME: protocol,
+    clocks.PASS_NAME: clocks,
 }
 
 PASS_NAMES = tuple(PASSES)

@@ -1,0 +1,86 @@
+"""Clock-discipline pass: heartbeat/watchdog/deadline code must measure
+time with ``time.monotonic`` (CLOCK_MONOTONIC), never the wall clock.
+
+The PR-11 heartbeat design leans on CLOCK_MONOTONIC being system-wide on
+Linux (a child's ``started_at`` stamp is compared against the parent
+watchdog's clock), and every stall/deadline computation in the tree is a
+*liveness* question: an NTP step or DST jump must never condemn a
+healthy worker or expire a live deadline.  ``time.time()`` in those
+modules is therefore a finding — wall time belongs only to naming
+(file timestamps) and operator-facing observability ages, which carry
+per-site annotations where they live in a scoped module.
+
+Scope: the declared module set below (full-repo runs).  For fixture /
+single-file runs (``full_repo`` False) a file is scoped when its
+basename carries a liveness cue (watchdog/heartbeat/deadline/clock/
+stall) — the same pattern as the hot-imports fixture mode.
+
+Suppression: ``# lint: clock-discipline ok — <reason>`` per site.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from .common import Config, Finding, ParsedFile, suppressed
+
+PASS_NAME = "clock-discipline"
+DESCRIPTION = ("heartbeat/watchdog/deadline code uses time.monotonic — "
+               "time.time()/datetime wall clocks there are findings")
+
+# the modules whose timing IS liveness: heartbeat cells + watchdog
+# scanning (procworkers), the watchdog itself, retry deadlines, and the
+# two runtime detectors (their probes reason about liveness windows)
+CLOCK_SCOPED = frozenset({
+    "kpw_tpu/runtime/watchdog.py",
+    "kpw_tpu/runtime/procworkers.py",
+    "kpw_tpu/runtime/retry.py",
+    "kpw_tpu/utils/lockcheck.py",
+    "kpw_tpu/utils/schedcheck.py",
+})
+
+_NAME_CUES = ("watchdog", "heartbeat", "deadline", "clock", "stall")
+
+# wall-clock calls: time.time() and the datetime constructors people
+# reach for instead of a monotonic source
+_WALL_ATTRS = {
+    ("time", "time"): "time.time()",
+    ("datetime", "now"): "datetime.now()",
+    ("datetime", "utcnow"): "datetime.utcnow()",
+}
+
+
+def _scoped(pf: ParsedFile, cfg: Config) -> bool:
+    if pf.path in CLOCK_SCOPED:
+        return True
+    if cfg.full_repo:
+        return False
+    base = os.path.basename(pf.path).lower()
+    return any(cue in base for cue in _NAME_CUES)
+
+
+def run(files: dict[str, ParsedFile], cfg: Config) -> list[Finding]:
+    findings: list[Finding] = []
+    for pf in files.values():
+        if not _scoped(pf, cfg):
+            continue
+        for node in ast.walk(pf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if not (isinstance(f, ast.Attribute)
+                    and isinstance(f.value, ast.Name)):
+                continue
+            spelled = _WALL_ATTRS.get((f.value.id, f.attr))
+            if spelled is None:
+                continue
+            if suppressed(pf, PASS_NAME, node.lineno, findings):
+                continue
+            findings.append(Finding(
+                PASS_NAME, pf.path, node.lineno,
+                f"{spelled} in a heartbeat/watchdog/deadline module — "
+                f"liveness math must use time.monotonic (an NTP step "
+                f"would condemn a healthy worker); wall time here needs "
+                f"a justified annotation"))
+    return findings

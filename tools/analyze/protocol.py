@@ -1,0 +1,234 @@
+"""Protocol-exhaustiveness pass: the two cross-process protocol surfaces
+that drifted (or nearly drifted) in PR 11/12, mechanized.
+
+**Descriptor tags.**  The process-worker queues speak tagged tuples —
+``("unit", seq, slot)`` out, ``("free"|"published"|"died"|...)`` back —
+and a tag sent without a receiving handler (or a handler for a tag
+nothing sends) is a protocol hole that only shows up as silently dropped
+acks or dead code.  Within any module that dispatches on tags (a
+``kind = msg[0]`` variable compared against string literals), every tag
+staged into a queue-shaped receiver (``*.put(("tag", ...))`` on a
+``*_q``/``*queue`` attribute) must have a matching comparison, and every
+compared tag must be sent by someone.  Modules with sends but no
+dispatch at all are skipped — there is no protocol table to drift.
+
+**Capability forwarding.**  ``io/fs.py publish_file`` dispatches the
+publish protocol on FileSystem CAPABILITIES: the ``supports_rename``
+class attribute and the capability-gated ``publish_commit`` method (the
+base raises TypeError by design).  A *wrapper* filesystem that forwards
+operations to an inner one but not the capabilities silently flips the
+wrapped sink's publish protocol — the ``FaultInjectingFileSystem`` bug
+caught in PR-12 review: ``__getattr__`` does NOT forward them, because
+the base class defines defaults that shadow it.  A wrapper (a FileSystem
+subclass with >= 3 same-name delegating methods to one ``self.<inner>``
+receiver) must therefore define every capability EXPLICITLY in its own
+class body (property, method, or assignment), or carry a justified
+annotation (``FailoverFileSystem`` rejects rename-less sides at
+construction, so the inherited defaults are correct by contract — the
+annotation records exactly that).
+
+Suppression: ``# lint: protocol-exhaustiveness ok — <reason>`` per site.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .common import Config, Finding, ParsedFile, suppressed
+
+PASS_NAME = "protocol-exhaustiveness"
+DESCRIPTION = ("queue descriptor tags matched send<->handle both "
+               "directions; wrapper filesystems must forward every "
+               "publish capability explicitly")
+
+_FS_MODULE = "kpw_tpu/io/fs.py"
+# fallback capability set for partial scans (fixtures, single files)
+# where io/fs.py is not in view — matches what the live base declares
+_DEFAULT_CAPABILITIES = frozenset({"supports_rename", "publish_commit"})
+_MIN_DELEGATIONS = 3
+
+
+# -- descriptor tags ---------------------------------------------------------
+
+def _queue_receiver(call: ast.Call) -> str | None:
+    """The queue-ish receiver name of an ``X.put(...)`` call, else None."""
+    f = call.func
+    if not (isinstance(f, ast.Attribute) and f.attr == "put"):
+        return None
+    recv = f.value
+    name = (recv.attr if isinstance(recv, ast.Attribute)
+            else recv.id if isinstance(recv, ast.Name) else None)
+    if name is None:
+        return None
+    if name == "q" or name.endswith("_q") or name.endswith("queue"):
+        return name
+    return None
+
+
+def _tag_protocol(pf: ParsedFile):
+    """(sent tags with line numbers, handled tags with line numbers) for
+    one module.  A handled tag is a string literal compared against a
+    variable assigned from a ``<msg>[0]`` subscript — the repo's
+    dispatch idiom."""
+    sends: list[tuple[str, int]] = []
+    kind_vars: set[str] = set()
+    for node in ast.walk(pf.tree):
+        if isinstance(node, ast.Call) and _queue_receiver(node) is not None:
+            if (node.args and isinstance(node.args[0], ast.Tuple)
+                    and node.args[0].elts
+                    and isinstance(node.args[0].elts[0], ast.Constant)
+                    and isinstance(node.args[0].elts[0].value, str)):
+                sends.append((node.args[0].elts[0].value, node.lineno))
+        elif isinstance(node, ast.Assign):
+            if (len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and isinstance(node.value, ast.Subscript)
+                    and isinstance(node.value.slice, ast.Constant)
+                    and node.value.slice.value == 0):
+                kind_vars.add(node.targets[0].id)
+    handles: list[tuple[str, int]] = []
+    for node in ast.walk(pf.tree):
+        if not isinstance(node, ast.Compare):
+            continue
+        if not (isinstance(node.left, ast.Name)
+                and node.left.id in kind_vars
+                and len(node.ops) == 1
+                and isinstance(node.ops[0], (ast.Eq, ast.NotEq))):
+            continue
+        comp = node.comparators[0]
+        if isinstance(comp, ast.Constant) and isinstance(comp.value, str):
+            handles.append((comp.value, node.lineno))
+    return sends, handles
+
+
+# -- capability forwarding ----------------------------------------------------
+
+def _base_capabilities(files: dict) -> set[str]:
+    """Capability names off the FileSystem base: plain class attributes
+    plus capability-gated methods (body raises TypeError — present but
+    not part of the abstract surface)."""
+    pf = files.get(_FS_MODULE)
+    if pf is None:
+        return set(_DEFAULT_CAPABILITIES)
+    for node in pf.tree.body:
+        if isinstance(node, ast.ClassDef) and node.name == "FileSystem":
+            caps: set[str] = set()
+            for item in node.body:
+                if (isinstance(item, ast.Assign)
+                        and len(item.targets) == 1
+                        and isinstance(item.targets[0], ast.Name)):
+                    caps.add(item.targets[0].id)
+                elif isinstance(item, ast.FunctionDef):
+                    for sub in ast.walk(item):
+                        if (isinstance(sub, ast.Raise)
+                                and isinstance(sub.exc, ast.Call)
+                                and isinstance(sub.exc.func, ast.Name)
+                                and sub.exc.func.id == "TypeError"):
+                            caps.add(item.name)
+                            break
+            return caps
+    return set(_DEFAULT_CAPABILITIES)
+
+
+def _is_fs_subclass(cls: ast.ClassDef) -> bool:
+    for base in cls.bases:
+        name = (base.id if isinstance(base, ast.Name)
+                else base.attr if isinstance(base, ast.Attribute) else "")
+        if name.endswith("FileSystem"):
+            return True
+    return False
+
+
+def _delegation_votes(cls: ast.ClassDef) -> dict[str, int]:
+    """How many of the class's methods forward a SAME-NAME call to a
+    common ``self.<attr>`` receiver — the wrapper signature.  Adapters
+    that translate to a foreign API (HDFS -> pyarrow) do not match."""
+    votes: dict[str, int] = {}
+    for item in cls.body:
+        if not isinstance(item, ast.FunctionDef):
+            continue
+        for sub in ast.walk(item):
+            if not isinstance(sub, ast.Call):
+                continue
+            f = sub.func
+            if (isinstance(f, ast.Attribute) and f.attr == item.name
+                    and isinstance(f.value, ast.Attribute)
+                    and isinstance(f.value.value, ast.Name)
+                    and f.value.value.id == "self"):
+                votes[f.value.attr] = votes.get(f.value.attr, 0) + 1
+                break  # one vote per method
+    return votes
+
+
+def _defined_names(cls: ast.ClassDef) -> set[str]:
+    out: set[str] = set()
+    for item in cls.body:
+        if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.add(item.name)
+        elif isinstance(item, ast.Assign):
+            for t in item.targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+        elif (isinstance(item, ast.AnnAssign)
+              and isinstance(item.target, ast.Name)):
+            # annotated class attr (`supports_rename: bool = False`)
+            out.add(item.target.id)
+    return out
+
+
+def run(files: dict[str, ParsedFile], cfg: Config) -> list[Finding]:
+    findings: list[Finding] = []
+    capabilities = _base_capabilities(files)
+    for pf in files.values():
+        # -- descriptor tags ------------------------------------------------
+        sends, handles = _tag_protocol(pf)
+        if handles:  # only modules that actually dispatch on tags
+            sent_tags = {t for t, _ in sends}
+            handled_tags = {t for t, _ in handles}
+            for tag, line in sends:
+                if tag in handled_tags:
+                    continue
+                if suppressed(pf, PASS_NAME, line, findings):
+                    continue
+                findings.append(Finding(
+                    PASS_NAME, pf.path, line,
+                    f"descriptor tag {tag!r} is sent across a queue but "
+                    f"no handler in this module compares against it — "
+                    f"the receiving side would drop it silently"))
+            seen: set[str] = set()
+            for tag, line in handles:
+                if tag in sent_tags or tag in seen:
+                    continue
+                seen.add(tag)
+                if suppressed(pf, PASS_NAME, line, findings):
+                    continue
+                findings.append(Finding(
+                    PASS_NAME, pf.path, line,
+                    f"handler compares against descriptor tag {tag!r} "
+                    f"that nothing sends — dead protocol arm (renamed "
+                    f"tag? stale handler?)"))
+        # -- capability forwarding ------------------------------------------
+        if pf.path == _FS_MODULE:
+            continue  # the base itself defines the capabilities
+        for node in pf.tree.body:
+            if not isinstance(node, ast.ClassDef) or not _is_fs_subclass(node):
+                continue
+            votes = _delegation_votes(node)
+            if not votes or max(votes.values()) < _MIN_DELEGATIONS:
+                continue  # adapter or leaf implementation, not a wrapper
+            defined = _defined_names(node)
+            missing = sorted(c for c in capabilities if c not in defined)
+            if not missing:
+                continue
+            if suppressed(pf, PASS_NAME, node.lineno, findings):
+                continue
+            inner = max(votes, key=lambda k: votes[k])
+            findings.append(Finding(
+                PASS_NAME, pf.path, node.lineno,
+                f"wrapper filesystem {node.name} (delegates to "
+                f"self.{inner}) does not forward capability(ies) "
+                f"{', '.join(missing)} — the base-class defaults shadow "
+                f"__getattr__, so wrapping a rename-less sink silently "
+                f"flips its publish protocol; define them explicitly or "
+                f"annotate why the defaults are correct"))
+    return findings

@@ -1,0 +1,110 @@
+"""Resource-pairing pass: every acquisition of an unlink/abort/free-shaped
+resource must have a matching release in reach, or carry a per-site
+justified annotation.
+
+The PR-12 review caught two instances of the same class by hand: orphaned
+multipart uploads (a ``create_multipart`` whose abort/complete could be
+skipped on a crash path) and dead store-observer accumulation (an
+``add_observer`` with NO removal API, attached unconditionally per
+adapter — every recovery/verify flow leaked a callback forever).  PR 11's
+shared-memory ring is the same shape (a ``SharedMemory`` create with no
+``unlink`` leaks a ``/dev/shm`` segment past the process).  This pass
+mechanizes the rule for the known acquire-shaped APIs in the tree:
+
+* a call to an acquire name (table below) requires at least one call to
+  one of its release names **in the same module** — module scope is the
+  deliberate approximation: the repo's resource lifecycles are owned by
+  one module each (ring, objectstore adapter, heartbeat), and a release
+  living in a different module is exactly the drift this pass should
+  surface for human review via an annotation;
+* an acquire whose release set is EMPTY (no removal API exists —
+  ``add_observer``) is always a finding: the annotation must justify why
+  unbounded accumulation cannot happen (the PR-12 fix gated attachment,
+  and the annotation records that reasoning next to the call);
+* ``SharedMemory`` counts as an acquisition only when its ``create``
+  keyword is present and not literally False — ``create=False`` is an
+  attach, and only the creator may unlink (cpython #82300 discipline).
+
+Suppression: ``# lint: resource-pairing ok — <reason>`` per site.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .common import Config, Finding, ParsedFile, suppressed
+
+PASS_NAME = "resource-pairing"
+DESCRIPTION = ("acquire-shaped calls (SharedMemory create, multipart "
+               "create, ring staging, observer attach, heartbeat tokens) "
+               "need a reachable release or a justified annotation")
+
+# acquire callee name -> (release callee names, human description).
+# An empty release tuple means no removal API exists: every call site
+# must carry a justified annotation.
+PAIRS: dict[str, tuple[tuple[str, ...], str]] = {
+    "SharedMemory": (("unlink",), "shared-memory segment"),
+    "create_multipart": (("abort_multipart", "complete_multipart"),
+                         "multipart upload"),
+    "write_slot_parts": (("note_free", "drain_unfreed_slots"),
+                         "staged ring slot"),
+    "io_started": (("io_finished",), "heartbeat pending-IO token"),
+    "add_observer": ((), "store observer (no removal API exists)"),
+}
+
+
+def _callee_name(node: ast.Call) -> str | None:
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return None
+
+
+def _is_acquisition(name: str, node: ast.Call) -> bool:
+    if name != "SharedMemory":
+        return True
+    for kw in node.keywords:
+        if kw.arg == "create":
+            if isinstance(kw.value, ast.Constant) and kw.value.value is False:
+                return False
+            return True
+    return False  # SharedMemory() default create=False: an attach
+
+
+def run(files: dict[str, ParsedFile], cfg: Config) -> list[Finding]:
+    findings: list[Finding] = []
+    for pf in files.values():
+        called: set[str] = set()
+        acquires: list[tuple[str, ast.Call]] = []
+        for node in ast.walk(pf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _callee_name(node)
+            if name is None:
+                continue
+            called.add(name)
+            if name in PAIRS and _is_acquisition(name, node):
+                acquires.append((name, node))
+        for name, node in acquires:
+            releases, what = PAIRS[name]
+            if releases and any(r in called for r in releases):
+                continue
+            if suppressed(pf, PASS_NAME, node.lineno, findings):
+                continue
+            if releases:
+                findings.append(Finding(
+                    PASS_NAME, pf.path, node.lineno,
+                    f"{name}(...) acquires a {what} but no release "
+                    f"({' / '.join(releases)}) is called anywhere in this "
+                    f"module — a crash/early-exit path here leaks it; add "
+                    f"the release or a justified annotation"))
+            else:
+                findings.append(Finding(
+                    PASS_NAME, pf.path, node.lineno,
+                    f"{name}(...) attaches a {what}: unbounded "
+                    f"accumulation unless the call site is gated — "
+                    f"justify with an annotation (the PR-12 dead-observer "
+                    f"leak is this exact class)"))
+    return findings

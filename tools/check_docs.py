@@ -395,6 +395,78 @@ def check_analyze_docs(docs: dict) -> list[str]:
     return failures
 
 
+# --- schedule-explorer / tsan claim reconciliation (ISSUE 13) ---------------
+# README's Correctness tooling section quotes the schedule explorer's
+# committed seed-set size and scenario count, and the tsan leg's
+# iteration configuration.  Those are CLAIMS about committed files
+# (tools/schedx/seeds.json, tools/sanitize.sh) and reconcile
+# mechanically like every bench number: quoted counts must equal the
+# committed ones, and every scenario must commit a non-empty refind set
+# — a scenario without its negative control is a detector nobody has
+# proven can detect.
+
+_SCHEDX_ANCHOR = re.compile(
+    r"\*\*(\d+)\*\*\s+committed\s+seeds\s+across\s+\*\*(\d+)\*\*\s+scenarios")
+_TSAN_ANCHOR = re.compile(
+    r"\*\*(\d+)\*\*\s+iterations\s+per\s+thread\s+across\s+"
+    r"\*\*(\d+)\*\*\s+threads")
+
+
+def _schedx_committed() -> dict:
+    with open(os.path.join(ROOT, "tools", "schedx", "seeds.json")) as f:
+        return json.load(f)["scenarios"]
+
+
+def _tsan_committed() -> tuple:
+    with open(os.path.join(ROOT, "tools", "sanitize.sh")) as f:
+        sh = f.read()
+    it = re.search(r"^TSAN_ITERS=(\d+)", sh, re.M)
+    th = re.search(r"^TSAN_THREADS=(\d+)", sh, re.M)
+    return (int(it.group(1)) if it else None,
+            int(th.group(1)) if th else None)
+
+
+def check_schedx_claims(docs: dict, scenarios: dict | None = None,
+                        tsan: tuple | None = None) -> list[str]:
+    if scenarios is None:
+        scenarios = _schedx_committed()
+    if tsan is None:
+        tsan = _tsan_committed()
+    failures = []
+    text = docs["README.md"]
+    m = _SCHEDX_ANCHOR.search(text)
+    total = sum(len(v.get("seeds", [])) for v in scenarios.values())
+    if m is None:
+        failures.append(
+            "README.md: schedule-explorer seed-count claim anchor not "
+            "found (/**N** committed seeds across **M** scenarios/)")
+    elif (int(m.group(1)), int(m.group(2))) != (total, len(scenarios)):
+        failures.append(
+            f"README.md: quotes {m.group(1)} committed seeds / "
+            f"{m.group(2)} scenarios but tools/schedx/seeds.json commits "
+            f"{total} / {len(scenarios)}")
+    for name, v in sorted(scenarios.items()):
+        if not v.get("refind_seeds"):
+            failures.append(
+                f"tools/schedx/seeds.json: scenario {name} commits no "
+                f"refind_seeds — its negative control is unproven")
+    it, th = tsan
+    m = _TSAN_ANCHOR.search(text)
+    if m is None:
+        failures.append(
+            "README.md: tsan iteration-count claim anchor not found "
+            "(/**N** iterations per thread across **T** threads/)")
+    elif it is None or th is None:
+        failures.append(
+            "tools/sanitize.sh: TSAN_ITERS/TSAN_THREADS assignments not "
+            "found — the committed tsan configuration moved")
+    elif (int(m.group(1)), int(m.group(2))) != (it, th):
+        failures.append(
+            f"README.md: quotes tsan {m.group(1)} iters x {m.group(2)} "
+            f"threads but tools/sanitize.sh commits {it} x {th}")
+    return failures
+
+
 def check_name_completeness(docs: dict) -> list[str]:
     """Every registered canonical metric/stage name must appear
     (backticked) somewhere in README or PARITY — completeness, the
@@ -515,6 +587,7 @@ def main() -> int:
     failures += check_durability_claims(docs)
     failures += check_analyze_docs(docs)
     failures += check_name_completeness(docs)
+    failures += check_schedx_claims(docs)
     for fname, pattern, paths in CHECKS:
         m = re.search(pattern, docs[fname])
         if not m:

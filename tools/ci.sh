@@ -1,11 +1,13 @@
 #!/usr/bin/env bash
-# Single CI entry point for the correctness tooling (ISSUE 7, README
-# "Correctness tooling"): the four gates, in cheap-to-expensive order,
-# each failing fast and loudly.
+# Single CI entry point for the correctness tooling (ISSUE 7/13, README
+# "Correctness tooling"): the gates, in cheap-to-expensive order, each
+# failing fast and loudly.
 #
 #   1. lint suite        — python -m tools.analyze   (static analysis:
 #                          lock discipline, hot imports, canonical
-#                          names, fault isolation, swallowed exceptions)
+#                          names, fault isolation, swallowed exceptions,
+#                          spawn safety, resource pairing, protocol
+#                          exhaustiveness, clock discipline)
 #   2. tier-1 pytest     — the fast suite (-m 'not slow'); compare the
 #                          passed count against the baseline in
 #                          CHANGES.md (this container carries ~31
@@ -38,12 +40,24 @@
 #                          mid-multipart crash replay recovers; exits
 #                          nonzero unless the invariant holds, committed
 #                          artifact never overwritten)
-#   8. doc reconciliation — python tools/check_docs.py (every doc-cited
-#                          number/name/test/pass exists and matches)
-#   9. sanitizer smoke   — bash tools/sanitize.sh --smoke (ASan/UBSan
+#   8. schedx smoke      — python -m tools.schedx --smoke (deterministic
+#                          schedule explorer: the committed seed subset
+#                          over the PR-11/12 race scenarios must run
+#                          CLEAN — a violation report carries its replay
+#                          seed and both participating stacks)
+#   9. doc reconciliation — python tools/check_docs.py (every doc-cited
+#                          number/name/test/pass/seed-count exists and
+#                          matches)
+#  10. sanitizer smoke   — bash tools/sanitize.sh --smoke (ASan/UBSan
 #                          native build + fuzz; prints a LOUD notice and
 #                          exits 0 when the toolchain is absent — never
 #                          a silent pass)
+#  11. tsan smoke        — bash tools/sanitize.sh --tsan --smoke
+#                          (ThreadSanitizer build of the GIL-released
+#                          entries driven from concurrent threads; the
+#                          deliberate-race canary must be REPORTED first
+#                          so the clean run is non-vacuous; loud SKIPPED
+#                          when libtsan is absent — never a silent pass)
 #
 # Usage: bash tools/ci.sh        (exit 0 = all gates green)
 
@@ -53,10 +67,10 @@ cd "$(dirname "$0")/.."
 fail=0
 step() { echo; echo "=== ci.sh [$1] $2 ==="; }
 
-step 1/9 "lint suite (python -m tools.analyze)"
+step 1/11 "lint suite (python -m tools.analyze)"
 python -m tools.analyze || fail=1
 
-step 2/9 "tier-1 pytest (-m 'not slow')"
+step 2/11 "tier-1 pytest (-m 'not slow')"
 # tier-1's exit code is nonzero on THIS container because of the known
 # environmental failures (python zstandard + jax shard_map absent — see
 # the CHANGES.md baseline), so the gate is mechanical instead of
@@ -79,26 +93,32 @@ if [ "$t1_errors" -gt 0 ] || [ "$t1_failed" -gt "$max_failed" ] \
 fi
 rm -f "$T1_LOG"
 
-step 3/9 "compaction smoke (bench.py --compact --smoke)"
+step 3/11 "compaction smoke (bench.py --compact --smoke)"
 JAX_PLATFORMS=cpu python bench.py --compact --smoke || fail=1
 
-step 4/9 "scan smoke (bench.py --scan --smoke)"
+step 4/11 "scan smoke (bench.py --scan --smoke)"
 JAX_PLATFORMS=cpu python bench.py --scan --smoke || fail=1
 
-step 5/9 "e2e smoke (bench.py --e2e --smoke)"
+step 5/11 "e2e smoke (bench.py --e2e --smoke)"
 JAX_PLATFORMS=cpu python bench.py --e2e --smoke || fail=1
 
-step 6/9 "process-mode smoke (bench.py --procs --smoke)"
+step 6/11 "process-mode smoke (bench.py --procs --smoke)"
 JAX_PLATFORMS=cpu python bench.py --procs --smoke || fail=1
 
-step 7/9 "object-store smoke (bench.py --objstore --smoke)"
+step 7/11 "object-store smoke (bench.py --objstore --smoke)"
 JAX_PLATFORMS=cpu python bench.py --objstore --smoke || fail=1
 
-step 8/9 "doc reconciliation (tools/check_docs.py)"
+step 8/11 "schedule-explorer smoke (python -m tools.schedx --smoke)"
+JAX_PLATFORMS=cpu python -m tools.schedx --smoke || fail=1
+
+step 9/11 "doc reconciliation (tools/check_docs.py)"
 python tools/check_docs.py || fail=1
 
-step 9/9 "sanitizer smoke (tools/sanitize.sh --smoke)"
+step 10/11 "sanitizer smoke (tools/sanitize.sh --smoke)"
 bash tools/sanitize.sh --smoke || fail=1
+
+step 11/11 "tsan smoke (tools/sanitize.sh --tsan --smoke)"
+bash tools/sanitize.sh --tsan --smoke || fail=1
 
 echo
 if [ "$fail" -ne 0 ]; then

@@ -1,14 +1,28 @@
 #!/usr/bin/env bash
-# ASan/UBSan leg of the correctness tooling (ISSUE 7): build the native
-# shredders/codecs with -fsanitize=address,undefined and run the
-# shred/gather/offset-validation and verify/thrift test subsets plus the
-# seeded mutation-fuzz harness under them.  Every native OOB/UB the
-# hardening PRs fixed by hand (thrift CompactReader, the shred_flat_buf
-# malformed-offset read) traps loudly here instead of reading garbage.
+# Sanitizer legs of the correctness tooling (ISSUE 7 ASan/UBSan, ISSUE 13
+# TSan — coverage is NOT ASan/UBSan-only since round 17):
 #
-# Usage:  bash tools/sanitize.sh [--smoke]
-#   --smoke  : smaller fuzz iteration count (CI entry point; default is
+#   default        : ASan/UBSan — build the native shredders/codecs with
+#                    -fsanitize=address,undefined and run the shred/
+#                    gather/offset-validation and verify/thrift test
+#                    subsets plus the seeded mutation-fuzz harness under
+#                    them.  Every native OOB/UB the hardening PRs fixed
+#                    by hand (thrift CompactReader, the shred_flat_buf
+#                    malformed-offset read) traps loudly here instead of
+#                    reading garbage.
+#   --tsan         : ThreadSanitizer — build with -fsanitize=thread
+#                    (KPW_NATIVE_SANITIZE=tsan, separate _kpw_*_tsan.so
+#                    caches) and drive the GIL-released entries
+#                    (shred_flat_buf / gather_buf / assemble_pages) from
+#                    concurrent threads via python -m tools.tsan_stress.
+#                    A deliberate-race canary (--canary) must be REPORTED
+#                    by TSan first, so the clean run is never vacuous.
+#
+# Usage:  bash tools/sanitize.sh [--smoke] [--tsan]
+#   --smoke  : smaller iteration counts (CI entry point; defaults are
 #              the committed regression configuration below)
+#   --tsan   : run ONLY the TSan leg (tools/ci.sh runs both as separate
+#              steps so each skips/fails independently)
 #
 # Skip policy: when g++ or the sanitizer runtimes are absent the script
 # prints an UNMISSABLE notice and exits 0 — a missing toolchain must
@@ -16,39 +30,98 @@
 # difference), and must not fail CI on boxes that legitimately lack it.
 #
 # Mechanics worth knowing (cost us a debugging session each):
-#   * the host python is NOT instrumented, so libasan/libubsan must be
-#     LD_PRELOADed or the sanitized .so fails to load;
+#   * the host python is NOT instrumented, so libasan/libubsan/libtsan
+#     must be LD_PRELOADed or the sanitized .so fails to load;
 #   * PYTHONMALLOC=malloc is REQUIRED for ASan to see Python-owned
 #     buffers — pymalloc arenas bypass malloc interception, and without
 #     this an out-of-bounds read into a neighboring arena page is
 #     silent (verified with a deliberate OOB through gather_buf);
-#   * sanitized artifacts cache as _kpw_*_san.so next to the normal
-#     ones (kpw_tpu/native/build.py KPW_NATIVE_SANITIZE=1), so this
-#     script never pollutes the fast build.
+#   * the TSan artifacts must be PREBUILT by an un-preloaded python:
+#     forking g++ out of a TSan-preloaded interpreter that already has
+#     live threads (jax's import machinery) deadlocks in subprocess —
+#     so the tsan leg builds first, preloads second;
+#   * sanitized artifacts cache as _kpw_*_san.so / _kpw_*_tsan.so next
+#     to the normal ones (kpw_tpu/native/build.py KPW_NATIVE_SANITIZE),
+#     so this script never pollutes the fast build.
 
 set -u -o pipefail
 cd "$(dirname "$0")/.."
 
 FUZZ_ITERS=2000          # committed regression configuration (seed is
 SEED=20260803            # tools/fuzz.py DEFAULT_SEED — keep in sync)
-if [ "${1:-}" = "--smoke" ]; then
-    FUZZ_ITERS=500
-fi
+TSAN_ITERS=200           # committed per-thread iteration count
+TSAN_THREADS=4
+MODE=asan
+for arg in "$@"; do
+    case "$arg" in
+        --smoke) FUZZ_ITERS=500; TSAN_ITERS=60 ;;
+        --tsan)  MODE=tsan ;;
+        *) echo "unknown arg: $arg" >&2; exit 2 ;;
+    esac
+done
 
 loud_skip() {
     echo "=============================================================="
     echo "SANITIZER SMOKE SKIPPED (NOT PASSED): $1"
-    echo "The ASan/UBSan leg did not run. Install g++ with libasan/"
-    echo "libubsan to exercise it. This is a loud no-op, never a pass."
+    echo "The $2 leg did not run. Install g++ with the sanitizer"
+    echo "runtimes to exercise it. This is a loud no-op, never a pass."
     echo "=============================================================="
     exit 0
 }
 
-command -v g++ >/dev/null 2>&1 || loud_skip "g++ not found"
+command -v g++ >/dev/null 2>&1 || loud_skip "g++ not found" "$MODE"
+
+if [ "$MODE" = "tsan" ]; then
+    TSAN_LIB="$(g++ -print-file-name=libtsan.so)"
+    [ -e "$TSAN_LIB" ] || loud_skip "libtsan.so not found ($TSAN_LIB)" "TSan"
+    # canary: the preload must produce a working interpreter (TSan's
+    # shadow mappings can fail on exotic kernels) — a broken runtime is
+    # a SKIP, not a silent pass and not a spurious failure
+    if ! LD_PRELOAD="$TSAN_LIB" python -c "print('ok')" >/dev/null 2>&1; then
+        loud_skip "libtsan preload cannot start python on this host" "TSan"
+    fi
+    export JAX_PLATFORMS=cpu
+    echo "== sanitize.sh --tsan: prebuilding tsan artifacts (no preload) =="
+    # prebuild WITHOUT the preload: forking g++ from a TSan-preloaded,
+    # already-threaded interpreter deadlocks in subprocess
+    KPW_NATIVE_SANITIZE=tsan python -c "
+from kpw_tpu.native import build
+build._build(); build._build_pyshred(); build._build_assemble()
+print('tsan artifacts built')" || exit 1
+    echo "== sanitize.sh --tsan: deliberate-race canary (must be REPORTED) =="
+    CANARY_LOG="$(mktemp)"
+    # exitcode=0 makes TSan's own reports exit clean, so a NONZERO exit
+    # here is unambiguously harness breakage (import error, .so failed
+    # to load) — a hard failure, never a skip
+    if ! KPW_NATIVE_SANITIZE=tsan LD_PRELOAD="$TSAN_LIB" \
+        TSAN_OPTIONS="halt_on_error=0 exitcode=0" \
+        python -m tools.tsan_stress --canary >"$CANARY_LOG" 2>&1; then
+        echo "sanitize.sh: the tsan canary HARNESS crashed (see below) —"
+        echo "this is a broken gate, not a missing toolchain"
+        tail -10 "$CANARY_LOG"
+        exit 1
+    fi
+    if ! grep -q "WARNING: ThreadSanitizer: data race" "$CANARY_LOG"; then
+        echo "TSan did NOT report the deliberate race — the leg would be"
+        echo "vacuous; treating as a loud skip (see $CANARY_LOG)"
+        tail -5 "$CANARY_LOG"
+        loud_skip "deliberate-race canary not reported" "TSan"
+    fi
+    rm -f "$CANARY_LOG"
+    echo "== sanitize.sh --tsan: concurrent native entries, 0 races required =="
+    KPW_NATIVE_SANITIZE=tsan LD_PRELOAD="$TSAN_LIB" \
+        TSAN_OPTIONS="halt_on_error=1" \
+        python -m tools.tsan_stress --iters "$TSAN_ITERS" \
+            --threads "$TSAN_THREADS" || {
+        echo "sanitize.sh: TSan FOUND RACES (or the stress diverged)"; exit 1; }
+    echo "sanitize.sh: tsan leg clean (threads=$TSAN_THREADS, iters=$TSAN_ITERS)"
+    exit 0
+fi
+
 ASAN_LIB="$(g++ -print-file-name=libasan.so)"
 UBSAN_LIB="$(g++ -print-file-name=libubsan.so)"
-[ -e "$ASAN_LIB" ] || loud_skip "libasan.so not found ($ASAN_LIB)"
-[ -e "$UBSAN_LIB" ] || loud_skip "libubsan.so not found ($UBSAN_LIB)"
+[ -e "$ASAN_LIB" ] || loud_skip "libasan.so not found ($ASAN_LIB)" "ASan/UBSan"
+[ -e "$UBSAN_LIB" ] || loud_skip "libubsan.so not found ($UBSAN_LIB)" "ASan/UBSan"
 
 export KPW_NATIVE_SANITIZE=1
 export PYTHONMALLOC=malloc
@@ -93,3 +166,4 @@ if [ "$rc" -ne 0 ]; then
     exit 1
 fi
 echo "sanitize.sh: sanitized subsets + fuzz (iters=$FUZZ_ITERS, seed=$SEED) all clean"
+echo "(TSan leg runs separately: bash tools/sanitize.sh --tsan)"

@@ -1,0 +1,32 @@
+"""schedx: the deterministic concurrency-schedule explorer CLI.
+
+Runs the scenario drivers in ``tools/schedx/scenarios.py`` — the PR-11/12
+cross-process race windows reconstructed over REAL repo code — across a
+committed seed set (``tools/schedx/seeds.json``), with every preemption
+schedule determined by its seed (see ``kpw_tpu/utils/schedcheck.py``).
+
+    python -m tools.schedx               # committed seeds, exit 0 = clean
+    python -m tools.schedx --smoke       # CI subset of the seeds
+    python -m tools.schedx --revert      # negative control: pre-fix shapes
+    python -m tools.schedx --scenario ring-free-respawn --seeds 0:64
+
+The current tree must be CLEAN across the whole committed seed set
+(tests/test_schedx.py pins it); ``--revert`` swaps each scenario's
+historical pre-fix method back in test-locally, and the committed
+``refind_seeds`` must re-find every historical race — the negative
+control proving the explorer detects what it claims to."""
+
+from __future__ import annotations
+
+import json
+import os
+
+from .scenarios import HISTORY, SCENARIOS
+
+SEED_FILE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "seeds.json")
+
+
+def load_seeds(path: str | None = None) -> dict:
+    with open(path or SEED_FILE) as f:
+        return json.load(f)["scenarios"]

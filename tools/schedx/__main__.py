@@ -1,0 +1,106 @@
+"""CLI for the schedule explorer: ``python -m tools.schedx``.
+
+Exit codes: 0 = every explored schedule clean, 1 = violations found
+(each reported with its replay seed and both participating stacks),
+2 = usage error.  ``--revert`` is the negative-control mode: it expects
+violations (that is the point) and exits 0 iff every scenario's
+committed ``refind_seeds`` re-found its historical race."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from . import HISTORY, SCENARIOS, load_seeds
+
+
+def _parse_seed_range(spec: str) -> list[int]:
+    if ":" in spec:
+        a, b = spec.split(":", 1)
+        return list(range(int(a), int(b)))
+    return [int(spec)]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.schedx",
+        description="deterministic concurrency-schedule explorer "
+                    "(see tools/schedx/__init__.py)")
+    ap.add_argument("--scenario", action="append", default=[],
+                    metavar="NAME", help="run only this scenario "
+                    "(repeatable; default: all)")
+    ap.add_argument("--seeds", default=None, metavar="N|A:B",
+                    help="explicit seed or seed range (default: the "
+                         "committed seed set in tools/schedx/seeds.json)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="CI subset: first 4 committed seeds per scenario")
+    ap.add_argument("--revert", action="store_true",
+                    help="negative control: reintroduce each scenario's "
+                         "pre-fix shape test-locally and REQUIRE the "
+                         "committed refind_seeds to re-find the race")
+    ap.add_argument("--virtual", action="store_true",
+                    help="virtual delays (yield loops) for fast wide "
+                         "seed walks; committed seeds use wall delays")
+    ap.add_argument("--list", action="store_true",
+                    help="list scenarios and exit")
+    args = ap.parse_args(argv)
+
+    if args.list:
+        for name in SCENARIOS:
+            print(f"{name}: {HISTORY[name]}")
+        return 0
+    for name in args.scenario:
+        if name not in SCENARIOS:
+            print(f"unknown scenario {name!r}; known: "
+                  f"{', '.join(SCENARIOS)}", file=sys.stderr)
+            return 2
+
+    committed = load_seeds()
+    names = args.scenario or list(SCENARIOS)
+    failures = 0
+    for name in names:
+        entry = committed.get(name, {})
+        if args.seeds is not None:
+            seeds = _parse_seed_range(args.seeds)
+        elif args.revert:
+            seeds = entry.get("refind_seeds", [])
+        else:
+            seeds = entry.get("seeds", [])
+        if args.smoke:
+            seeds = seeds[:4]
+        found: list[int] = []
+        for seed in seeds:
+            checker = SCENARIOS[name](seed, revert=args.revert,
+                                      virtual=args.virtual)
+            if checker.violations:
+                found.append(seed)
+                for v in checker.violations:
+                    first = str(v).splitlines()[0]
+                    print(f"[{name} seed={seed}] {type(v).__name__}: "
+                          f"{first}")
+                    if args.seeds is not None or not args.revert:
+                        # full report (both stacks) for unexpected finds
+                        print(str(v))
+        if args.revert:
+            ok = bool(found)
+            print(f"schedx --revert {name}: {len(found)}/{len(seeds)} "
+                  f"seeds re-found the {HISTORY[name]} "
+                  f"({'OK' if ok else 'FAILED — fix revert found nothing'})")
+            if not ok and seeds:
+                failures += 1
+        else:
+            print(f"schedx {name}: {len(seeds)} seed(s) explored, "
+                  f"{len(found)} violation(s)")
+            failures += len(found)
+    if args.revert:
+        return 1 if failures else 0
+    if failures:
+        print(f"schedx: {failures} violated schedule(s) — each report "
+              f"above carries its replay seed", file=sys.stderr)
+        return 1
+    print("schedx: all explored schedules clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,423 @@
+"""Schedule-exploration scenarios: the cross-process race windows PR 11
+and PR 12 fixed by hand, each reconstructed as two REAL repo code paths
+racing under a seeded ``kpw_tpu.utils.schedcheck`` install.
+
+Every scenario runs the production classes (``ProcessWorkerPool``,
+``_ProcWorkerSlot``, ``ShmBatchRing``, ``_ProcHeartbeat``,
+``ObjectStoreFileSystem``) — not models of them — and relies on the
+invariant probes registered inside those classes to detect a violated
+schedule.  ``revert=True`` swaps in the PRE-FIX shape of exactly one
+method (reintroduced test-locally below, the negative-control pattern of
+``test_fuzz_reporting_path_detects_crashes``): under the reverted fix a
+committed subset of seeds MUST re-find the historical race, and under
+the current tree every committed seed must run clean — both pinned by
+tests/test_schedx.py.
+
+Determinism: a seed fully determines which preemption points park and
+for how long (per-(seed, label, occurrence) coins — see
+``SchedCheck._coin``); a parked thread stays parked while the racing
+thread's whole critical region completes, so on any box the schedule a
+seed selects replays.  The scenarios keep their racy regions tiny
+(microseconds) against delays of tens of milliseconds for exactly this
+reason.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import os
+import queue
+import shutil
+import sys
+import tempfile
+import threading
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+for _p in (_REPO, os.path.join(_REPO, "tests")):
+    if _p not in sys.path:
+        sys.path.insert(0, _p)
+
+from kpw_tpu.utils import schedcheck  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# harness plumbing
+# ---------------------------------------------------------------------------
+
+class _ScenarioWriter:
+    """The minimal writer surface ``ProcessWorkerPool`` touches on the
+    probed paths (collector free/died branches, respawn bookkeeping) —
+    real ``Meter``s so the production code runs unmodified."""
+
+    def __init__(self, b) -> None:
+        from kpw_tpu.runtime.metrics import Histogram, Meter
+
+        self._b = b
+        self._restart_counts = collections.defaultdict(int)
+        for name in ("_written_records", "_written_bytes",
+                     "_flushed_records", "_flushed_bytes", "_failed",
+                     "_verified", "_verify_failed", "_quarantined",
+                     "_rotated_time", "_rotated_size", "_indexed",
+                     "_bloom_bytes_meter", "_native_asm_chunks",
+                     "_native_asm_pages"):
+            setattr(self, name, Meter())
+        self._file_size_histogram = Histogram()
+        self.deaths_notified = 0
+
+    def _notify_worker_death(self) -> None:
+        self.deaths_notified += 1
+
+
+def _make_pool(tmpdir: str, workers: int = 1, ring_slots: int = 4):
+    from proto_helpers import sample_message_class
+
+    from kpw_tpu import Builder
+    from kpw_tpu.runtime.procworkers import ProcessWorkerPool
+
+    b = (Builder().proto_class(sample_message_class())
+         .target_dir(tmpdir).instance_name("schedx")
+         .process_workers(workers, ring_slots=ring_slots,
+                          slot_bytes=1 << 16))
+    return ProcessWorkerPool(_ScenarioWriter(b))
+
+
+def _close_pool(pool) -> None:
+    pool._stop.set()
+    for s in pool.slots:
+        with contextlib.suppress(OSError, ValueError):
+            s.work_q.close()
+    with contextlib.suppress(OSError, ValueError):
+        pool.ack_q.close()
+    pool.ring.close()
+    pool.ring.unlink()
+
+
+class _Patch:
+    def __init__(self, owner, name, replacement) -> None:
+        self.owner, self.name = owner, name
+        self.original = getattr(owner, name)
+        setattr(owner, name, replacement)
+
+    def undo(self) -> None:
+        setattr(self.owner, self.name, self.original)
+
+
+def _run_threads(targets, timeout_s: float = 10.0) -> None:
+    """Run the racing parties; a ScheduleViolation raised inside a party
+    is already recorded on the checker — swallow it there so the harness
+    reports through ``checker.violations`` uniformly.  Anything ELSE is
+    harness/regression breakage and must not read as a clean seed: a
+    non-violation exception re-raises here, and a party still alive
+    after the join (deadlock) is an explicit failure."""
+    errors: list[BaseException] = []
+
+    def wrap(fn):
+        def body():
+            try:
+                fn()
+            except schedcheck.ScheduleViolation:
+                pass
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                errors.append(e)
+        return body
+
+    threads = [threading.Thread(target=wrap(t), daemon=True)
+               for t in targets]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout_s)
+    hung = [t for t in threads if t.is_alive()]
+    if hung:
+        raise RuntimeError(
+            f"{len(hung)} racing part(y/ies) still running after "
+            f"{timeout_s}s — deadlocked schedule, NOT a clean seed")
+    if errors:
+        raise errors[0]
+
+
+# ---------------------------------------------------------------------------
+# scenario: ring slot double-free (PR-11 stale free ack vs. respawn)
+# ---------------------------------------------------------------------------
+
+def _legacy_drain_unfreed_slots(self):
+    # PR-11 PRE-FIX shape, reintroduced test-locally for the negative
+    # control: returns the un-freed slots WITHOUT marking them freed, so
+    # a stale `free` ack that lands after the respawn reclaim finds its
+    # ledger entry still live and recycles the same ring slot again
+    with self._mu:
+        return [e["slot"] for e in self._ledger.values() if not e["freed"]]
+
+
+def ring_free_respawn(seed: int, revert: bool = False,
+                      virtual: bool = False):
+    """A child died after sending its last ``free`` ack: the collector
+    handles the stale ack while the supervisor respawn reclaims the dead
+    child's un-drained slots.  Exactly one of them may recycle the ring
+    slot; the double-recycle probe in ``ProcessWorkerPool._recycle_slot``
+    catches the schedules where both do."""
+    from kpw_tpu.runtime import procworkers as pw
+
+    # perturbation is ONE-SIDED (the stale-ack party only) and the delays
+    # dwarf thread-scheduling noise on a loaded box: a seed's verdict
+    # then depends only on its own coins, never on how long the racing
+    # respawn happened to take — that is what makes the seed replay
+    checker = schedcheck.install(
+        seed=seed, virtual=virtual, max_delay_s=0.25,
+        labels=("proc.collector.free", "proc.slot.note_free"))
+    patches = []
+    if revert:
+        patches.append(_Patch(pw._ProcWorkerSlot, "drain_unfreed_slots",
+                              _legacy_drain_unfreed_slots))
+    tmpdir = tempfile.mkdtemp(prefix="schedx-ring-")
+    try:
+        pool = _make_pool(tmpdir)
+        try:
+            ri = pool._get_free_slot()
+            pool.slots[0].note_dispatch(seq=1, runs=[(0, 0, 5)], count=5,
+                                        nbytes=10, slot_idx=ri)
+            _run_threads([
+                lambda: pool._handle(("free", 0, ri, 1)),
+                lambda: pool.respawn_slot(0),
+            ])
+        finally:
+            _close_pool(pool)
+    finally:
+        for p in patches:
+            p.undo()
+        schedcheck.uninstall()
+        shutil.rmtree(tmpdir, ignore_errors=True)
+    return checker
+
+
+# ---------------------------------------------------------------------------
+# scenario: heartbeat torn read (PR-11 pending-without-start)
+# ---------------------------------------------------------------------------
+
+def _legacy_hb_publish(self, widx, label_code, pending, started_at):
+    # PR-11 PRE-FIX single-path version, reintroduced test-locally: one
+    # write order for set AND clear with the pending flag flipped BEFORE
+    # the started_at stamp — a racing watchdog read between the two
+    # observes pending=1 paired with the previous clear's 0.0 clock and
+    # computes an enormous stall age (healthy child condemned)
+    if self._hb_i is None:
+        return
+    schedcheck.note_hb_write(widx)
+    self._hb_i[widx, 0] = label_code
+    self._hb_i[widx, 1] = 1 if pending else 0
+    schedcheck.point("proc.hb.publish.legacy")
+    self._hb_f[widx, 2] = started_at
+    self._hb_f[widx, 3] = time.monotonic()
+
+
+def _legacy_stall(self):
+    # PR-11 PRE-FIX stall(): no started_at==0.0 guard — the historical
+    # fix was two-layer (publish write order AND this guard), so the
+    # negative control reverts both.  The probe call is the same
+    # computation-site invariant the fixed stall() carries.
+    from kpw_tpu.runtime.procworkers import _HB_LABELS
+
+    code, pending, started_at, _beat = self._ring.hb_read(self._widx)
+    if not pending:
+        return 0.0, None
+    schedcheck.note_hb_sample(self._widx, True, started_at)
+    label = (_HB_LABELS[code - 1]
+             if 1 <= code <= len(_HB_LABELS) else "io")
+    return max(0.0, time.monotonic() - started_at), label
+
+
+def heartbeat_torn_read(seed: int, revert: bool = False,
+                        virtual: bool = False):
+    """A child's heartbeat publisher cycles pending set/clear around
+    short IO ops while the parent-side watchdog adapter samples
+    ``stall()`` concurrently — the torn-read probe in
+    ``_ProcHeartbeat.stall`` rejects any schedule where pending is
+    observable without its started_at stamp."""
+    from kpw_tpu.runtime import procworkers as pw
+
+    checker = schedcheck.install(
+        seed=seed, virtual=virtual, max_delay_s=0.01,
+        labels=("proc.hb.publish", "proc.hb.publish.legacy"))
+    patches = []
+    if revert:
+        patches.append(_Patch(pw.ShmBatchRing, "hb_publish",
+                              _legacy_hb_publish))
+        patches.append(_Patch(pw._ProcHeartbeat, "stall", _legacy_stall))
+    ring = pw.ShmBatchRing(1, 1 << 15)
+    hb = pw._ProcHeartbeat(ring, 0)
+    done = threading.Event()
+    try:
+        def publisher():
+            try:
+                for _ in range(40):
+                    ring.hb_publish(0, 1, True, time.monotonic())
+                    ring.hb_publish(0, 0, False, 0.0)
+            finally:
+                done.set()
+
+        def watchdog_reader():
+            while not done.is_set():
+                try:
+                    hb.stall()
+                except schedcheck.ScheduleViolation:
+                    pass  # recorded; keep sampling the remaining cycles
+
+        _run_threads([publisher, watchdog_reader], timeout_s=20.0)
+    finally:
+        for p in patches:
+            p.undo()
+        schedcheck.uninstall()
+        ring.close()
+        ring.unlink()
+    return checker
+
+
+# ---------------------------------------------------------------------------
+# scenario: background uploader spawn race (PR-12)
+# ---------------------------------------------------------------------------
+
+def _legacy_ensure_uploader(self):
+    # PR-12 PRE-FIX shape, reintroduced test-locally: the singleton is
+    # liveness-checked and assigned under the lock but STARTED outside
+    # it — a concurrent first-part submitter observes is_alive() False
+    # on the not-yet-started thread and spawns a second drainer on the
+    # same queue (two drainers reorder a dirty re-upload behind its
+    # stale original)
+    with self._mu:
+        if self._uploader is not None and self._uploader.is_alive():
+            return
+        if self._q is None:
+            self._q = queue.Queue()
+        t = threading.Thread(target=self._uploader_loop,
+                             name="KPW-objstore-uploader", daemon=True)
+        self._uploader = t
+        schedcheck.note_uploader_spawn(id(self))
+    schedcheck.point("objstore.uploader.legacy")
+    t.start()
+
+
+def uploader_spawn_race(seed: int, revert: bool = False,
+                        virtual: bool = False):
+    """Two encode threads submit their first completed part concurrently
+    on a fresh adapter; the uploader-singleton probe rejects any
+    schedule that spawns a second drainer loop."""
+    from kpw_tpu.io import objectstore as objs
+
+    checker = schedcheck.install(
+        seed=seed, virtual=virtual, max_delay_s=0.1,
+        labels=("objstore.uploader.ensure", "objstore.uploader.legacy",
+                "thread.start:KPW-objstore-uploader"))
+    patches = []
+    if revert:
+        patches.append(_Patch(objs.ObjectStoreFileSystem,
+                              "_ensure_uploader", _legacy_ensure_uploader))
+    store = objs.EmulatedObjectStore()
+    fs = objs.ObjectStoreFileSystem(store, "schedx", part_size=4096)
+    try:
+        pendings = []
+        for name in ("a", "b"):
+            p = objs._Pending(fs._key(f"/t/{name}.tmp"))
+            p.upload_id = store.create_multipart("schedx", p.key)
+            pendings.append(p)
+        _run_threads([
+            lambda: fs._submit_part(pendings[0], 1, b"x" * 4096),
+            lambda: fs._submit_part(pendings[1], 1, b"y" * 4096),
+        ])
+        # wait out the drainer(s) so the store teardown is quiet
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            with fs._mu:
+                if all(p.inflight == 0 for p in pendings):
+                    break
+            time.sleep(0.005)
+    finally:
+        for p in patches:
+            p.undo()
+        schedcheck.uninstall()
+        # poison the drainer(s): the production loop only exits on None
+        # and a daemon thread parked in q.get() would otherwise outlive
+        # every seed run, pinning the adapter+store for process lifetime
+        if fs._q is not None:
+            for _ in range(2):  # a reverted run may have spawned two
+                fs._q.put(None)
+    return checker
+
+
+# ---------------------------------------------------------------------------
+# scenario: stale death notice vs. respawned slot (PR-11)
+# ---------------------------------------------------------------------------
+
+def _legacy_handle_died(pool, msg):
+    # PR-11 PRE-FIX died branch, reintroduced test-locally: no
+    # sender-pid check — a death notice delayed in the ack queue past
+    # the supervisor's respawn condemns the index's healthy replacement
+    _, widx, pid, reason = msg
+    schedcheck.point("proc.collector.died")
+    slot = pool.slots[widx]
+    acted = not slot.failed and not slot.condemned
+    schedcheck.note_death_notice(slot.pid, pid, acted)
+    if acted:
+        slot.exit_reason = reason
+        slot.failed = True
+        pool.w._failed.mark()
+        pool.w._notify_worker_death()
+
+
+def stale_death_notice(seed: int, revert: bool = False,
+                       virtual: bool = False):
+    """A delayed ``died`` message from the slot's previous occupant races
+    the supervisor respawn that already replaced it; the death-notice
+    probe rejects any schedule that condemns a process other than the
+    sender."""
+    # one-sided perturbation (see ring_free_respawn): only the delivery
+    # parks, and its park must dwarf the racing respawn's slot rebuild
+    # (proto descriptor closure + spawn Process/Queue objects — tens of
+    # ms under load) for the seed to replay
+    checker = schedcheck.install(
+        seed=seed, virtual=virtual, max_delay_s=0.4,
+        labels=("proc.collector.died",))
+    tmpdir = tempfile.mkdtemp(prefix="schedx-died-")
+    try:
+        pool = _make_pool(tmpdir)
+        try:
+            old_pid = 4242
+            pool.slots[0].pid = old_pid  # the notice's sender
+
+            def deliver():
+                msg = ("died", 0, old_pid, "child terminated")
+                if revert:
+                    _legacy_handle_died(pool, msg)
+                else:
+                    pool._handle(msg)
+
+            def respawn():
+                pool.respawn_slot(0)
+                pool.slots[0].pid = 5151  # replacement reported ready
+
+            _run_threads([deliver, respawn])
+        finally:
+            _close_pool(pool)
+    finally:
+        schedcheck.uninstall()
+        shutil.rmtree(tmpdir, ignore_errors=True)
+    return checker
+
+
+# registration order = report order; names are the CLI / seeds.json keys
+SCENARIOS = {
+    "ring-free-respawn": ring_free_respawn,
+    "heartbeat-torn-read": heartbeat_torn_read,
+    "uploader-spawn-race": uploader_spawn_race,
+    "stale-death-notice": stale_death_notice,
+}
+
+# which historical PR the reverted fix belongs to (reporting only)
+HISTORY = {
+    "ring-free-respawn": "PR-11 shm ring slot double-free",
+    "heartbeat-torn-read": "PR-11 heartbeat torn read",
+    "uploader-spawn-race": "PR-12 uploader-thread spawn race",
+    "stale-death-notice": "PR-11 stale death notice",
+}

@@ -1,0 +1,169 @@
+"""Concurrent-thread stress over the GIL-released native entries — the
+ThreadSanitizer leg's workload (``tools/sanitize.sh --tsan``).
+
+The repo's native hot path deliberately runs WITHOUT the GIL:
+``shred_flat_buf``/``gather_buf`` (PR 6) decode broker buffers while the
+encode pipeline thread runs, and ``assemble_pages`` (PR 10) assembles
+whole column chunks concurrently from the encoder pool.  A data race in
+that code is a real race no Python-level tool can see — so this driver
+hammers all three entries from several true-concurrent threads against
+the ``KPW_NATIVE_SANITIZE=tsan`` build, where TSan traps any racy
+access instead of letting it silently corrupt a page.
+
+Workload discipline (why this is race-clean by DESIGN, which is exactly
+what TSan verifies): shared inputs are allocated once in the main thread
+BEFORE the workers spawn (``pthread_create`` is TSan-visible sync, so
+the handoff is ordered) and only READ concurrently; every output buffer
+is thread-private.
+
+Usage:  python -m tools.tsan_stress [--iters N] [--threads T]
+
+Exit 0 = all iterations completed (under the tsan build with
+``halt_on_error=1`` any detected race aborts the process loudly).
+Running it without the tsan build is still a valid concurrency smoke —
+outputs are cross-checked against the main thread's reference bytes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import threading
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+for _p in (_REPO, os.path.join(_REPO, "tests")):
+    if _p not in sys.path:
+        sys.path.insert(0, _p)
+
+DEFAULT_ITERS = 200   # committed regression configuration per thread
+DEFAULT_THREADS = 4
+
+
+def _shred_inputs():
+    """One contiguous wire-format batch + columnarizer, built in the
+    main thread (shared read-only by every worker)."""
+    from proto_helpers import sample_message_class
+
+    from kpw_tpu.models.proto_bridge import ProtoColumnarizer
+
+    cls = sample_message_class()
+    col = ProtoColumnarizer(cls)
+    payloads = [cls(query=f"q-{i}" * (i % 7 + 1), timestamp=i,
+                    page_number=i % 11).SerializeToString()
+                for i in range(400)]
+    lens = np.fromiter(map(len, payloads), np.int64, count=len(payloads))
+    offs = np.zeros(len(payloads) + 1, np.int64)
+    np.cumsum(lens, out=offs[1:])
+    return col, b"".join(payloads), offs
+
+
+def _assemble_inputs():
+    """A minimal valid RAW-op plan for ``assemble_pages`` (same shape as
+    tests/test_assemble.py's valid-plan contract); page/op/meta tables
+    are templates each thread COPIES (meta is an output array)."""
+    from kpw_tpu.core.metadata import DATA_PAGE_PREFIX, data_page_suffix
+    from kpw_tpu.native.build import load_assemble
+
+    asm = load_assemble()
+    body = bytes(range(1, 250)) * 8
+    buffers = (body, DATA_PAGE_PREFIX, data_page_suffix(8, 0))
+    pages = np.array([[0, 1, 1, 2, 0, 0, 0]], np.int64)
+    ops = np.array([[0, 0, 0, len(body), 0]], np.int64)
+    return asm, buffers, pages, ops
+
+
+def run(iters: int = DEFAULT_ITERS, threads: int = DEFAULT_THREADS) -> int:
+    col, blob, offs, = _shred_inputs()
+    asm, buffers, pages, ops = _assemble_inputs()
+
+    # reference outputs from the main thread: workers must reproduce
+    # them bit-for-bit (a race that slips past TSan would still corrupt)
+    ref_batch = col.columnarize_buffer(blob, offs)
+    ref_col0 = bytes(memoryview(ref_batch.chunks[0].values.data))
+    ref_meta = np.zeros((1, 3), np.int64)
+    ref_out = asm.assemble_pages(buffers, pages, ops, 0, 3, None, 0,
+                                 ref_meta, None, None)
+
+    barrier = threading.Barrier(threads)
+    errors: list[BaseException] = []
+    mu = threading.Lock()
+
+    def worker(widx: int) -> None:
+        try:
+            barrier.wait()
+            for i in range(iters):
+                batch = col.columnarize_buffer(blob, offs)
+                if bytes(memoryview(batch.chunks[0].values.data)) \
+                        != ref_col0:
+                    raise AssertionError(
+                        f"worker {widx} iter {i}: shred output diverged")
+                meta = np.zeros((1, 3), np.int64)
+                out = asm.assemble_pages(buffers, pages.copy(), ops.copy(),
+                                         0, 3, None, 0, meta, None, None)
+                if out != ref_out:
+                    raise AssertionError(
+                        f"worker {widx} iter {i}: assembled page diverged")
+        except BaseException as e:  # noqa: BLE001 — reported to the runner
+            with mu:
+                errors.append(e)
+
+    ts = [threading.Thread(target=worker, args=(w,)) for w in range(threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    if errors:
+        for e in errors:
+            print(f"tsan_stress: FAILED: {e!r}", file=sys.stderr)
+        return 1
+    mode = os.environ.get("KPW_NATIVE_SANITIZE", "")
+    print(f"tsan_stress: {threads} threads x {iters} iters over "
+          f"shred_flat_buf/gather_buf/assemble_pages completed "
+          f"(KPW_NATIVE_SANITIZE={mode or 'off'}); outputs byte-identical "
+          f"to the single-thread reference")
+    return 0
+
+
+def canary(iters: int = 300) -> int:
+    """Negative control: a DELIBERATE data race (two threads writing one
+    shared meta output table through ``assemble_pages``) that TSan must
+    report — run by tools/sanitize.sh with ``halt_on_error=0`` and its
+    stderr grepped for the race warning, so a misconfigured preload can
+    never report the clean run as 'sanitizers ran clean' vacuously."""
+    asm, buffers, pages, ops = _assemble_inputs()
+    meta = np.zeros((1, 3), np.int64)  # SHARED output: the planted race
+    barrier = threading.Barrier(2)
+
+    def worker() -> None:
+        barrier.wait()
+        for _ in range(iters):
+            asm.assemble_pages(buffers, pages.copy(), ops.copy(), 0, 3,
+                               None, 0, meta, None, None)
+
+    ts = [threading.Thread(target=worker) for _ in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    print("tsan_stress: canary completed (expect ThreadSanitizer data-race "
+          "warnings on stderr under the tsan build)")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m tools.tsan_stress")
+    ap.add_argument("--iters", type=int, default=DEFAULT_ITERS)
+    ap.add_argument("--threads", type=int, default=DEFAULT_THREADS)
+    ap.add_argument("--canary", action="store_true",
+                    help="run the deliberate-race negative control")
+    args = ap.parse_args(argv)
+    if args.canary:
+        return canary()
+    return run(iters=args.iters, threads=args.threads)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
