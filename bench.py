@@ -73,6 +73,17 @@ encode (NYC-taxi-shaped replay, one chip), printed as ONE JSON line
                With --smoke: reduced run, committed artifact untouched,
                nonzero exit unless the invariant holds (the tools/ci.sh
                gate)
+  --nested     nested-vs-flat replay sweep (ISSUE 14): the cfg5/cfg7
+               list<struct> arm through the FUSED nested pipeline
+               (batched nogil shred materialization + one-native-call
+               page assembly) vs the cfg6 flat arm, interleaved pairs
+               min-of-3 per arm, ratio of arm medians, bracketed by
+               cpu_capacity_x probes; plus a fused-vs-ctypes-route A/B
+               and the fused/fallback/oracle file-byte identity check;
+               writes BENCH_NESTED_r18.json.  With --smoke: one reduced
+               nested replay + the identity check, committed artifact
+               untouched, nonzero exit unless ack-lag drains to exactly
+               0 AND the bytes match (the tools/ci.sh gate)
   --cpu        force the virtual CPU platform (local smoke)
 
 Baseline for configs 1/2/3/5 is pyarrow's C++ parquet writer with matched
@@ -4002,6 +4013,286 @@ def procs_probe(rows: int = 400_000, parts: int = 4, pairs: int = 3,
 
 
 # ---------------------------------------------------------------------------
+# --nested: nested-vs-flat replay sweep (the ISSUE 14 fused-pipeline gauge)
+# ---------------------------------------------------------------------------
+
+def _nested_message_payloads(rows: int, seed: int = 7):
+    """cfg5/cfg7-shaped nested list<struct> records, pre-serialized."""
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                    "tests"))
+    from proto_helpers import nested_message_classes
+
+    Order = nested_message_classes()
+    rng = np.random.default_rng(seed)
+    item_counts = rng.integers(0, 4, rows)
+    skus = rng.integers(0, 64, int(item_counts.sum()) + 1)
+    qtys = rng.integers(1, 100, int(item_counts.sum()) + 1)
+    payloads = []
+    it_i = 0
+    for r in range(rows):
+        o = Order()
+        o.order_id = r
+        for _ in range(int(item_counts[r])):
+            it = o.items.add()
+            it.sku = f"sku{int(skus[it_i])}"
+            it.qty = int(qtys[it_i])
+            it_i += 1
+        payloads.append(o.SerializeToString())
+    return Order, payloads
+
+
+def _nested_fused_identity() -> dict:
+    """File bytes through the writer from all three batch routes (fused
+    shred / ctypes shred / Python visitor) x native assembly on/off —
+    the invariant the smoke gate refuses to pass without."""
+    import io as _io
+
+    from kpw_tpu.core.writer import ParquetFileWriter, WriterProperties
+    from kpw_tpu.models.proto_bridge import ProtoColumnarizer
+    from kpw_tpu.native.encoder import NativeChunkEncoder
+
+    Order, payloads = _nested_message_payloads(4000, seed=3)
+    col = ProtoColumnarizer(Order)
+    assert col.wire_capable and col._wire is None, "nested plan must engage"
+    offs = np.zeros(len(payloads) + 1, np.int64)
+    np.cumsum([len(p) for p in payloads], out=offs[1:])
+    buf = b"".join(payloads)
+
+    def batch(route: str):
+        if route == "oracle":
+            return col.columnarize([Order.FromString(p) for p in payloads])
+        col._nested_fused = route == "fused"
+        try:
+            return col.columnarize_buffer(buf, offs)
+        finally:
+            col._nested_fused = True
+
+    outputs = {}
+    fused_native_chunks = 0
+    for route in ("fused", "ctypes", "oracle"):
+        for native in (True, False):
+            sink = _io.BytesIO()
+            props = WriterProperties(native_assembly=native, codec=1,
+                                     page_checksums=True)
+            enc = NativeChunkEncoder(props.encoder_options())
+            w = ParquetFileWriter(sink, col.schema, props, encoder=enc)
+            w.write_batch(batch(route))
+            w.close()
+            outputs[(route, native)] = sink.getvalue()
+            if route == "fused" and native:
+                fused_native_chunks = enc.native_asm_chunks
+    ref = outputs[("fused", True)]
+    identical = all(blob == ref for blob in outputs.values())
+    return {
+        "bytes_identical": identical,
+        "arms": ["fused/native", "fused/python", "ctypes/native",
+                 "ctypes/python", "oracle/native", "oracle/python"],
+        "file_bytes": len(ref),
+        "fused_native_chunks": fused_native_chunks,
+        "fused_engaged": fused_native_chunks > 0,
+    }
+
+
+def nested_probe(rows: int = 120_000, parts: int = 4, pairs: int = 3,
+                 smoke: bool = False) -> dict:
+    """``--nested``: the nested-vs-flat replay sweep (ISSUE 14).
+
+    Both arms drain the FULL poll -> wire-shred -> encode -> rotate ->
+    publish -> ack leg to committed==rows AND ack-lag exactly 0 — the
+    nested arm over cfg5/cfg7-shaped list<struct> records through the
+    fused nested pipeline (batched nogil shred_nested_buf/nested_fill
+    materialization + one-native-call page assembly with level RLE ops),
+    the flat arm over cfg6-shaped flat records through the PR-6/PR-10
+    path.  Interleaved alternating pairs, min-of-3 per arm per pair,
+    ratio of arm medians (repo A/B convention), bracketed by
+    ``cpu_capacity_x`` probes.  A second A/B isolates the fuse itself:
+    fused shred vs the retained ctypes route, nested arm only.
+
+    The fused-vs-fallback-vs-oracle FILE-BYTE identity check runs in
+    both modes; ``smoke=True`` (the tools/ci.sh gate) additionally runs
+    one reduced nested replay and exits nonzero unless ack-lag drained
+    to exactly 0 AND the bytes matched — and never touches the committed
+    artifact."""
+    from kpw_tpu import Builder, FakeBroker, MemoryFileSystem
+    from kpw_tpu.runtime.select import choose_backend
+
+    identity = _nested_fused_identity()
+    print(f"[bench:nested] fused identity: bytes_identical="
+          f"{identity['bytes_identical']} over {identity['arms']}",
+          file=sys.stderr)
+    if smoke:
+        rows = 30_000
+    Order, nested_payloads = _nested_message_payloads(rows)
+    nested_bytes = sum(len(p) for p in nested_payloads)
+    broker = FakeBroker()
+    broker.create_topic("nested", parts)
+    broker.produce_many("nested", nested_payloads)
+    flat_bytes = 0
+    Msg = None
+    if not smoke:
+        Msg, flat_payloads = _e2e_message_payloads(rows)
+        flat_bytes = sum(len(p) for p in flat_payloads)
+        broker.create_topic("flat", parts)
+        broker.produce_many("flat", flat_payloads)
+    backend = choose_backend()
+    print(f"[bench:nested] backend {backend}; {rows} records/arm "
+          f"(nested {nested_bytes / 1e6:.1f} MB, flat "
+          f"{flat_bytes / 1e6:.1f} MB on the wire)", file=sys.stderr)
+    run_id = 0
+
+    def one_run(topic: str, cls, fused: bool = True):
+        """(seconds to all-written, full drain seconds, final lag)."""
+        nonlocal run_id
+        run_id += 1
+        fs = MemoryFileSystem()
+        if not fused:
+            os.environ["KPW_NESTED_FUSED"] = "0"
+        try:
+            w = (Builder().broker(broker).topic(topic).proto_class(cls)
+                 .target_dir(f"/nb/{run_id}").filesystem(fs)
+                 .instance_name(f"nb{run_id}").group_id(f"nb-{run_id}")
+                 .encoder_backend(backend).compression("snappy")
+                 .batch_ingest(True)
+                 # nested records are small: 1 MiB rotation keeps several
+                 # publishes inside the window (cfg7 convention); the
+                 # flat arm uses the same so the ratio compares pipelines,
+                 # not rotation cadences
+                 .max_file_size(1024 * 1024).block_size(512 * 1024)
+                 .max_file_open_duration_seconds(0.5).build())
+        finally:
+            os.environ.pop("KPW_NESTED_FUSED", None)
+        group = f"nb-{run_id}"
+        t0 = time.perf_counter()
+        w.start()
+        deadline = time.time() + 180
+        t_written = None
+        try:
+            while time.time() < deadline:
+                if w.total_written_records >= rows:
+                    t_written = time.perf_counter() - t0
+                    break
+                time.sleep(0.002)
+            while time.time() < deadline:
+                if (sum(broker.committed(group, topic, p)
+                        for p in range(parts)) >= rows
+                        and w.ack_lag()["unacked_records"] == 0):
+                    break
+                time.sleep(0.01)
+            else:
+                raise RuntimeError(
+                    f"nested replay never drained (lag {w.ack_lag()})")
+            if t_written is None:
+                raise RuntimeError("nested replay never finished writing")
+            t_drain = time.perf_counter() - t0
+            lag = w.ack_lag()
+        finally:
+            w.close()
+        return t_written, t_drain, lag
+
+    if smoke:
+        tw, td, lag = one_run("nested", Order)
+        out = {
+            "metric": "nested_records_per_sec",
+            "value": round(rows / tw, 1),
+            "rows": rows,
+            "written_seconds": round(tw, 3),
+            "drain_seconds": round(td, 3),
+            "final_ack_lag": lag,
+            "ack_lag_zero": lag["unacked_records"] == 0,
+            "fused_identity": identity,
+            "smoke": True,
+        }
+        print(f"[bench:nested] smoke: {out['value']:,.0f} rec/s nested; "
+              f"final lag {lag['unacked_records']}; bytes_identical="
+              f"{identity['bytes_identical']}", file=sys.stderr)
+        return out
+
+    cap_before = _cpu_capacity_probe()
+    one_run("nested", Order)
+    one_run("flat", Msg)  # warm both arms
+    tn, tf, ratios = [], [], []
+    for i in range(pairs):
+        order = (("nested", Order), ("flat", Msg)) if i % 2 == 0 \
+            else (("flat", Msg), ("nested", Order))
+        pair = {}
+        for topic, cls in order:
+            pair[topic] = min(one_run(topic, cls)[0] for _ in range(3))
+        tn.append(pair["nested"])
+        tf.append(pair["flat"])
+        ratios.append(round(pair["nested"] / pair["flat"], 2))
+        print(f"[bench:nested] pair {i}: nested {pair['nested']:.3f}s vs "
+              f"flat {pair['flat']:.3f}s -> {ratios[-1]:.2f}x",
+              file=sys.stderr)
+
+    # fused-vs-ctypes-route A/B, nested arm only (the fuse itself)
+    f_on, f_off, f_ratios = [], [], []
+    for i in range(pairs):
+        order = (True, False) if i % 2 == 0 else (False, True)
+        pair = {}
+        for fused in order:
+            pair[fused] = min(one_run("nested", Order, fused=fused)[0]
+                              for _ in range(3))
+        f_on.append(pair[True])
+        f_off.append(pair[False])
+        f_ratios.append(round(pair[False] / pair[True], 2))
+    cap_after = _cpu_capacity_probe()
+
+    mn, mf = _median(tn), _median(tf)
+    m_on, m_off = _median(f_on), _median(f_off)
+    cap_min = min(cap_before, cap_after)
+    nested_over_flat = round(mn / mf, 2)
+    out = {
+        "metric": "nested_over_flat_x",
+        "value": nested_over_flat,
+        "rows": rows,
+        "partitions": parts,
+        "nested_payload_bytes": nested_bytes,
+        "flat_payload_bytes": flat_bytes,
+        "backend": str(backend),
+        "nested_records_per_sec_median": round(rows / mn, 1),
+        "flat_records_per_sec_median": round(rows / mf, 1),
+        "nested_over_flat_x": nested_over_flat,
+        "within_target": nested_over_flat <= 1.3,
+        "pair_ratios_x": ratios,
+        "nested_written_seconds": [round(t, 3) for t in tn],
+        "flat_written_seconds": [round(t, 3) for t in tf],
+        "fused_ab": {
+            "speedup_x": round(m_off / m_on, 2),
+            "fused_seconds": [round(t, 3) for t in f_on],
+            "ctypes_route_seconds": [round(t, 3) for t in f_off],
+            "pair_speedups_x": f_ratios,
+            "policy": ("interleaved pairs (order alternating), min-of-3 "
+                       "per arm per pair, speedup = ratio of arm medians "
+                       "on time-to-all-written: fused shred_nested_buf/"
+                       "nested_fill vs the retained ctypes "
+                       "NestedShredResult route (KPW_NESTED_FUSED=0), "
+                       "nested arm only, identical config otherwise"),
+        },
+        "fused_identity": identity,
+        "pairs": pairs,
+        "cpu_capacity_x": {"before": cap_before, "after": cap_after},
+        "capacity_gated": cap_min < 1.7,
+        "policy": ("interleaved nested/flat pairs (order alternating), "
+                   "min-of-3 per arm per pair, nested_over_flat_x = "
+                   "ratio of arm medians on time-to-all-written (repo "
+                   "A/B convention); both arms drain to committed==rows "
+                   "AND ack-lag==0; snappy, 1 MiB size rotation, 0.5 s "
+                   "time rotation, MemoryFileSystem sink; nested arm = "
+                   "cfg5/cfg7 list<struct> shape, flat arm = cfg6 shape; "
+                   "cpu_capacity_x brackets the sweep per repo "
+                   "convention"),
+    }
+    print(f"[bench:nested] nested/flat {nested_over_flat:.2f}x "
+          f"(nested {rows / mn:,.0f} vs flat {rows / mf:,.0f} rec/s; "
+          f"target <=1.3x {'MET' if out['within_target'] else 'MISSED'}); "
+          f"fused A/B {out['fused_ab']['speedup_x']:.2f}x; capacity "
+          f"bracket {cap_before}-{cap_after}"
+          f"{' (CAPACITY-GATED)' if out['capacity_gated'] else ''}",
+          file=sys.stderr)
+    return out
+
+
+# ---------------------------------------------------------------------------
 # config 7: nested streaming replay (cfg5 shape through the FULL writer)
 # ---------------------------------------------------------------------------
 
@@ -4289,7 +4580,7 @@ def main() -> None:
                for f in ("--all", "--rowgroup", "--hostasm", "--config",
                          "--obs", "--chaos", "--crash", "--degrade",
                          "--e2e", "--compact", "--scan", "--procs",
-                         "--objstore")):
+                         "--objstore", "--nested")):
         # default graded path: jax-free orchestrator (see _graded_main)
         _graded_main()
         return
@@ -4310,7 +4601,7 @@ def main() -> None:
             or "--crash" in sys.argv or "--degrade" in sys.argv
             or "--e2e" in sys.argv or "--compact" in sys.argv
             or "--scan" in sys.argv or "--procs" in sys.argv
-            or "--objstore" in sys.argv):
+            or "--objstore" in sys.argv or "--nested" in sys.argv):
         # --hostasm/--obs/--chaos/--crash/--degrade/--e2e/--compact/--scan
         # /--objstore measure HOST work only and must never grab the real
         # chip; the switch must precede the first device use below
@@ -4655,6 +4946,36 @@ def main() -> None:
         summary = {k: v for k, v in out.items()
                    if k not in ("procs_sweep", "scenario", "capacity_note")}
         summary["procs_speedup_x"] = out["procs_sweep"]["speedup_x"]
+        summary["artifact"] = os.path.basename(path)
+        print(json.dumps(summary))
+        return
+    if "--nested" in sys.argv:
+        if "--smoke" in sys.argv:
+            # the CI gate: reduced nested replay + the fused identity
+            # check, never writes the artifact, exits nonzero unless
+            # ack-lag drained to exactly 0 AND fused-vs-fallback file
+            # bytes matched
+            out = nested_probe(smoke=True)
+            print(json.dumps(out))
+            ok = (out["ack_lag_zero"]
+                  and out["fused_identity"]["bytes_identical"]
+                  and out["fused_identity"]["fused_engaged"])
+            sys.exit(0 if ok else 7)
+        out = nested_probe()
+        path = os.environ.get(
+            "KPW_NESTED_PATH",
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "BENCH_NESTED_r18.json"))
+        with open(path, "w") as f:
+            json.dump(out, f, indent=1)
+        print(f"[bench:nested] artifact written to {path}", file=sys.stderr)
+        summary = {k: v for k, v in out.items()
+                   if k not in ("pair_ratios_x", "nested_written_seconds",
+                                "flat_written_seconds", "fused_ab",
+                                "fused_identity", "policy")}
+        summary["fused_speedup_x"] = out["fused_ab"]["speedup_x"]
+        summary["bytes_identical"] = \
+            out["fused_identity"]["bytes_identical"]
         summary["artifact"] = os.path.basename(path)
         print(json.dumps(summary))
         return

@@ -359,6 +359,23 @@ class CpuChunkEncoder:
         itself (the TPU backend overrides with its planner's blobs)."""
         return None
 
+    def _planned_level_ops(self, chunk: "ColumnChunkData", a: int,
+                           b: int) -> list | None:
+        """Op-level form of :meth:`_planned_levels_blob` for assemblers
+        that carry the RLE-from-runs op (``OP_KINDS >= 4``): None, or a
+        list of descriptors in stream order —
+
+        * ``("raw", part)`` — bytes/buffer emitted verbatim (already
+          carrying its v1 length prefix), and
+        * ``("runs", run_vals u32, run_lens i32, width)`` — the device
+          level planner's compact run table, replayed to the exact
+          mixed RLE/bit-pack stream INSIDE the one nogil native call
+          (kOpRleRuns, kModeLen32 prefix) instead of through the
+          Python ``rle_hybrid_from_runs`` loop.
+
+        The TPU backend overrides; the default has no planner."""
+        return None
+
     def _page_stats_min_max(self, chunk: "ColumnChunkData", va: int, vb: int,
                             pt: int):
         """Per-page (min_bytes, max_bytes, min_key, max_key) over the
@@ -712,6 +729,12 @@ class CpuChunkEncoder:
             nd = 0
             idx_buf = -1
 
+        # op-kind generation of the loaded assembler: >= 4 adds the
+        # nested-pipeline ops (RLE-from-runs for planner level streams,
+        # bytes-plain straight from the packed ByteColumn representation);
+        # a stale cached .so keeps the old lowering
+        asm_ops = getattr(asm, "OP_KINDS", 2)
+
         # zero-copy PLAIN: the page body IS the contiguous value slice
         contig_vals = None
         if isinstance(values, np.ndarray):
@@ -722,6 +745,19 @@ class CpuChunkEncoder:
                      and values.dtype == enc._PLAIN_DTYPES.get(pt))
         val_buf = add_buf(contig_vals) if plain_raw else -1
         isz = values.dtype.itemsize if plain_raw else 0
+
+        # packed BYTE_ARRAY PLAIN: the page body assembles from the
+        # ByteColumn's (data, offsets) buffers inside the native call
+        # (kOpBytesPlain — 4-byte LE length + raw bytes per value,
+        # byte-identical to byte_array_plain_encode), so non-dictionary
+        # string pages cost no host materialization at all
+        bytes_plain = (not use_dict and value_encoding == Encoding.PLAIN
+                       and asm_ops >= 4 and isinstance(values, ByteColumn)
+                       and pt == PhysicalType.BYTE_ARRAY)
+        if bytes_plain:
+            ba_data_buf = add_buf(values.data)
+            ba_offs_buf = add_buf(np.ascontiguousarray(values.offsets,
+                                                       np.int64))
 
         sdt = 0
         if page_stats_on and contig_vals is not None:
@@ -737,14 +773,29 @@ class CpuChunkEncoder:
                 va, vb = a, b
             op_start = len(ops) // 5
             if max_rep > 0 or max_def > 0:
-                planned = self._planned_levels_blob(chunk, a, b)
-                if planned is not None:
-                    add_raw(planned)
+                lvl_ops = (self._planned_level_ops(chunk, a, b)
+                           if asm_ops >= 4 else None)
+                if lvl_ops is not None:
+                    for d in lvl_ops:
+                        if d[0] == "raw":
+                            add_raw(d[1])
+                        else:  # ("runs", vals u32, lens i32, width)
+                            _, rv, rl, width = d
+                            rv_buf = add_buf(np.ascontiguousarray(
+                                rv, np.uint32))
+                            rl_buf = add_buf(np.ascontiguousarray(
+                                rl, np.int32))
+                            ops.extend((2, rv_buf, 0, len(rv),
+                                        width | (2 << 8) | (rl_buf << 16)))
                 else:
-                    if max_rep > 0:
-                        ops.extend((1, rep_buf, a, b, rep_aux))
-                    if max_def > 0:
-                        ops.extend((1, def_buf, a, b, def_aux))
+                    planned = self._planned_levels_blob(chunk, a, b)
+                    if planned is not None:
+                        add_raw(planned)
+                    else:
+                        if max_rep > 0:
+                            ops.extend((1, rep_buf, a, b, rep_aux))
+                        if max_def > 0:
+                            ops.extend((1, def_buf, a, b, def_aux))
             if use_dict:
                 if idx_buf >= 0:
                     ops.extend((1, idx_buf, va, vb, idx_aux))
@@ -759,6 +810,8 @@ class CpuChunkEncoder:
                         add_raw(body)
             elif plain_raw:
                 ops.extend((0, val_buf, va * isz, vb * isz, 0))
+            elif bytes_plain:
+                ops.extend((3, ba_data_buf, va, vb, ba_offs_buf << 16))
             else:
                 for part in self._values_page_parts(chunk, va, vb, pt,
                                                     value_encoding):

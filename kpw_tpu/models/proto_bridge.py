@@ -10,6 +10,8 @@ pages — the boundary where the TPU path takes over.
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 from google.protobuf.descriptor import FieldDescriptor as FD
@@ -181,7 +183,25 @@ class _NestedPlan:
                  "child_begin", "child_end", "leaf_idx", "ftab", "ftab_off",
                  "max_fn", "enum_vals", "enum_off", "enum_len",
                  "null_leaves", "null_off", "null_len",
-                 "leaf_kinds", "leaf_dtypes", "enum_names")
+                 "leaf_kinds", "leaf_dtypes", "enum_names", "_cont")
+
+    _TAB_NAMES = ("child_begin", "child_end", "leaf_idx", "ftab",
+                  "ftab_off", "max_fn", "enum_vals", "enum_off", "enum_len",
+                  "null_leaves", "null_off", "null_len")
+
+    def cont(self):
+        """Cached contiguous buffer forms for the C-extension fused entry
+        (shred_nested_buf): (fnum u32, kind bytes, flags bytes, 12 int32
+        table buffers) — built once per columnarizer, like _WirePlan._cont."""
+        c = getattr(self, "_cont", None)
+        if c is None:
+            c = self._cont = (
+                np.ascontiguousarray(self.fnum, np.uint32),
+                bytes(np.ascontiguousarray(self.kind, np.uint8)),
+                bytes(np.ascontiguousarray(self.flags, np.uint8)),
+                tuple(np.ascontiguousarray(getattr(self, name), np.int32)
+                      for name in self._TAB_NAMES))
+        return c
 
 
 class _LeafBuffer:
@@ -209,6 +229,11 @@ class ProtoColumnarizer:
         self._leaf_index: dict[tuple[str, ...], int] = {
             c.path: i for i, c in enumerate(self.schema.columns)
         }
+        # fused nested shred opt-out (KPW_NESTED_FUSED=0 restores the
+        # ctypes NestedShredResult route byte-identically — the bench's
+        # fused A/B arm and a triage lever), read at construction so a
+        # live writer's route never flips mid-stream
+        self._nested_fused = os.environ.get("KPW_NESTED_FUSED", "1") != "0"
 
     # -- shredding ---------------------------------------------------------
     def _flat_plan(self):
@@ -647,7 +672,10 @@ class ProtoColumnarizer:
             raise ValueError(
                 "offsets must be ascending and within the buffer")
         if self._wire is None:
-            return self._shred_nested(bytes(buf), offs)
+            # the fused entry takes any buffer (a RecordBatch / ring-slot
+            # memoryview stays zero-copy); only the ctypes fallback inside
+            # _shred_nested materializes bytes
+            return self._shred_nested(buf, offs)
         plan: _WirePlan = self._wire
         from ..native import lib as _native_lib, pyshred as _pyshred
 
@@ -688,17 +716,40 @@ class ProtoColumnarizer:
         batch.wire_bytes = int(offs[-1] - offs[0])
         return batch
 
-    def _shred_nested(self, buf: bytes, offs: np.ndarray) -> ColumnBatch:
-        """Nested/repeated/enum wire shred via kpw_proto_shred_nested over
-        a contiguous buffer + record offsets; the output (values for
-        present entries + per-visit def/rep levels) is element-identical
-        to :meth:`columnarize` over the parsed messages (asserted by
-        tests/test_nested_shred.py)."""
-        from ..native import lib as _native_lib
+    def _shred_nested(self, buf, offs: np.ndarray) -> ColumnBatch:
+        """Nested/repeated/enum wire shred over a contiguous buffer +
+        record offsets; the output (values for present entries + per-visit
+        def/rep levels) is element-identical to :meth:`columnarize` over
+        the parsed messages (asserted by tests/test_nested_shred.py).
+
+        Two routes, byte-identical output (pinned by
+        tests/test_nested_fused.py):
+
+        * **fused** (default when the C extension carries the entries) —
+          ONE GIL-released decode (``shred_nested_buf``) plus ONE
+          GIL-released materialization (``nested_fill``) that lands every
+          leaf in its final packed form: span payloads gathered straight
+          into their ByteColumn payload bytes with the int64 offset table
+          built in the same pass, def/rep levels widened to the uint32 the
+          nogil page assembler's RLE ops slice with zero further copies.
+          Accepts any buffer (a RecordBatch / shared-memory ring view
+          stays zero-copy).
+        * **ctypes fallback** (stale .so, ``_nested_fused = False``) — the
+          historical NestedShredResult route: per-leaf accessor round
+          trips + numpy copies + a separate gather_spans pass."""
+        from ..native import lib as _native_lib, pyshred as _pyshred
 
         plan: _NestedPlan = self._nested
-        L = _native_lib()
         n = len(offs) - 1
+        pys = _pyshred()
+        if (pys is not None and getattr(self, "_nested_fused", True)
+                and getattr(pys, "shred_nested_buf", None) is not None):
+            batch = self._shred_nested_fused(pys, buf, offs, plan, n)
+            batch.wire_bytes = int(offs[-1] - offs[0]) if n else 0
+            return batch
+        L = _native_lib()
+        if not isinstance(buf, bytes):
+            buf = bytes(buf)  # ctypes c_char_p route needs real bytes
         res = L.proto_shred_nested(buf, offs, plan)
         if isinstance(res, int):
             raise WireShredError(res)
@@ -726,8 +777,59 @@ class ProtoColumnarizer:
         finally:
             res.close()
         batch = ColumnBatch(chunks, n)
-        batch.wire_bytes = int(offs[-1] - offs[0])
+        batch.wire_bytes = int(offs[-1] - offs[0]) if n else 0
         return batch
+
+    def _shred_nested_fused(self, pys, buf, offs: np.ndarray,
+                            plan: "_NestedPlan", n: int) -> ColumnBatch:
+        """The fused decode+materialize route (see :meth:`_shred_nested`).
+        Output element-identical to the ctypes route by construction —
+        same decoder object code, same emission order — with levels
+        arriving as uint32 (the dtype every downstream consumer treats
+        numerically; the RLE lowering in core/pages.py now slices them
+        with no conversion copy at all)."""
+        from ..native import lib as _native_lib
+
+        fnum_c, kind_c, flags_c, tabs = plan.cont()
+        rc, cap, sizes_b = pys.shred_nested_buf(
+            buf, offs, plan.n_nodes, plan.n_leaves, fnum_c, kind_c, flags_c,
+            tabs)
+        if cap is None:
+            raise WireShredError(int(rc))
+        sizes = np.frombuffer(sizes_b, np.int64)
+        cols = self.schema.columns
+        vals_t, offsets_t, defs_t, reps_t = [], [], [], []
+        for li, col in enumerate(cols):
+            k = plan.leaf_kinds[li]
+            row = 4 * li
+            nlev = int(sizes[row + 3])
+            if k in (_K_SPAN, _K_SPAN_UTF8):
+                vals_t.append(None)
+                offsets_t.append(np.empty(int(sizes[row + 1]) + 1, np.int64))
+            else:
+                dt = np.dtype(np.int32 if k == _K_ENUM
+                              else plan.leaf_dtypes[li])
+                vals_t.append(np.empty(int(sizes[row]) // dt.itemsize, dt))
+                offsets_t.append(None)
+            defs_t.append(np.empty(nlev, np.uint32)
+                          if col.max_def > 0 else None)
+            reps_t.append(np.empty(nlev, np.uint32)
+                          if col.max_rep > 0 else None)
+        payloads = pys.nested_fill(cap, buf, tuple(vals_t), tuple(offsets_t),
+                                   tuple(defs_t), tuple(reps_t))
+        chunks = []
+        for li, col in enumerate(cols):
+            k = plan.leaf_kinds[li]
+            if k in (_K_SPAN, _K_SPAN_UTF8):
+                values = ByteColumn(payloads[li], offsets_t[li])
+            elif k == _K_ENUM:
+                values = self._enum_bytecol(_native_lib(), vals_t[li],
+                                            plan.enum_names[li])
+            else:
+                values = vals_t[li]
+            chunks.append(ColumnChunkData(col, values, defs_t[li],
+                                          reps_t[li], n))
+        return ColumnBatch(chunks, n)
 
     @staticmethod
     def _enum_bytecol(L, nums: np.ndarray, names: dict) -> ByteColumn:

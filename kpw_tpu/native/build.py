@@ -710,8 +710,13 @@ def load() -> NativeLib:
 
 
 # -- zero-copy CPython shred extension --------------------------------------
+# shred_nested.cc compiles into BOTH this .so and the ctypes library (same
+# source, no logic duplication) — the fused nested entries
+# (shred_nested_buf/nested_fill) and the ctypes NestedShredResult route
+# decode with identical object code, so the two paths cannot drift.
 _PYSHRED_SRCS = [os.path.join(_SRC_DIR, "src", "pyshred.cc"),
-                 os.path.join(_SRC_DIR, "src", "shred.cc")]
+                 os.path.join(_SRC_DIR, "src", "shred.cc"),
+                 os.path.join(_SRC_DIR, "src", "shred_nested.cc")]
 _PYSHRED_SO = os.path.join(_SRC_DIR, "_kpw_pyshred.so")
 _PYSHRED_TAG = _PYSHRED_SO + ".hosttag"
 

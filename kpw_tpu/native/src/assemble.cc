@@ -46,6 +46,9 @@ extern "C" {
 size_t kpw_rle_hybrid_cap(size_t n, int width);
 int kpw_rle_hybrid_u32(const uint32_t* v, size_t n, int width, uint8_t* out,
                        size_t* out_len);
+int kpw_rle_hybrid_from_runs_u32(const uint32_t* run_vals,
+                                 const int32_t* run_lens, size_t n_runs,
+                                 int width, uint8_t* out, size_t* out_len);
 // codecs.cc
 size_t kpw_snappy_max_compressed_length(size_t n);
 int kpw_snappy_compress(const uint8_t* in, size_t n, uint8_t* out,
@@ -64,6 +67,22 @@ constexpr int kPageStride = 7;  // op_start, op_end, prefix, suffix, flags, va, 
 constexpr int kOpStride = 5;    // kind, buf, a, b, aux
 constexpr int64_t kOpRaw = 0;   // bytes buffers[buf][a:b)
 constexpr int64_t kOpRle = 1;   // u32 elements [a:b); aux = width | mode << 8
+// RLE/bit-pack replay from a PRECOMPUTED run table (the device level
+// planner's compact output, ops/levels.py): run values u32 in
+// buffers[buf][a:b), run lengths i32 in buffers[aux >> 16][a:b);
+// aux = width | mode << 8 | lens_buf << 16.  Byte-identical to
+// core.encodings.rle_hybrid_from_runs (kpw_rle_hybrid_from_runs_u32,
+// encode.cc) — the O(runs) host Python replay, moved behind the nogil
+// boundary.
+constexpr int64_t kOpRleRuns = 2;
+// BYTE_ARRAY PLAIN assembly straight from the packed ByteColumn
+// representation: values are elements [a:b) of the int64 offset table in
+// buffers[aux >> 16] (absolute into the data buffer buffers[buf]); each
+// emits a 4-byte LE length + the raw bytes — byte-identical to
+// core.encodings.byte_array_plain_encode.  Offset CONTENT is snapshotted
+// and bounds-checked at execution (it lives in a caller-mutable numpy
+// array); a bad table raises ValueError, never an OOB read.
+constexpr int64_t kOpBytesPlain = 3;
 constexpr int64_t kModeBare = 0;
 constexpr int64_t kModeWidthByte = 1;  // 1-byte bit width prefix (dict bodies)
 constexpr int64_t kModeLen32 = 2;      // u32 LE length prefix (v1 level streams)
@@ -322,6 +341,42 @@ PyObject* py_assemble_pages(PyObject*, PyObject* args) {
           return fail_value("rle aux bits out of range"), nullptr;
         body_cap += kpw_rle_hybrid_cap(static_cast<size_t>(b - a),
                                        static_cast<int>(width)) + 5;
+      } else if (kind == kOpRleRuns) {
+        const int64_t elems = view.len / 4;
+        const int64_t width = aux & 0xFF, mode = (aux >> 8) & 0xFF;
+        const int64_t lens_buf = aux >> 16;
+        if (a < 0 || b < a || b > elems)
+          return fail_value("runs op range out of vals buffer bounds"),
+                 nullptr;
+        if (width < 1 || width > 32)
+          return fail_value("runs width out of range"), nullptr;
+        if (mode != kModeBare && mode != kModeWidthByte && mode != kModeLen32)
+          return fail_value("unknown rle mode"), nullptr;
+        if (lens_buf < 0 || lens_buf >= n_bufs)
+          return fail_value("runs lens buffer index out of range"), nullptr;
+        if (b > bufs.views[lens_buf].len / 4)
+          return fail_value("runs op range out of lens buffer bounds"),
+                 nullptr;
+        // body size depends on run CONTENT (summed at execution from a
+        // snapshot); contributes only the prefix bound here — the
+        // emitted body is re-checked against the thrift i32 ceiling
+        // after assembly
+        body_cap += 5;
+      } else if (kind == kOpBytesPlain) {
+        const int64_t offs_buf = aux >> 16;
+        if (aux & 0xFFFF)
+          return fail_value("bytes-plain aux low bits must be zero"),
+                 nullptr;
+        if (offs_buf < 0 || offs_buf >= n_bufs)
+          return fail_value("bytes-plain offsets buffer index out of range"),
+                 nullptr;
+        const int64_t offs_elems = bufs.views[offs_buf].len / 8;
+        if (a < 0 || b < a || b + 1 > offs_elems)
+          return fail_value("bytes-plain range out of offsets bounds"),
+                 nullptr;
+        // payload size depends on offset CONTENT (snapshotted + bounds-
+        // checked at execution); length prefixes are bounded here
+        body_cap += static_cast<size_t>(b - a) * 4;
       } else {
         return fail_value("unknown op kind"), nullptr;
       }
@@ -343,12 +398,16 @@ PyObject* py_assemble_pages(PyObject*, PyObject* args) {
   std::vector<uint8_t> body;      // per-page body scratch
   std::vector<uint8_t> comp;      // per-page compression scratch
   std::vector<uint8_t> rle;       // per-op rle scratch
+  std::vector<uint32_t> run_vals; // per-op run-table snapshots (content is
+  std::vector<int32_t> run_lens;  // caller-mutable while the GIL is down)
+  std::vector<int64_t> offs_snap;
   bool oom = false;
   int codec_rc = 0;
+  const char* op_err = nullptr;
 
   Py_BEGIN_ALLOW_THREADS try {
     out.reserve(cap);
-    for (int64_t p = 0; p < n_pages; p++) {
+    for (int64_t p = 0; p < n_pages && op_err == nullptr; p++) {
       const int64_t* pg = pages + p * kPageStride;
       const int64_t op_start = pg[0], op_end = pg[1];
       const Py_buffer& prefix = bufs.views[pg[2]];
@@ -357,14 +416,14 @@ PyObject* py_assemble_pages(PyObject*, PyObject* args) {
 
       // 1. body: gather RAW parts / RLE-encode streams into scratch
       body.clear();
-      for (int64_t o = op_start; o < op_end; o++) {
+      for (int64_t o = op_start; o < op_end && op_err == nullptr; o++) {
         const int64_t* op = ops + o * kOpStride;
         const Py_buffer& view = bufs.views[op[1]];
         const int64_t a = op[2], b = op[3];
         if (op[0] == kOpRaw) {
           const uint8_t* src = static_cast<const uint8_t*>(view.buf) + a;
           body.insert(body.end(), src, src + (b - a));
-        } else {
+        } else if (op[0] == kOpRle) {
           const uint32_t* v = static_cast<const uint32_t*>(view.buf) + a;
           const size_t n = static_cast<size_t>(b - a);
           const int width = static_cast<int>(op[4] & 0xFF);
@@ -381,7 +440,75 @@ PyObject* py_assemble_pages(PyObject*, PyObject* args) {
             body.insert(body.end(), le, le + 4);
           }
           body.insert(body.end(), rle.data(), rle.data() + rle_len);
+        } else if (op[0] == kOpRleRuns) {
+          // snapshot the run table first: the scratch is sized from the
+          // summed lengths, and the caller's arrays are mutable while
+          // the GIL is down — size and encode must see the same content
+          const size_t n = static_cast<size_t>(b - a);
+          const int width = static_cast<int>(op[4] & 0xFF);
+          const int64_t mode = (op[4] >> 8) & 0xFF;
+          const Py_buffer& lview = bufs.views[op[4] >> 16];
+          const uint32_t* v = static_cast<const uint32_t*>(view.buf) + a;
+          const int32_t* l = static_cast<const int32_t*>(lview.buf) + a;
+          run_vals.assign(v, v + n);
+          run_lens.resize(n);
+          uint64_t total = 0;
+          for (size_t i = 0; i < n; i++) {
+            const int32_t rl = l[i] > 0 ? l[i] : 0;
+            run_lens[i] = rl;
+            total += static_cast<uint64_t>(rl);
+          }
+          // bound the SCRATCH, not just the emitted body: a hostile run
+          // table summing just under 2^30 values at width 32 would
+          // otherwise drive a ~4.3 GiB transient allocation before the
+          // post-encode body check could reject the page
+          if (total > (1ull << 30) ||
+              kpw_rle_hybrid_cap(static_cast<size_t>(total), width) >
+                  (1ull << 30)) {
+            op_err = "runs op total length too large";
+            break;
+          }
+          rle.resize(kpw_rle_hybrid_cap(static_cast<size_t>(total), width));
+          size_t rle_len = 0;
+          kpw_rle_hybrid_from_runs_u32(run_vals.data(), run_lens.data(), n,
+                                       width, rle.data(), &rle_len);
+          if (mode == kModeWidthByte) {
+            body.push_back(static_cast<uint8_t>(width));
+          } else if (mode == kModeLen32) {
+            uint32_t ln = static_cast<uint32_t>(rle_len);
+            uint8_t le[4];
+            std::memcpy(le, &ln, 4);
+            body.insert(body.end(), le, le + 4);
+          }
+          body.insert(body.end(), rle.data(), rle.data() + rle_len);
+        } else {  // kOpBytesPlain
+          const size_t n = static_cast<size_t>(b - a);
+          const Py_buffer& oview = bufs.views[op[4] >> 16];
+          const int64_t* table = static_cast<const int64_t*>(oview.buf) + a;
+          offs_snap.assign(table, table + n + 1);
+          const int64_t data_len = view.len;
+          for (size_t i = 0; i < n; i++) {
+            const int64_t s = offs_snap[i], e = offs_snap[i + 1];
+            if (s < 0 || e < s || e > data_len ||
+                e - s > int64_t(0x7FFFFFFF)) {
+              op_err = "bytes-plain offset table out of data bounds";
+              break;
+            }
+            const uint32_t ln = static_cast<uint32_t>(e - s);
+            uint8_t le[4];
+            std::memcpy(le, &ln, 4);
+            body.insert(body.end(), le, le + 4);
+            const uint8_t* src = static_cast<const uint8_t*>(view.buf) + s;
+            body.insert(body.end(), src, src + (e - s));
+          }
         }
+      }
+      if (op_err != nullptr) break;
+      if (body.size() > (1ull << 30)) {
+        // content-sized ops (runs / bytes-plain) can only be bounded
+        // here; the RAW/RLE ops were already bounded at validation
+        op_err = "page body too large for a thrift i32 header";
+        break;
       }
       const size_t body_len = body.size();
 
@@ -457,6 +584,7 @@ PyObject* py_assemble_pages(PyObject*, PyObject* args) {
   Py_END_ALLOW_THREADS
 
   if (oom) return PyErr_NoMemory();
+  if (op_err != nullptr) return fail_value(op_err), nullptr;
   if (codec_rc != 0) {
     PyErr_Format(PyExc_RuntimeError, "native page compression failed rc=%d",
                  codec_rc);
@@ -486,5 +614,10 @@ PyMODINIT_FUNC PyInit__kpw_assemble(void) {
 #else
   PyModule_AddIntConstant(m, "HAS_ZSTD", 0);
 #endif
+  // op-kind generation: 4 = RAW/RLE + the nested-pipeline additions
+  // (RLE-from-runs, bytes-plain).  The Python lowering getattr-gates on
+  // this, so a stale cached .so silently keeps the old lowering instead
+  // of emitting ops it cannot execute.
+  PyModule_AddIntConstant(m, "OP_KINDS", 4);
   return m;
 }

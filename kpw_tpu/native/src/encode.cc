@@ -900,4 +900,57 @@ int kpw_rle_hybrid_u32(const uint32_t* v, size_t n, int width, uint8_t* out,
   return 0;
 }
 
+// Mixed RLE/bit-pack assembly driven from a precomputed run list — the C
+// twin of kpw_tpu.core.encodings.rle_hybrid_from_runs (byte-identical by
+// construction: same top-up / flush / RLE-threshold walk), so a device
+// run-scan (ops/levels.py) can hand its compact run table STRAIGHT to the
+// nogil page assembler instead of replaying the runs through a Python
+// loop.  ``out`` needs kpw_rle_hybrid_cap(sum(run_lens), width) bytes;
+// non-positive run lengths are skipped (padded device slots).
+int kpw_rle_hybrid_from_runs_u32(const uint32_t* run_vals,
+                                 const int32_t* run_lens, size_t n_runs,
+                                 int width, uint8_t* out, size_t* out_len) {
+  uint8_t* op = out;
+  if (width == 0) {  // single possible value: one RLE run, no value bytes
+    uint64_t total = 0;
+    for (size_t r = 0; r < n_runs; r++)
+      if (run_lens[r] > 0) total += static_cast<uint64_t>(run_lens[r]);
+    if (total) op += varint(total << 1, op);
+    *out_len = static_cast<size_t>(op - out);
+    return 0;
+  }
+  const int nbytes = (width + 7) / 8;
+  std::vector<uint32_t> buf;
+  buf.reserve(4096);
+  auto flush = [&]() {
+    if (buf.empty()) return;
+    const size_t groups = (buf.size() + 7) / 8;
+    buf.resize(groups * 8, 0);
+    op += varint((static_cast<uint64_t>(groups) << 1) | 1, op);
+    op = bitpack_stream(buf.data(), buf.size(), width, op);
+    buf.clear();
+  };
+  for (size_t r = 0; r < n_runs; r++) {
+    if (run_lens[r] <= 0) continue;
+    const uint32_t rv = run_vals[r];
+    size_t rl = static_cast<size_t>(run_lens[r]);
+    if (buf.size() % 8) {  // top up the open 8-value group first
+      const size_t take = std::min(8 - buf.size() % 8, rl);
+      buf.insert(buf.end(), take, rv);
+      rl -= take;
+    }
+    if (rl >= 8) {
+      flush();
+      op += varint(static_cast<uint64_t>(rl) << 1, op);
+      for (int b = 0; b < nbytes; ++b)
+        *op++ = static_cast<uint8_t>(rv >> (8 * b));
+      rl = 0;
+    }
+    if (rl) buf.insert(buf.end(), rl, rv);
+  }
+  flush();
+  *out_len = static_cast<size_t>(op - out);
+  return 0;
+}
+
 }  // extern "C"

@@ -39,6 +39,25 @@ int64_t kpw_proto_shred(const uint8_t* buf, const int64_t* offs,
                         int32_t* const* out_len, uint8_t* const* out_pres);
 void kpw_gather_spans(const uint8_t* src, const int64_t* pos,
                       const int32_t* len, int64_t n, uint8_t* out);
+// shred_nested.cc (compiled into this .so — same source as the ctypes
+// library, so the two decode paths cannot drift)
+struct KpwNestedOut;
+int64_t kpw_proto_shred_nested(
+    const uint8_t* buf, const int64_t* offs, int64_t n_rec, int32_t n_nodes,
+    int32_t n_leaves, const uint32_t* fnum, const uint8_t* kind,
+    const uint8_t* flags, const int32_t* child_begin,
+    const int32_t* child_end, const int32_t* leaf_idx, const int32_t* ftab,
+    const int32_t* ftab_off, const int32_t* max_fn, const int32_t* enum_vals,
+    const int32_t* enum_off, const int32_t* enum_len,
+    const int32_t* null_leaves, const int32_t* null_off,
+    const int32_t* null_len, KpwNestedOut** out);
+void kpw_nested_free(KpwNestedOut* o);
+int32_t kpw_nested_n_leaves(KpwNestedOut* o);
+void kpw_nested_sizes(KpwNestedOut* o, int64_t* out);
+int kpw_nested_fill_leaf(KpwNestedOut* o, int32_t leaf, const uint8_t* buf,
+                         int64_t buf_len, void* values_out,
+                         int64_t* offsets_out, uint8_t* payload_out,
+                         uint32_t* defs_out, uint32_t* reps_out);
 }
 
 namespace {
@@ -80,6 +99,15 @@ struct BufferSet {
     if (PyObject_GetBuffer(obj, &v, flags) != 0) return false;
     views.push_back(v);
     *out = v.buf;
+    return true;
+  }
+  // like get, but also reports the view's byte length (0 for None) — the
+  // nested_fill geometry checks need pointer AND length per output
+  bool get_sized(PyObject* obj, void** out, Py_ssize_t* len_out,
+                 int flags = PyBUF_WRITABLE) {
+    *len_out = 0;
+    if (!get(obj, out, flags)) return false;
+    if (*out != nullptr) *len_out = views.back().len;
     return true;
   }
 };
@@ -290,6 +318,269 @@ PyObject* py_gather_buf(PyObject*, PyObject* args) {
   return out;
 }
 
+// -- fused nested shred ------------------------------------------------------
+//
+// The ctypes nested route (build.py NestedShredResult) pays 5 ctypes
+// round trips per leaf with the GIL held, copies every output twice
+// (C arena -> ctypes view -> numpy .copy()), widens levels u8->i32 in
+// numpy, and gathers string payloads through create_string_buffer (a
+// third copy).  These two entries replace all of it with TWO C calls
+// per batch: decode (GIL released) returning an opaque handle plus the
+// per-leaf geometry table, then one fill call (GIL released) that
+// materializes every leaf straight into its FINAL representation —
+// fixed values into numpy arrays, span payloads into freshly-allocated
+// bytes objects with their int64 ByteColumn offset tables, def/rep
+// levels widened to the uint32 the nogil page assembler's RLE ops
+// consume.  One copy per output, zero per-leaf Python work.
+//
+// Contract: the PLAN buffers are trusted (built by proto_bridge
+// _NestedPlan from the schema — same trust the ctypes route extends);
+// the WIRE buffer and offset table are hostile and fully validated
+// (ascending walk, bounds) before the decoder runs, and every span is
+// re-checked against the buffer handed to nested_fill.
+
+void nested_capsule_free(PyObject* cap) {
+  auto* o = static_cast<KpwNestedOut*>(
+      PyCapsule_GetPointer(cap, "kpw_nested_out"));
+  if (o != nullptr) kpw_nested_free(o);
+}
+
+// shred_nested_buf(buf, offs, n_nodes, n_leaves, fnum, kind, flags,
+//                  tabs: tuple of 12 int32 buffers)
+//   -> (rc, capsule | None, sizes bytes | None)
+// rc = -1 on success; else the first record index needing the Python
+// fallback.  sizes = int64[n_leaves, 4]:
+//   [value_bytes, n_spans, span_payload_bytes, n_levels] per leaf.
+PyObject* py_shred_nested_buf(PyObject*, PyObject* args) {
+  PyObject *buf_o, *offs_o, *fnum_o, *kind_o, *flags_o, *tabs_t;
+  int n_nodes, n_leaves;
+  if (!PyArg_ParseTuple(args, "OOiiOOOO!", &buf_o, &offs_o, &n_nodes,
+                        &n_leaves, &fnum_o, &kind_o, &flags_o, &PyTuple_Type,
+                        &tabs_t))
+    return nullptr;
+  if (n_nodes <= 0 || n_leaves <= 0) {
+    PyErr_SetString(PyExc_ValueError, "n_nodes/n_leaves must be positive");
+    return nullptr;
+  }
+  if (PyTuple_GET_SIZE(tabs_t) != 12) {
+    PyErr_SetString(PyExc_ValueError, "plan tabs tuple must have 12 buffers");
+    return nullptr;
+  }
+  BufferSet bufs;
+  void *buf_p, *offs_p, *fnum_p, *kind_p, *flags_p;
+  if (!bufs.get(buf_o, &buf_p, PyBUF_SIMPLE) ||
+      !bufs.get(offs_o, &offs_p, PyBUF_SIMPLE) ||
+      !bufs.get(fnum_o, &fnum_p, PyBUF_SIMPLE) ||
+      !bufs.get(kind_o, &kind_p, PyBUF_SIMPLE) ||
+      !bufs.get(flags_o, &flags_p, PyBUF_SIMPLE))
+    return nullptr;
+  // hostile-input validation, exactly shred_flat_buf's discipline
+  Py_ssize_t n_rec = bufs.views[1].len / Py_ssize_t(sizeof(int64_t)) - 1;
+  if (n_rec < 0) {
+    PyErr_SetString(PyExc_ValueError, "offs must hold >= 1 int64");
+    return nullptr;
+  }
+  const int64_t* offs = static_cast<const int64_t*>(offs_p);
+  if (n_rec > 0 && (offs[0] < 0 ||
+                    offs[n_rec] > int64_t(bufs.views[0].len))) {
+    PyErr_SetString(PyExc_ValueError, "offs out of buffer bounds");
+    return nullptr;
+  }
+  for (Py_ssize_t i = 0; i < n_rec; i++) {
+    if (offs[i + 1] < offs[i]) {
+      PyErr_SetString(PyExc_ValueError, "offs must be ascending");
+      return nullptr;
+    }
+  }
+  // plan-shape sanity: node-indexed tables must cover n_nodes entries
+  // (content is trusted; a SHORT buffer would still be an OOB read)
+  if (bufs.views[2].len < Py_ssize_t(n_nodes) * 4 ||
+      bufs.views[3].len < n_nodes || bufs.views[4].len < n_nodes) {
+    PyErr_SetString(PyExc_ValueError, "plan fnum/kind/flags too short");
+    return nullptr;
+  }
+  const int32_t* tabs[12];
+  // per-node int32 tables (every one indexed by node id except ftab /
+  // enum_vals / null_leaves, whose minimum is 1 element)
+  static const bool per_node[12] = {true, true, true, false, true, true,
+                                    false, true, true, false, true, true};
+  for (int t = 0; t < 12; t++) {
+    void* p;
+    if (!bufs.get(PyTuple_GET_ITEM(tabs_t, t), &p, PyBUF_SIMPLE))
+      return nullptr;
+    const Py_buffer& v = bufs.views[bufs.views.size() - 1];
+    const Py_ssize_t need = (per_node[t] ? Py_ssize_t(n_nodes) : 1) * 4;
+    if (v.len < need) {
+      PyErr_SetString(PyExc_ValueError, "plan table too short");
+      return nullptr;
+    }
+    tabs[t] = static_cast<const int32_t*>(p);
+  }
+  KpwNestedOut* out = nullptr;
+  int64_t rc;
+  Py_BEGIN_ALLOW_THREADS
+  rc = kpw_proto_shred_nested(
+      static_cast<const uint8_t*>(buf_p), offs, n_rec, n_nodes, n_leaves,
+      static_cast<const uint32_t*>(fnum_p),
+      static_cast<const uint8_t*>(kind_p),
+      static_cast<const uint8_t*>(flags_p), tabs[0], tabs[1], tabs[2],
+      tabs[3], tabs[4], tabs[5], tabs[6], tabs[7], tabs[8], tabs[9],
+      tabs[10], tabs[11], &out);
+  Py_END_ALLOW_THREADS
+  if (rc >= 0)
+    return Py_BuildValue("LOO", static_cast<long long>(rc), Py_None,
+                         Py_None);
+  PyObject* sizes = PyBytes_FromStringAndSize(
+      nullptr, Py_ssize_t(n_leaves) * 4 * sizeof(int64_t));
+  if (sizes == nullptr) {
+    kpw_nested_free(out);
+    return nullptr;
+  }
+  kpw_nested_sizes(out,
+                   reinterpret_cast<int64_t*>(PyBytes_AS_STRING(sizes)));
+  PyObject* cap = PyCapsule_New(out, "kpw_nested_out", nested_capsule_free);
+  if (cap == nullptr) {
+    Py_DECREF(sizes);
+    kpw_nested_free(out);
+    return nullptr;
+  }
+  PyObject* res = Py_BuildValue("LNN", -1LL, cap, sizes);
+  return res;
+}
+
+// nested_fill(capsule, buf, values_t, offsets_t, defs_t, reps_t)
+//   -> tuple of span payload bytes (None for non-span leaves)
+// Per leaf: values_t = writable fixed-width array or None (must be None
+// for span leaves — their payload is allocated HERE as bytes);
+// offsets_t = writable int64 (n_spans + 1) array for span leaves, None
+// otherwise; defs_t / reps_t = writable uint32 arrays or None.  All
+// output geometry is validated against the decode's size table before
+// the GIL is released.
+PyObject* py_nested_fill(PyObject*, PyObject* args) {
+  PyObject *cap, *buf_o, *vals_t, *offsets_t, *defs_t, *reps_t;
+  if (!PyArg_ParseTuple(args, "OOO!O!O!O!", &cap, &buf_o, &PyTuple_Type,
+                        &vals_t, &PyTuple_Type, &offsets_t, &PyTuple_Type,
+                        &defs_t, &PyTuple_Type, &reps_t))
+    return nullptr;
+  auto* o = static_cast<KpwNestedOut*>(
+      PyCapsule_GetPointer(cap, "kpw_nested_out"));
+  if (o == nullptr) return nullptr;  // wrong/expired capsule: TypeError set
+  const Py_ssize_t nl = PyTuple_GET_SIZE(vals_t);
+  if (PyTuple_GET_SIZE(offsets_t) != nl || PyTuple_GET_SIZE(defs_t) != nl ||
+      PyTuple_GET_SIZE(reps_t) != nl) {
+    PyErr_SetString(PyExc_ValueError, "output tuples must align");
+    return nullptr;
+  }
+  if (Py_ssize_t(kpw_nested_n_leaves(o)) != nl) {
+    PyErr_SetString(PyExc_ValueError,
+                    "output tuples do not match the handle's leaf count");
+    return nullptr;
+  }
+  std::vector<int64_t> sizes(size_t(nl) * 4);
+  kpw_nested_sizes(o, sizes.data());
+
+  BufferSet bufs;
+  void* buf_p;
+  if (!bufs.get(buf_o, &buf_p, PyBUF_SIMPLE)) return nullptr;
+  const int64_t buf_len = int64_t(bufs.views[0].len);
+
+  std::vector<void*> vals(nl, nullptr);
+  std::vector<int64_t*> offsets(nl, nullptr);
+  std::vector<uint8_t*> payloads(nl, nullptr);
+  std::vector<uint32_t*> defs(nl, nullptr);
+  std::vector<uint32_t*> reps(nl, nullptr);
+  PyObject* payload_objs = PyTuple_New(nl);
+  if (payload_objs == nullptr) return nullptr;
+  bool bad = false;
+  const char* bad_msg = nullptr;
+  for (Py_ssize_t f = 0; f < nl && !bad; f++) {
+    const int64_t value_bytes = sizes[4 * f + 0];
+    const int64_t n_spans = sizes[4 * f + 1];
+    const int64_t payload_bytes = sizes[4 * f + 2];
+    const int64_t n_levels = sizes[4 * f + 3];
+    PyObject* off_o = PyTuple_GET_ITEM(offsets_t, f);
+    const bool is_span = off_o != Py_None;
+    void *vp, *op, *dp, *rp;
+    Py_ssize_t vlen, olen, dlen, rlen;
+    if (!bufs.get_sized(PyTuple_GET_ITEM(vals_t, f), &vp, &vlen) ||
+        !bufs.get_sized(off_o, &op, &olen) ||
+        !bufs.get_sized(PyTuple_GET_ITEM(defs_t, f), &dp, &dlen) ||
+        !bufs.get_sized(PyTuple_GET_ITEM(reps_t, f), &rp, &rlen)) {
+      Py_DECREF(payload_objs);
+      return nullptr;
+    }
+    // geometry checks against the decode's own size table: a wrong
+    // allocation must raise here, never write out of bounds nogil
+    if (is_span) {
+      if (vp != nullptr) {
+        bad = true;
+        bad_msg = "span leaves take no values buffer (payload is "
+                  "allocated by nested_fill)";
+        break;
+      }
+      if (olen != (n_spans + 1) * Py_ssize_t(sizeof(int64_t))) {
+        bad = true;
+        bad_msg = "offsets buffer length mismatch";
+        break;
+      }
+      PyObject* pb = PyBytes_FromStringAndSize(nullptr,
+                                               Py_ssize_t(payload_bytes));
+      if (pb == nullptr) {
+        Py_DECREF(payload_objs);
+        return nullptr;
+      }
+      payloads[f] = reinterpret_cast<uint8_t*>(PyBytes_AS_STRING(pb));
+      PyTuple_SET_ITEM(payload_objs, f, pb);
+    } else {
+      if (op != nullptr) {
+        bad = true;
+        bad_msg = "offsets buffer on a non-span leaf";
+        break;
+      }
+      if (vp == nullptr ? value_bytes != 0
+                        : vlen != Py_ssize_t(value_bytes)) {
+        bad = true;
+        bad_msg = "values buffer length mismatch";
+        break;
+      }
+      Py_INCREF(Py_None);
+      PyTuple_SET_ITEM(payload_objs, f, Py_None);
+    }
+    const Py_ssize_t lvl_len = n_levels * Py_ssize_t(sizeof(uint32_t));
+    if ((dp != nullptr && dlen != lvl_len) ||
+        (rp != nullptr && rlen != lvl_len)) {
+      bad = true;
+      bad_msg = "level buffer length mismatch";
+      break;
+    }
+    vals[f] = vp;
+    offsets[f] = static_cast<int64_t*>(op);
+    defs[f] = static_cast<uint32_t*>(dp);
+    reps[f] = static_cast<uint32_t*>(rp);
+  }
+  if (bad) {
+    Py_DECREF(payload_objs);
+    PyErr_SetString(PyExc_ValueError, bad_msg);
+    return nullptr;
+  }
+  int rc = 0;
+  Py_BEGIN_ALLOW_THREADS
+  for (Py_ssize_t f = 0; f < nl && rc == 0; f++)
+    rc = kpw_nested_fill_leaf(o, int32_t(f),
+                              static_cast<const uint8_t*>(buf_p), buf_len,
+                              vals[f], offsets[f], payloads[f], defs[f],
+                              reps[f]);
+  Py_END_ALLOW_THREADS
+  if (rc != 0) {
+    Py_DECREF(payload_objs);
+    PyErr_SetString(PyExc_ValueError,
+                    "span out of payload-buffer bounds (buffer does not "
+                    "match the decoded batch)");
+    return nullptr;
+  }
+  return payload_objs;
+}
+
 PyMethodDef methods[] = {
     {"shred_flat", py_shred_flat, METH_VARARGS,
      "Zero-copy flat wire shred over a list of payload bytes."},
@@ -299,6 +590,12 @@ PyMethodDef methods[] = {
      "Flat wire shred over one contiguous buffer + record offsets."},
     {"gather_buf", py_gather_buf, METH_VARARGS,
      "Concatenate spans (pos, len) from one contiguous buffer -> bytes."},
+    {"shred_nested_buf", py_shred_nested_buf, METH_VARARGS,
+     "Nested wire shred over one contiguous buffer + record offsets, "
+     "GIL released; returns (rc, handle, per-leaf size table)."},
+    {"nested_fill", py_nested_fill, METH_VARARGS,
+     "Materialize every leaf of a shred_nested_buf handle into final "
+     "arrays/ByteColumn payloads in one GIL-released pass."},
     {nullptr, nullptr, 0, nullptr}};
 
 PyModuleDef moduledef = {PyModuleDef_HEAD_INIT, "_kpw_pyshred",

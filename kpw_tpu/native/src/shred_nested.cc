@@ -500,4 +500,62 @@ void kpw_nested_free(KpwNestedOut* o) {
   delete o;
 }
 
+int32_t kpw_nested_n_leaves(KpwNestedOut* o) {
+  return int32_t(o->sh->leaves.size());
+}
+
+// Batched output geometry: one int64 row of 4 per leaf —
+// [value_bytes, n_spans, span_payload_bytes, n_levels].  The fused
+// materialization path (pyshred.cc shred_nested_buf/nested_fill) sizes
+// every output allocation from this table in ONE call instead of the
+// 5-accessors-per-leaf ctypes round trips the NestedShredResult route
+// pays with the GIL held.
+void kpw_nested_sizes(KpwNestedOut* o, int64_t* out) {
+  const auto& leaves = o->sh->leaves;
+  for (size_t i = 0; i < leaves.size(); i++) {
+    const LeafOut& lf = leaves[i];
+    int64_t payload = 0;
+    for (int32_t ln : lf.slen) payload += ln;
+    out[4 * i + 0] = int64_t(lf.values.size());
+    out[4 * i + 1] = int64_t(lf.spos.size());
+    out[4 * i + 2] = payload;
+    out[4 * i + 3] = int64_t(lf.defs.size());
+  }
+}
+
+// Materialize one leaf into caller-allocated output buffers (any may be
+// null to skip): fixed values memcpy'd, span payload gathered straight
+// into its final ByteColumn payload with the int64 offset table built in
+// the same pass, def/rep levels widened uint8 -> uint32 (the dtype the
+// nogil page assembler's RLE ops consume — no Python-side astype copies).
+// ``buf`` is re-supplied by the caller, so every span is bounds-checked
+// against ``buf_len`` before the copy; returns 0 ok, 1 = span out of
+// bounds (hostile/mismatched buffer: the caller must raise, not read).
+int kpw_nested_fill_leaf(KpwNestedOut* o, int32_t leaf, const uint8_t* buf,
+                         int64_t buf_len, void* values_out,
+                         int64_t* offsets_out, uint8_t* payload_out,
+                         uint32_t* defs_out, uint32_t* reps_out) {
+  const LeafOut& lf = o->sh->leaves[leaf];
+  if (values_out != nullptr && !lf.values.empty())
+    std::memcpy(values_out, lf.values.data(), lf.values.size());
+  if (payload_out != nullptr || offsets_out != nullptr) {
+    int64_t at = 0;
+    if (offsets_out != nullptr) offsets_out[0] = 0;
+    for (size_t i = 0; i < lf.spos.size(); i++) {
+      const int64_t pos = lf.spos[i];
+      const int64_t len = lf.slen[i];
+      if (pos < 0 || len < 0 || pos > buf_len - len) return 1;
+      if (payload_out != nullptr && len > 0)
+        std::memcpy(payload_out + at, buf + pos, size_t(len));
+      at += len;
+      if (offsets_out != nullptr) offsets_out[i + 1] = at;
+    }
+  }
+  if (defs_out != nullptr)
+    for (size_t i = 0; i < lf.defs.size(); i++) defs_out[i] = lf.defs[i];
+  if (reps_out != nullptr)
+    for (size_t i = 0; i < lf.reps.size(); i++) reps_out[i] = lf.reps[i];
+  return 0;
+}
+
 }  // extern "C"

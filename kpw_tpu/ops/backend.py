@@ -97,6 +97,40 @@ class _PageBodies:
         return self.n
 
 
+class _LevelPages:
+    """One page's planned rep+def level streams as op descriptors —
+    ``("raw", part)`` (bytes or a zero-copy device-readback view, length
+    prefix already included) and ``("runs", vals u32, lens i32, width)``
+    (compact device run table, replayed by the native assembler's
+    RLE-from-runs op).  ``blob()`` composes the exact same bytes on host
+    for the Python page loop / runs-op-less assemblers — byte-identity
+    between the two consumers holds by construction
+    (kpw_rle_hybrid_from_runs_u32 is the C twin of
+    core.encodings.rle_hybrid_from_runs)."""
+
+    __slots__ = ("ops", "_blob")
+
+    def __init__(self, ops: list) -> None:
+        self.ops = ops
+        self._blob: bytes | None = None
+
+    def blob(self) -> bytes:
+        if self._blob is None:
+            out = []
+            for d in self.ops:
+                if d[0] == "raw":
+                    p = d[1]
+                    out.append(p if isinstance(p, bytes)
+                               else np.asarray(p).tobytes())
+                else:
+                    _, rv, rl, width = d
+                    payload = enc.rle_hybrid_from_runs(
+                        rv.astype(np.uint64), rl, width)
+                    out.append(struct.pack("<I", len(payload)) + payload)
+            self._blob = b"".join(out)
+        return self._blob
+
+
 class _LevelPlanner:
     """Device encoding of every rep/def level stream in a row group
     (BASELINE.md config 5), folded into the planner's two round trips:
@@ -202,36 +236,50 @@ class _LevelPlanner:
         return [g[2] for g in self._b_groups]
 
     def assemble(self, fetched) -> None:
-        """Build the per-page level payloads (v1: 4-byte LE length prefix)
-        and fold rep+def into per-(chunk, page) blobs."""
-        parts: dict[tuple[int, int, int], dict] = {}  # (i, a, b) -> kind -> bytes
+        """Fold the fetched device outputs into per-(chunk, page) level
+        plans.  Each plan entry (:class:`_LevelPages`) carries op
+        DESCRIPTORS, not composed bytes: bit-packed pages as zero-copy
+        [v1 length+varint header, packed row view] raw parts, run-heavy
+        pages as their compact (run_vals, run_lens) tables — which the
+        native lowering hands to the assembler's RLE-from-runs op so the
+        O(runs) replay happens inside the one nogil call per chunk.  The
+        Python page loop (and a runs-op-less assembler) composes the same
+        bytes on demand via :meth:`_LevelPages.blob`."""
+        parts: dict[tuple[int, int, int], dict] = {}  # (i, a, b) -> kind -> ops
         for (mode, items, _), host in zip(self._b_groups, fetched):
             if mode == "fast":
                 packed_h = host
                 for r, (row, i, kind, a, b, width) in enumerate(items):
                     count = b - a
                     groups = (count + 7) // 8
-                    payload = (varint_bytes((groups << 1) | 1)
-                               + packed_h[r, : groups * width].tobytes())
-                    parts.setdefault((i, a, b), {})[kind] = payload
+                    head = varint_bytes((groups << 1) | 1)
+                    packed = packed_h[r, : groups * width]
+                    # v1 length prefix + bit-pack header composed WITHOUT
+                    # materializing the packed bytes (the row view rides
+                    # to the sink / native call as a buffer)
+                    hdr = struct.pack(
+                        "<I", len(head) + groups * width) + head
+                    parts.setdefault((i, a, b), {})[kind] = [
+                        ("raw", hdr), ("raw", packed)]
             else:
                 vals_h, lens_h = host
                 for r, ((row, i, kind, a, b, width), n_runs) in enumerate(items):
-                    payload = enc.rle_hybrid_from_runs(
-                        vals_h[r, :n_runs].astype(np.uint64),
-                        lens_h[r, :n_runs], width)
-                    parts.setdefault((i, a, b), {})[kind] = payload
+                    parts.setdefault((i, a, b), {})[kind] = [
+                        ("runs",
+                         np.ascontiguousarray(vals_h[r, :n_runs], np.uint32),
+                         np.ascontiguousarray(lens_h[r, :n_runs], np.int32),
+                         width)]
         for (i, a, b), kinds in parts.items():
             chunk = self._chunks[i]
             col = chunk.column
-            blob = b""
+            ops: list = []
             for kind, max_level in (("rep", col.max_rep), ("def", col.max_def)):
                 if max_level > 0:
-                    payload = kinds[kind]
-                    blob += struct.pack("<I", len(payload)) + payload
+                    ops.extend(kinds[kind])
             # entries carry the chunk itself so a consumer can identity-check
             # against id() reuse (plans may survive an aborted _prepare_all)
-            self.plans.setdefault(id(chunk), (chunk, {}))[1][(a, b)] = blob
+            self.plans.setdefault(id(chunk), (chunk, {}))[1][(a, b)] = \
+                _LevelPages(ops)
 
 
 def _trivial_body(width: int, count: int) -> bytes | None:
@@ -787,17 +835,31 @@ class TpuChunkEncoder(NativeChunkEncoder):
             return [body]
         return super()._values_page_parts(chunk, va, vb, pt, encoding)
 
-    def _planned_levels_blob(self, chunk, a: int, b: int) -> bytes | None:
-        """The planner's device-encoded rep+def blob for slots [a, b) when
-        one exists — consulted by both the Python page loop (via
-        _levels_page_blob) and the native assembly lowering (as a RAW op
-        instead of re-RLE-encoding the streams)."""
+    def _planned_level_entry(self, chunk, a: int, b: int):
+        """The planner's :class:`_LevelPages` entry for slots [a, b), or
+        None — one place owns the id()-keyed cache protocol."""
         plans = getattr(self, "_level_plans", None)
         if plans:
             hit = plans.get(id(chunk))
             if hit is not None and hit[0] is chunk:  # guard against id() reuse
                 return hit[1].get((a, b))
         return None
+
+    def _planned_levels_blob(self, chunk, a: int, b: int) -> bytes | None:
+        """The planner's device-encoded rep+def blob for slots [a, b) when
+        one exists — consulted by both the Python page loop (via
+        _levels_page_blob) and the native assembly lowering when the
+        loaded assembler predates the RLE-from-runs op."""
+        entry = self._planned_level_entry(chunk, a, b)
+        return entry.blob() if entry is not None else None
+
+    def _planned_level_ops(self, chunk, a: int, b: int) -> list | None:
+        """Planned level streams as ops for the nogil lowering: raw parts
+        stay raw (zero-copy views included), run tables ride the
+        assembler's RLE-from-runs op — the device->file handoff with no
+        host replay loop at all."""
+        entry = self._planned_level_entry(chunk, a, b)
+        return entry.ops if entry is not None else None
 
     def _levels_page_blob(self, chunk, a: int, b: int) -> bytes:
         body = self._planned_levels_blob(chunk, a, b)

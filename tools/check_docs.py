@@ -196,6 +196,29 @@ CHECKS = [
     ("PARITY.md", r"mid-multipart crash replay's \*\*(\d+)\*\* acked\s+"
                   r"offsets",
      ["objstore:crash_replay.acked_offsets_checked"]),
+    # fused-nested-pipeline PR: the nested-vs-flat ratio, arm medians,
+    # fused A/B, and capacity bracket reconcile against the nested
+    # artifact (`nested:` prefix, BENCH_NESTED_r18.json)
+    ("README.md", r"arm at \*\*([\d.]+)k\*\* records/s vs the flat cfg6\s+"
+                  r"arm's \*\*([\d.]+)k\*\*",
+     [("nested:nested_records_per_sec_median", 1e3),
+      ("nested:flat_records_per_sec_median", 1e3)]),
+    ("README.md", r"`nested_over_flat_x` \*\*([\d.]+)x\*\*,\s+far inside",
+     ["nested:nested_over_flat_x"]),
+    ("README.md", r"read \*\*([\d.]+)\*\*–\*\*([\d.]+)\*\* of 2 cores",
+     ["nested:cpu_capacity_x.before", "nested:cpu_capacity_x.after"]),
+    ("README.md", r"fused-route A/B\s+at \*\*([\d.]+)x\*\*",
+     ["nested:fused_ab.speedup_x"]),
+    ("PARITY.md", r"`nested_over_flat_x` \*\*([\d.]+)x\*\* \(nested "
+                  r"\*\*([\d.]+)k\*\* vs flat \*\*([\d.]+)k\*\*",
+     ["nested:nested_over_flat_x",
+      ("nested:nested_records_per_sec_median", 1e3),
+      ("nested:flat_records_per_sec_median", 1e3)]),
+    ("PARITY.md", r"fused-vs-ctypes `speedup_x` \*\*([\d.]+)x\*\*",
+     ["nested:fused_ab.speedup_x"]),
+    ("PARITY.md", r"bracket recorded \*\*([\d.]+)\*\*–\*\*([\d.]+)\*\* "
+                  r"of 2 cores",
+     ["nested:cpu_capacity_x.before", "nested:cpu_capacity_x.after"]),
 ]
 
 
@@ -578,6 +601,12 @@ def main() -> int:
         "KPW_OBJSTORE_PATH", os.path.join(ROOT, "BENCH_OBJSTORE_r16.json"))
     if os.path.exists(objstore_path):
         key_record["objstore"] = json.load(open(objstore_path))
+    # the nested-vs-flat fused-pipeline artifact (bench.py --nested) is
+    # the eleventh
+    nested_path = os.environ.get(
+        "KPW_NESTED_PATH", os.path.join(ROOT, "BENCH_NESTED_r18.json"))
+    if os.path.exists(nested_path):
+        key_record["nested"] = json.load(open(nested_path))
     docs = {f: open(os.path.join(ROOT, f)).read()
             for f in ({c[0] for c in CHECKS} | set(KEY_DOCS)
                       | set(NAME_DOCS))}
@@ -612,6 +641,8 @@ def main() -> int:
                 root, spec = key_record.get("procs", {}), spec[6:]
             elif spec.startswith("objstore:"):
                 root, spec = key_record.get("objstore", {}), spec[9:]
+            elif spec.startswith("nested:"):
+                root, spec = key_record.get("nested", {}), spec[7:]
             try:
                 expect = float(art(root, spec)) / scale
             except (KeyError, TypeError):

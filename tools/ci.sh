@@ -40,19 +40,25 @@
 #                          mid-multipart crash replay recovers; exits
 #                          nonzero unless the invariant holds, committed
 #                          artifact never overwritten)
-#   8. schedx smoke      — python -m tools.schedx --smoke (deterministic
+#   8. nested smoke      — python bench.py --nested --smoke (reduced
+#                          nested list<struct> replay through the fused
+#                          pipeline + the fused-vs-fallback-vs-oracle
+#                          file-byte identity check; exits nonzero
+#                          unless ack-lag drains to 0 AND the bytes
+#                          match, committed artifact never overwritten)
+#   9. schedx smoke      — python -m tools.schedx --smoke (deterministic
 #                          schedule explorer: the committed seed subset
 #                          over the PR-11/12 race scenarios must run
 #                          CLEAN — a violation report carries its replay
 #                          seed and both participating stacks)
-#   9. doc reconciliation — python tools/check_docs.py (every doc-cited
+#  10. doc reconciliation — python tools/check_docs.py (every doc-cited
 #                          number/name/test/pass/seed-count exists and
 #                          matches)
-#  10. sanitizer smoke   — bash tools/sanitize.sh --smoke (ASan/UBSan
+#  11. sanitizer smoke   — bash tools/sanitize.sh --smoke (ASan/UBSan
 #                          native build + fuzz; prints a LOUD notice and
 #                          exits 0 when the toolchain is absent — never
 #                          a silent pass)
-#  11. tsan smoke        — bash tools/sanitize.sh --tsan --smoke
+#  12. tsan smoke        — bash tools/sanitize.sh --tsan --smoke
 #                          (ThreadSanitizer build of the GIL-released
 #                          entries driven from concurrent threads; the
 #                          deliberate-race canary must be REPORTED first
@@ -67,10 +73,10 @@ cd "$(dirname "$0")/.."
 fail=0
 step() { echo; echo "=== ci.sh [$1] $2 ==="; }
 
-step 1/11 "lint suite (python -m tools.analyze)"
+step 1/12 "lint suite (python -m tools.analyze)"
 python -m tools.analyze || fail=1
 
-step 2/11 "tier-1 pytest (-m 'not slow')"
+step 2/12 "tier-1 pytest (-m 'not slow')"
 # tier-1's exit code is nonzero on THIS container because of the known
 # environmental failures (python zstandard + jax shard_map absent — see
 # the CHANGES.md baseline), so the gate is mechanical instead of
@@ -93,31 +99,34 @@ if [ "$t1_errors" -gt 0 ] || [ "$t1_failed" -gt "$max_failed" ] \
 fi
 rm -f "$T1_LOG"
 
-step 3/11 "compaction smoke (bench.py --compact --smoke)"
+step 3/12 "compaction smoke (bench.py --compact --smoke)"
 JAX_PLATFORMS=cpu python bench.py --compact --smoke || fail=1
 
-step 4/11 "scan smoke (bench.py --scan --smoke)"
+step 4/12 "scan smoke (bench.py --scan --smoke)"
 JAX_PLATFORMS=cpu python bench.py --scan --smoke || fail=1
 
-step 5/11 "e2e smoke (bench.py --e2e --smoke)"
+step 5/12 "e2e smoke (bench.py --e2e --smoke)"
 JAX_PLATFORMS=cpu python bench.py --e2e --smoke || fail=1
 
-step 6/11 "process-mode smoke (bench.py --procs --smoke)"
+step 6/12 "process-mode smoke (bench.py --procs --smoke)"
 JAX_PLATFORMS=cpu python bench.py --procs --smoke || fail=1
 
-step 7/11 "object-store smoke (bench.py --objstore --smoke)"
+step 7/12 "object-store smoke (bench.py --objstore --smoke)"
 JAX_PLATFORMS=cpu python bench.py --objstore --smoke || fail=1
 
-step 8/11 "schedule-explorer smoke (python -m tools.schedx --smoke)"
+step 8/12 "nested-replay smoke (bench.py --nested --smoke)"
+JAX_PLATFORMS=cpu python bench.py --nested --smoke || fail=1
+
+step 9/12 "schedule-explorer smoke (python -m tools.schedx --smoke)"
 JAX_PLATFORMS=cpu python -m tools.schedx --smoke || fail=1
 
-step 9/11 "doc reconciliation (tools/check_docs.py)"
+step 10/12 "doc reconciliation (tools/check_docs.py)"
 python tools/check_docs.py || fail=1
 
-step 10/11 "sanitizer smoke (tools/sanitize.sh --smoke)"
+step 11/12 "sanitizer smoke (tools/sanitize.sh --smoke)"
 bash tools/sanitize.sh --smoke || fail=1
 
-step 11/11 "tsan smoke (tools/sanitize.sh --tsan --smoke)"
+step 12/12 "tsan smoke (tools/sanitize.sh --tsan --smoke)"
 bash tools/sanitize.sh --tsan --smoke || fail=1
 
 echo

@@ -30,6 +30,13 @@ inputs, with a strict allowed-outcome contract per target —
   ``read_sorting_columns``, ``bloom_check``) must return or raise
   ``ThriftDecodeError`` — a scan planner fed a hostile file may refuse
   it, never crash on it.
+* ``nested``  — the FUSED nested wire path (ISSUE 14):
+  ``columnarize_buffer`` over a nested (list<struct>) schema with
+  mutated offset tables and mutated wire bytes, driving the batched
+  ``shred_nested_buf``/``nested_fill`` decoder output.  Must return a
+  ColumnBatch or raise ``ValueError`` / ``WireShredError`` — an OOB in
+  the decode, the span gather, or the level widening is a crash (the
+  ASan build aborts on it).
 
 Deterministic by construction: ``--seed`` fixes the whole run, and the
 committed regression configuration is seed=20260803 (tools/ci.sh runs
@@ -177,6 +184,57 @@ def _make_wire_batch():
     buf = b"".join(payloads)
     col = ProtoColumnarizer(cls)
     assert col.wire_capable, "fuzz schema must be wire-shreddable"
+    return col, buf, offs
+
+
+def _make_nested_wire_batch():
+    """(columnarizer, payload buffer, valid offsets) for the nested
+    target — a list<struct> schema (the cfg5/cfg7 shape) whose batches
+    ride the fused shred_nested_buf/nested_fill path."""
+    from google.protobuf import (descriptor_pb2, descriptor_pool,
+                                 message_factory)
+
+    from kpw_tpu.models.proto_bridge import ProtoColumnarizer
+
+    F = descriptor_pb2.FieldDescriptorProto
+    pool = descriptor_pool.DescriptorPool()
+    fdp = descriptor_pb2.FileDescriptorProto(
+        name="fuzz_nested.proto", package="kpwfuzzn", syntax="proto2")
+    item = fdp.message_type.add(name="Item")
+    item.field.add(name="sku", number=1, type=F.TYPE_STRING,
+                   label=F.LABEL_REQUIRED)
+    item.field.add(name="qty", number=2, type=F.TYPE_INT32,
+                   label=F.LABEL_OPTIONAL)
+    item.field.add(name="tags", number=3, type=F.TYPE_STRING,
+                   label=F.LABEL_REPEATED)
+    order = fdp.message_type.add(name="Order")
+    order.field.add(name="order_id", number=1, type=F.TYPE_INT64,
+                    label=F.LABEL_REQUIRED)
+    order.field.add(name="items", number=2, type=F.TYPE_MESSAGE,
+                    label=F.LABEL_REPEATED, type_name=".kpwfuzzn.Item")
+    order.field.add(name="note", number=3, type=F.TYPE_STRING,
+                    label=F.LABEL_OPTIONAL)
+    fd = pool.Add(fdp)
+    cls = message_factory.GetMessageClass(fd.message_types_by_name["Order"])
+    payloads = []
+    for i in range(200):
+        msg = cls(order_id=i)
+        for j in range(i % 4):
+            it = msg.items.add()
+            it.sku = f"sku-{(i + j) % 13}"
+            if j % 2:
+                it.qty = j
+            for t in range(j % 3):
+                it.tags.append(f"t{t}")
+        if i % 3 == 0:
+            msg.note = f"n-{i}" * (i % 5 + 1)
+        payloads.append(msg.SerializeToString())
+    offs = np.zeros(len(payloads) + 1, np.int64)
+    np.cumsum([len(p) for p in payloads], out=offs[1:])
+    buf = b"".join(payloads)
+    col = ProtoColumnarizer(cls)
+    col._wire = None  # pin the NESTED decoder
+    assert col.wire_capable, "nested fuzz schema must be wire-shreddable"
     return col, buf, offs
 
 
@@ -353,6 +411,36 @@ def fuzz_index(seed: int, iters: int, report) -> int:
     return crashes
 
 
+def fuzz_nested(seed: int, iters: int, report) -> int:
+    """Adversarial wire bytes + offset tables through the fused nested
+    decoder (shred_nested_buf -> nested_fill): a ColumnBatch, ValueError
+    or WireShredError are the designed outcomes — anything else (or an
+    ASan abort in the decode / span gather / level widening) is a crash."""
+    from kpw_tpu.models.proto_bridge import WireShredError
+
+    col, buf, offs = _make_nested_wire_batch()
+    rng = random.Random(seed + 5)
+    crashes = 0
+    for i in range(iters):
+        if i % 4 == 3:
+            # valid table, mutated PAYLOAD: the nested decoder must reject
+            # into the Python fallback or accept with exact semantics,
+            # never walk out of the buffer
+            table, payload = offs, _mutate_bytes(rng, buf)
+            if len(payload) < len(buf):  # keep the table in-bounds
+                payload = payload + b"\0" * (len(buf) - len(payload))
+        else:
+            table, payload = _mutate_offsets(rng, offs, len(buf)), buf
+        try:
+            col.columnarize_buffer(payload, table)
+        except (ValueError, WireShredError):
+            pass                       # the designed outcomes
+        except Exception as e:
+            crashes += 1
+            report("nested", i, e)
+    return crashes
+
+
 def _make_assemble_plan():
     """(extension, buffers, page_tab, op_tab, values) — one valid lowered
     plan shaped like a real chunk (RAW body parts + RLE level/index ops +
@@ -370,20 +458,32 @@ def _make_assemble_plan():
     idx = np.ascontiguousarray(rng2.integers(0, 16, 512), np.uint32)
     levels = np.ascontiguousarray(rng2.integers(0, 2, 512), np.uint32)
     raw = bytes(rng2.integers(0, 256, 700, dtype=np.uint8))
+    # nested-pipeline op substrates (OP_KINDS >= 4): a run table (the
+    # device level planner's handoff) and a packed ByteColumn
+    run_vals = np.ascontiguousarray(rng2.integers(0, 4, 40), np.uint32)
+    run_lens = np.ascontiguousarray(rng2.integers(1, 20, 40), np.int32)
+    ba_lens = rng2.integers(0, 9, 64)
+    ba_offs = np.zeros(65, np.int64)
+    np.cumsum(ba_lens, out=ba_offs[1:])
+    ba_data = bytes(rng2.integers(0, 256, int(ba_offs[-1]), dtype=np.uint8))
     buffers = (raw, idx, levels, values.view(np.uint8).tobytes(),
                DATA_PAGE_PREFIX, DICT_PAGE_PREFIX,
-               data_page_suffix(256, 0, True), dict_page_suffix(16, 2, True))
+               data_page_suffix(256, 0, True), dict_page_suffix(16, 2, True),
+               run_vals, run_lens, ba_data, ba_offs)
     ops = np.array([
         [0, 0, 0, 700, 0],            # RAW whole buffer
         [1, 2, 0, 256, 1 | (2 << 8)],  # RLE levels, len32 mode
         [1, 1, 0, 256, 4 | (1 << 8)],  # RLE indices, width-byte mode
         [0, 3, 0, 2048, 0],           # RAW values-as-bytes slice
         [1, 1, 256, 512, 4 | (0 << 8)],  # RLE bare
+        [2, 8, 0, 40, 2 | (2 << 8) | (9 << 16)],  # RLE-from-runs, len32
+        [3, 10, 0, 64, 11 << 16],     # bytes-plain over the ByteColumn
     ], np.int64)
     pages = np.array([
         [0, 1, 5, 7, 1, 0, 0],    # dict-ish page: RAW body, CRC on
         [1, 3, 4, 6, 1, 0, 256],  # data page: levels+indices, stats range
         [3, 5, 4, 6, 0, 256, 512],
+        [5, 7, 4, 6, 1, 0, 0],    # nested-shaped page: runs + bytes-plain
     ], np.int64)
     return asm, buffers, pages, ops, values
 
@@ -421,7 +521,7 @@ def fuzz_assemble(seed: int, iters: int, report) -> int:
                           for _ in range(rng.randint(1, 4))], np.int64)
         elif kind == 4:    # random op kinds/aux over valid ranges
             for r in range(o.shape[0]):
-                o[r, 0] = rng.randrange(-2, 4)
+                o[r, 0] = rng.randrange(-2, 6)  # incl. runs/bytes-plain
                 o[r, 4] = rng.choice(adversarial)
         else:              # both tables perturbed
             p[rng.randrange(p.shape[0]), rng.randrange(7)] = rng.choice(
@@ -449,7 +549,7 @@ def fuzz_assemble(seed: int, iters: int, report) -> int:
 
 TARGETS = {"thrift": fuzz_thrift, "verify": fuzz_verify,
            "offsets": fuzz_offsets, "index": fuzz_index,
-           "assemble": fuzz_assemble}
+           "assemble": fuzz_assemble, "nested": fuzz_nested}
 DEFAULT_SEED = 20260803
 
 

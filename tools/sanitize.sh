@@ -157,6 +157,15 @@ python -m pytest tests/test_assemble.py \
     -k "malformed or valid_plan or stats_require or unsupported or byte_identical" \
     -q -p no:cacheprovider || rc=1
 
+# fused nested pipeline subset (ISSUE 14): the batched nested decoder +
+# nested_fill geometry contract and the fused/ctypes/oracle byte-identity
+# matrix, against the SANITIZED builds — a span-gather or level-widening
+# OOB traps instead of reading a neighboring arena page (the streaming
+# writer suites are excluded: thread-heavy, covered by tier-1)
+python -m pytest tests/test_nested_shred.py tests/test_nested_fused.py \
+    -k "not writer_streams" \
+    -q -p no:cacheprovider || rc=1
+
 # seeded mutation fuzz: thrift reader, verifier page walk, offset-table
 # validator — zero crashes/sanitizer findings required
 python -m tools.fuzz --seed "$SEED" --iters "$FUZZ_ITERS" || rc=1

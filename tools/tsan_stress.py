@@ -3,12 +3,14 @@ ThreadSanitizer leg's workload (``tools/sanitize.sh --tsan``).
 
 The repo's native hot path deliberately runs WITHOUT the GIL:
 ``shred_flat_buf``/``gather_buf`` (PR 6) decode broker buffers while the
-encode pipeline thread runs, and ``assemble_pages`` (PR 10) assembles
-whole column chunks concurrently from the encoder pool.  A data race in
-that code is a real race no Python-level tool can see — so this driver
-hammers all three entries from several true-concurrent threads against
-the ``KPW_NATIVE_SANITIZE=tsan`` build, where TSan traps any racy
-access instead of letting it silently corrupt a page.
+encode pipeline thread runs, ``assemble_pages`` (PR 10) assembles whole
+column chunks concurrently from the encoder pool, and the fused nested
+entries ``shred_nested_buf``/``nested_fill`` (ISSUE 14) decode and
+materialize list<struct> batches the same way.  A data race in that
+code is a real race no Python-level tool can see — so this driver
+hammers all of them from several true-concurrent threads against the
+``KPW_NATIVE_SANITIZE=tsan`` build, where TSan traps any racy access
+instead of letting it silently corrupt a page.
 
 Workload discipline (why this is race-clean by DESIGN, which is exactly
 what TSan verifies): shared inputs are allocated once in the main thread
@@ -60,6 +62,34 @@ def _shred_inputs():
     return col, b"".join(payloads), offs
 
 
+def _nested_inputs():
+    """One contiguous NESTED wire batch + columnarizer forced onto the
+    nested decoder (fused shred_nested_buf/nested_fill path), built in
+    the main thread — shared read-only by every worker; each worker's
+    decode handle and output arrays are thread-private."""
+    from proto_helpers import nested_message_classes
+
+    from kpw_tpu.models.proto_bridge import ProtoColumnarizer
+
+    cls = nested_message_classes()
+    col = ProtoColumnarizer(cls)
+    col._wire = None  # pin the nested decoder
+    assert col.wire_capable, "nested plan must engage"
+    payloads = []
+    for i in range(300):
+        m = cls()
+        m.order_id = i
+        for j in range(i % 4):
+            it = m.items.add()
+            it.sku = f"sku{(i + j) % 9}"
+            it.qty = j + 1
+        payloads.append(m.SerializeToString())
+    lens = np.fromiter(map(len, payloads), np.int64, count=len(payloads))
+    offs = np.zeros(len(payloads) + 1, np.int64)
+    np.cumsum(lens, out=offs[1:])
+    return col, b"".join(payloads), offs
+
+
 def _assemble_inputs():
     """A minimal valid RAW-op plan for ``assemble_pages`` (same shape as
     tests/test_assemble.py's valid-plan contract); page/op/meta tables
@@ -77,12 +107,16 @@ def _assemble_inputs():
 
 def run(iters: int = DEFAULT_ITERS, threads: int = DEFAULT_THREADS) -> int:
     col, blob, offs, = _shred_inputs()
+    ncol, nblob, noffs = _nested_inputs()
     asm, buffers, pages, ops = _assemble_inputs()
 
     # reference outputs from the main thread: workers must reproduce
     # them bit-for-bit (a race that slips past TSan would still corrupt)
     ref_batch = col.columnarize_buffer(blob, offs)
     ref_col0 = bytes(memoryview(ref_batch.chunks[0].values.data))
+    nref = ncol.columnarize_buffer(nblob, noffs)
+    nref_sku = bytes(memoryview(nref.chunks[1].values.data))
+    nref_defs = np.asarray(nref.chunks[1].def_levels).tobytes()
     ref_meta = np.zeros((1, 3), np.int64)
     ref_out = asm.assemble_pages(buffers, pages, ops, 0, 3, None, 0,
                                  ref_meta, None, None)
@@ -100,6 +134,13 @@ def run(iters: int = DEFAULT_ITERS, threads: int = DEFAULT_THREADS) -> int:
                         != ref_col0:
                     raise AssertionError(
                         f"worker {widx} iter {i}: shred output diverged")
+                nbatch = ncol.columnarize_buffer(nblob, noffs)
+                if (bytes(memoryview(nbatch.chunks[1].values.data))
+                        != nref_sku
+                        or np.asarray(nbatch.chunks[1].def_levels).tobytes()
+                        != nref_defs):
+                    raise AssertionError(
+                        f"worker {widx} iter {i}: nested shred diverged")
                 meta = np.zeros((1, 3), np.int64)
                 out = asm.assemble_pages(buffers, pages.copy(), ops.copy(),
                                          0, 3, None, 0, meta, None, None)
@@ -121,7 +162,8 @@ def run(iters: int = DEFAULT_ITERS, threads: int = DEFAULT_THREADS) -> int:
         return 1
     mode = os.environ.get("KPW_NATIVE_SANITIZE", "")
     print(f"tsan_stress: {threads} threads x {iters} iters over "
-          f"shred_flat_buf/gather_buf/assemble_pages completed "
+          f"shred_flat_buf/gather_buf/shred_nested_buf/nested_fill/"
+          f"assemble_pages completed "
           f"(KPW_NATIVE_SANITIZE={mode or 'off'}); outputs byte-identical "
           f"to the single-thread reference")
     return 0
