@@ -4293,6 +4293,250 @@ def nested_probe(rows: int = 120_000, parts: int = 4, pairs: int = 3,
 
 
 # ---------------------------------------------------------------------------
+# --tenants: multi-tenant bulkheads — skewed traffic, quota throttling,
+# fault/poison containment across routes sharing one broker session
+# ---------------------------------------------------------------------------
+
+def _tenants_nested_payloads(rows: int, seed: int = 21):
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                    "tests"))
+    from proto_helpers import nested_message_classes
+
+    order_cls = nested_message_classes()
+    out = []
+    for i in range(rows):
+        m = order_cls(order_id=i, note=f"n-{i}")
+        for j in range(1 + i % 3):
+            it = m.items.add()
+            it.sku = f"sku-{i}-{j}"
+            it.qty = j
+            it.tags.append(f"t{j}")
+        out.append(m.SerializeToString())
+    return order_cls, out
+
+
+def tenants_probe(tenants: int = 12, smoke: bool = False) -> dict:
+    """``--tenants`` mode: the multi-tenant bulkhead evidence (ISSUE 15).
+
+    ~A dozen tenants of SKEWED traffic share one broker session through
+    ``Builder.route(...)`` (different protos: one tenant streams the
+    nested list<struct> shape).  Three tenants misbehave at once:
+
+    * the BURST tenant replays several times every victim's volume under
+      a deliberately small queue share — its own fetch gate must park
+      (the stall counters are the committed evidence of throttling)
+      while every victim's p99 ack-lag stays under the declared SLA;
+    * the FAULT tenant's sink runs a transient fault persona (scattered
+      EIO writes, publish faults, latency injections) — retried, never
+      fatal, zero worker deaths anywhere;
+    * the POISON tenant's stream carries garbage payloads — dead-lettered
+      (typed frames, then acked) in ITS tree only.
+
+    Containment is read off committed counters: per-tenant deaths/
+    restarts (zero cross-tenant), per-tenant dead-letter counts (exact),
+    per-tenant quota stalls (bind on the offender, zero on pure
+    victims), per-tenant p99 ack-lag vs the SLA.  ``--smoke`` is the CI
+    gate: reduced tenant mix, exit nonzero unless every route's ack-lag
+    drains to 0 AND the containment counters show zero cross-tenant
+    deaths; the committed artifact is never overwritten."""
+    import errno as _errno
+
+    from kpw_tpu import (Builder, FakeBroker, FaultInjectingFileSystem,
+                         FaultSchedule, MemoryFileSystem, MetricRegistry)
+
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                    "tests"))
+    from proto_helpers import sample_message_class
+
+    parts = 2
+    if smoke:
+        tenants = min(tenants, 6)
+        burst_rows, base_rows = 6_000, 1_200
+        sla_s, deadline_s = 30.0, 150.0
+        burst_quota = 800
+    else:
+        burst_rows, base_rows = 30_000, 4_000
+        sla_s, deadline_s = 10.0, 300.0
+        burst_quota = 2_500
+    victim_quota = 20_000
+    names = [f"t{i:02d}" for i in range(tenants)]
+    burst, fault, poison, nested = names[0], names[1], names[2], names[3]
+    cls = sample_message_class()
+    order_cls, nested_payloads = _tenants_nested_payloads(base_rows)
+
+    broker = FakeBroker()
+    n_poison = 0
+    rows_by_tenant: dict[str, int] = {}
+    pad = "x" * 60
+    for t in names:
+        broker.create_topic(t, parts)
+        rows = burst_rows if t == burst else base_rows
+        rows_by_tenant[t] = rows
+        if t == nested:
+            for i, p in enumerate(nested_payloads):
+                broker.produce(t, p, partition=i % parts)
+            continue
+        for i in range(rows):
+            if t == poison and i % 97 == 13:
+                broker.produce(t, b"\xff\xfe poison " + bytes([i % 251]),
+                               partition=i % parts)
+                n_poison += 1
+            else:
+                broker.produce(
+                    t, cls(query=f"q-{i}-{pad}",
+                           timestamp=i).SerializeToString(),
+                    partition=i % parts)
+
+    # transient fault persona on the FAULT tenant's sink only: scattered
+    # EIO writes + publish faults + latency — retried-not-fatal under
+    # the default policy, so containment must show ZERO deaths even on
+    # the faulted route
+    sched = (FaultSchedule(seed=17)
+             .fail_random("write", 8, 60, err=_errno.EIO)
+             .fail_nth("rename", 2, count=2)
+             .delay_nth("write", 12, 0.02, count=4))
+    fault_fs = FaultInjectingFileSystem(MemoryFileSystem(), sched)
+    shared_fs = MemoryFileSystem()
+
+    reg = MetricRegistry()
+    b = (Builder().broker(broker).filesystem(shared_fs)
+         .metric_registry(reg).instance_name("tenantsbench")
+         .thread_count(1).batch_size(256)
+         .max_file_size(256 * 1024).block_size(32 * 1024)
+         .max_file_open_duration_seconds(0.5)
+         .supervise(True, max_restarts=4, restart_backoff_seconds=0.02))
+    for t in names:
+        overrides: dict = {}
+        proto = cls
+        quota = victim_quota
+        if t == burst:
+            quota = burst_quota
+        if t == fault:
+            overrides["filesystem"] = fault_fs
+        if t == poison:
+            overrides["on_parse_error"] = "dead_letter"
+        if t == nested:
+            proto = order_cls
+        b.route(t, proto, f"/tenants/{t}", queue_quota=quota,
+                ack_sla_seconds=sla_s, **overrides)
+    mw = b.build()
+
+    samples: dict[str, list] = {t: [] for t in names}
+    t0 = time.perf_counter()
+    mw.start()
+    group = mw.route(names[0])._b._group_id
+    deadline = time.time() + deadline_s
+    drained = False
+    while time.time() < deadline:
+        lag = mw.ack_lag()
+        for t, per in lag["by_tenant"].items():
+            samples[t].append(per["oldest_unacked_age_s"])
+        done = all(
+            sum(broker.committed(group, t, p) for p in range(parts))
+            >= rows_by_tenant[t] for t in names)
+        if done and lag["unacked_records"] == 0:
+            drained = True
+            break
+        time.sleep(0.025)
+    drain_s = time.perf_counter() - t0
+    st = mw.stats()
+    led = st["quota_ledger"]["tenants"]
+
+    def p99(vals: list) -> float:
+        if not vals:
+            return 0.0
+        vs = sorted(vals)
+        return vs[int(0.99 * (len(vs) - 1))]
+
+    ack_p99 = {t: round(p99(v), 3) for t, v in samples.items()}
+    pure_victims = [t for t in names if t not in (burst, fault, poison)]
+    victims = [t for t in names if t != burst]
+    sla_violations = sum(1 for t in victims if ack_p99[t] > sla_s)
+    deaths = {t: st["tenants"][t]["workers_dead"]
+              + st["tenants"][t]["restarts_total"] for t in names}
+    deadletters = {t: st["tenants"][t]["deadletter_records"] for t in names}
+    fault_retries = sum(w["retries"]
+                        for w in mw.route_stats(fault)["workers"])
+    sibling_deaths = sum(v for t, v in deaths.items()
+                         if t not in (fault, poison))
+    zero_cross = (sibling_deaths == 0)
+    victim_stalls_max = max(led[t]["quota_stalls"] for t in pure_victims)
+    mw.close()
+
+    invariant = (drained
+                 and sla_violations == 0
+                 and zero_cross
+                 and deaths[fault] == 0
+                 and len(sched.fired()) > 0  # the fault leg is non-vacuous
+                 and led[burst]["quota_stalls"] > 0
+                 and victim_stalls_max == 0
+                 and deadletters[poison] == n_poison
+                 and sum(v for t, v in deadletters.items()
+                         if t != poison) == 0)
+    out = {
+        "metric": "tenant_bulkheads",
+        "value": tenants,
+        "unit": "tenants",
+        "tenants": tenants,
+        "parts": parts,
+        "rows_total": sum(rows_by_tenant.values()),
+        "burst_rows": burst_rows,
+        "rows_per_victim": base_rows,
+        "burst_tenant": burst,
+        "fault_tenant": fault,
+        "poison_tenant": poison,
+        "nested_tenant": nested,
+        "sla_seconds": sla_s,
+        "drain_seconds": round(drain_s, 3),
+        "ack_lag_zero": drained,
+        "quota": {
+            "burst_queue_quota": burst_quota,
+            "victim_queue_quota": victim_quota,
+            "burst_stalls": led[burst]["quota_stalls"],
+            "burst_stall_s": led[burst]["quota_stall_s"],
+            "victim_stalls_max": victim_stalls_max,
+        },
+        "ack_p99_s_by_tenant": ack_p99,
+        "victim_ack_p99_s_max": max(ack_p99[t] for t in victims),
+        "sla_violations": sla_violations,
+        "containment": {
+            "sibling_worker_deaths": sibling_deaths,
+            "fault_tenant_deaths": deaths[fault],
+            "deaths_by_tenant": deaths,
+            "fault_events_fired": len(sched.fired()),
+            "fault_route_retries": fault_retries,
+            "deadlettered_records": deadletters[poison],
+            "poison_records_produced": n_poison,
+            "deadletters_by_tenant": deadletters,
+            "zero_cross_tenant_deaths": zero_cross,
+        },
+        "session_records_by_tenant": st["session"]["records_by_tenant"],
+        "invariant_holds": invariant,
+        "policy": ("skewed replay: burst tenant carries several times "
+                   "every victim's volume under a small queue share "
+                   "(ledger gate = the throttle; stall counters are the "
+                   "evidence), fault persona on one tenant's sink "
+                   "(transient EIO/rename/latency — retried, never "
+                   "fatal), poison payloads on another tenant's stream "
+                   "(dead-lettered, then acked); p99 ack-lag per tenant "
+                   "sampled every 25 ms during the drive; containment "
+                   "read off per-route death/restart/dead-letter/stall "
+                   "counters"),
+    }
+    if smoke:
+        out["smoke"] = True
+    print(f"[bench:tenants] {tenants} tenants, "
+          f"{out['rows_total']} rows drained={drained} in {drain_s:.1f}s; "
+          f"burst stalls {led[burst]['quota_stalls']} "
+          f"({led[burst]['quota_stall_s']:.2f}s), victim p99 max "
+          f"{out['victim_ack_p99_s_max']:.2f}s vs SLA {sla_s}s, "
+          f"sibling deaths {sibling_deaths}, deadletters "
+          f"{deadletters[poison]}/{n_poison}; invariant_holds={invariant}",
+          file=sys.stderr)
+    return out
+
+
+# ---------------------------------------------------------------------------
 # config 7: nested streaming replay (cfg5 shape through the FULL writer)
 # ---------------------------------------------------------------------------
 
@@ -4580,7 +4824,7 @@ def main() -> None:
                for f in ("--all", "--rowgroup", "--hostasm", "--config",
                          "--obs", "--chaos", "--crash", "--degrade",
                          "--e2e", "--compact", "--scan", "--procs",
-                         "--objstore", "--nested")):
+                         "--objstore", "--nested", "--tenants")):
         # default graded path: jax-free orchestrator (see _graded_main)
         _graded_main()
         return
@@ -4601,7 +4845,8 @@ def main() -> None:
             or "--crash" in sys.argv or "--degrade" in sys.argv
             or "--e2e" in sys.argv or "--compact" in sys.argv
             or "--scan" in sys.argv or "--procs" in sys.argv
-            or "--objstore" in sys.argv or "--nested" in sys.argv):
+            or "--objstore" in sys.argv or "--nested" in sys.argv
+            or "--tenants" in sys.argv):
         # --hostasm/--obs/--chaos/--crash/--degrade/--e2e/--compact/--scan
         # /--objstore measure HOST work only and must never grab the real
         # chip; the switch must precede the first device use below
@@ -4976,6 +5221,43 @@ def main() -> None:
         summary["fused_speedup_x"] = out["fused_ab"]["speedup_x"]
         summary["bytes_identical"] = \
             out["fused_identity"]["bytes_identical"]
+        summary["artifact"] = os.path.basename(path)
+        print(json.dumps(summary))
+        return
+    if "--tenants" in sys.argv:
+        if "--smoke" in sys.argv:
+            # the CI gate: reduced tenant mix, never writes the
+            # artifact, exits nonzero unless every route's ack-lag
+            # drained to 0 AND the containment counters show zero
+            # cross-tenant worker deaths
+            out = tenants_probe(smoke=True)
+            print(json.dumps({k: out[k] for k in
+                              ("metric", "value", "tenants", "smoke",
+                               "ack_lag_zero", "sla_violations",
+                               "invariant_holds")}
+                             | {"quota": out["quota"],
+                                "zero_cross_tenant_deaths":
+                                    out["containment"][
+                                        "zero_cross_tenant_deaths"]}))
+            ok = (out["ack_lag_zero"]
+                  and out["containment"]["zero_cross_tenant_deaths"])
+            sys.exit(0 if ok else 8)
+        out = tenants_probe()
+        path = os.environ.get(
+            "KPW_TENANTS_PATH",
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "BENCH_TENANTS_r19.json"))
+        with open(path, "w") as f:
+            json.dump(out, f, indent=1)
+        print(f"[bench:tenants] artifact written to {path}",
+              file=sys.stderr)
+        summary = {k: v for k, v in out.items()
+                   if k not in ("ack_p99_s_by_tenant", "containment",
+                                "session_records_by_tenant", "policy",
+                                "quota")}
+        summary["burst_stalls"] = out["quota"]["burst_stalls"]
+        summary["sibling_worker_deaths"] = out["containment"][
+            "sibling_worker_deaths"]
         summary["artifact"] = os.path.basename(path)
         print(json.dumps(summary))
         return

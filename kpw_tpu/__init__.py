@@ -20,10 +20,13 @@ from .runtime import (  # noqa: E402,F401
     Gauge,
     KafkaProtoParquetWriter,
     MetricRegistry,
+    MultiWriter,
     Partitioner,
     PublishVerificationError,
     RetryBudgetExceeded,
     RetryPolicy,
+    SchemaIncompatibleError,
+    TenantQuotaLedger,
     WriterFailedError,
     registry_to_json,
     registry_to_prometheus,

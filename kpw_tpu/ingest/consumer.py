@@ -40,6 +40,7 @@ class SmartCommitConsumer:
         retry_policy: RetryPolicy | None = None,
         batch_ingest: bool = False,
         autotuner: IngestAutotuner | None = None,
+        queue_listener=None,
     ) -> None:
         self.broker = broker
         self.group_id = group_id
@@ -98,6 +99,14 @@ class SmartCommitConsumer:
         # backpressure autotuning (owned by the writer; ticked from the
         # fetch loop): None = fixed knobs, reference parity
         self._autotune = autotuner
+        # queue-occupancy listener (the multi-tenant quota ledger's
+        # charge/credit seam, runtime/multiwriter.py): ``on_enqueued(n)``
+        # fires per admitted slice, ``on_drained(n)`` per drain round,
+        # both under the buffer condition so charge and credit see the
+        # same admission the queue accounting saw.  The listener must not
+        # block and may only take its OWN lock (buffer-cond -> listener
+        # lock is the one ordering; the ledger never takes this one).
+        self._listener = queue_listener
 
     # -- lifecycle ---------------------------------------------------------
     def subscribe(self, topic: str) -> None:
@@ -228,6 +237,8 @@ class SmartCommitConsumer:
             else:
                 out.extend(chunk)
         if taken:
+            if self._listener is not None:
+                self._listener.on_drained(taken)
             self._buf_cond.notify_all()
         return out
 
@@ -270,6 +281,8 @@ class SmartCommitConsumer:
                 self._records_in += take
                 if self._buf_count > self._buf_hwm:
                     self._buf_hwm = self._buf_count
+                if self._listener is not None:
+                    self._listener.on_enqueued(take)
                 pos += take
                 self._buf_cond.notify_all()
         return True

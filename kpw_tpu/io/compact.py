@@ -148,7 +148,8 @@ class Compactor:
                  sort_by=None,
                  bandwidth_bytes_per_s: float | None = None,
                  request_budget_per_round: int | None = None,
-                 partition_quota: int | None = None) -> None:
+                 partition_quota: int | None = None,
+                 bandwidth_budget=None) -> None:
         # runtime imports are deferred (the failover-module pattern):
         # io.compact is imported during kpw_tpu.io package init, while
         # kpw_tpu.runtime may still be mid-initialization
@@ -220,14 +221,26 @@ class Compactor:
         self.request_budget_per_round = request_budget_per_round
         self.partition_quota = partition_quota
         self._budget = None
-        if bandwidth_bytes_per_s is not None or request_budget_per_round:
+        if (bandwidth_budget is not None
+                or bandwidth_bytes_per_s is not None
+                or request_budget_per_round):
             # remote tier: wrap the sink in the byte-throttling +
             # request-counting composite (reads and writes draw from ONE
-            # token bucket, so total traffic stays under the budget)
+            # token bucket, so total traffic stays under the budget).
+            # ``bandwidth_budget`` is a caller-owned BandwidthBudget —
+            # the multi-tenant compaction service passes ONE bucket to
+            # every route's compactor so the merged background traffic
+            # shares a single cap instead of multiplying per tenant.
             from .objectstore import (BandwidthBudget,
                                       BandwidthBudgetedFileSystem)
 
-            if bandwidth_bytes_per_s is not None:
+            if bandwidth_budget is not None:
+                self._budget = bandwidth_budget
+                # surface the SHARED bucket's rate in compactor_stats'
+                # remote block (this compactor draws from it even though
+                # no per-compactor rate was configured)
+                self.bandwidth_bytes_per_s = bandwidth_budget.rate
+            elif bandwidth_bytes_per_s is not None:
                 self._budget = BandwidthBudget(bandwidth_bytes_per_s)
             fs = BandwidthBudgetedFileSystem(fs, self._budget)
         self.fs = fs

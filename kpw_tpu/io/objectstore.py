@@ -39,10 +39,13 @@ publish-name pattern — the protocol is otherwise identical), and (2) a
 sealed-but-uncompleted upload's bytes can be read back
 (:meth:`EmulatedObjectStore.pending_part_bytes`) so verify-before-publish
 works; a production adapter verifies its local staging buffer instead.
-The write handle retains the file bytes until publish (the seek-back
+The write handle retains the file bytes until it seals (the seek-back
 retry protocol of ``core/writer.py`` can rewind into already-shipped
 parts, which are then re-uploaded under the same part number — last
-upload of a part number wins, exactly S3's semantics).
+upload of a part number wins, exactly S3's semantics).  Retention is
+spill-bounded: past ``spill_threshold_bytes`` the retained bytes roll
+to an anonymous local tmp file (:class:`_RetainedBuffer`) so handle
+memory stays bounded at GiB-rotation scale, released at seal.
 """
 
 from __future__ import annotations
@@ -354,6 +357,80 @@ class _Pending:
         self.error: BaseException | None = None
 
 
+class _RetainedBuffer:
+    """The write handle's retained file bytes, spill-bounded: an
+    in-memory bytearray until ``spill_threshold_bytes``, then rolled to
+    an anonymous local tmp file (``tempfile.TemporaryFile`` — unlinked
+    at creation, gone on process death) so the handle's memory stays
+    bounded at GiB-rotation scale while seek-back rewrites into shipped
+    territory and close-time re-ships stay byte-perfect (random
+    ``write_at`` + ranged ``read`` work identically in both modes;
+    sparse seek-ahead gaps read back as zeros either way).  ``None``
+    threshold = never spill (the pre-spill behavior, byte for byte)."""
+
+    __slots__ = ("_threshold", "_mem", "_file", "_size", "spilled",
+                 "_on_spill")
+
+    def __init__(self, threshold: int | None, on_spill=None) -> None:
+        self._threshold = threshold
+        self._mem: bytearray | None = bytearray()
+        self._file = None
+        self._size = 0
+        self.spilled = False
+        self._on_spill = on_spill
+
+    def __len__(self) -> int:
+        return self._size
+
+    def _roll(self) -> None:
+        import tempfile
+
+        f = tempfile.TemporaryFile(prefix="kpw-objstore-spill-")
+        f.write(bytes(self._mem))
+        self._file = f
+        self._mem = None
+        self.spilled = True
+        if self._on_spill is not None:
+            self._on_spill()
+
+    def write_at(self, pos: int, b: bytes) -> None:
+        if self._file is None:
+            mem = self._mem
+            if pos > len(mem):  # sparse seek-ahead: zero-fill the gap
+                mem.extend(b"\x00" * (pos - len(mem)))
+            mem[pos:pos + len(b)] = b
+            self._size = len(mem)
+            if self._threshold is not None and self._size > self._threshold:
+                self._roll()
+        else:
+            # a write past EOF leaves a hole that reads back as zeros —
+            # the same sparse-gap semantics as the bytearray mode
+            self._file.seek(pos)
+            self._file.write(b)
+            self._size = max(self._size, pos + len(b))
+
+    def read(self, start: int, end: int) -> bytes:
+        end = min(end, self._size)
+        if start >= end:
+            return b""
+        if self._file is None:
+            return bytes(self._mem[start:end])
+        self._file.seek(start)
+        return self._file.read(end - start)
+
+    def to_bytes(self) -> bytes:
+        return self.read(0, self._size)
+
+    def release(self) -> None:
+        """Drop the retained bytes (close the spill file / free the
+        bytearray) once every byte is on the server — after seal, the
+        handle can never be asked to re-ship."""
+        f, self._file = self._file, None
+        self._mem = bytearray()
+        if f is not None:
+            f.close()
+
+
 class _ObjectWriteFile:
     """Write handle over the adapter: buffers the file locally, streams
     completed ``part_size`` slices to the background uploader while the
@@ -365,12 +442,17 @@ class _ObjectWriteFile:
 
     Background upload failures never surface mid-write: the handle keeps
     the bytes, notes the lowest failed part, and close re-ships
-    synchronously inside the worker's retried ``close`` seam."""
+    synchronously inside the worker's retried ``close`` seam.  The
+    retained bytes are SPILL-BOUNDED (``spill_threshold_bytes`` on the
+    adapter): past the threshold they live in an anonymous local tmp
+    file instead of memory (:class:`_RetainedBuffer`), released once the
+    handle seals."""
 
     def __init__(self, fs: "ObjectStoreFileSystem", path: str) -> None:
         self._fs = fs
         self._path = path
-        self._data = bytearray()
+        self._data = _RetainedBuffer(fs.spill_threshold_bytes,
+                                     on_spill=fs._note_spill)
         self._pos = 0
         self._clean_parts = 0  # parts 1..n uploaded and not overwritten
         self._pending = _Pending(fs._key(path))
@@ -381,9 +463,7 @@ class _ObjectWriteFile:
     def write(self, data) -> int:
         b = bytes(data)
         pos = self._pos
-        if pos > len(self._data):  # sparse seek-ahead: zero-fill the gap
-            self._data.extend(b"\x00" * (pos - len(self._data)))
-        self._data[pos:pos + len(b)] = b
+        self._data.write_at(pos, b)
         self._pos = pos + len(b)
         if pos < self._clean_parts * self._fs.part_size:
             # rewind-overwrite into shipped territory: those parts are
@@ -414,7 +494,7 @@ class _ObjectWriteFile:
 
     def _part_bytes(self, idx: int) -> bytes:
         ps = self._fs.part_size
-        return bytes(self._data[idx * ps:(idx + 1) * ps])
+        return self._data.read(idx * ps, (idx + 1) * ps)
 
     def _ship_full_parts(self) -> None:
         """Hand every newly-completed part_size slice to the uploader
@@ -459,10 +539,11 @@ class _ObjectWriteFile:
         total = len(self._data)
         if p.upload_id is None and total < fs.part_size:
             # small file: stage locally, publish is a single PUT
-            p.single_data = bytes(self._data)
+            p.single_data = self._data.to_bytes()
             p.size = total
             p.sealed = True
             self._closed = True
+            self._data.release()
             fs._note_overlap(p, exposed_s=0.0)
             return
         with fs._mu:
@@ -492,6 +573,9 @@ class _ObjectWriteFile:
         p.size = total
         p.sealed = True
         self._closed = True
+        # every byte is on the server now: drop the retained buffer (and
+        # its spill file, when the handle rolled past the threshold)
+        self._data.release()
         fs._note_overlap(p, exposed_s=time.perf_counter() - t0)
 
     def __enter__(self):
@@ -542,6 +626,7 @@ class ObjectStoreFileSystem(FileSystem):
     def __init__(self, store: EmulatedObjectStore, bucket: str, *,
                  part_size: int = 8 * 1024 * 1024,
                  pipeline_uploads: bool = True,
+                 spill_threshold_bytes: int | None = None,
                  registry=None) -> None:
         if part_size < 4096:
             raise ValueError("part_size must be >= 4096")
@@ -549,11 +634,22 @@ class ObjectStoreFileSystem(FileSystem):
             raise ValueError(
                 f"part_size {part_size} below the store's min_part_size "
                 f"{store.min_part_size}")
+        if spill_threshold_bytes is not None and spill_threshold_bytes < 4096:
+            raise ValueError("spill_threshold_bytes must be >= 4096")
         self.store = store
         self.bucket = bucket
         store.create_bucket(bucket)
         self.part_size = int(part_size)
         self.pipeline_uploads = bool(pipeline_uploads)
+        # spill-to-disk bound for each write handle's retained buffer
+        # (the PR-12 ROADMAP headroom): past this many bytes a handle's
+        # retained file bytes roll to an anonymous local tmp file so
+        # memory stays bounded at GiB-rotation scale.  None = retain in
+        # memory (historical behavior).
+        self.spill_threshold_bytes = (int(spill_threshold_bytes)
+                                      if spill_threshold_bytes is not None
+                                      else None)
+        self._spilled_handles = 0
         self._mu = threading.Lock()
         self._cv = threading.Condition(self._mu)
         self._pending: dict[str, _Pending] = {}  # norm path -> staged file
@@ -693,6 +789,10 @@ class ObjectStoreFileSystem(FileSystem):
                 p.inflight -= 1
                 self._upload_total_s += dt
                 self._cv.notify_all()
+
+    def _note_spill(self) -> None:
+        with self._mu:
+            self._spilled_handles += 1
 
     def _note_sync_upload(self, seconds: float) -> None:
         with self._mu:
@@ -912,6 +1012,8 @@ class ObjectStoreFileSystem(FileSystem):
                 "overlap_pct": round(
                     100.0 * hidden / (hidden + exposed), 2)
                 if (hidden + exposed) > 0 else 0.0,
+                "spill_threshold_bytes": self.spill_threshold_bytes,
+                "spilled_handles": self._spilled_handles,
             }
         return {
             "bucket": self.bucket,

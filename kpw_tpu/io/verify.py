@@ -67,6 +67,7 @@ _TAIL = 8
 _FMD_VERSION, _FMD_SCHEMA, _FMD_NUM_ROWS, _FMD_ROW_GROUPS = 1, 2, 3, 4
 # SchemaElement
 _SE_TYPE, _SE_NUM_CHILDREN = 1, 5
+_SE_REPETITION, _SE_NAME, _SE_CONVERTED = 3, 4, 6
 # RowGroup
 _RG_COLUMNS, _RG_NUM_ROWS, _RG_SORTING = 1, 3, 4
 # SortingColumn
@@ -643,6 +644,159 @@ def verify_dir(fs: FileSystem, target_dir: str,
             continue
         out.append(verify_file(fs, p))
     return out
+
+
+def schema_leaves_from_bytes(data: bytes,
+                             path: str = "<bytes>") -> dict[str, tuple]:
+    """The leaf schema of one parquet file from its bytes: dotted column
+    path -> ``(physical_type, repetition, converted_type)`` thrift ints.
+    Raises ``ValueError`` on anything whose footer cannot be parsed —
+    callers auditing a tree route unreadable files through the
+    structural verifier instead of guessing a schema for them."""
+    if len(data) < _TAIL + len(MAGIC) or data[-len(MAGIC):] != MAGIC:
+        raise ValueError(f"{path}: trailing PAR1 magic missing")
+    footer_len = int.from_bytes(data[-_TAIL:-len(MAGIC)], "little")
+    footer_start = len(data) - _TAIL - footer_len
+    if footer_len <= 0 or footer_start < len(MAGIC):
+        raise ValueError(
+            f"{path}: footer length {footer_len} does not fit the file")
+    r = CompactReader(data, footer_start, limit=len(data) - _TAIL)
+    try:
+        fmd = r.read_struct()
+    except ThriftDecodeError as e:
+        raise ValueError(f"{path}: footer thrift parse failed: {e}")
+    elems = fmd.get(_FMD_SCHEMA)
+    if not isinstance(elems, list) or not elems:
+        raise ValueError(f"{path}: footer has no schema elements")
+    leaves: dict[str, tuple] = {}
+    pos = [0]
+
+    def walk(prefix: str) -> None:
+        if pos[0] >= len(elems):
+            raise ValueError(f"{path}: schema element list truncated")
+        el = elems[pos[0]]
+        pos[0] += 1
+        if not isinstance(el, dict):
+            raise ValueError(f"{path}: schema element is not a struct")
+        name = el.get(_SE_NAME)
+        name = (name.decode("utf-8", "replace")
+                if isinstance(name, bytes) else str(name))
+        dotted = f"{prefix}.{name}" if prefix else name
+        nchildren = el.get(_SE_NUM_CHILDREN)
+        if isinstance(nchildren, int) and nchildren > 0:
+            for _ in range(nchildren):
+                walk(dotted)
+        else:
+            leaves[dotted] = (el.get(_SE_TYPE), el.get(_SE_REPETITION),
+                              el.get(_SE_CONVERTED))
+
+    root = elems[pos[0]]
+    pos[0] += 1
+    n_top = root.get(_SE_NUM_CHILDREN) if isinstance(root, dict) else None
+    if not isinstance(n_top, int) or n_top <= 0:
+        raise ValueError(f"{path}: schema root has no children")
+    for _ in range(n_top):
+        walk("")
+    return leaves
+
+
+def file_schema(fs: FileSystem, path: str) -> dict[str, tuple]:
+    """Read ``path`` through ``fs`` and return its leaf schema (see
+    :func:`schema_leaves_from_bytes`)."""
+    with fs.open_read(path) as f:
+        data = f.read()
+    return schema_leaves_from_bytes(data, path)
+
+
+#: the writer's working subtrees a schema verdict must never read from:
+#: ``tmp/`` holds open files, ``quarantine/`` condemned ones,
+#: ``compacted/`` tombstoned duplicates, ``deadletter/`` raw frames
+SCHEMA_EXCLUDE_DIRS = ("tmp", "quarantine", "compacted", "deadletter")
+
+
+def tree_schemas(fs: FileSystem, target_dir: str,
+                 extension: str = ".parquet",
+                 exclude_dirs: tuple = SCHEMA_EXCLUDE_DIRS,
+                 ) -> tuple[dict, list]:
+    """Walk one partition tree's published files and collect each one's
+    leaf schema: ``(per_file, unreadable)`` where ``per_file`` maps path
+    -> the :func:`file_schema` leaf dict and ``unreadable`` lists files
+    whose footer could not be parsed (the structural verifier's problem,
+    not a schema verdict).  The ONE tree-walk the schema audit and the
+    route-level schema guard share — the exclude set and the
+    unreadable-file policy cannot diverge between them."""
+    target = target_dir.rstrip("/")
+    skips = tuple(f"{target}/{d}/" for d in exclude_dirs)
+    per_file: dict[str, dict] = {}
+    unreadable: list[dict] = []
+    try:
+        files = fs.list_files(target, extension=extension)
+    except FileNotFoundError:
+        return per_file, unreadable
+    for p in files:
+        if any(p.startswith(s) for s in skips):
+            continue
+        try:
+            per_file[p] = file_schema(fs, p)
+        except (ValueError, OSError, KeyError) as e:
+            unreadable.append({"path": p, "error": repr(e)})
+    return per_file, unreadable
+
+
+def audit_schema_consistency(
+        fs: FileSystem, target_dir: str, extension: str = ".parquet",
+        exclude_dirs: tuple = SCHEMA_EXCLUDE_DIRS) -> dict:
+    """Cross-file schema-consistency audit over one partition tree — the
+    schema half of the PR-9 structural verifier, grown for schema
+    evolution (multi-tenant routes write one tree per tenant over a
+    proto lineage that changes additively over time):
+
+    * a **conflict** is one dotted leaf path carrying more than one
+      physical type across the tree's published files — a merged-schema
+      reader (pyarrow dataset schema unification) cannot reconcile
+      ``int64`` and ``byte_array`` under one name, so this is the shape
+      the route-level schema guard dead-letters and this audit flags;
+    * **additive columns** (present in some files, absent in others) are
+      the EXPECTED evolution shape — merged reads surface them as nulls
+      for the older files — and are reported, never flagged;
+    * unreadable/unparsable files are listed separately (they are the
+      structural verifier's problem, not a schema verdict).
+
+    Returns ``{"files", "consistent", "conflicts", "additive_columns",
+    "by_partition", "unreadable"}`` with each conflict naming the column,
+    its observed types, and up to 3 carrier files per type."""
+    target = target_dir.rstrip("/")
+    per_file, unreadable = tree_schemas(fs, target_dir, extension,
+                                        exclude_dirs)
+    # column -> physical type -> carrier files
+    types: dict[str, dict[int, list]] = {}
+    by_partition: dict[str, int] = {}
+    for p, leaves in per_file.items():
+        rel_dir = p[len(target) + 1:].rsplit("/", 1)
+        by_partition[rel_dir[0] if len(rel_dir) == 2 else "."] = (
+            by_partition.get(rel_dir[0] if len(rel_dir) == 2 else ".", 0) + 1)
+        for col, (pt, _rep, _conv) in leaves.items():
+            types.setdefault(col, {}).setdefault(pt, []).append(p)
+    conflicts = []
+    for col in sorted(types):
+        if len(types[col]) > 1:
+            conflicts.append({
+                "column": col,
+                "types": {str(pt): sorted(files)[:3]
+                          for pt, files in sorted(types[col].items(),
+                                                  key=lambda kv: str(kv[0]))},
+            })
+    additive = sorted(
+        col for col, by_type in types.items()
+        if sum(len(f) for f in by_type.values()) < len(per_file))
+    return {
+        "files": len(per_file),
+        "consistent": not conflicts,
+        "conflicts": conflicts,
+        "additive_columns": additive,
+        "by_partition": by_partition,
+        "unreadable": unreadable,
+    }
 
 
 def summarize(reports: list[FileReport]) -> dict:

@@ -21,3 +21,8 @@ from .writer import (  # noqa: F401
     PublishVerificationError,
     WriterFailedError,
 )
+from .multiwriter import (  # noqa: F401
+    MultiWriter,
+    SchemaIncompatibleError,
+    TenantQuotaLedger,
+)

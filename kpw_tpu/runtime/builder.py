@@ -132,6 +132,12 @@ class Builder:
         self._proc_ring_slots = 16
         self._proc_slot_bytes = 1 << 20
         self._proc_max_inflight = 8
+        # multi-tenant routes (runtime/multiwriter.py): route() specs;
+        # build() returns a MultiWriter when any exist.  _queue_listener
+        # is the consumer's queue-occupancy seam the MultiWriter wires
+        # per route (the shared quota ledger's charge/credit source).
+        self._routes: list[dict] = []
+        self._queue_listener = None
 
     # -- required ----------------------------------------------------------
     def broker(self, broker) -> "Builder":
@@ -400,7 +406,8 @@ class Builder:
 
     def object_store(self, store, bucket: str = "kpw", *,
                      part_size: int = 8 * 1024 * 1024,
-                     pipeline_uploads: bool = True) -> "Builder":
+                     pipeline_uploads: bool = True,
+                     spill_threshold_bytes: int | None = None) -> "Builder":
         """Publish to an S3/GCS-class object store (``io/objectstore.py``):
         the sink becomes an :class:`~kpw_tpu.io.objectstore.
         ObjectStoreFileSystem` over ``store``/``bucket``, whose atomic
@@ -412,10 +419,15 @@ class Builder:
         ``stats()['objectstore']``), so closing a file costs one tail
         part and the publish is one ``complete`` call.  Request/byte
         accounting and the observed-bandwidth gauge ride the canonical
-        ``parquet.writer.objstore.*`` names."""
+        ``parquet.writer.objstore.*`` names.  ``spill_threshold_bytes``
+        bounds each write handle's retained buffer: past it the retained
+        file bytes roll to an anonymous local tmp file (seek-back
+        re-upload and close-time re-ship stay byte-perfect), so memory
+        stays bounded at GiB-rotation scale."""
         self._filesystem = ObjectStoreFileSystem(
             store, bucket, part_size=part_size,
-            pipeline_uploads=pipeline_uploads)
+            pipeline_uploads=pipeline_uploads,
+            spill_threshold_bytes=spill_threshold_bytes)
         return self
 
     def encoder_backend(self, backend) -> "Builder":
@@ -753,6 +765,67 @@ class Builder:
         self._proc_max_inflight = max_inflight_units
         return self
 
+    def route(self, topic: str, proto_class, target_dir: str, *,
+              name: str | None = None, queue_quota: int | None = None,
+              open_file_budget: int | None = None,
+              ack_sla_seconds: float | None = None,
+              **overrides) -> "Builder":
+        """Declare one multi-tenant route (``runtime/multiwriter.py``):
+        a (topic, proto, target_dir) triple that shares this builder's
+        broker session, encoder pool and compaction service with every
+        other route but lives in its own BULKHEAD — its own workers,
+        consumer queue, ack frontier and fault domain.  With any route
+        declared, ``build()`` returns a
+        :class:`~kpw_tpu.runtime.multiwriter.MultiWriter` instead of a
+        single writer (the base builder's ``topic``/``proto_class``/
+        ``target_dir`` are then unused).
+
+        * ``name`` — the tenant name (defaults to the topic); keys the
+          per-tenant stats/quota/status surfaces.
+        * ``queue_quota`` — this tenant's queue share: the records it
+          may hold in its consumer queue before its OWN fetch gate
+          parks (backpressure on the offender, never drop; stall
+          episodes metered as ``parquet.writer.tenant.queue.stalls``).
+        * ``open_file_budget`` — the PR-8 LRU bound generalized across
+          the route's workers: at the budget, opening one more
+          partition file first closes-and-publishes the route's own LRU
+          open file (``parquet.writer.tenant.files.evicted``).
+        * ``ack_sla_seconds`` — the route's declared ack-lag SLA,
+          surfaced (and checked live as ``sla_violated``) in
+          ``stats()['tenants']`` — the observable ``bench.py --tenants``
+          proves noisy neighbors cannot violate.
+        * ``**overrides`` — any Builder setter by name, applied to this
+          route's cloned builder: a scalar for one-argument setters
+          (``thread_count=2``, ``on_parse_error="dead_letter"``), a
+          tuple for positional args, a dict for keyword args
+          (``durability={"fsync": False, "verify_on_publish": True}``).
+        """
+        for key in overrides:
+            setter = getattr(Builder, key, None)
+            if not callable(setter):
+                raise ValueError(
+                    f"route override {key!r} is not a Builder setter")
+        if queue_quota is not None and queue_quota < 1:
+            raise ValueError("queue_quota must be >= 1")
+        if open_file_budget is not None and open_file_budget < 1:
+            raise ValueError("open_file_budget must be >= 1")
+        if ack_sla_seconds is not None and ack_sla_seconds <= 0:
+            raise ValueError("ack_sla_seconds must be positive")
+        rname = name or topic
+        if any(r["name"] == rname for r in self._routes):
+            raise ValueError(f"duplicate route name {rname!r}")
+        self._routes.append({
+            "name": rname,
+            "topic": topic,
+            "proto_class": proto_class,
+            "target_dir": target_dir,
+            "queue_quota": queue_quota,
+            "open_file_budget": open_file_budget,
+            "ack_sla_seconds": ack_sla_seconds,
+            "overrides": dict(overrides),
+        })
+        return self
+
     def on_parse_error(self, policy: str) -> "Builder":
         """'raise' (reference parity: poison pill kills the worker,
         KPW.java:271-275), 'skip' (log + ack), or 'dead_letter' (raw payload
@@ -810,6 +883,13 @@ class Builder:
     def build(self):
         if self._broker is None and self._consumer_config is not None:
             self._broker = self._broker_from_consumer_config()
+        if self._routes:
+            # multi-tenant mode: the MultiWriter clones this builder per
+            # route (topic/proto/target applied there) and shares the
+            # broker session, encoder pool and compaction service
+            from .multiwriter import MultiWriter
+
+            return MultiWriter(self)
         if self._filesystem is None and self._filesystem_config is not None:
             self._filesystem = self._filesystem_from_config()
         # required fields (reference :729-733)

@@ -393,6 +393,21 @@ PROC_RING_FREE_GAUGE = "worker.proc.ring.free"
 PROC_INFLIGHT_GAUGE = "worker.proc.inflight.records"
 PROC_RSS_GAUGE = "worker.proc.rss.bytes"
 PROC_ALIVE_GAUGE = "worker.proc.alive"
+# multi-tenant layer (runtime/multiwriter.py): the shared-session quota
+# ledger's backpressure evidence — quota-stall episodes (one fetch gate
+# blocked because its tenant was at its queue share) and the cumulative
+# stall milliseconds across them, open files evicted because a tenant hit
+# its open-file budget (the generalized PR-8 LRU bound), records appended
+# to dead-letter files (poison payloads + schema-incompatible routes),
+# plus live route counts: total routes and routes currently degraded
+# (paused / dead-lettering / failed) — marked across tenants (per-tenant
+# breakdowns ride stats()['tenants'], names stay canonical)
+TENANT_QUEUE_STALLS_METER = "parquet.writer.tenant.queue.stalls"
+TENANT_QUEUE_STALL_MS_METER = "parquet.writer.tenant.queue.stall.ms"
+TENANT_FILES_EVICTED_METER = "parquet.writer.tenant.files.evicted"
+DEADLETTER_METER = "parquet.writer.deadletter.records"
+TENANT_ROUTES_GAUGE = "parquet.writer.tenant.routes"
+TENANT_ROUTES_DEGRADED_GAUGE = "parquet.writer.tenant.routes.degraded"
 
 # the canonical registry docs cite from (tools/check_docs.py verifies
 # every doc-cited metric name is listed here)
@@ -440,4 +455,10 @@ METRIC_NAMES = (
     PROC_INFLIGHT_GAUGE,
     PROC_RSS_GAUGE,
     PROC_ALIVE_GAUGE,
+    TENANT_QUEUE_STALLS_METER,
+    TENANT_QUEUE_STALL_MS_METER,
+    TENANT_FILES_EVICTED_METER,
+    DEADLETTER_METER,
+    TENANT_ROUTES_GAUGE,
+    TENANT_ROUTES_DEGRADED_GAUGE,
 )

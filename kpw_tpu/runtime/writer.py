@@ -159,6 +159,7 @@ class KafkaProtoParquetWriter:
             retry_policy=self.retry_policy,
             batch_ingest=b._batch_ingest,
             autotuner=self.autotuner,
+            queue_listener=getattr(b, "_queue_listener", None),
         )
         self.consumer.subscribe(b._topic)
         self._workers: list = []
@@ -235,6 +236,19 @@ class KafkaProtoParquetWriter:
         self.partitioner = b._partitioner
         self._partitions_evicted = (reg.meter(M.PARTITIONS_EVICTED_METER)
                                     if reg else M.Meter())
+        # multi-tenant bulkhead seam (runtime/multiwriter.py): the tenant
+        # name + shared quota ledger a MultiWriter binds via bind_tenant
+        # (None on a plain single-route writer — zero cost), the
+        # open-file-budget eviction meter, and the dead-letter meters —
+        # the canonical one aggregates across routes on a shared
+        # registry, the local one keeps this route's own count
+        self._tenant: str | None = None
+        self._tenant_ledger = None
+        self._tenant_files_evicted = (reg.meter(M.TENANT_FILES_EVICTED_METER)
+                                      if reg else M.Meter())
+        self._deadlettered = (reg.meter(M.DEADLETTER_METER)
+                              if reg else M.Meter())
+        self._deadletter_route = M.Meter()
         self._compactor: Compactor | None = None
         self._paused: dict[int, dict] = {}
         self._pause_lock = threading.Lock()
@@ -262,6 +276,22 @@ class KafkaProtoParquetWriter:
         # (installed at start(), uninstalled at close() iff still ours)
         self.stage_timer: tracing.StageTimer | None = None
         self.span_recorder: tracing.SpanRecorder | None = None
+
+    def bind_tenant(self, tenant: str, ledger) -> None:
+        """Join this writer to a multi-tenant quota ledger
+        (``runtime/multiwriter.py``) as ``tenant``: the open-file-budget
+        enforcement (``_file_budget_exceeded``) starts consulting the
+        ledger, and the tenant block appears in stats()."""
+        self._tenant = tenant
+        self._tenant_ledger = ledger
+
+    def _file_budget_exceeded(self) -> bool:
+        """True when this writer's tenant is at its open-file budget
+        (the PR-8 LRU bound generalized across the route's workers) —
+        the worker about to open one more file evicts its own LRU
+        first.  Always False on an unbound (single-route) writer."""
+        led = self._tenant_ledger
+        return led is not None and led.files_over_budget(self._tenant)
 
     def _make_encoder_factory(self, backend):
         if backend == "cpu" or backend is None:
@@ -779,6 +809,7 @@ class KafkaProtoParquetWriter:
                     self._native_asm_chunks.snapshot(),
                 M.NATIVE_ASM_PAGES_METER:
                     self._native_asm_pages.snapshot(),
+                M.DEADLETTER_METER: self._deadlettered.snapshot(),
             },
             "file_size": self._file_size_histogram.snapshot(),
             "rotations": {
@@ -874,6 +905,17 @@ class KafkaProtoParquetWriter:
         }
         if self._compactor is not None:
             out["compactor"] = self._compactor.compactor_stats()
+        # multi-tenant block only when a MultiWriter bound this writer to
+        # a shared quota ledger (mirrors watchdog/failover/compactor):
+        # this route's tenant name, its quota snapshot, and its own
+        # dead-letter count (the canonical meter aggregates across
+        # routes on a shared registry)
+        if self._tenant_ledger is not None:
+            out["tenant"] = {
+                "name": self._tenant,
+                "quota": self._tenant_ledger.tenant_snapshot(self._tenant),
+                "deadletter_records": self._deadletter_route.count,
+            }
         # process-mode block only when the pool exists (mirrors
         # watchdog/failover/compactor): ring occupancy, per-child rss +
         # in-flight units + restart counts, dispatcher/collector counters
@@ -1231,6 +1273,8 @@ class _Worker:
             # durability first, like the main path: the raw payload lands
             # in the dead-letter file before ack
             self._retry(lambda: self._dead_letter(rec), "dead_letter")
+            self.p._deadlettered.mark()
+            self.p._deadletter_route.mark()
             self.p.consumer.ack(PartitionOffset(rec.partition, rec.offset))
         elif b._on_parse_error == "skip":
             logger.exception("Skipping %s record %s/%s", what,
@@ -1311,6 +1355,17 @@ class _Worker:
             self._part_files[pkey] = f  # dict order == LRU order
             return f
         while len(self._part_files) >= self.p._b._max_open_partitions:
+            self._finalize_partition(next(iter(self._part_files)), "evict")
+        # per-tenant open-file budget (runtime/multiwriter.py — the PR-8
+        # LRU bound generalized across the route's workers): at the
+        # budget, opening a NEW partition first closes-and-publishes
+        # this worker's LRU open file.  Backpressure lands on the
+        # offending route (it pays the publish), siblings never see it,
+        # and nothing is dropped.  A worker with nothing left to evict
+        # proceeds — bounded overshoot of one file per worker, and the
+        # next open re-checks.
+        while self._part_files and self.p._file_budget_exceeded():
+            self.p._tenant_files_evicted.mark()
             self._finalize_partition(next(iter(self._part_files)), "evict")
         f = self._open_new_file(subdir=pkey)
         self._part_files[pkey] = f

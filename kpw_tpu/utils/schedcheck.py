@@ -96,6 +96,14 @@ class StaleDeathNoticeError(ScheduleViolation):
     replacement."""
 
 
+class QuotaLedgerTornError(ScheduleViolation):
+    """A multi-tenant quota ledger's per-tenant counters diverged from
+    its global total — a torn multi-route update (one side of the
+    charge/credit pair landed without the other, i.e. an update escaped
+    the ledger lock) would let one tenant's accounting leak into a
+    sibling's quota headroom."""
+
+
 def _stack(skip: int = 2, limit: int = 14) -> str:
     while skip > 0:
         try:
@@ -132,6 +140,8 @@ class SchedCheck:
         self._uploaders: dict[int, str] = {}
         # heartbeat writers: worker idx -> last hb_publish stack
         self._hb_writers: dict[int, str] = {}
+        # quota ledgers: ledger key -> last consistent-update stack
+        self._ledger_writers: dict[int, str] = {}
 
     # -- perturbation ---------------------------------------------------------
     def _coin(self, label: str) -> tuple[bool, float]:
@@ -245,6 +255,26 @@ class SchedCheck:
                 "object-store adapter (two drainers reorder dirty part "
                 "re-uploads)", prior)))
 
+    # -- probe: multi-tenant quota ledger ------------------------------------
+    def note_quota_ledger(self, ledger_key: int, per_tenant_sum: int,
+                          global_total: int) -> None:
+        """Guards the shared-session quota ledger's pairing invariant:
+        at every charge/credit the sum of the per-tenant counters must
+        equal the global total (both are updated under one lock, with a
+        preemption point between them — an update that escapes the lock
+        tears here).  The caller computes both sums INSIDE its critical
+        section, so a violation is a real torn update, never reader-side
+        tearing."""
+        if per_tenant_sum != global_total:
+            with self._mu:
+                first = self._ledger_writers.get(ledger_key)
+            raise self._record(QuotaLedgerTornError(self._report(
+                f"quota ledger {ledger_key:#x}: per-tenant counters sum to "
+                f"{per_tenant_sum} but the global total reads "
+                f"{global_total} — a multi-route update tore", first)))
+        with self._mu:
+            self._ledger_writers[ledger_key] = _stack(2)
+
     # -- probe: death-notice pid check ---------------------------------------
     def note_death_notice(self, slot_pid: int | None, msg_pid: int,
                           acted: bool) -> None:
@@ -318,6 +348,13 @@ def note_death_notice(slot_pid: int | None, msg_pid: int,
     c = _active
     if c is not None:
         c.note_death_notice(slot_pid, msg_pid, acted)
+
+
+def note_quota_ledger(ledger_key: int, per_tenant_sum: int,
+                      global_total: int) -> None:
+    c = _active
+    if c is not None:
+        c.note_quota_ledger(ledger_key, per_tenant_sum, global_total)
 
 
 def _patched_thread_start(self: threading.Thread) -> None:

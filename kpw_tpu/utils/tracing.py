@@ -58,6 +58,7 @@ STAGE_NAMES = (
     "encode.page_index",
     "compactor.merge",
     "upload.part",
+    "tenant.quota.wait",
 )
 
 

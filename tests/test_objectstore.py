@@ -610,3 +610,47 @@ def test_objstore_canonical_names_render_in_both_exporters():
     prom = registry_to_prometheus(reg)
     assert "parquet_writer_objstore_requests_total" in prom
     assert "parquet_writer_objstore_bandwidth" in prom
+
+
+def test_spill_threshold_bounds_retained_buffer_byte_perfect():
+    """Spill-to-disk bound for the write handle's retained buffer (the
+    PR-12 ROADMAP headroom): past ``spill_threshold_bytes`` the retained
+    file bytes roll to a local tmp file — seek-back re-upload into
+    shipped territory and the close-time tail re-ship stay byte-perfect,
+    and the spill is observable in the adapter stats."""
+    import random as _random
+
+    store, fs = _objfs(part_size=4096, spill_threshold_bytes=8192)
+    rng = _random.Random(19)
+    expected = bytearray()
+
+    def w(f, data, pos=None):
+        if pos is not None:
+            f.seek(pos)
+        else:
+            pos = f.tell()
+        f.write(data)
+        if pos > len(expected):
+            expected.extend(b"\x00" * (pos - len(expected)))
+        expected[pos:pos + len(data)] = data
+
+    with fs.open_write("/d/big.tmp") as f:
+        for _ in range(10):  # 40 KiB sequential: 5x the spill threshold
+            w(f, bytes(rng.getrandbits(8) for _ in range(4096)))
+        # rewind-overwrite into the FIRST shipped part (dirty re-upload)
+        w(f, b"REWRITTEN-AFTER-SHIP", pos=100)
+        # and a tail append past the end again
+        w(f, b"tail-after-rewind", pos=len(expected))
+        assert f._data.spilled, "40 KiB never crossed the 8 KiB threshold"
+    publish_file(fs, "/d/big.tmp", "/d/big.bin", durable=False)
+    assert store.get_object("t", "d/big.bin") == bytes(expected)
+    up = fs.objectstore_stats()["upload"]
+    assert up["spilled_handles"] == 1
+    assert up["spill_threshold_bytes"] == 8192
+    # below the threshold nothing spills (and small files still PUT)
+    with fs.open_write("/d/small.tmp") as f2:
+        f2.write(b"tiny")
+        assert not f2._data.spilled
+    publish_file(fs, "/d/small.tmp", "/d/small.bin", durable=False)
+    assert store.get_object("t", "d/small.bin") == b"tiny"
+    assert fs.objectstore_stats()["upload"]["spilled_handles"] == 1

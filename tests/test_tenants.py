@@ -1,0 +1,564 @@
+"""Multi-tenant bulkheads (ISSUE 15): N routes over one broker session,
+isolated by per-tenant quotas (queue share + open-file budget, enforced
+as backpressure-on-the-offender), per-tenant fault domains (a sink
+fault, a poison stream, or an incompatible schema is contained to its
+route), per-tenant observability (stats/ack-lag/canonical meters in both
+exporters), and schema evolution handled the way parquet readers expect
+(additive merged-schema reads; incompatible changes dead-letter with a
+typed reason; the cross-file schema audit flags a planted mixed tree).
+
+The whole module runs under the LIVE lockcheck + schedcheck probes
+(module-autouse fixtures, the procworkers-suite pattern): the shared
+quota ledger's torn-update invariant probe and the lock-order graph are
+armed on every drill below, and any violation fails the test here.
+"""
+
+import errno
+import time
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from kpw_tpu import (
+    Builder,
+    FakeBroker,
+    MemoryFileSystem,
+    MetricRegistry,
+    MultiWriter,
+    TenantQuotaLedger,
+    registry_to_json,
+    registry_to_prometheus,
+)
+from kpw_tpu.io import FaultInjectingFileSystem, FaultSchedule
+from kpw_tpu.io.fs import publish_file
+from kpw_tpu.io.verify import audit_schema_consistency, file_schema
+from kpw_tpu.models.proto_bridge import ProtoColumnarizer
+from kpw_tpu.runtime import metrics as M
+from kpw_tpu.runtime.parquet_file import ParquetFile
+from kpw_tpu.utils import schedcheck
+from kpw_tpu.utils.schedcheck import QuotaLedgerTornError
+
+from proto_helpers import _F, _field, build_classes, sample_message_class
+
+PARTS = 2
+
+
+@pytest.fixture(autouse=True)
+def _probes(schedcheck_checker, lockcheck_detector):
+    """Module autouse: every drill runs with the schedule explorer's
+    invariant probes (incl. the quota-ledger torn-update probe) AND the
+    runtime lock-order detector live — assertions below run unchanged,
+    any probe/lock violation fails here."""
+    yield
+    assert not schedcheck_checker.violations, [
+        repr(v) for v in schedcheck_checker.violations]
+    assert not lockcheck_detector.violations, [
+        repr(v) for v in lockcheck_detector.violations]
+
+
+def sample_v2_class():
+    """Additive evolution of the sample schema: one new optional field."""
+    return build_classes("sample_v2", {
+        "SampleMessage": [
+            _field("query", 1, _F.TYPE_STRING, _F.LABEL_REQUIRED),
+            _field("timestamp", 2, _F.TYPE_INT64, _F.LABEL_REQUIRED),
+            _field("page_number", 3, _F.TYPE_INT32),
+            _field("result_per_page", 4, _F.TYPE_INT32),
+            _field("extra_score", 5, _F.TYPE_INT32),
+        ]
+    })["SampleMessage"]
+
+
+def sample_incompatible_class():
+    """Incompatible evolution: ``timestamp`` flips int64 -> string (one
+    dotted leaf path, two physical types — the merged-read breaker)."""
+    return build_classes("sample_bad", {
+        "SampleMessage": [
+            _field("query", 1, _F.TYPE_STRING, _F.LABEL_REQUIRED),
+            _field("timestamp", 2, _F.TYPE_STRING, _F.LABEL_REQUIRED),
+        ]
+    })["SampleMessage"]
+
+
+def produce(broker, topic, cls, n, start=0, pad=40, page_mod=None):
+    for i in range(start, start + n):
+        m = cls(query=f"q-{i}-{'x' * pad}", timestamp=i)
+        if page_mod is not None:
+            m.page_number = i % page_mod
+        broker.produce(topic, m.SerializeToString(), partition=i % PARTS)
+
+
+def base_builder(broker, fs, reg=None):
+    b = (Builder().broker(broker).filesystem(fs)
+         .instance_name("tenants").thread_count(1).batch_size(256)
+         .max_file_size(128 * 1024).block_size(32 * 1024)
+         .max_file_open_duration_seconds(0.4)
+         .supervise(True, max_restarts=4, restart_backoff_seconds=0.02))
+    if reg is not None:
+        b.metric_registry(reg)
+    return b
+
+
+def drain(mw, broker, expected, deadline_s=90, sample=None):
+    """Run until every (topic, rows) pair in ``expected`` is committed
+    and the aggregate ack lag is 0.  ``sample(mw)`` is called each tick
+    (the SLA/occupancy probes some drills record)."""
+    group = next(iter(mw.routes.values()))._b._group_id
+    deadline = time.time() + deadline_s
+    while time.time() < deadline:
+        if sample is not None:
+            sample(mw)
+        done = all(
+            sum(broker.committed(group, topic, p)
+                for p in range(PARTS)) >= rows
+            for topic, rows in expected.items())
+        if done and mw.ack_lag()["unacked_records"] == 0:
+            return
+        time.sleep(0.02)
+    raise AssertionError(
+        f"never drained: lag={mw.ack_lag()}, committed="
+        f"{{t: [broker.committed(group, t, p) for p in range(PARTS)] "
+        f"for t in expected}}")
+
+
+def seed_tree(fs, target, cls, rows, name="seed.parquet", start=0,
+              extra=None):
+    """Publish one parquet file of ``cls`` rows into ``target`` directly
+    (no writer) — the pre-existing-tree fixture for the schema drills."""
+    props = Builder().proto_class(cls).writer_properties()
+    msgs = []
+    for i in range(start, start + rows):
+        m = cls(query=f"s-{i}", timestamp=i)
+        if extra is not None:
+            setattr(m, extra, i)
+        msgs.append(m)
+    tmp = f"{target}/tmp/{name}.tmp"
+    fs.mkdirs(f"{target}/tmp")
+    pf = ParquetFile(fs, tmp, ProtoColumnarizer(cls), props, batch_size=256)
+    pf.append_records(msgs)
+    pf.close()
+    publish_file(fs, tmp, f"{target}/{name}", durable=False)
+    return f"{target}/{name}"
+
+
+# -- shared session, per-tenant trees, observability --------------------------
+
+def test_routes_share_session_publish_per_tenant_trees_and_meters():
+    """Three tenants (two protos) over ONE broker session: each drains
+    into its own tree, the session's per-tenant fetch split is
+    observable, per-tenant stats carry ack/status/quota, and the
+    canonical tenant meters render in BOTH generic exporters."""
+    cls = sample_message_class()
+    broker = FakeBroker()
+    for t in ("ta", "tb", "tc"):
+        broker.create_topic(t, PARTS)
+        produce(broker, t, cls, 2000)
+    fs = MemoryFileSystem()
+    reg = MetricRegistry()
+    b = (base_builder(broker, fs, reg)
+         .route("ta", cls, "/mt/ta", queue_quota=50_000, ack_sla_seconds=30)
+         .route("tb", cls, "/mt/tb")
+         .route("tc", cls, "/mt/tc"))
+    mw = b.build()
+    assert isinstance(mw, MultiWriter)
+    with mw:
+        drain(mw, broker, {"ta": 2000, "tb": 2000, "tc": 2000})
+        st = mw.stats()
+        assert st["healthy"]
+        for t in ("ta", "tb", "tc"):
+            ten = st["tenants"][t]
+            assert ten["state"] == "running"
+            assert ten["ack"]["unacked_records"] == 0
+            assert ten["workers_dead"] == 0
+            assert not ten["sla_violated"]
+            # every tenant's traffic went through the ONE shared session
+            assert st["session"]["records_by_tenant"][t] >= 2000
+        assert st["tenants"]["ta"]["quota"]["queue_quota"] == 50_000
+        # full single-writer stats reachable per route
+        assert mw.route_stats("tb")["ack"]["unacked_records"] == 0
+    for t in ("ta", "tb", "tc"):
+        files = [f for f in fs.list_files(f"/mt/{t}", extension=".parquet")
+                 if "/tmp/" not in f]
+        assert files, f"tenant {t} published nothing"
+        rows = sum(len(pq.read_table(fs.open_read(f))) for f in files)
+        assert rows >= 2000
+    # canonical tenant meters/gauges in both exporters, no per-metric wiring
+    prom = registry_to_prometheus(reg)
+    js = registry_to_json(reg)
+    for name in (M.TENANT_QUEUE_STALLS_METER, M.TENANT_QUEUE_STALL_MS_METER,
+                 M.TENANT_FILES_EVICTED_METER, M.DEADLETTER_METER,
+                 M.TENANT_ROUTES_GAUGE, M.TENANT_ROUTES_DEGRADED_GAUGE):
+        assert name in js
+        assert name.replace(".", "_") in prom
+
+
+# -- quotas: backpressure on the offender -------------------------------------
+
+def test_noisy_neighbor_quota_throttles_offender_not_victims():
+    """The burst tenant's small queue share parks ITS OWN fetch gate
+    (stall episodes bind on the offender); the victim's gate never
+    fires, both drain, nothing is dropped."""
+    cls = sample_message_class()
+    broker = FakeBroker()
+    broker.create_topic("burst", PARTS)
+    broker.create_topic("victim", PARTS)
+    produce(broker, "burst", cls, 12_000)
+    produce(broker, "victim", cls, 2000)
+    fs = MemoryFileSystem()
+    mw = (base_builder(broker, fs)
+          .route("burst", cls, "/nn/burst", queue_quota=600)
+          .route("victim", cls, "/nn/victim", queue_quota=50_000,
+                 ack_sla_seconds=30)
+          .build())
+    with mw:
+        drain(mw, broker, {"burst": 12_000, "victim": 2000})
+        led = mw.stats()["quota_ledger"]["tenants"]
+        assert led["burst"]["quota_stalls"] > 0, \
+            "the burst tenant's gate never bound — the quota is vacuous"
+        assert led["victim"]["quota_stalls"] == 0
+        assert led["burst"]["queued_records"] == 0  # credits matched charges
+        assert led["victim"]["queued_records"] == 0
+        assert not mw.stats()["tenants"]["victim"]["sla_violated"]
+
+
+def test_quota_gate_blocks_until_credit_and_counts_stall():
+    """Ledger unit: a tenant at its share parks in wait_turn until a
+    drain credit frees headroom; the stall episode and seconds are
+    counted on the offender only."""
+    import threading
+
+    led = TenantQuotaLedger()
+    led.register("a", queue_quota=2)
+    led.register("b", queue_quota=2)
+    led.on_enqueued("a", 2)
+    released = threading.Event()
+
+    def gate():
+        led.wait_turn("a", tick_s=0.01)
+        released.set()
+
+    t = threading.Thread(target=gate, daemon=True)
+    t.start()
+    assert not released.wait(0.15), "gate passed while at quota"
+    assert led.wait_turn("b") == 0.0  # sibling never parks
+    led.on_drained("a", 1)
+    assert released.wait(2.0), "credit did not release the gate"
+    t.join(2.0)
+    snap = led.tenant_snapshot("a")
+    assert snap["quota_stalls"] == 1
+    assert snap["quota_stall_s"] > 0.0
+    assert led.tenant_snapshot("b")["quota_stalls"] == 0
+
+
+def test_quota_ledger_torn_update_probe():
+    """The schedx-style invariant probe guards the ledger against torn
+    multi-route updates: a consistent charge passes, a diverged
+    per-tenant-sum vs global-total raises AND records with the replay
+    seed (negative control — the recorded violation is then cleared so
+    the module-autouse zero-violations assertion stays meaningful)."""
+    act = schedcheck.active()
+    assert act is not None
+    schedcheck.note_quota_ledger(0xbeef, 7, 7)  # consistent: passes
+    with pytest.raises(QuotaLedgerTornError) as ei:
+        schedcheck.note_quota_ledger(0xbeef, 3, 4)
+    assert "torn" in str(ei.value)
+    assert any(isinstance(v, QuotaLedgerTornError) for v in act.violations)
+    act.violations.clear()  # negative control: not a real violation
+
+
+def test_open_file_budget_evicts_lru_within_the_offending_route():
+    """The PR-8 LRU bound generalized: a partitioned route at its
+    open-file budget closes-and-publishes its own LRU file before
+    opening another — open files stay at/under the budget, the tenant
+    eviction meter binds, everything still drains and acks."""
+    cls = sample_message_class()
+    broker = FakeBroker()
+    broker.create_topic("pt", PARTS)
+    produce(broker, "pt", cls, 4000, page_mod=6)
+    fs = MemoryFileSystem()
+    reg = MetricRegistry()
+    mw = (base_builder(broker, fs, reg)
+          .route("pt", cls, "/fb/pt", open_file_budget=2,
+                 partition_by={"spec": "page_number",
+                               "max_open_partitions": 8})
+          .build())
+    seen_open = []
+    with mw:
+        drain(mw, broker, {"pt": 4000},
+              sample=lambda m: seen_open.append(
+                  m.stats()["tenants"]["pt"]["quota"]["open_files"]))
+    assert max(seen_open) <= 2, f"budget exceeded: {max(seen_open)}"
+    assert reg.get(M.TENANT_FILES_EVICTED_METER).count > 0
+    # six partitions' rows all landed despite the 2-file budget
+    got = set()
+    for f in fs.list_files("/fb/pt", extension=".parquet"):
+        if "/tmp/" in f:
+            continue
+        got.update(r["timestamp"]
+                   for r in pq.read_table(fs.open_read(f)).to_pylist())
+    assert got.issuperset(range(4000))
+
+
+# -- fault domains: containment ----------------------------------------------
+
+def test_sink_fault_pauses_offending_route_alone_then_recovers():
+    """A fatal sink condition (ENOSPC) on ONE tenant's filesystem pauses
+    that route alone (degraded-mode bulkhead): the sibling keeps
+    publishing and fully drains DURING the outage with zero worker
+    deaths, and after heal() the faulted route resumes and drains too."""
+    cls = sample_message_class()
+    broker = FakeBroker()
+    broker.create_topic("sick", PARTS)
+    broker.create_topic("well", PARTS)
+    produce(broker, "sick", cls, 3000)
+    produce(broker, "well", cls, 3000)
+    sched = FaultSchedule(seed=3).recover_after("write", nth=6,
+                                                err=errno.ENOSPC)
+    sick_fs = FaultInjectingFileSystem(MemoryFileSystem(), sched)
+    well_fs = MemoryFileSystem()
+    mw = (base_builder(broker, MemoryFileSystem())
+          .route("sick", cls, "/fd/sick", filesystem=sick_fs,
+                 degraded_mode={"flag": True,
+                                "probe_interval_seconds": 0.05,
+                                "probe_backoff_max_seconds": 0.2})
+          .route("well", cls, "/fd/well", filesystem=well_fs,
+                 ack_sla_seconds=30)
+          .build())
+    group = None
+    try:
+        mw.start()
+        group = mw.route("well")._b._group_id
+        # wait for the sick route to PAUSE (not die)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if mw.stats()["tenants"]["sick"]["state"] == "paused":
+                break
+            time.sleep(0.02)
+        st = mw.stats()
+        assert st["tenants"]["sick"]["state"] == "paused", \
+            st["tenants"]["sick"]
+        # sibling drains FULLY while the offender is paused
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if (sum(broker.committed(group, "well", p)
+                    for p in range(PARTS)) >= 3000
+                    and mw.route("well").ack_lag()["unacked_records"] == 0):
+                break
+            time.sleep(0.02)
+        st = mw.stats()
+        assert sum(broker.committed(group, "well", p)
+                   for p in range(PARTS)) >= 3000
+        assert st["tenants"]["well"]["workers_dead"] == 0
+        assert st["tenants"]["well"]["restarts_total"] == 0
+        assert st["tenants"]["well"]["healthy"]
+        # heal the sink: the paused route resumes and drains alone
+        sched.heal()
+        drain(mw, broker, {"sick": 3000, "well": 3000})
+        st = mw.stats()
+        assert st["tenants"]["sick"]["state"] == "running"
+        assert st["tenants"]["sick"]["workers_dead"] == 0
+    finally:
+        mw.close()
+
+
+def test_poison_stream_dead_letters_alone():
+    """Garbage payloads on one tenant's topic dead-letter (typed frames
+    in ITS tree, then ack) without touching the sibling: zero sibling
+    deaths, sibling rows all published, per-tenant dead-letter counts
+    exact, canonical meter aggregates."""
+    cls = sample_message_class()
+    broker = FakeBroker()
+    broker.create_topic("poison", PARTS)
+    broker.create_topic("clean", PARTS)
+    n_poison = 0
+    for i in range(2000):
+        if i % 100 == 7:
+            broker.produce("poison", b"\xff\xfe garbage " + bytes([i % 256]),
+                           partition=i % PARTS)
+            n_poison += 1
+        else:
+            broker.produce("poison",
+                           cls(query=f"q-{i}",
+                               timestamp=i).SerializeToString(),
+                           partition=i % PARTS)
+    produce(broker, "clean", cls, 2000)
+    fs = MemoryFileSystem()
+    reg = MetricRegistry()
+    mw = (base_builder(broker, fs, reg)
+          .route("poison", cls, "/ps/poison", on_parse_error="dead_letter")
+          .route("clean", cls, "/ps/clean")
+          .build())
+    with mw:
+        drain(mw, broker, {"poison": 2000, "clean": 2000})
+        st = mw.stats()
+        assert st["tenants"]["poison"]["deadletter_records"] == n_poison
+        assert st["tenants"]["clean"]["deadletter_records"] == 0
+        assert st["tenants"]["clean"]["workers_dead"] == 0
+        assert st["tenants"]["clean"]["restarts_total"] == 0
+    assert reg.get(M.DEADLETTER_METER).count == n_poison
+    assert fs.list_files("/ps/poison/deadletter")
+    clean_rows = set()
+    for f in fs.list_files("/ps/clean", extension=".parquet"):
+        if "/tmp/" not in f:
+            clean_rows.update(
+                r["timestamp"]
+                for r in pq.read_table(fs.open_read(f)).to_pylist())
+    assert clean_rows == set(range(2000))
+
+
+# -- schema evolution ---------------------------------------------------------
+
+def test_schema_additive_evolution_reads_consistently_merged():
+    """V1 files then V2 (one added optional field) in ONE tree: the
+    merged-schema read (pyarrow promotion) stays consistent — old rows
+    surface the new column as null, new rows carry it — and the
+    cross-file audit reports the column as additive, not a conflict."""
+    v1, v2 = sample_message_class(), sample_v2_class()
+    broker = FakeBroker()
+    broker.create_topic("evo", PARTS)
+    fs = MemoryFileSystem()
+    seed_tree(fs, "/evo/tree", v1, 500)  # the V1 era
+    for i in range(500, 1000):  # the V2 era streams through a route
+        m = v2(query=f"q-{i}", timestamp=i)
+        m.extra_score = i * 2
+        broker.produce("evo", m.SerializeToString(), partition=i % PARTS)
+    mw = (base_builder(broker, fs)
+          .route("evo", v2, "/evo/tree")
+          .build())
+    with mw:
+        drain(mw, broker, {"evo": 500})
+        assert mw.stats()["tenants"]["evo"]["state"] == "running"
+    files = [f for f in fs.list_files("/evo/tree", extension=".parquet")
+             if "/tmp/" not in f]
+    assert len(files) >= 2
+    tables = [pq.read_table(fs.open_read(f)) for f in files]
+    merged = pa.concat_tables(tables, promote_options="permissive")
+    assert "extra_score" in merged.schema.names
+    by_ts = {r["timestamp"]: r for r in merged.to_pylist()}
+    assert set(by_ts) == set(range(1000))
+    assert by_ts[100]["extra_score"] is None       # V1 row: null
+    assert by_ts[700]["extra_score"] == 1400       # V2 row: value
+    audit = audit_schema_consistency(fs, "/evo/tree")
+    assert audit["consistent"], audit["conflicts"]
+    assert "extra_score" in audit["additive_columns"]
+
+
+def test_schema_incompatible_route_dead_letters_with_typed_reason():
+    """A route whose proto conflicts with its published tree (int64 ->
+    string on one leaf) flips to dead_lettering at start(): every record
+    lands in ITS dead-letter file with the typed reason surfaced, the
+    tree gains no mixed-schema file, acks still commit (the stream keeps
+    draining), and the sibling route is untouched."""
+    v1, bad = sample_message_class(), sample_incompatible_class()
+    broker = FakeBroker()
+    broker.create_topic("tbad", PARTS)
+    broker.create_topic("tok", PARTS)
+    for i in range(300):
+        broker.produce("tbad",
+                       bad(query=f"q-{i}",
+                           timestamp=str(i)).SerializeToString(),
+                       partition=i % PARTS)
+    produce(broker, "tok", v1, 1000)
+    fs = MemoryFileSystem()
+    seed_tree(fs, "/si/tree", v1, 200)
+    files_before = set(fs.list_files("/si/tree", extension=".parquet"))
+    mw = (base_builder(broker, fs)
+          .route("tbad", bad, "/si/tree")
+          .route("tok", v1, "/si/ok")
+          .build())
+    with mw:
+        status = mw.route_status("tbad")
+        assert status["state"] == "dead_lettering"
+        assert status["reason_type"] == "SchemaIncompatibleError"
+        assert "timestamp" in status["reason"]
+        assert mw.route_status("tok")["state"] == "running"
+        drain(mw, broker, {"tbad": 300, "tok": 1000})
+        st = mw.stats()
+        assert st["tenants"]["tbad"]["deadletter_records"] == 300
+        assert st["tenants"]["tok"]["deadletter_records"] == 0
+        assert st["tenants"]["tok"]["workers_dead"] == 0
+    # the tree gained NO mixed-schema file; the audit stays clean
+    files_after = set(fs.list_files("/si/tree", extension=".parquet"))
+    assert {f for f in files_after if "/tmp/" not in f} == \
+        {f for f in files_before if "/tmp/" not in f}
+    assert audit_schema_consistency(fs, "/si/tree")["consistent"]
+    assert fs.list_files("/si/tree/deadletter")
+
+
+def test_cross_file_schema_audit_flags_planted_mixed_tree():
+    """The PR-9 verifier's schema half: a partition tree holding the
+    same leaf under two physical types is flagged with the column name
+    and carrier files; a clean tree (and a merely-additive one) is not."""
+    v1, bad = sample_message_class(), sample_incompatible_class()
+    fs = MemoryFileSystem()
+    seed_tree(fs, "/audit/tree", v1, 50, name="a.parquet")
+    seed_tree(fs, "/audit/tree", v1, 50, name="b.parquet", start=50)
+    clean = audit_schema_consistency(fs, "/audit/tree")
+    assert clean["consistent"] and clean["files"] == 2
+    # plant the conflicting file (timestamp: int64 in a/b, string here)
+    props = Builder().proto_class(bad).writer_properties()
+    tmp = "/audit/tree/tmp/x.tmp"
+    fs.mkdirs("/audit/tree/tmp")
+    pf = ParquetFile(fs, tmp, ProtoColumnarizer(bad), props, batch_size=64)
+    pf.append_records([bad(query=f"q-{i}", timestamp=str(i))
+                       for i in range(20)])
+    pf.close()
+    publish_file(fs, tmp, "/audit/tree/mixed.parquet", durable=False)
+    audit = audit_schema_consistency(fs, "/audit/tree")
+    assert not audit["consistent"]
+    assert audit["files"] == 3
+    cols = {c["column"] for c in audit["conflicts"]}
+    assert "timestamp" in cols
+    conflict = next(c for c in audit["conflicts"]
+                    if c["column"] == "timestamp")
+    assert any("mixed.parquet" in f
+               for files in conflict["types"].values() for f in files)
+    # file_schema surfaces the leaf map the audit is built from
+    leaves = file_schema(fs, "/audit/tree/mixed.parquet")
+    assert "timestamp" in leaves and "query" in leaves
+
+
+# -- shared compaction service ------------------------------------------------
+
+def test_shared_compaction_service_compacts_every_route():
+    """ONE service thread drives both routes' compactors: small files in
+    BOTH tenants' trees merge (inputs tombstoned, outputs verified), and
+    the per-tenant compaction stats ride the MultiWriter snapshot."""
+    cls = sample_message_class()
+    broker = FakeBroker()
+    for t in ("ca", "cb"):
+        broker.create_topic(t, PARTS)
+        produce(broker, t, cls, 5000, pad=80)
+    fs = MemoryFileSystem()
+    mw = (base_builder(broker, fs)
+          .max_file_size(100 * 1024)
+          .route("ca", cls, "/cp/ca",
+                 compaction={"target_size": 512 * 1024,
+                             "scan_interval_seconds": 0.2})
+          .route("cb", cls, "/cp/cb",
+                 compaction={"target_size": 512 * 1024,
+                             "scan_interval_seconds": 0.2})
+          .build())
+    with mw:
+        drain(mw, broker, {"ca": 5000, "cb": 5000})
+        deadline = time.time() + 30
+        merged = {}
+        while time.time() < deadline:
+            snap = mw.stats()["compaction"]["by_tenant"]
+            merged = {t: snap[t]["merged"] for t in ("ca", "cb")}
+            if all(v > 0 for v in merged.values()):
+                break
+            time.sleep(0.05)
+        assert all(v > 0 for v in merged.values()), \
+            f"shared service left a route uncompacted: {merged}"
+    for t in ("ca", "cb"):
+        # every row still readable exactly once per published tree
+        got = {}
+        for f in fs.list_files(f"/cp/{t}", extension=".parquet"):
+            if "/tmp/" in f or "/compacted/" in f:
+                continue
+            for r in pq.read_table(fs.open_read(f)).to_pylist():
+                got[r["timestamp"]] = got.get(r["timestamp"], 0) + 1
+        assert set(got) == set(range(5000))
+        assert all(c == 1 for c in got.values())

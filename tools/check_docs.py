@@ -219,6 +219,35 @@ CHECKS = [
     ("PARITY.md", r"bracket recorded \*\*([\d.]+)\*\*–\*\*([\d.]+)\*\* "
                   r"of 2 cores",
      ["nested:cpu_capacity_x.before", "nested:cpu_capacity_x.after"]),
+    # multi-tenant-bulkheads PR: tenant count, quota-throttle evidence,
+    # victim SLA headroom and containment counters reconcile against the
+    # tenants artifact (`tenants:` prefix, BENCH_TENANTS_r19.json)
+    ("README.md", r"bulkheads across \*\*(\d+)\*\* tenants",
+     ["tenants:tenants"]),
+    ("README.md", r"burst tenant \(\*\*(\d+)\*\* records vs \*\*(\d+)\*\* "
+                  r"per victim\)",
+     ["tenants:burst_rows", "tenants:rows_per_victim"]),
+    ("README.md", r"\*\*(\d+)\*\* quota-stall\s+episodes\s+"
+                  r"\(\*\*([\d.]+)\s?s\*\*\s+parked\)",
+     ["tenants:quota.burst_stalls", "tenants:quota.burst_stall_s"]),
+    ("README.md", r"victim p99 ack-lag\s+\*\*([\d.]+)\s?s\*\* against "
+                  r"the\s+\*\*([\d.]+)\s?s\*\* SLA",
+     ["tenants:victim_ack_p99_s_max", "tenants:sla_seconds"]),
+    ("README.md", r"\*\*(\d+)\*\* sibling\s+worker deaths and "
+                  r"\*\*(\d+)\*\* of\s+\*\*(\d+)\*\* poison records "
+                  r"dead-lettered",
+     ["tenants:containment.sibling_worker_deaths",
+      "tenants:containment.deadlettered_records",
+      "tenants:containment.poison_records_produced"]),
+    ("PARITY.md", r"`victim_ack_p99_s_max` \*\*([\d.]+)\s?s\*\* against "
+                  r"the\s+\*\*([\d.]+)\s?s\*\* `sla_seconds`",
+     ["tenants:victim_ack_p99_s_max", "tenants:sla_seconds"]),
+    ("PARITY.md", r"`burst_stalls` \*\*(\d+)\*\* with\s+"
+                  r"`victim_stalls_max` \*\*(\d+)\*\*",
+     ["tenants:quota.burst_stalls", "tenants:quota.victim_stalls_max"]),
+    ("PARITY.md", r"`sibling_worker_deaths` \*\*(\d+)\*\* across\s+"
+                  r"\*\*(\d+)\*\* tenants",
+     ["tenants:containment.sibling_worker_deaths", "tenants:tenants"]),
 ]
 
 
@@ -607,6 +636,12 @@ def main() -> int:
         "KPW_NESTED_PATH", os.path.join(ROOT, "BENCH_NESTED_r18.json"))
     if os.path.exists(nested_path):
         key_record["nested"] = json.load(open(nested_path))
+    # the multi-tenant-bulkheads artifact (bench.py --tenants) is the
+    # twelfth
+    tenants_path = os.environ.get(
+        "KPW_TENANTS_PATH", os.path.join(ROOT, "BENCH_TENANTS_r19.json"))
+    if os.path.exists(tenants_path):
+        key_record["tenants"] = json.load(open(tenants_path))
     docs = {f: open(os.path.join(ROOT, f)).read()
             for f in ({c[0] for c in CHECKS} | set(KEY_DOCS)
                       | set(NAME_DOCS))}
@@ -643,6 +678,8 @@ def main() -> int:
                 root, spec = key_record.get("objstore", {}), spec[9:]
             elif spec.startswith("nested:"):
                 root, spec = key_record.get("nested", {}), spec[7:]
+            elif spec.startswith("tenants:"):
+                root, spec = key_record.get("tenants", {}), spec[8:]
             try:
                 expect = float(art(root, spec)) / scale
             except (KeyError, TypeError):

@@ -64,6 +64,14 @@
 #                          deliberate-race canary must be REPORTED first
 #                          so the clean run is non-vacuous; loud SKIPPED
 #                          when libtsan is absent — never a silent pass)
+#  13. tenants smoke     — python bench.py --tenants --smoke (reduced
+#                          multi-tenant mix: burst tenant under a small
+#                          queue share + fault persona on one sink +
+#                          poison stream on another topic; exits nonzero
+#                          unless every route's ack-lag drains to 0 AND
+#                          the containment counters show zero
+#                          cross-tenant worker deaths; committed
+#                          artifact never overwritten)
 #
 # Usage: bash tools/ci.sh        (exit 0 = all gates green)
 
@@ -73,10 +81,10 @@ cd "$(dirname "$0")/.."
 fail=0
 step() { echo; echo "=== ci.sh [$1] $2 ==="; }
 
-step 1/12 "lint suite (python -m tools.analyze)"
+step 1/13 "lint suite (python -m tools.analyze)"
 python -m tools.analyze || fail=1
 
-step 2/12 "tier-1 pytest (-m 'not slow')"
+step 2/13 "tier-1 pytest (-m 'not slow')"
 # tier-1's exit code is nonzero on THIS container because of the known
 # environmental failures (python zstandard + jax shard_map absent — see
 # the CHANGES.md baseline), so the gate is mechanical instead of
@@ -99,35 +107,38 @@ if [ "$t1_errors" -gt 0 ] || [ "$t1_failed" -gt "$max_failed" ] \
 fi
 rm -f "$T1_LOG"
 
-step 3/12 "compaction smoke (bench.py --compact --smoke)"
+step 3/13 "compaction smoke (bench.py --compact --smoke)"
 JAX_PLATFORMS=cpu python bench.py --compact --smoke || fail=1
 
-step 4/12 "scan smoke (bench.py --scan --smoke)"
+step 4/13 "scan smoke (bench.py --scan --smoke)"
 JAX_PLATFORMS=cpu python bench.py --scan --smoke || fail=1
 
-step 5/12 "e2e smoke (bench.py --e2e --smoke)"
+step 5/13 "e2e smoke (bench.py --e2e --smoke)"
 JAX_PLATFORMS=cpu python bench.py --e2e --smoke || fail=1
 
-step 6/12 "process-mode smoke (bench.py --procs --smoke)"
+step 6/13 "process-mode smoke (bench.py --procs --smoke)"
 JAX_PLATFORMS=cpu python bench.py --procs --smoke || fail=1
 
-step 7/12 "object-store smoke (bench.py --objstore --smoke)"
+step 7/13 "object-store smoke (bench.py --objstore --smoke)"
 JAX_PLATFORMS=cpu python bench.py --objstore --smoke || fail=1
 
-step 8/12 "nested-replay smoke (bench.py --nested --smoke)"
+step 8/13 "nested-replay smoke (bench.py --nested --smoke)"
 JAX_PLATFORMS=cpu python bench.py --nested --smoke || fail=1
 
-step 9/12 "schedule-explorer smoke (python -m tools.schedx --smoke)"
+step 9/13 "schedule-explorer smoke (python -m tools.schedx --smoke)"
 JAX_PLATFORMS=cpu python -m tools.schedx --smoke || fail=1
 
-step 10/12 "doc reconciliation (tools/check_docs.py)"
+step 10/13 "doc reconciliation (tools/check_docs.py)"
 python tools/check_docs.py || fail=1
 
-step 11/12 "sanitizer smoke (tools/sanitize.sh --smoke)"
+step 11/13 "sanitizer smoke (tools/sanitize.sh --smoke)"
 bash tools/sanitize.sh --smoke || fail=1
 
-step 12/12 "tsan smoke (tools/sanitize.sh --tsan --smoke)"
+step 12/13 "tsan smoke (tools/sanitize.sh --tsan --smoke)"
 bash tools/sanitize.sh --tsan --smoke || fail=1
+
+step 13/13 "multi-tenant smoke (bench.py --tenants --smoke)"
+JAX_PLATFORMS=cpu python bench.py --tenants --smoke || fail=1
 
 echo
 if [ "$fail" -ne 0 ]; then
