@@ -331,3 +331,38 @@ def delta_length_byte_array_encode(values) -> bytes:
     concatenated bytes."""
     lens = np.fromiter((len(v) for v in values), np.int64, count=len(values))
     return delta_binary_packed_encode(lens, bit_size=32) + b"".join(values)
+
+
+# ---------------------------------------------------------------------------
+# BYTE_STREAM_SPLIT (fixed-width values) — byte-plane transpose
+# ---------------------------------------------------------------------------
+
+def byte_stream_split_encode(values, physical_type: int) -> bytes:
+    """BYTE_STREAM_SPLIT per the spec: the K byte planes of N K-byte values,
+    concatenated — plane j holds byte j of every value in order.  Same byte
+    COUNT as PLAIN; the win is that grouping same-significance bytes makes
+    the stream compress far better (float mantissa noise stays contained in
+    its own planes).  Defined for FLOAT/DOUBLE since format 2.8 and for
+    INT32/INT64/FIXED_LEN_BYTE_ARRAY since 2.11."""
+    dtype = _PLAIN_DTYPES.get(physical_type)
+    if dtype is None:
+        raise ValueError(
+            f"BYTE_STREAM_SPLIT needs a fixed-width type, got {physical_type}")
+    v = np.ascontiguousarray(values, dtype=dtype)
+    n = len(v)
+    if n == 0:
+        return b""
+    return v.view(np.uint8).reshape(n, dtype.itemsize).T.tobytes()
+
+
+def byte_stream_split_decode(data: bytes, physical_type: int) -> np.ndarray:
+    """Inverse of :func:`byte_stream_split_encode` (tests / readback)."""
+    dtype = _PLAIN_DTYPES[physical_type]
+    k = dtype.itemsize
+    if len(data) % k:
+        raise ValueError("BYTE_STREAM_SPLIT payload not a multiple of width")
+    n = len(data) // k
+    if n == 0:
+        return np.zeros(0, dtype)
+    planes = np.frombuffer(data, np.uint8).reshape(k, n)
+    return np.ascontiguousarray(planes.T).reshape(-1).view(dtype).copy()

@@ -35,6 +35,7 @@ from .metadata import (
     write_page_header,
 )
 from .schema import Codec, ColumnDescriptor, Encoding, PageType, PhysicalType
+from .select_encoding import EncodingChooser
 from ..utils.tracing import stage
 
 
@@ -219,7 +220,20 @@ class EncoderOptions:
     # False -> PLAIN (parquet-mr v1 behavior); True -> DELTA_BINARY_PACKED
     # for int columns and DELTA_LENGTH_BYTE_ARRAY for byte arrays
     # (BASELINE.md config 3: high-cardinality/string-heavy workloads).
+    # LEGACY SPELLING: since ISSUE 16 this is a forced-override rule inside
+    # the encoding chooser (core/select_encoding.py) — prefer
+    # ``adaptive_encodings`` / the ``encodings`` override map.
     delta_fallback: bool = False
+    # Stats-driven per-column encoding chooser (core/select_encoding.py):
+    # row group 1's observed stats pick among PLAIN / dictionary+RLE /
+    # DELTA_BINARY_PACKED / DELTA_LENGTH_BYTE_ARRAY / BYTE_STREAM_SPLIT,
+    # pinned per file for reader coherence.  Off = byte-identical
+    # pre-chooser output (PLAIN / delta_fallback rules).
+    adaptive_encodings: bool = False
+    # Explicit per-column overrides (column name or dotted path -> Encoding
+    # int or spec name); takes precedence over every adaptive rule and
+    # disables the dictionary attempt for that column.
+    encodings: dict | None = None
     # Column-parallel encode threads in the native backend (0 = one per
     # core).  The BASELINE target is per *host*, and the native primitives
     # release the GIL, so columns encode in parallel; 1 disables.
@@ -260,12 +274,22 @@ class CpuChunkEncoder:
 
     def __init__(self, options: EncoderOptions) -> None:
         self.options = options
+        # the ONE encoding-decision point (core/select_encoding.py):
+        # override map > legacy delta_fallback > per-file adaptive pin
+        self.chooser = EncodingChooser(options)
         # nogil-assembly accounting (chunks/pages that went through the
         # native assemble_pages call) — read by the writer's stats/meters;
         # the lock only guards the two increments (assembly pool threads)
         self.native_asm_chunks = 0
         self.native_asm_pages = 0
         self._asm_count_lock = threading.Lock()
+
+    def begin_file(self) -> None:
+        """Per-file reset hook, called by ``ParquetFileWriter.__init__``:
+        the chooser's adaptive decisions are pinned per FILE (reader
+        coherence), and a custom Builder backend may hand the same encoder
+        object to every rotated file (runtime/parquet_file.py)."""
+        self.chooser.begin_file()
 
     # -- primitive ops (overridden by the TPU backend) ---------------------
     def _dictionary_build(self, values, pt: int):
@@ -281,14 +305,17 @@ class CpuChunkEncoder:
     def _plain_body(self, values, pt: int) -> bytes:
         return enc.plain_encode(values, pt)
 
-    def _fallback_encoding(self, pt: int) -> int:
-        """Value encoding for non-dictionary chunks."""
-        if self.options.delta_fallback:
-            if pt in (PhysicalType.INT32, PhysicalType.INT64):
-                return Encoding.DELTA_BINARY_PACKED
-            if pt == PhysicalType.BYTE_ARRAY:
-                return Encoding.DELTA_LENGTH_BYTE_ARRAY
-        return Encoding.PLAIN
+    def _fallback_encoding(self, pt: int, col=None) -> int:
+        """Value encoding for non-dictionary chunks — delegated WHOLLY to
+        the chooser (core/select_encoding.py), the one decision point.
+        With ``col`` the pinned/overridden per-column decision applies;
+        without it only the column-independent rules (legacy
+        ``delta_fallback``, PLAIN) can answer."""
+        if col is not None:
+            d = self.chooser.peek(col)
+            if d is not None:
+                return d.value_encoding
+        return self.chooser.static_value_encoding(pt)
 
     def _values_body(self, values, pt: int, encoding: int) -> bytes:
         if encoding == Encoding.DELTA_BINARY_PACKED:
@@ -296,6 +323,8 @@ class CpuChunkEncoder:
             return enc.delta_binary_packed_encode(np.asarray(values), bit_size)
         if encoding == Encoding.DELTA_LENGTH_BYTE_ARRAY:
             return enc.delta_length_byte_array_encode(values)
+        if encoding == Encoding.BYTE_STREAM_SPLIT:
+            return enc.byte_stream_split_encode(values, pt)
         return self._plain_body(values, pt)
 
     def _levels_body(self, levels: np.ndarray, max_level: int) -> bytes:
@@ -712,6 +741,8 @@ class CpuChunkEncoder:
             nd = len(dict_values)
             dict_prefix = add_buf(DICT_PAGE_PREFIX)
             dict_suffix = add_buf(dict_page_suffix(
+                # lint: encoding-choice ok — dict page header field, not a
+                # value-encoding choice (acceptance was decided upstream)
                 nd, Encoding.PLAIN_DICTIONARY, crc_on))
             op_start = len(ops) // 5
             add_raw(dict_plain)
@@ -743,8 +774,16 @@ class CpuChunkEncoder:
         plain_raw = (not use_dict and value_encoding == Encoding.PLAIN
                      and contig_vals is not None
                      and values.dtype == enc._PLAIN_DTYPES.get(pt))
-        val_buf = add_buf(contig_vals) if plain_raw else -1
-        isz = values.dtype.itemsize if plain_raw else 0
+        # BYTE_STREAM_SPLIT straight from the contiguous value buffer: the
+        # byte-plane transpose runs INSIDE the one nogil native call
+        # (kOpBss, OP_KINDS >= 5), so BSS pages cost no host
+        # materialization — same zero-copy shape as plain_raw
+        bss_raw = (not use_dict
+                   and value_encoding == Encoding.BYTE_STREAM_SPLIT
+                   and asm_ops >= 5 and contig_vals is not None
+                   and values.dtype == enc._PLAIN_DTYPES.get(pt))
+        val_buf = add_buf(contig_vals) if plain_raw or bss_raw else -1
+        isz = values.dtype.itemsize if plain_raw or bss_raw else 0
 
         # packed BYTE_ARRAY PLAIN: the page body assembles from the
         # ByteColumn's (data, offsets) buffers inside the native call
@@ -810,6 +849,10 @@ class CpuChunkEncoder:
                         add_raw(body)
             elif plain_raw:
                 ops.extend((0, val_buf, va * isz, vb * isz, 0))
+            elif bss_raw:
+                # element-indexed (aux = value width): the native op
+                # transposes values [va, vb) into their byte planes
+                ops.extend((4, val_buf, va, vb, isz))
             elif bytes_plain:
                 ops.extend((3, ba_data_buf, va, vb, ba_offs_buf << 16))
             else:
@@ -923,7 +966,9 @@ class CpuChunkEncoder:
         use_dict = False
         dict_values = None
         indices = None
-        if self._dictionary_viable(chunk):
+        n_uniq = None
+        if self._dictionary_viable(chunk) and \
+                self.chooser.dictionary_wanted(col):
             built = self._finish_prepare(pre) if pre is not None else None
             if built is None:
                 built = self._try_dictionary(chunk)
@@ -936,14 +981,25 @@ class CpuChunkEncoder:
                     if len(dict_plain) <= opts.dictionary_page_size_limit:
                         use_dict = True
 
+        # the one decision point: pinned per file after row group 1 (the
+        # dictionary build just handed cardinality over for free)
+        decision = self.chooser.choose(chunk, pt, dict_accepted=use_dict,
+                                       dict_size=n_uniq)
         encodings = set()
         if use_dict:
+            # lint: encoding-choice ok — dictionary is an acceptance
+            # mechanism (the chooser gates whether to ATTEMPT the build;
+            # PLAIN_DICTIONARY is what acceptance spells on the wire)
             value_encoding = Encoding.PLAIN_DICTIONARY
+            # lint: encoding-choice ok — footer encodings list spelling
+            # of the accepted dictionary (levels are RLE by spec)
             encodings.update([Encoding.PLAIN_DICTIONARY, Encoding.RLE])
         else:
-            value_encoding = self._fallback_encoding(pt)
+            value_encoding = decision.value_encoding
             encodings.add(value_encoding)
         if col.max_def > 0 or col.max_rep > 0:
+            # lint: encoding-choice ok — footer encodings list; levels
+            # are always RLE by spec, never chosen
             encodings.add(Encoding.RLE)
 
         # Map slots -> present-value offsets for page slicing.
@@ -1000,6 +1056,7 @@ class CpuChunkEncoder:
                 PageType.DICTIONARY_PAGE,
                 len(dict_plain),
                 comp_len,
+                # lint: encoding-choice ok — dict page header field
                 dict_header=DictionaryPageHeader(len(dict_values), Encoding.PLAIN_DICTIONARY),
                 crc=self._page_crc([dict_plain] if comp_buf is None
                                    else [comp_buf]),
@@ -1079,7 +1136,10 @@ class CpuChunkEncoder:
                     data_header=DataPageHeader(
                         num_values=b - a,
                         encoding=value_encoding,
+                        # lint: encoding-choice ok — level encodings are
+                        # always RLE by spec, never chosen
                         definition_level_encoding=Encoding.RLE,
+                        # lint: encoding-choice ok — same: levels are RLE
                         repetition_level_encoding=Encoding.RLE,
                     ),
                     crc=self._page_crc(parts if comp_buf is None

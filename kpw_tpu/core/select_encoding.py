@@ -1,0 +1,301 @@
+"""Per-column encoding selection — the ONE place a value encoding is chosen.
+
+ISSUE 16: "An Empirical Evaluation of Columnar Storage Formats" shows the
+encoding choice dominates both file size and scan speed, and the stats
+machinery here already measures every chunk — so instead of one global
+``delta_fallback`` switch, the chooser picks per column among PLAIN /
+dictionary+RLE / DELTA_BINARY_PACKED / DELTA_LENGTH_BYTE_ARRAY /
+BYTE_STREAM_SPLIT, driven by the FIRST row group's observed stats
+(cardinality from the dictionary build, monotone-delta width, value width,
+null density).  The decision is **pinned per file** after row group 1 for
+reader coherence: later row groups reuse the pin in O(1) — the chooser
+costs nothing on the hot path and never rescans values after the first
+row group.
+
+Resolution order (first hit wins):
+
+1. the explicit ``Builder.encodings()`` override map (forces the value
+   encoding and disables the dictionary attempt for that column),
+2. the legacy ``delta_fallback(True)`` switch, re-expressed here as a
+   forced per-type override (ints -> DELTA_BINARY_PACKED, byte arrays ->
+   DELTA_LENGTH_BYTE_ARRAY) so the old spelling keeps its exact behavior,
+3. the per-file pinned adaptive choice (``adaptive_encodings=True``),
+   computed once from row group 1,
+4. PLAIN (the non-adaptive default — byte-identical pre-chooser output).
+
+Every encoder backend funnels through :meth:`CpuChunkEncoder._fallback_encoding`,
+which is a one-line delegation here; ``tools/analyze``'s
+``encoding-choice`` pass flags any ``Encoding.`` literal *chosen* outside
+this module so a second decision point cannot creep back in.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from .schema import Codec, Encoding, PhysicalType
+
+_ENCODING_NAMES = {v: k for k, v in vars(Encoding).items()
+                   if not k.startswith("_")}
+
+# override map: which value encodings a column of each physical type may be
+# forced to (dictionary is an acceptance mechanism, not a forced override)
+_OVERRIDABLE = {
+    PhysicalType.INT32: (Encoding.PLAIN, Encoding.DELTA_BINARY_PACKED,
+                         Encoding.BYTE_STREAM_SPLIT),
+    PhysicalType.INT64: (Encoding.PLAIN, Encoding.DELTA_BINARY_PACKED,
+                         Encoding.BYTE_STREAM_SPLIT),
+    PhysicalType.FLOAT: (Encoding.PLAIN, Encoding.BYTE_STREAM_SPLIT),
+    PhysicalType.DOUBLE: (Encoding.PLAIN, Encoding.BYTE_STREAM_SPLIT),
+    PhysicalType.BYTE_ARRAY: (Encoding.PLAIN,
+                              Encoding.DELTA_LENGTH_BYTE_ARRAY),
+    PhysicalType.BOOLEAN: (Encoding.PLAIN,),
+    PhysicalType.FIXED_LEN_BYTE_ARRAY: (Encoding.PLAIN,),
+    PhysicalType.INT96: (Encoding.PLAIN,),
+}
+
+# adaptive rule thresholds (trigger stats are surfaced per decision, so a
+# surprising choice is always explainable from the report)
+_MIN_ADAPTIVE_ROWS = 8        # below this RG1 carries no signal: PLAIN
+# delta wins when the packed miniblock width saves at least one byte per
+# value over PLAIN — below that the block headers and the slower decode
+# buy nothing (a 61-bit-wide random int64 column stays PLAIN; a 33-bit
+# random id column in an INT64 leaf still packs ~2x)
+_DELTA_MIN_SAVED_BITS = 8
+
+
+def encoding_name(encoding: int) -> str:
+    return _ENCODING_NAMES.get(encoding, str(encoding))
+
+
+@dataclass
+class EncodingDecision:
+    """One column's pinned choice + the stats that triggered it."""
+
+    value_encoding: int            # non-dictionary value encoding
+    use_dictionary: bool           # whether later row groups attempt dict
+    reason: str                    # "override" / "delta_fallback" / rule
+    pinned: bool = False           # True once fixed for the file
+    stats: dict = field(default_factory=dict)   # trigger stats (RG1)
+
+    def describe(self) -> dict:
+        return {
+            "value_encoding": encoding_name(self.value_encoding),
+            "use_dictionary": self.use_dictionary,
+            "reason": self.reason,
+            "pinned": self.pinned,
+            "stats": dict(self.stats),
+        }
+
+
+def _normalize_overrides(mapping) -> dict:
+    """Builder.encodings() accepts Encoding ints or (case-insensitive)
+    spec names; normalize to ints once at construction."""
+    out = {}
+    for name, spec in (mapping or {}).items():
+        if isinstance(spec, str):
+            try:
+                spec = getattr(Encoding, spec.upper())
+            except AttributeError:
+                raise ValueError(f"unknown encoding name {spec!r} for "
+                                 f"column {name!r}") from None
+        if spec not in _ENCODING_NAMES:
+            raise ValueError(f"unknown encoding {spec!r} for column {name!r}")
+        if spec in (Encoding.PLAIN_DICTIONARY, Encoding.RLE_DICTIONARY,
+                    Encoding.RLE, Encoding.BIT_PACKED,
+                    Encoding.DELTA_BYTE_ARRAY):
+            raise ValueError(
+                f"encoding {encoding_name(spec)} cannot be forced per "
+                f"column (dictionary is an acceptance mechanism; levels "
+                f"are always RLE)")
+        out[name] = spec
+    return out
+
+
+class EncodingChooser:
+    """Per-file value-encoding decisions for every column of one encoder.
+
+    Thread-safety: ``assemble_many`` shards columns across the assembly
+    pool, so pin writes go through a lock; row groups are sequential per
+    writer, so row group 2+ always observes row group 1's pins.
+    ``begin_file()`` resets the pins — called per ``ParquetFileWriter``
+    because a custom Builder backend may hand the SAME encoder object to
+    every rotated file (runtime/parquet_file.py), and the pin must be
+    per *file* for reader coherence, not per encoder lifetime.
+    """
+
+    def __init__(self, options) -> None:
+        self.options = options
+        self.overrides = _normalize_overrides(
+            getattr(options, "encodings", None))
+        self.adaptive = bool(getattr(options, "adaptive_encodings", False))
+        self._pins: dict = {}            # path tuple -> EncodingDecision
+        self._lock = threading.Lock()
+
+    # -- lifecycle ---------------------------------------------------------
+    def begin_file(self) -> None:
+        """Reset per-file pin state (new file = new row group 1)."""
+        with self._lock:
+            self._pins = {}
+
+    # -- resolution --------------------------------------------------------
+    def _override_for(self, col) -> int | None:
+        if not self.overrides:
+            return None
+        spec = self.overrides.get(".".join(col.path))
+        if spec is None:
+            spec = self.overrides.get(col.name)
+        if spec is None:
+            return None
+        pt = col.leaf.physical_type
+        if spec not in _OVERRIDABLE.get(pt, (Encoding.PLAIN,)):
+            raise ValueError(
+                f"encoding {encoding_name(spec)} is not valid for column "
+                f"{'.'.join(col.path)!r} (physical type {pt})")
+        return spec
+
+    def static_value_encoding(self, pt: int) -> int:
+        """The pre-chooser column-independent rule: the legacy
+        ``delta_fallback`` spelling, else PLAIN.  Also the terminal
+        default for adaptive columns whose stats trigger nothing."""
+        if self.options.delta_fallback:
+            if pt in (PhysicalType.INT32, PhysicalType.INT64):
+                return Encoding.DELTA_BINARY_PACKED
+            if pt == PhysicalType.BYTE_ARRAY:
+                return Encoding.DELTA_LENGTH_BYTE_ARRAY
+        return Encoding.PLAIN
+
+    def _static_decision(self, col, pt: int) -> EncodingDecision | None:
+        """A decision resolvable WITHOUT chunk stats, or None when the
+        adaptive rules need row group 1 first."""
+        forced = self._override_for(col)
+        if forced is not None:
+            return EncodingDecision(forced, use_dictionary=False,
+                                    reason="override", pinned=True)
+        if not self.adaptive:
+            return EncodingDecision(self.static_value_encoding(pt),
+                                    use_dictionary=True,
+                                    reason=("delta_fallback"
+                                            if self.options.delta_fallback
+                                            else "default"),
+                                    pinned=True)
+        return None
+
+    def peek(self, col) -> EncodingDecision | None:
+        """Pinned or statically-forced decision — NEVER computes or pins.
+        The pipelined planners use this: row group N+1's launch may run
+        before row group 1's assembly pinned anything, in which case the
+        planner simply skips pre-planning (correctness lives in encode())."""
+        pt = col.leaf.physical_type
+        d = self._pins.get(tuple(col.path))
+        if d is not None:
+            return d
+        return self._static_decision(col, pt)
+
+    def dictionary_wanted(self, col) -> bool:
+        """Whether this column should still ATTEMPT a dictionary build.
+        False once an override forces a value encoding, or once the
+        adaptive pin recorded that row group 1's build was rejected (the
+        build would be re-rejected anyway — skipping it is the hot-path
+        win that makes the chooser free after row group 1)."""
+        d = self.peek(col)
+        return True if d is None else d.use_dictionary
+
+    def choose(self, chunk, pt: int, *, dict_accepted: bool,
+               dict_size: int | None) -> EncodingDecision:
+        """Resolve (and pin, in adaptive mode) the decision for ``chunk``'s
+        column.  Called from ``encode()`` AFTER the dictionary attempt, so
+        cardinality arrives for free from the build; the only extra work
+        is one O(n) delta scan for int columns, on row group 1 only."""
+        col = chunk.column
+        d = self.peek(col)
+        if d is not None:
+            return d
+        d = self._adaptive_decision(chunk, pt, dict_accepted, dict_size)
+        with self._lock:
+            # first writer wins: columns are unique within a row group and
+            # row groups are sequential, so this only guards pool threads
+            # racing distinct columns into the dict
+            return self._pins.setdefault(tuple(col.path), d)
+
+    # -- the adaptive rules ------------------------------------------------
+    def _adaptive_decision(self, chunk, pt: int, dict_accepted: bool,
+                           dict_size: int | None) -> EncodingDecision:
+        values = chunk.values
+        n = len(values)
+        stats: dict = {"rows": n}
+        # cardinality only when the build was ACCEPTED: a rejected build's
+        # count is backend-dependent (the native/mesh paths early-abort at
+        # max_k without counting), and the decision stats land in the
+        # footer, where every backend must stay byte-identical
+        if dict_accepted and dict_size is not None:
+            stats["cardinality"] = dict_size
+        if chunk.def_levels is not None:
+            lv = np.asarray(chunk.def_levels)
+            stats["null_density"] = round(
+                float((lv < chunk.column.max_def).mean()), 4) if len(lv) else 0.0
+        fallback = self.static_value_encoding(pt)
+        if n < _MIN_ADAPTIVE_ROWS:
+            # no signal: pin the column-independent default, but leave the
+            # dictionary attempt OPEN — banning dict off an empty/tiny row
+            # group 1 would be a decision made from noise
+            return EncodingDecision(fallback, True,
+                                    "rg1-too-small", True, stats)
+        if pt in (PhysicalType.INT32, PhysicalType.INT64):
+            width, value_bits, monotone = _delta_profile(values, pt)
+            stats.update(delta_packed_width=width, value_bits=value_bits,
+                         monotone=monotone)
+            if width + _DELTA_MIN_SAVED_BITS <= value_bits:
+                return EncodingDecision(
+                    Encoding.DELTA_BINARY_PACKED, dict_accepted,
+                    f"delta_width={width}<={value_bits}"
+                    f"-{_DELTA_MIN_SAVED_BITS}", True, stats)
+            return EncodingDecision(fallback, dict_accepted,
+                                    "wide-deltas", True, stats)
+        if pt in (PhysicalType.FLOAT, PhysicalType.DOUBLE):
+            # BYTE_STREAM_SPLIT has the SAME byte count as PLAIN — the win
+            # is compressibility of the grouped byte planes, so it only
+            # pays under a codec
+            if self.options.codec != Codec.UNCOMPRESSED:
+                return EncodingDecision(Encoding.BYTE_STREAM_SPLIT,
+                                        dict_accepted,
+                                        "float-under-codec", True, stats)
+            return EncodingDecision(fallback, dict_accepted,
+                                    "uncompressed-float", True, stats)
+        if pt == PhysicalType.BYTE_ARRAY:
+            # delta-packed lengths beat the 4-byte-per-value PLAIN prefix
+            # regardless of content; the payload bytes are identical
+            return EncodingDecision(Encoding.DELTA_LENGTH_BYTE_ARRAY,
+                                    dict_accepted,
+                                    "byte-array-lengths", True, stats)
+        return EncodingDecision(fallback, dict_accepted, "no-rule",
+                                True, stats)
+
+    # -- surfacing ---------------------------------------------------------
+    def report(self) -> dict:
+        """Per-column decision + trigger stats, for ``stats()`` and the
+        ``encoding_info()`` accessor (keys = dotted column paths)."""
+        with self._lock:
+            return {".".join(path): d.describe()
+                    for path, d in sorted(self._pins.items())}
+
+
+def _delta_profile(values, pt: int) -> tuple[int, int, bool]:
+    """(packed delta bit width, value bits, monotone?) for an int chunk —
+    the exact width DELTA_BINARY_PACKED would need for the widest value:
+    deltas in ring arithmetic, re-based on the block min (the spec packs
+    ``delta - min_delta``)."""
+    itype = np.int32 if pt == PhysicalType.INT32 else np.int64
+    utype = np.uint32 if pt == PhysicalType.INT32 else np.uint64
+    value_bits = 32 if pt == PhysicalType.INT32 else 64
+    v = np.asarray(values, itype)
+    if len(v) < 2:
+        return 0, value_bits, True
+    with np.errstate(over="ignore"):
+        deltas = v[1:] - v[:-1]
+        rel = (deltas - deltas.min()).view(utype)
+    width = int(rel.max()).bit_length()
+    return width, value_bits, bool((deltas >= 0).all())

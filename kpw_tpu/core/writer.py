@@ -11,6 +11,7 @@ KafkaProtoParquetWriter.java:473).
 from __future__ import annotations
 
 import io
+import json
 import os
 import queue
 import threading
@@ -114,7 +115,15 @@ class WriterProperties:
     compression_level: int | None = None
     enable_dictionary: bool = True
     write_statistics: bool = True
+    # LEGACY SPELLING (see core/select_encoding.py): a forced per-type
+    # override rule inside the encoding chooser — kept for back-compat;
+    # prefer adaptive_encodings / the encodings override map below
     delta_fallback: bool = False
+    # adaptive per-column encodings: decide from row group 1's observed
+    # stats, pinned per file (reader coherence); encodings maps column
+    # name/dotted path -> Encoding and takes precedence over everything
+    adaptive_encodings: bool = False
+    encodings: dict | None = None
     encoder_threads: int = 0
     page_checksums: bool = False
     key_value_metadata: dict = field(default_factory=dict)
@@ -140,6 +149,8 @@ class WriterProperties:
             data_page_size=self.data_page_size,
             write_statistics=self.write_statistics,
             delta_fallback=self.delta_fallback,
+            adaptive_encodings=self.adaptive_encodings,
+            encodings=self.encodings,
             encoder_threads=self.encoder_threads,
             page_checksums=self.page_checksums,
             write_page_index=self.write_page_index,
@@ -181,6 +192,11 @@ class ParquetFileWriter:
         self.schema = schema
         self.properties = properties or WriterProperties()
         self.encoder = encoder or CpuChunkEncoder(self.properties.encoder_options())
+        # adaptive encoding decisions are pinned PER FILE (reader
+        # coherence): a shared encoder (custom Builder backend across
+        # rotated files) must re-decide from this file's first row group
+        if hasattr(self.encoder, "begin_file"):
+            self.encoder.begin_file()
         # IO-retry classification for the pipelined IO thread (duck-typed
         # runtime.retry.RetryPolicy: is_fatal + next_sleep).  None keeps the
         # historical fixed-100ms retry-every-OSError loop.
@@ -862,6 +878,17 @@ class ParquetFileWriter:
                 "sorting_columns": [(s.column_idx, s.descending,
                                      s.nulls_first) for s in self._sorting]}
 
+    def encoding_info(self) -> dict:
+        """Per-column value-encoding decisions of this file's encoder
+        (core/select_encoding.py): dotted column path -> the chosen
+        encoding, whether dictionary was kept, the trigger reason, and
+        the row-group-1 stats that drove it.  Empty for custom backends
+        without the chooser, and until the first row group encodes."""
+        chooser = getattr(self.encoder, "chooser", None)
+        if chooser is None:
+            return {}
+        return chooser.report()
+
     def assembly_info(self) -> dict:
         """Nogil-assembly accounting of this file's encoder: column chunks
         and pages whose assembly ran as one GIL-released native call
@@ -887,11 +914,20 @@ class ParquetFileWriter:
         self.flush_row_group()  # no-op unless something is still pending
         if self._defer_cc_bytes and self._row_groups:
             self._write_index_sections()
+        kv = list(self.properties.key_value_metadata.items())
+        # surface the chooser's per-column choice + trigger stats in the
+        # footer (readers see the encoding itself in each ColumnMetaData's
+        # encodings list; this records WHY, for audit/debug tooling)
+        if self.properties.adaptive_encodings or self.properties.encodings:
+            einfo = self.encoding_info()
+            if einfo:
+                kv.append(("kpw.encoding_decisions",
+                           json.dumps(einfo, sort_keys=True)))
         meta = FileMetaData(
             schema_fields=self.schema.flatten(),
             num_rows=self._num_rows,
             row_groups=self._row_groups,
-            key_value_metadata=list(self.properties.key_value_metadata.items()),
+            key_value_metadata=kv,
         )
         footer = meta.serialize()
         # one positioned write so a retried close() can't append twice

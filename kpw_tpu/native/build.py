@@ -273,6 +273,9 @@ class NativeLib:
         cdll.kpw_rle_hybrid_u32.restype = ctypes.c_int
         cdll.kpw_rle_hybrid_u32.argtypes = [
             c_u32p, c_sz, ctypes.c_int, c_p, ctypes.POINTER(c_sz)]
+        cdll.kpw_byte_stream_split.restype = ctypes.c_int
+        cdll.kpw_byte_stream_split.argtypes = [
+            ctypes.c_void_p, c_sz, c_sz, c_p]
         if self.has_zstd:
             cdll.kpw_zstd_compress_parts.restype = ctypes.c_int
             cdll.kpw_zstd_compress_parts.argtypes = [
@@ -572,6 +575,23 @@ class NativeLib:
         if rc != 0:
             raise RuntimeError(f"kpw_delta_bp rc={rc}")
         return out.raw[: out_len.value]
+
+    def byte_stream_split(self, values) -> bytes:
+        """BYTE_STREAM_SPLIT byte-plane transpose, byte-identical to
+        kpw_tpu.core.encodings.byte_stream_split_encode (``values`` must
+        already be a fixed-width ndarray in the column's PLAIN dtype)."""
+        import numpy as np
+
+        v = np.ascontiguousarray(values)
+        n, width = len(v), v.dtype.itemsize
+        if n == 0:
+            return b""
+        out = ctypes.create_string_buffer(n * width)
+        rc = self._c.kpw_byte_stream_split(
+            v.ctypes.data_as(ctypes.c_void_p), n, width, out)
+        if rc != 0:
+            raise RuntimeError(f"kpw_byte_stream_split rc={rc}")
+        return out.raw[: n * width]
 
     def proto_shred(self, buf: bytes, rec_offsets, n_fields: int,
                     fnum, kinds, flags, out_vals, out_pos, out_len,

@@ -174,6 +174,12 @@ class NativeChunkEncoder(CpuChunkEncoder):
         if L is not None and encoding == Encoding.DELTA_LENGTH_BYTE_ARRAY:
             lens, payload = lens_and_payload(values)
             return L.delta_binary_packed(lens, 32) + payload
+        if (L is not None and encoding == Encoding.BYTE_STREAM_SPLIT
+                and pt in enc._PLAIN_DTYPES):
+            # coerce to the column's PLAIN dtype first, exactly like the
+            # oracle — the transpose must see the on-wire value bytes
+            return L.byte_stream_split(
+                np.ascontiguousarray(values, enc._PLAIN_DTYPES[pt]))
         return super()._values_body(values, pt, encoding)
 
     def _values_page_parts(self, chunk, va: int, vb: int, pt: int,

@@ -49,6 +49,8 @@ int kpw_rle_hybrid_u32(const uint32_t* v, size_t n, int width, uint8_t* out,
 int kpw_rle_hybrid_from_runs_u32(const uint32_t* run_vals,
                                  const int32_t* run_lens, size_t n_runs,
                                  int width, uint8_t* out, size_t* out_len);
+int kpw_byte_stream_split(const uint8_t* in, size_t n, size_t width,
+                          uint8_t* out);
 // codecs.cc
 size_t kpw_snappy_max_compressed_length(size_t n);
 int kpw_snappy_compress(const uint8_t* in, size_t n, uint8_t* out,
@@ -83,6 +85,12 @@ constexpr int64_t kOpRleRuns = 2;
 // and bounds-checked at execution (it lives in a caller-mutable numpy
 // array); a bad table raises ValueError, never an OOB read.
 constexpr int64_t kOpBytesPlain = 3;
+// BYTE_STREAM_SPLIT straight from the contiguous value buffer (ISSUE 16):
+// elements [a:b) of buffers[buf], aux = value width in bytes (4 or 8),
+// transposed into their byte planes inside the nogil call — byte-identical
+// to core.encodings.byte_stream_split_encode via kpw_byte_stream_split
+// (encode.cc, the same object code the ctypes path runs).
+constexpr int64_t kOpBss = 4;
 constexpr int64_t kModeBare = 0;
 constexpr int64_t kModeWidthByte = 1;  // 1-byte bit width prefix (dict bodies)
 constexpr int64_t kModeLen32 = 2;      // u32 LE length prefix (v1 level streams)
@@ -377,6 +385,13 @@ PyObject* py_assemble_pages(PyObject*, PyObject* args) {
         // payload size depends on offset CONTENT (snapshotted + bounds-
         // checked at execution); length prefixes are bounded here
         body_cap += static_cast<size_t>(b - a) * 4;
+      } else if (kind == kOpBss) {
+        const int64_t width = aux;
+        if (width != 4 && width != 8)
+          return fail_value("bss op width must be 4 or 8"), nullptr;
+        if (a < 0 || b < a || b > view.len / width)
+          return fail_value("bss op range out of buffer bounds"), nullptr;
+        body_cap += static_cast<size_t>(b - a) * width;
       } else {
         return fail_value("unknown op kind"), nullptr;
       }
@@ -481,6 +496,14 @@ PyObject* py_assemble_pages(PyObject*, PyObject* args) {
             body.insert(body.end(), le, le + 4);
           }
           body.insert(body.end(), rle.data(), rle.data() + rle_len);
+        } else if (op[0] == kOpBss) {
+          const size_t n = static_cast<size_t>(b - a);
+          const size_t width = static_cast<size_t>(op[4]);
+          const size_t at = body.size();
+          body.resize(at + n * width);
+          kpw_byte_stream_split(
+              static_cast<const uint8_t*>(view.buf) + a * width, n, width,
+              body.data() + at);
         } else {  // kOpBytesPlain
           const size_t n = static_cast<size_t>(b - a);
           const Py_buffer& oview = bufs.views[op[4] >> 16];
@@ -615,9 +638,10 @@ PyMODINIT_FUNC PyInit__kpw_assemble(void) {
   PyModule_AddIntConstant(m, "HAS_ZSTD", 0);
 #endif
   // op-kind generation: 4 = RAW/RLE + the nested-pipeline additions
-  // (RLE-from-runs, bytes-plain).  The Python lowering getattr-gates on
-  // this, so a stale cached .so silently keeps the old lowering instead
-  // of emitting ops it cannot execute.
-  PyModule_AddIntConstant(m, "OP_KINDS", 4);
+  // (RLE-from-runs, bytes-plain); 5 adds BYTE_STREAM_SPLIT (kOpBss).
+  // The Python lowering getattr-gates on this, so a stale cached .so
+  // silently keeps the old lowering instead of emitting ops it cannot
+  // execute.
+  PyModule_AddIntConstant(m, "OP_KINDS", 5);
   return m;
 }

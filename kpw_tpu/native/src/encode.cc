@@ -953,4 +953,24 @@ int kpw_rle_hybrid_from_runs_u32(const uint32_t* run_vals,
   return 0;
 }
 
+// BYTE_STREAM_SPLIT (ISSUE 16): scatter the K byte planes of n K-byte
+// values — plane j collects byte j of every value in order.  Output is
+// exactly n*width bytes (same count as PLAIN; the win is that grouped
+// same-significance bytes compress far better).  The C twin of
+// kpw_tpu.core.encodings.byte_stream_split_encode and the object code the
+// nogil assembler's kOpBss op shares (both .so builds compile this file).
+int kpw_byte_stream_split(const uint8_t* in, size_t n, size_t width,
+                          uint8_t* out) {
+  if (width == 0) return 1;
+  // plane-major walk: each output plane is a sequential write while the
+  // strided reads stay within one cache line per value — measurably
+  // faster than value-major scatter for the 4/8-byte widths used here
+  for (size_t w = 0; w < width; w++) {
+    uint8_t* op = out + w * n;
+    const uint8_t* ip = in + w;
+    for (size_t i = 0; i < n; i++) op[i] = ip[i * width];
+  }
+  return 0;
+}
+
 }  // extern "C"

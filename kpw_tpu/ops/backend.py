@@ -37,6 +37,7 @@ from ..native.encoder import NativeChunkEncoder
 from ..core.schema import Encoding, PhysicalType
 from ..core.thrift import varint_bytes
 from ..core.bytecol import ByteColumn
+from .bss import byte_stream_split_device
 from .delta import (assemble_delta_page, delta_binary_packed_device,
                     delta_bits_bucket, delta_length_byte_array_device,
                     delta_pages_multi)
@@ -348,6 +349,7 @@ class _StringDictPlanner:
             pt = chunk.column.leaf.physical_type
             values = chunk.values
             if (not encoder._dictionary_viable(chunk)
+                    or not encoder.chooser.dictionary_wanted(chunk.column)
                     or not encoder._bytes_native_ok(values, pt)
                     or len(values) < encoder.min_device_rows):
                 continue
@@ -435,14 +437,23 @@ class _DeltaPlanner:
         self._jobs = []  # (row, chunk, bit_size, pages)
         streams: list[np.ndarray] = []  # per-job int64/int32-ring lo streams
         opts = encoder.options
-        if not opts.delta_fallback:
-            self.empty = True
+        chooser = encoder.chooser
+        if not (opts.delta_fallback or opts.adaptive_encodings or opts.encodings):
+            self.empty = True  # every column resolves to PLAIN: nothing here
             return
         for i, chunk in enumerate(chunks):
-            if encoder._dictionary_viable(chunk):
+            col = chunk.column
+            if (encoder._dictionary_viable(chunk)
+                    and chooser.dictionary_wanted(col)):
                 continue  # dictionary path (or rejected later: per-page route)
-            pt = chunk.column.leaf.physical_type
-            enc_kind = encoder._fallback_encoding(pt)
+            pt = col.leaf.physical_type
+            if chooser.peek(col) is None:
+                # adaptive & not yet pinned: the decision is made inside
+                # encode() (row group 1 stats) — launch_many may run ahead
+                # of the pinning assemble, so pre-planning here would race.
+                # Correctness lives in encode()'s per-page route.
+                continue
+            enc_kind = encoder._fallback_encoding(pt, col)
             values = chunk.values
             if len(values) < encoder.min_device_rows:
                 continue
@@ -639,6 +650,7 @@ class TpuChunkEncoder(NativeChunkEncoder):
         eligible = [
             (i, chunk) for i, chunk in enumerate(chunks)
             if self._dictionary_viable(chunk)
+            and self.chooser.dictionary_wanted(chunk.column)
             and self._device_eligible(chunk.values, chunk.column.leaf.physical_type)
         ]
         opts = self.options
@@ -806,6 +818,12 @@ class TpuChunkEncoder(NativeChunkEncoder):
                 return delta_binary_packed_device(values, bit_size)
             if encoding == Encoding.DELTA_LENGTH_BYTE_ARRAY:
                 return delta_length_byte_array_device(values)
+            if (encoding == Encoding.BYTE_STREAM_SPLIT
+                    and pt in enc._PLAIN_DTYPES):
+                # coerce to the PLAIN dtype first, like the native route —
+                # the transpose must see the on-wire value bytes
+                return byte_stream_split_device(
+                    np.ascontiguousarray(values, enc._PLAIN_DTYPES[pt]))
         return super()._values_body(values, pt, encoding)
 
     def _planned_body(self, chunk, va: int, vb: int) -> bytes | None:

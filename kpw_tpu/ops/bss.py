@@ -1,0 +1,49 @@
+"""BYTE_STREAM_SPLIT on the device — the byte-plane transpose as one jit.
+
+The encoding is a pure data-movement transform: the K byte planes of N
+K-byte values, concatenated (plane j holds byte j of every value in
+order).  On device that is a (N, K) uint8 reshape + transpose, which XLA
+lowers to a vectorized copy — no arithmetic, so the win over the native
+host loop is purely bandwidth/overlap (the transpose rides the chip while
+the host assembles other pages).
+
+Byte-identity contract: output == kpw_tpu.core.encodings
+.byte_stream_split_encode(values, pt) for values already in the column's
+PLAIN dtype.  Inputs are padded to a power-of-two bucket (ops.packing
+.pad_bucket) so the jit cache stays bounded like the delta kernels
+(ops/delta.py); the pad tail is sliced off per plane on host.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .packing import pad_bucket
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def _bss_planes(flat_u8, width: int):
+    """(pad_n * width,) uint8 value bytes -> (width, pad_n) byte planes."""
+    return flat_u8.reshape(-1, width).T
+
+
+def byte_stream_split_device(values: np.ndarray) -> bytes:
+    """BYTE_STREAM_SPLIT body for ``values`` (already the column's PLAIN
+    dtype — caller coerces, exactly like the native route), transposed on
+    device.  Byte-identical to the numpy oracle."""
+    v = np.ascontiguousarray(values)
+    n, width = len(v), v.dtype.itemsize
+    if n == 0:
+        return b""
+    pad_n = pad_bucket(n)
+    flat = np.zeros(pad_n * width, np.uint8)
+    flat[: n * width] = v.view(np.uint8).reshape(-1)
+    planes = np.asarray(jax.device_get(_bss_planes(jnp.asarray(flat), width)))
+    # drop the pad tail of every plane, keeping plane order (= the spec's
+    # plane-major concatenation)
+    return np.ascontiguousarray(planes[:, :n]).tobytes()

@@ -56,7 +56,11 @@ class Builder:
         self._consumer_config: dict | None = None  # KPW.java:627-631 analog
         self._filesystem_config: dict | None = None  # KPW.java:662-666 analog
         self._enable_dictionary = True  # (:489)
-        self._delta_fallback = False  # BASELINE config 3 opt-in
+        self._delta_fallback = False  # BASELINE config 3 opt-in (legacy)
+        # adaptive per-column encodings (core/select_encoding.py):
+        # stats-driven chooser pinned per file + explicit override map
+        self._adaptive_encodings = False
+        self._encodings: dict | None = None
         self._encoder_threads = 0  # native column-parallel encode (0 = auto)
         self._page_checksums = False  # parquet-mr 1.10 parity: no page CRCs
         # query-ready files (core/index.py): PARQUET-922 page indexes on
@@ -344,8 +348,38 @@ class Builder:
 
     def delta_fallback(self, flag: bool) -> "Builder":
         """Use DELTA_BINARY_PACKED / DELTA_LENGTH_BYTE_ARRAY instead of
-        PLAIN when a column's dictionary is rejected (high cardinality)."""
+        PLAIN when a column's dictionary is rejected (high cardinality).
+
+        LEGACY SPELLING: since the adaptive-encoding chooser landed
+        (core/select_encoding.py) this is a forced per-type override rule
+        inside it, kept for back-compat (same bytes as before).  Prefer
+        :meth:`encodings` — ``adaptive=True`` for the stats-driven
+        chooser, or an explicit per-column map."""
         self._delta_fallback = flag
+        return self
+
+    def encodings(self, mapping: dict | None = None, *,
+                  adaptive: bool | None = None) -> "Builder":
+        """Per-column value encodings (core/select_encoding.py).
+
+        ``mapping`` pins columns explicitly: ``{column_name_or_dotted_path:
+        Encoding-or-name}`` — e.g. ``{"price": "byte_stream_split",
+        "seq": Encoding.DELTA_BINARY_PACKED}``.  A pinned column skips the
+        dictionary attempt entirely.  ``adaptive=True`` turns on the
+        stats-driven chooser for everything else: the first row group's
+        observed stats (cardinality, delta width, value width, null
+        density) pick among PLAIN / dictionary / DELTA_BINARY_PACKED /
+        DELTA_LENGTH_BYTE_ARRAY / BYTE_STREAM_SPLIT, and the decision is
+        pinned for the rest of the file (reader coherence).  Encoding
+        values validate here; column names validate against the proto
+        schema at :meth:`build` (like sort_order / bloom_filters)."""
+        if mapping is not None:
+            from ..core.select_encoding import _normalize_overrides
+
+            mapping = _normalize_overrides(mapping)  # raises on bad values
+        self._encodings = mapping
+        if adaptive is not None:
+            self._adaptive_encodings = bool(adaptive)
         return self
 
     def encoder_threads(self, n: int) -> "Builder":
@@ -961,7 +995,7 @@ class Builder:
         # inside every worker's background file-open (a supervised
         # restart storm, not a config error), and a misspelled pinned
         # bloom column would silently never match any chunk
-        if self._sorting_columns or self._bloom_columns:
+        if self._sorting_columns or self._bloom_columns or self._encodings:
             from ..models.proto_bridge import proto_to_schema
 
             cols = proto_to_schema(self._proto_class).columns
@@ -976,6 +1010,11 @@ class Builder:
                 if name not in names:
                     raise ValueError(
                         f"bloom_filters column {name!r} is not a schema "
+                        f"leaf (have {sorted(names)})")
+            for name in (self._encodings or ()):
+                if name not in names:
+                    raise ValueError(
+                        f"encodings column {name!r} is not a schema "
                         f"leaf (have {sorted(names)})")
         if self._group_id is None:
             # reference default group id pattern (KPW.java:158)
@@ -1029,6 +1068,8 @@ class Builder:
             compression_level=self._compression_level,
             enable_dictionary=self._enable_dictionary,
             delta_fallback=self._delta_fallback,
+            adaptive_encodings=self._adaptive_encodings,
+            encodings=self._encodings,
             encoder_threads=self._encoder_threads,
             page_checksums=self._page_checksums,
             write_page_index=self._page_index,

@@ -166,6 +166,15 @@ class ParquetFile:
         meters."""
         return self._writer.index_info()
 
+    def encoding_info(self) -> dict:
+        """Per-column value-encoding decisions of the underlying writer
+        (core/select_encoding.py): dotted path -> chosen encoding,
+        dictionary verdict, trigger reason and the row-group-1 stats.
+        Per-FILE by construction — the writer resets the chooser's pins
+        at open even when a custom Builder backend shares one encoder
+        across rotated files."""
+        return self._writer.encoding_info()
+
     def assembly_info(self) -> dict:
         """Nogil-assembly counters for THIS file (chunks/pages assembled
         by the GIL-released native call) — the worker's publish path reads

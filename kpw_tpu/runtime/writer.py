@@ -27,6 +27,7 @@ import threading
 import time
 from datetime import datetime
 
+from ..core.select_encoding import encoding_name
 from ..core.writer import PipelineError
 from ..io.compact import Compactor
 from ..io.fs import publish_file
@@ -215,6 +216,10 @@ class KafkaProtoParquetWriter:
                                    if reg else M.Meter())
         self._native_asm_pages = (reg.meter(M.NATIVE_ASM_PAGES_METER)
                                   if reg else M.Meter())
+        # adaptive-encoding observability: the most recent published
+        # file's per-column chooser decisions (core/select_encoding.py) —
+        # dotted path -> chosen encoding + trigger stats, per-file pinned
+        self._last_encoding_info: dict = {}
         self._verified = reg.meter(M.VERIFIED_METER) if reg else M.Meter()
         self._verify_failed = (reg.meter(M.VERIFY_FAILED_METER)
                                if reg else M.Meter())
@@ -896,6 +901,16 @@ class KafkaProtoParquetWriter:
             "files_indexed": self._indexed.count,
             "bloom_bytes": self._bloom_bytes_meter.count,
         }
+        # adaptive-encoding block always (same rationale: "everything
+        # stayed PLAIN/dictionary" is itself evidence): the chooser config
+        # plus the most recent published file's per-column decisions
+        out["encodings"] = {
+            "adaptive": self.properties.adaptive_encodings,
+            "overrides": {k: encoding_name(v) for k, v in
+                          (self.properties.encodings or {}).items()},
+            "delta_fallback": self.properties.delta_fallback,  # legacy
+            "last_file": self._last_encoding_info,
+        }
         out["partitions"] = {
             "enabled": self.partitioner is not None,
             "max_open_per_worker": b._max_open_partitions,
@@ -1425,6 +1440,10 @@ class _Worker:
         if asm.get("native_chunks"):
             self.p._native_asm_chunks.mark(asm["native_chunks"])
             self.p._native_asm_pages.mark(asm["native_pages"])
+        einfo = f.encoding_info()
+        if einfo:
+            # last published file's chooser decisions (stats()["encodings"])
+            self.p._last_encoding_info = einfo
 
     def _maybe_ack_all(self) -> None:
         """Commit the held offset runs iff NO open file still holds

@@ -1,0 +1,14 @@
+"""Fixture: an Encoding literal CHOSEN outside core/select_encoding.py
+(assignment, not dispatch) — must trip encoding-choice and nothing else."""
+
+
+class Encoding:
+    PLAIN = 0
+    DELTA_BINARY_PACKED = 5
+
+
+def pick(encoding):
+    if encoding == Encoding.PLAIN:  # dispatch: allowed
+        return encoding
+    chosen = Encoding.DELTA_BINARY_PACKED  # a second decision point: finding
+    return chosen

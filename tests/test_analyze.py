@@ -31,6 +31,7 @@ FIXTURE_EXPECT = {
     "unhandled_tag.py": "protocol-exhaustiveness",
     "unforwarded_capability.py": "protocol-exhaustiveness",
     "wallclock_watchdog.py": "clock-discipline",
+    "encoding_literal.py": "encoding-choice",
 }
 
 
@@ -123,7 +124,7 @@ def test_pass_registry_matches_modules():
         "lock-discipline", "hot-imports", "canonical-names",
         "fault-isolation", "swallowed-exceptions", "spawn-safety",
         "resource-pairing", "protocol-exhaustiveness",
-        "clock-discipline"}
+        "clock-discipline", "encoding-choice"}
 
 
 def test_hotimport_allowlist_entries_all_justified():

@@ -1,0 +1,483 @@
+"""Adaptive per-column encodings (ISSUE 16): chooser rules, the
+BYTE_STREAM_SPLIT encoding end to end, per-file pin coherence, and the
+override surface — with pyarrow as the independent read-back oracle and
+cross-backend byte-identity as the internal one."""
+
+import io
+import json
+
+import numpy as np
+import pyarrow.parquet as pq
+import pytest
+
+from kpw_tpu.core import (
+    Codec,
+    ParquetFileWriter,
+    Repetition,
+    Schema,
+    WriterProperties,
+    columns_from_arrays,
+    leaf,
+)
+from kpw_tpu.core.pages import CpuChunkEncoder
+from kpw_tpu.core.schema import Encoding, PhysicalType
+from kpw_tpu.core.select_encoding import (
+    EncodingChooser,
+    _normalize_overrides,
+    encoding_name,
+)
+from kpw_tpu.native.encoder import NativeChunkEncoder
+
+
+def _write(schema, arrays, props, encoder=None):
+    sink = io.BytesIO()
+    w = ParquetFileWriter(sink, schema, props, encoder=encoder)
+    w.write_batch(columns_from_arrays(schema, arrays))
+    w.close()
+    return sink.getvalue()
+
+
+def _column_encodings(blob, col_idx):
+    """Footer-declared encodings for one column, per row group."""
+    meta = pq.read_metadata(io.BytesIO(blob))
+    return [set(meta.row_group(rg).column(col_idx).encodings)
+            for rg in range(meta.num_row_groups)]
+
+
+# ---------------------------------------------------------------------------
+# BYTE_STREAM_SPLIT: oracle roundtrip + native + device byte-identity
+# ---------------------------------------------------------------------------
+
+_BSS_TYPES = {
+    np.float32: PhysicalType.FLOAT,
+    np.float64: PhysicalType.DOUBLE,
+    np.int32: PhysicalType.INT32,
+    np.int64: PhysicalType.INT64,
+}
+
+
+def _bss_values(rng, dtype, n):
+    if np.issubdtype(dtype, np.floating):
+        return rng.standard_normal(n).astype(dtype)
+    return rng.integers(-(1 << 30), 1 << 30, n).astype(dtype)
+
+
+@pytest.mark.parametrize("dtype", sorted(_BSS_TYPES, key=str))
+@pytest.mark.parametrize("n", [0, 1, 7, 255, 4096])
+def test_bss_oracle_roundtrip(dtype, n):
+    from kpw_tpu.core import encodings as enc
+
+    pt = _BSS_TYPES[dtype]
+    vals = _bss_values(np.random.default_rng(5), dtype, n)
+    blob = enc.byte_stream_split_encode(vals, pt)
+    assert len(blob) == vals.nbytes  # same byte count as PLAIN
+    np.testing.assert_array_equal(enc.byte_stream_split_decode(blob, pt),
+                                  vals)
+
+
+@pytest.mark.parametrize("dtype", sorted(_BSS_TYPES, key=str))
+@pytest.mark.parametrize("n", [0, 1, 7, 255, 5000])
+def test_bss_native_and_device_byte_identical(dtype, n):
+    """ctypes kpw_byte_stream_split and the jitted device transpose must
+    both reproduce the Python oracle's exact bytes."""
+    from kpw_tpu.core import encodings as enc
+    from kpw_tpu.native.build import load
+    from kpw_tpu.ops.bss import byte_stream_split_device
+
+    vals = _bss_values(np.random.default_rng(6), dtype, n)
+    want = enc.byte_stream_split_encode(vals, _BSS_TYPES[dtype])
+    assert load().byte_stream_split(vals) == want
+    assert byte_stream_split_device(vals) == want
+
+
+# ---------------------------------------------------------------------------
+# encoding x shape x codec read-back matrix (pyarrow oracle)
+# ---------------------------------------------------------------------------
+
+# (encoding to force, leaf type, value factory) — dictionary rides the
+# default path (acceptance mechanism, not forceable)
+_MATRIX = {
+    "PLAIN": ("int64", lambda rng, n:
+              rng.integers(-(1 << 40), 1 << 40, n).astype(np.int64)),
+    "DELTA_BINARY_PACKED": ("int64", lambda rng, n:
+                            np.cumsum(rng.integers(0, 9, n)).astype(np.int64)),
+    "DELTA_LENGTH_BYTE_ARRAY": ("string", lambda rng, n:
+                                [b"v-%d" % v for v in
+                                 rng.integers(0, 1 << 30, n)]),
+    "BYTE_STREAM_SPLIT": ("double", lambda rng, n:
+                          np.cumsum(rng.standard_normal(n) * 0.25) + 100.0),
+}
+
+
+@pytest.mark.parametrize("codec", [Codec.UNCOMPRESSED, Codec.SNAPPY])
+@pytest.mark.parametrize("shape", ["flat", "nulls", "empty", "tiny_pages"])
+@pytest.mark.parametrize("encoding", sorted(_MATRIX))
+def test_encoding_matrix_readback(encoding, shape, codec):
+    type_name, make = _MATRIX[encoding]
+    rng = np.random.default_rng(16)
+    n = 0 if shape == "empty" else 3000
+    vals = make(rng, n)
+    rep = Repetition.OPTIONAL if shape == "nulls" else Repetition.REQUIRED
+    schema = Schema([leaf("x", type_name, rep)])
+    if shape == "nulls":
+        valid = rng.random(n) > 0.3
+        arrays = {"x": (np.asarray(vals) if type_name != "string" else vals,
+                        valid)}
+    else:
+        arrays = {"x": vals}
+    props = WriterProperties(
+        codec=codec,
+        data_page_size=512 if shape == "tiny_pages" else 1024 * 1024,
+        encodings=None if encoding == "PLAIN" else {"x": encoding},
+        enable_dictionary=encoding != "PLAIN")
+    blob = _write(schema, arrays, props)
+    table = pq.read_table(io.BytesIO(blob))
+    got = table["x"].to_pylist()
+    if shape == "nulls":
+        want = [v if ok else None for v, ok in zip(vals, valid)]
+    else:
+        want = list(vals)
+    norm = (lambda v: v.encode() if isinstance(v, str) else v) \
+        if type_name == "string" else (lambda v: v)
+    assert [norm(g) for g in got] == [None if w is None else norm(w)
+                                      for w in want]
+    if shape != "empty":
+        for rg_encodings in _column_encodings(blob, 0):
+            assert encoding in rg_encodings
+
+
+def test_nested_adaptive_readback():
+    """list<struct> leaves route through the nested shredder; adaptive
+    choices there must still read back value-exact."""
+    import sys
+    sys.path.insert(0, __file__.rsplit("/", 1)[0])
+    from proto_helpers import nested_message_classes
+
+    from kpw_tpu.models.proto_bridge import ProtoColumnarizer
+
+    cls = nested_message_classes()
+    col = ProtoColumnarizer(cls)
+    rng = np.random.default_rng(8)
+    msgs = []
+    for i in range(500):
+        m = cls()
+        m.order_id = i * 3
+        for j in range(int(rng.integers(0, 4))):
+            it = m.items.add()
+            it.sku = f"sku{int(rng.integers(0, 1 << 20))}"
+            it.qty = int(rng.integers(1, 100))
+        msgs.append(m)
+    props = WriterProperties(codec=Codec.SNAPPY, adaptive_encodings=True)
+    sink = io.BytesIO()
+    w = ParquetFileWriter(sink, col.schema, props)
+    w.write_batch(col.columnarize(msgs))
+    w.close()
+    table = pq.read_table(io.BytesIO(sink.getvalue()))
+    assert table["order_id"].to_pylist() == [m.order_id for m in msgs]
+    got_items = table["items"].to_pylist()
+    for m, items in zip(msgs, got_items):
+        want = [{"sku": it.sku, "qty": it.qty, "tags": []}
+                for it in m.items]
+        got = [{"sku": d["sku"], "qty": d["qty"],
+                "tags": d.get("tags") or []} for d in (items or [])]
+        assert got == want
+    # monotone order_id must have triggered the delta rule
+    info = json.loads(dict(pq.read_metadata(io.BytesIO(sink.getvalue()))
+                           .metadata)[b"kpw.encoding_decisions"])
+    assert info["order_id"]["value_encoding"] == "DELTA_BINARY_PACKED"
+
+
+# ---------------------------------------------------------------------------
+# chooser unit rules
+# ---------------------------------------------------------------------------
+
+
+def _chunk(type_name, vals):
+    schema = Schema([leaf("c", type_name)])
+    return columns_from_arrays(schema, {"c": vals}).chunks[0]
+
+
+def _chooser(**props):
+    return EncodingChooser(WriterProperties(**props).encoder_options())
+
+
+def test_chooser_monotone_ints_pick_delta():
+    ch = _chooser(adaptive_encodings=True)
+    chunk = _chunk("int64", np.cumsum(np.ones(1000, np.int64)))
+    d = ch.choose(chunk, PhysicalType.INT64, dict_accepted=False,
+                  dict_size=None)
+    assert d.value_encoding == Encoding.DELTA_BINARY_PACKED
+    assert d.pinned and d.stats["monotone"]
+    assert "cardinality" not in d.stats  # rejected build: backend-dependent
+
+
+def test_chooser_wide_random_ints_stay_plain():
+    rng = np.random.default_rng(9)
+    ch = _chooser(adaptive_encodings=True)
+    chunk = _chunk("int64", rng.integers(-(1 << 62), 1 << 62, 1000))
+    d = ch.choose(chunk, PhysicalType.INT64, dict_accepted=False,
+                  dict_size=None)
+    assert d.value_encoding == Encoding.PLAIN
+    assert d.reason == "wide-deltas"
+
+
+def test_chooser_floats_bss_only_under_codec():
+    vals = np.random.default_rng(10).standard_normal(100)
+    snappy = _chooser(adaptive_encodings=True, codec=Codec.SNAPPY)
+    d = snappy.choose(_chunk("double", vals), PhysicalType.DOUBLE,
+                      dict_accepted=False, dict_size=None)
+    assert d.value_encoding == Encoding.BYTE_STREAM_SPLIT
+    raw = _chooser(adaptive_encodings=True)
+    d = raw.choose(_chunk("double", vals), PhysicalType.DOUBLE,
+                   dict_accepted=False, dict_size=None)
+    assert d.value_encoding == Encoding.PLAIN  # same bytes as PLAIN: no win
+
+
+def test_chooser_byte_arrays_pick_delta_length():
+    ch = _chooser(adaptive_encodings=True)
+    vals = [b"x-%d" % i for i in range(64)]
+    d = ch.choose(_chunk("string", vals), PhysicalType.BYTE_ARRAY,
+                  dict_accepted=False, dict_size=None)
+    assert d.value_encoding == Encoding.DELTA_LENGTH_BYTE_ARRAY
+
+
+def test_chooser_accepted_dictionary_keeps_dict_and_cardinality():
+    ch = _chooser(adaptive_encodings=True)
+    chunk = _chunk("int64", np.arange(1000, dtype=np.int64) % 4)
+    d = ch.choose(chunk, PhysicalType.INT64, dict_accepted=True, dict_size=4)
+    assert d.use_dictionary and d.stats["cardinality"] == 4
+
+
+def test_chooser_tiny_rg1_pins_default_keeps_dict_open():
+    ch = _chooser(adaptive_encodings=True)
+    d = ch.choose(_chunk("int64", np.arange(3, dtype=np.int64)),
+                  PhysicalType.INT64, dict_accepted=False, dict_size=None)
+    assert d.reason == "rg1-too-small"
+    assert d.value_encoding == Encoding.PLAIN and d.use_dictionary
+
+
+def test_chooser_override_beats_adaptive_and_bans_dict():
+    ch = _chooser(adaptive_encodings=True,
+                  encodings={"c": "BYTE_STREAM_SPLIT"})
+    chunk = _chunk("double", np.ones(100))
+    d = ch.choose(chunk, PhysicalType.DOUBLE, dict_accepted=True,
+                  dict_size=1)
+    assert d.value_encoding == Encoding.BYTE_STREAM_SPLIT
+    assert d.reason == "override" and not d.use_dictionary
+    assert not ch.dictionary_wanted(chunk.column)
+
+
+def test_chooser_delta_fallback_legacy_spelling():
+    ch = _chooser(delta_fallback=True)
+    assert ch.static_value_encoding(PhysicalType.INT64) \
+        == Encoding.DELTA_BINARY_PACKED
+    assert ch.static_value_encoding(PhysicalType.BYTE_ARRAY) \
+        == Encoding.DELTA_LENGTH_BYTE_ARRAY
+    assert ch.static_value_encoding(PhysicalType.DOUBLE) == Encoding.PLAIN
+
+
+def test_override_invalid_for_type_raises():
+    ch = _chooser(encodings={"c": "DELTA_BINARY_PACKED"})
+    with pytest.raises(ValueError, match="not valid for column"):
+        ch.peek(_chunk("string", [b"a"]).column)
+
+
+def test_normalize_overrides_rejects_dict_family_and_unknown():
+    with pytest.raises(ValueError, match="unknown encoding name"):
+        _normalize_overrides({"x": "NOT_AN_ENCODING"})
+    with pytest.raises(ValueError, match="cannot be forced"):
+        _normalize_overrides({"x": "RLE_DICTIONARY"})
+    assert _normalize_overrides({"x": "byte_stream_split"}) \
+        == {"x": Encoding.BYTE_STREAM_SPLIT}
+
+
+# ---------------------------------------------------------------------------
+# per-file pin coherence
+# ---------------------------------------------------------------------------
+
+
+def test_pin_never_flips_after_rg1():
+    """Row group 1 pins DELTA off monotone data; row group 2's wide-random
+    values MUST keep the pin (reader coherence) even though a fresh
+    decision would have picked PLAIN."""
+    rng = np.random.default_rng(11)
+    schema = Schema([leaf("x", "int64")])
+    props = WriterProperties(adaptive_encodings=True,
+                             enable_dictionary=False)
+    sink = io.BytesIO()
+    w = ParquetFileWriter(sink, schema, props)
+    mono = np.cumsum(rng.integers(0, 5, 4000)).astype(np.int64)
+    wide = rng.integers(-(1 << 62), 1 << 62, 4000).astype(np.int64)
+    w.write_batch(columns_from_arrays(schema, {"x": mono}))
+    w.flush_row_group()
+    w.write_batch(columns_from_arrays(schema, {"x": wide}))
+    w.close()
+    blob = sink.getvalue()
+    per_rg = _column_encodings(blob, 0)
+    assert len(per_rg) == 2 and per_rg[0] == per_rg[1]
+    assert "DELTA_BINARY_PACKED" in per_rg[0]
+    table = pq.read_table(io.BytesIO(blob))
+    np.testing.assert_array_equal(
+        table["x"].to_numpy(), np.concatenate([mono, wide]))
+
+
+def test_begin_file_resets_pins_for_shared_encoder():
+    """A custom Builder backend hands ONE encoder to every rotated file:
+    each ParquetFileWriter must re-decide from its own row group 1."""
+    schema = Schema([leaf("x", "int64")])
+    props = WriterProperties(adaptive_encodings=True,
+                             enable_dictionary=False)
+    enc = CpuChunkEncoder(props.encoder_options())
+    blobs = {}
+    for name, vals in [
+            ("mono", np.cumsum(np.ones(4000, np.int64))),
+            ("wide", np.random.default_rng(12).integers(
+                -(1 << 62), 1 << 62, 4000).astype(np.int64))]:
+        sink = io.BytesIO()
+        w = ParquetFileWriter(sink, schema, props, encoder=enc)
+        w.write_batch(columns_from_arrays(schema, {"x": vals}))
+        w.close()
+        blobs[name] = sink.getvalue()
+    assert "DELTA_BINARY_PACKED" in _column_encodings(blobs["mono"], 0)[0]
+    assert "DELTA_BINARY_PACKED" not in _column_encodings(blobs["wide"], 0)[0]
+
+
+def test_footer_kv_present_only_when_chooser_active():
+    schema = Schema([leaf("x", "int64")])
+    vals = np.arange(100, dtype=np.int64)
+    adaptive = _write(schema, {"x": vals},
+                      WriterProperties(adaptive_encodings=True))
+    default = _write(schema, {"x": vals}, WriterProperties())
+    kv_a = dict(pq.read_metadata(io.BytesIO(adaptive)).metadata or {})
+    kv_d = dict(pq.read_metadata(io.BytesIO(default)).metadata or {})
+    info = json.loads(kv_a[b"kpw.encoding_decisions"])
+    assert info["x"]["pinned"] and "reason" in info["x"]
+    assert b"kpw.encoding_decisions" not in kv_d
+
+
+# ---------------------------------------------------------------------------
+# cross-backend byte-identity (cpu / native / device, +kOpBss route)
+# ---------------------------------------------------------------------------
+
+
+def _telemetry_arrays(rng, n=6000):
+    return {
+        "seq": np.cumsum(rng.integers(1, 4, n)).astype(np.int64),
+        "price": np.cumsum(rng.standard_normal(n) * 0.25) + 100.0,
+        "uid": [b"u%09d" % v for v in rng.integers(0, 1 << 30, n)],
+    }
+
+
+def test_adaptive_file_bytes_identical_across_backends():
+    from kpw_tpu.ops import TpuChunkEncoder
+
+    rng = np.random.default_rng(13)
+    schema = Schema([leaf("seq", "int64"), leaf("price", "double"),
+                     leaf("uid", "string")])
+    arrays = _telemetry_arrays(rng)
+    blobs = {}
+    for name, native_asm in [("cpu", False), ("native", False),
+                             ("native+asm", True), ("tpu", False)]:
+        props = WriterProperties(codec=Codec.SNAPPY, adaptive_encodings=True,
+                                 native_assembly=native_asm)
+        opts = props.encoder_options()
+        enc = {"cpu": lambda: CpuChunkEncoder(opts),
+               "native": lambda: NativeChunkEncoder(opts),
+               "native+asm": lambda: NativeChunkEncoder(opts),
+               "tpu": lambda: TpuChunkEncoder(opts, min_device_rows=1),
+               }[name]()
+        blobs[name] = _write(schema, arrays, props, encoder=enc)
+        if name == "native+asm":
+            assert enc.native_asm_chunks > 0  # kOpBss route engaged
+    ref = blobs["cpu"]
+    for name, blob in blobs.items():
+        assert blob == ref, f"adaptive file bytes diverged for {name}"
+    # the adaptive file must actually carry the new encodings
+    meta = pq.read_metadata(io.BytesIO(ref))
+    declared = set()
+    for rg in range(meta.num_row_groups):
+        for c in range(meta.num_columns):
+            declared |= set(meta.row_group(rg).column(c).encodings)
+    assert {"DELTA_BINARY_PACKED", "BYTE_STREAM_SPLIT",
+            "DELTA_LENGTH_BYTE_ARRAY"} <= declared
+    table = pq.read_table(io.BytesIO(ref))
+    np.testing.assert_array_equal(table["seq"].to_numpy(), arrays["seq"])
+    np.testing.assert_array_equal(table["price"].to_numpy(), arrays["price"])
+    assert [u.encode() if isinstance(u, str) else u
+            for u in table["uid"].to_pylist()] == arrays["uid"]
+
+
+def test_default_path_bytes_unchanged_by_chooser_plumbing():
+    """adaptive off + no overrides must stay byte-identical to the
+    delta_fallback spelling of the same rules (the legacy config is now a
+    forced override INSIDE the chooser — same file, one decision point)."""
+    rng = np.random.default_rng(14)
+    schema = Schema([leaf("a", "int64"), leaf("s", "string")])
+    arrays = {"a": np.cumsum(rng.integers(0, 7, 3000)).astype(np.int64),
+              "s": [b"k-%d" % v for v in rng.integers(0, 1 << 28, 3000)]}
+    legacy = _write(schema, arrays, WriterProperties(
+        delta_fallback=True, enable_dictionary=False))
+    forced = _write(schema, arrays, WriterProperties(
+        enable_dictionary=False,
+        encodings={"a": "DELTA_BINARY_PACKED",
+                   "s": "DELTA_LENGTH_BYTE_ARRAY"}))
+    # same pages, same encodings — only the footer kv (decision report)
+    # differs, and only the override file carries it
+    assert len(_column_encodings(legacy, 0)) == 1
+    assert _column_encodings(legacy, 0) == _column_encodings(forced, 0)
+    assert _column_encodings(legacy, 1) == _column_encodings(forced, 1)
+    t_legacy = pq.read_table(io.BytesIO(legacy))
+    t_forced = pq.read_table(io.BytesIO(forced))
+    assert t_legacy.equals(t_forced)
+
+
+# ---------------------------------------------------------------------------
+# Builder surface validation
+# ---------------------------------------------------------------------------
+
+
+def test_builder_encodings_validation():
+    import sys
+    sys.path.insert(0, __file__.rsplit("/", 1)[0])
+    from proto_helpers import sample_message_class
+
+    from kpw_tpu import Builder, FakeBroker, MemoryFileSystem
+
+    cls = sample_message_class()
+    with pytest.raises(ValueError, match="unknown encoding name"):
+        Builder().encodings({"timestamp": "bogus"})
+    with pytest.raises(ValueError, match="cannot be forced"):
+        Builder().encodings({"timestamp": "RLE_DICTIONARY"})
+    b = (Builder().broker(FakeBroker()).topic("t").proto_class(cls)
+         .target_dir("/out").filesystem(MemoryFileSystem())
+         .group_id("g-enc").instance_name("enc-validate")
+         .encodings({"no_such_column": "PLAIN"}))
+    with pytest.raises(ValueError, match="encodings column"):
+        b.build()
+
+
+def test_writer_stats_surface_encodings():
+    import sys
+    sys.path.insert(0, __file__.rsplit("/", 1)[0])
+    from proto_helpers import sample_message_class
+
+    from kpw_tpu import Builder, FakeBroker, MemoryFileSystem
+
+    cls = sample_message_class()
+    broker = FakeBroker()
+    for i in range(50):
+        broker.produce("t", cls(query=f"q{i}", timestamp=i,
+                                page_number=i % 3).SerializeToString())
+    w = (Builder().broker(broker).topic("t").proto_class(cls)
+         .target_dir("/out").filesystem(MemoryFileSystem())
+         .group_id("g-stats").instance_name("enc-stats")
+         .encodings({"timestamp": "DELTA_BINARY_PACKED"}, adaptive=True)
+         .build())
+    try:
+        st = w.stats()
+        assert st["encodings"]["adaptive"] is True
+        assert st["encodings"]["overrides"] == {
+            "timestamp": "DELTA_BINARY_PACKED"}
+        assert st["encodings"]["delta_fallback"] is False
+    finally:
+        w.close()

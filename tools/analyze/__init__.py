@@ -33,6 +33,9 @@ Passes (see each module's docstring for the rule and its history):
   publish capability explicitly (tools/analyze/protocol.py)
 * ``clock-discipline`` — heartbeat/watchdog/deadline code uses
   time.monotonic, never the wall clock (tools/analyze/clocks.py)
+* ``encoding-choice`` — value encodings are chosen only in
+  core/select_encoding.py; ``Encoding.`` literals elsewhere must be
+  comparisons or annotated mechanism sites (tools/analyze/encchoice.py)
 
 Suppression is per-site and justified: ``# lint: <pass> ok — <reason>``
 on the flagged line or the line above.  A reason-less annotation is
@@ -45,8 +48,8 @@ static passes lint).
 
 from __future__ import annotations
 
-from . import (clocks, faultiso, hotimports, locks, names, protocol,
-               respair, spawnsafety, swallow)
+from . import (clocks, encchoice, faultiso, hotimports, locks, names,
+               protocol, respair, spawnsafety, swallow)
 
 # registration order = report order
 PASSES = {
@@ -59,6 +62,7 @@ PASSES = {
     respair.PASS_NAME: respair,
     protocol.PASS_NAME: protocol,
     clocks.PASS_NAME: clocks,
+    encchoice.PASS_NAME: encchoice,
 }
 
 PASS_NAMES = tuple(PASSES)

@@ -1,0 +1,79 @@
+"""Encoding-choice pass: a value encoding is CHOSEN in exactly one
+place — ``kpw_tpu/core/select_encoding.py`` (the ISSUE 16 chooser).
+
+Before the chooser, the ``delta_fallback`` rule lived in
+``CpuChunkEncoder`` and each backend re-derived it; a second decision
+point is exactly how the native path once diverged from the CPU oracle
+by one encoding id.  This pass keeps the funnel closed: an
+``Encoding.<NAME>`` literal in the production tree is a finding unless
+it is *dispatch* (a comparison against an already-chosen encoding —
+``if encoding == Encoding.DELTA_BINARY_PACKED``, membership tests over
+literal tuples) or it lives in the chooser / the enum's own module.
+Everything else — assigning an encoding, passing one to a header
+composer, seeding a footer set — is either a real second decision point
+or one of the sanctioned *mechanism* sites (dictionary acceptance, page
+header fields, footer encoding lists), which carry per-site
+``# lint: encoding-choice ok — <reason>`` annotations so a reviewer can
+see the full closed list.
+
+Scope: the production tree (full-repo runs) minus the chooser and
+``core/schema.py`` (the enum definition).  Fixture / single-file runs
+lint whatever file they are given, same exemptions.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .common import Config, Finding, ParsedFile, suppressed
+
+PASS_NAME = "encoding-choice"
+DESCRIPTION = ("Encoding.<NAME> literals outside comparisons are value-"
+               "encoding choices — allowed only in core/select_encoding.py "
+               "or under a justified annotation")
+
+# the one decision point + the enum definition itself
+_EXEMPT = frozenset({
+    "kpw_tpu/core/select_encoding.py",
+    "kpw_tpu/core/schema.py",
+})
+
+
+def _is_dispatch(node: ast.AST, parents: dict) -> bool:
+    """True when the literal is a comparison operand (directly, or inside
+    a literal tuple/set/list operand: ``enc in (Encoding.A, Encoding.B)``)
+    — reading an already-made decision, not making one."""
+    child = node
+    parent = parents.get(child)
+    while isinstance(parent, (ast.Tuple, ast.Set, ast.List)):
+        child = parent
+        parent = parents.get(child)
+    if isinstance(parent, ast.Compare):
+        return child is parent.left or child in parent.comparators
+    return False
+
+
+def run(files: dict[str, ParsedFile], cfg: Config) -> list[Finding]:
+    findings: list[Finding] = []
+    for pf in files.values():
+        if pf.path in _EXEMPT:
+            continue
+        parents = {c: p for p in ast.walk(pf.tree)
+                   for c in ast.iter_child_nodes(p)}
+        for node in ast.walk(pf.tree):
+            if not (isinstance(node, ast.Attribute)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == "Encoding"
+                    and isinstance(node.ctx, ast.Load)):
+                continue
+            if _is_dispatch(node, parents):
+                continue
+            if suppressed(pf, PASS_NAME, node.lineno, findings):
+                continue
+            findings.append(Finding(
+                PASS_NAME, pf.path, node.lineno,
+                f"Encoding.{node.attr} used outside a comparison — value "
+                f"encodings are chosen ONLY in core/select_encoding.py "
+                f"(a second decision point is how backends drift); "
+                f"mechanism sites need a justified annotation"))
+    return findings

@@ -248,6 +248,27 @@ CHECKS = [
     ("PARITY.md", r"`sibling_worker_deaths` \*\*(\d+)\*\* across\s+"
                   r"\*\*(\d+)\*\* tenants",
      ["tenants:containment.sibling_worker_deaths", "tenants:tenants"]),
+    # adaptive-encodings artifact (`encodings:` prefix,
+    # BENCH_ENCODINGS_r20.json)
+    ("README.md", r"Adaptive lands at \*\*([\d.]+)×\*\* the default\s+"
+                  r"arm's file bytes \(a \*\*([\d.]+)%\*\* reduction",
+     ["encodings:file_bytes_ratio_adaptive_vs_default",
+      "encodings:bytes_reduction_vs_default_pct"]),
+    ("README.md", r"and \*\*([\d.]+)×\*\* all-PLAIN, at \*\*([\d.]+)×\*\* "
+                  r"the default arm's write\s+throughput",
+     ["encodings:file_bytes_ratio_adaptive_vs_plain",
+      "encodings:write_throughput_ratio_adaptive_vs_default"]),
+    ("PARITY.md", r"`file_bytes_ratio_adaptive_vs_default` \*\*([\d.]+)\*\* "
+                  r"\(a\s+`bytes_reduction_vs_default_pct` of "
+                  r"\*\*([\d.]+)%\*\*\)",
+     ["encodings:file_bytes_ratio_adaptive_vs_default",
+      "encodings:bytes_reduction_vs_default_pct"]),
+    ("PARITY.md", r"`file_bytes_ratio_adaptive_vs_plain` \*\*([\d.]+)\*\*,"
+                  r"\s+with\s+"
+                  r"`write_throughput_ratio_adaptive_vs_default` "
+                  r"\*\*([\d.]+)\*\*",
+     ["encodings:file_bytes_ratio_adaptive_vs_plain",
+      "encodings:write_throughput_ratio_adaptive_vs_default"]),
 ]
 
 
@@ -642,6 +663,12 @@ def main() -> int:
         "KPW_TENANTS_PATH", os.path.join(ROOT, "BENCH_TENANTS_r19.json"))
     if os.path.exists(tenants_path):
         key_record["tenants"] = json.load(open(tenants_path))
+    # the adaptive-encodings artifact (bench.py --encodings) is the
+    # thirteenth
+    encodings_path = os.environ.get(
+        "KPW_ENCODINGS_PATH", os.path.join(ROOT, "BENCH_ENCODINGS_r20.json"))
+    if os.path.exists(encodings_path):
+        key_record["encodings"] = json.load(open(encodings_path))
     docs = {f: open(os.path.join(ROOT, f)).read()
             for f in ({c[0] for c in CHECKS} | set(KEY_DOCS)
                       | set(NAME_DOCS))}
@@ -680,6 +707,8 @@ def main() -> int:
                 root, spec = key_record.get("nested", {}), spec[7:]
             elif spec.startswith("tenants:"):
                 root, spec = key_record.get("tenants", {}), spec[8:]
+            elif spec.startswith("encodings:"):
+                root, spec = key_record.get("encodings", {}), spec[10:]
             try:
                 expect = float(art(root, spec)) / scale
             except (KeyError, TypeError):

@@ -466,10 +466,13 @@ def _make_assemble_plan():
     ba_offs = np.zeros(65, np.int64)
     np.cumsum(ba_lens, out=ba_offs[1:])
     ba_data = bytes(rng2.integers(0, 256, int(ba_offs[-1]), dtype=np.uint8))
+    # BYTE_STREAM_SPLIT op substrate (OP_KINDS >= 5): 128 doubles' bytes
+    bss_vals = np.ascontiguousarray(rng2.standard_normal(128), np.float64)
     buffers = (raw, idx, levels, values.view(np.uint8).tobytes(),
                DATA_PAGE_PREFIX, DICT_PAGE_PREFIX,
                data_page_suffix(256, 0, True), dict_page_suffix(16, 2, True),
-               run_vals, run_lens, ba_data, ba_offs)
+               run_vals, run_lens, ba_data, ba_offs,
+               bss_vals.view(np.uint8).tobytes())
     ops = np.array([
         [0, 0, 0, 700, 0],            # RAW whole buffer
         [1, 2, 0, 256, 1 | (2 << 8)],  # RLE levels, len32 mode
@@ -478,12 +481,14 @@ def _make_assemble_plan():
         [1, 1, 256, 512, 4 | (0 << 8)],  # RLE bare
         [2, 8, 0, 40, 2 | (2 << 8) | (9 << 16)],  # RLE-from-runs, len32
         [3, 10, 0, 64, 11 << 16],     # bytes-plain over the ByteColumn
+        [4, 12, 0, 128, 8],           # BYTE_STREAM_SPLIT, 8-byte values
     ], np.int64)
     pages = np.array([
         [0, 1, 5, 7, 1, 0, 0],    # dict-ish page: RAW body, CRC on
         [1, 3, 4, 6, 1, 0, 256],  # data page: levels+indices, stats range
         [3, 5, 4, 6, 0, 256, 512],
         [5, 7, 4, 6, 1, 0, 0],    # nested-shaped page: runs + bytes-plain
+        [7, 8, 4, 6, 1, 0, 0],    # BSS page: transposed byte planes
     ], np.int64)
     return asm, buffers, pages, ops, values
 
@@ -521,7 +526,7 @@ def fuzz_assemble(seed: int, iters: int, report) -> int:
                           for _ in range(rng.randint(1, 4))], np.int64)
         elif kind == 4:    # random op kinds/aux over valid ranges
             for r in range(o.shape[0]):
-                o[r, 0] = rng.randrange(-2, 6)  # incl. runs/bytes-plain
+                o[r, 0] = rng.randrange(-2, 7)  # incl. runs/bytes-plain/bss
                 o[r, 4] = rng.choice(adversarial)
         else:              # both tables perturbed
             p[rng.randrange(p.shape[0]), rng.randrange(7)] = rng.choice(

@@ -166,6 +166,14 @@ python -m pytest tests/test_nested_shred.py tests/test_nested_fused.py \
     -k "not writer_streams" \
     -q -p no:cacheprovider || rc=1
 
+# adaptive-encodings subset (ISSUE 16): the BYTE_STREAM_SPLIT
+# oracle/ctypes/device byte-identity matrix and the cross-backend
+# adaptive file pin run against the SANITIZED libs, so a transpose
+# stride bug traps as an ASan abort instead of shipping scrambled planes
+python -m pytest tests/test_encodings_adaptive.py \
+    -k "bss or backends" \
+    -q -p no:cacheprovider || rc=1
+
 # seeded mutation fuzz: thrift reader, verifier page walk, offset-table
 # validator — zero crashes/sanitizer findings required
 python -m tools.fuzz --seed "$SEED" --iters "$FUZZ_ITERS" || rc=1

@@ -4,9 +4,11 @@ ThreadSanitizer leg's workload (``tools/sanitize.sh --tsan``).
 The repo's native hot path deliberately runs WITHOUT the GIL:
 ``shred_flat_buf``/``gather_buf`` (PR 6) decode broker buffers while the
 encode pipeline thread runs, ``assemble_pages`` (PR 10) assembles whole
-column chunks concurrently from the encoder pool, and the fused nested
-entries ``shred_nested_buf``/``nested_fill`` (ISSUE 14) decode and
-materialize list<struct> batches the same way.  A data race in that
+column chunks concurrently from the encoder pool (including the
+BYTE_STREAM_SPLIT transpose op, ISSUE 16), the fused nested entries
+``shred_nested_buf``/``nested_fill`` (ISSUE 14) decode and materialize
+list<struct> batches the same way, and ``kpw_byte_stream_split`` runs
+GIL-free under ctypes from every encoder thread.  A data race in that
 code is a real race no Python-level tool can see — so this driver
 hammers all of them from several true-concurrent threads against the
 ``KPW_NATIVE_SANITIZE=tsan`` build, where TSan traps any racy access
@@ -100,15 +102,38 @@ def _assemble_inputs():
     asm = load_assemble()
     body = bytes(range(1, 250)) * 8
     buffers = (body, DATA_PAGE_PREFIX, data_page_suffix(8, 0))
-    pages = np.array([[0, 1, 1, 2, 0, 0, 0]], np.int64)
-    ops = np.array([[0, 0, 0, len(body), 0]], np.int64)
-    return asm, buffers, pages, ops
+    pages = [[0, 1, 1, 2, 0, 0, 0]]
+    ops = [[0, 0, 0, len(body), 0]]
+    if getattr(asm, "OP_KINDS", 2) >= 5:
+        # BYTE_STREAM_SPLIT page (ISSUE 16): the kOpBss transpose walks
+        # a shared read-only value buffer from every worker
+        bss = np.ascontiguousarray(
+            np.random.default_rng(7).standard_normal(64), np.float64)
+        buffers = buffers + (bss.view(np.uint8).tobytes(),)
+        ops.append([4, 3, 0, 64, 8])
+        pages.append([1, 2, 1, 2, 0, 0, 0])
+    return asm, buffers, np.array(pages, np.int64), np.array(ops, np.int64)
+
+
+def _bss_inputs():
+    """One shared read-only float64 array for the GIL-free
+    ``kpw_byte_stream_split`` ctypes entry; each worker's output string
+    buffer is allocated inside the wrapper (thread-private)."""
+    from kpw_tpu.native.build import load
+
+    lib = load()
+    if not hasattr(lib, "byte_stream_split"):
+        return None, None
+    vals = np.ascontiguousarray(
+        np.random.default_rng(9).standard_normal(2048), np.float64)
+    return lib, vals
 
 
 def run(iters: int = DEFAULT_ITERS, threads: int = DEFAULT_THREADS) -> int:
     col, blob, offs, = _shred_inputs()
     ncol, nblob, noffs = _nested_inputs()
     asm, buffers, pages, ops = _assemble_inputs()
+    bss_lib, bss_vals = _bss_inputs()
 
     # reference outputs from the main thread: workers must reproduce
     # them bit-for-bit (a race that slips past TSan would still corrupt)
@@ -117,9 +142,10 @@ def run(iters: int = DEFAULT_ITERS, threads: int = DEFAULT_THREADS) -> int:
     nref = ncol.columnarize_buffer(nblob, noffs)
     nref_sku = bytes(memoryview(nref.chunks[1].values.data))
     nref_defs = np.asarray(nref.chunks[1].def_levels).tobytes()
-    ref_meta = np.zeros((1, 3), np.int64)
+    ref_meta = np.zeros((pages.shape[0], 3), np.int64)
     ref_out = asm.assemble_pages(buffers, pages, ops, 0, 3, None, 0,
                                  ref_meta, None, None)
+    ref_bss = bss_lib.byte_stream_split(bss_vals) if bss_lib else None
 
     barrier = threading.Barrier(threads)
     errors: list[BaseException] = []
@@ -141,12 +167,16 @@ def run(iters: int = DEFAULT_ITERS, threads: int = DEFAULT_THREADS) -> int:
                         != nref_defs):
                     raise AssertionError(
                         f"worker {widx} iter {i}: nested shred diverged")
-                meta = np.zeros((1, 3), np.int64)
+                meta = np.zeros((pages.shape[0], 3), np.int64)
                 out = asm.assemble_pages(buffers, pages.copy(), ops.copy(),
                                          0, 3, None, 0, meta, None, None)
                 if out != ref_out:
                     raise AssertionError(
                         f"worker {widx} iter {i}: assembled page diverged")
+                if bss_lib is not None \
+                        and bss_lib.byte_stream_split(bss_vals) != ref_bss:
+                    raise AssertionError(
+                        f"worker {widx} iter {i}: byte_stream_split diverged")
         except BaseException as e:  # noqa: BLE001 — reported to the runner
             with mu:
                 errors.append(e)
@@ -163,7 +193,7 @@ def run(iters: int = DEFAULT_ITERS, threads: int = DEFAULT_THREADS) -> int:
     mode = os.environ.get("KPW_NATIVE_SANITIZE", "")
     print(f"tsan_stress: {threads} threads x {iters} iters over "
           f"shred_flat_buf/gather_buf/shred_nested_buf/nested_fill/"
-          f"assemble_pages completed "
+          f"assemble_pages/byte_stream_split completed "
           f"(KPW_NATIVE_SANITIZE={mode or 'off'}); outputs byte-identical "
           f"to the single-thread reference")
     return 0
@@ -176,7 +206,8 @@ def canary(iters: int = 300) -> int:
     stderr grepped for the race warning, so a misconfigured preload can
     never report the clean run as 'sanitizers ran clean' vacuously."""
     asm, buffers, pages, ops = _assemble_inputs()
-    meta = np.zeros((1, 3), np.int64)  # SHARED output: the planted race
+    # SHARED output: the planted race
+    meta = np.zeros((pages.shape[0], 3), np.int64)
     barrier = threading.Barrier(2)
 
     def worker() -> None:
