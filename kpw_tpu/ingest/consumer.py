@@ -107,6 +107,20 @@ class SmartCommitConsumer:
         # block and may only take its OWN lock (buffer-cond -> listener
         # lock is the one ordering; the ledger never takes this one).
         self._listener = queue_listener
+        # end-to-end ack-latency plane: per-partition deques of
+        # (start_offset, end_offset, ingest_wall_ts) stamped at queue
+        # admission and popped when acks cover them — the writer's
+        # observer receives time-to-durable seconds per covered stamp.
+        # Own leaf lock (the buffer condition may be held when stamping;
+        # the ack path takes only this lock — acyclic).  Bounded per
+        # partition: if acks never come, old stamps age out silently
+        # rather than growing without bound.
+        self._stamp_lock = threading.Lock()
+        self._stamps: dict[int, deque] = {}
+        self._stamp_cap = 4096
+        self._latency_observer = None
+        self._lat_runs = 0
+        self._lat_records = 0
 
     # -- lifecycle ---------------------------------------------------------
     def subscribe(self, topic: str) -> None:
@@ -283,9 +297,80 @@ class SmartCommitConsumer:
                     self._buf_hwm = self._buf_count
                 if self._listener is not None:
                     self._listener.on_enqueued(take)
+                if is_batch:
+                    self._stamp_ingest(part.partition, part.start_offset,
+                                       part.start_offset + take)
+                elif part:
+                    self._stamp_ingest(part[0].partition, part[0].offset,
+                                       part[-1].offset + 1)
                 pos += take
                 self._buf_cond.notify_all()
         return True
+
+    # -- end-to-end ack latency --------------------------------------------
+    def set_latency_observer(self, fn) -> None:
+        """``fn(seconds, records)`` fires per ingest stamp an ack covers
+        — the writer binds the ``parquet.writer.ack.latency`` Histogram
+        here.  The observer must be cheap and must not raise."""
+        self._latency_observer = fn
+
+    def _stamp_ingest(self, partition: int, start: int, end: int) -> None:
+        # wall clock deliberately: the stamp crosses process boundaries
+        # (ring descriptor) and renders as operator-facing seconds
+        ts = time.time()
+        with self._stamp_lock:
+            dq = self._stamps.get(partition)
+            if dq is None:
+                dq = self._stamps[partition] = deque(maxlen=self._stamp_cap)
+            dq.append((start, end, ts))
+
+    def ingest_stamp(self, partition: int, offset: int) -> float | None:
+        """The ingest wall-time of the stamp covering ``offset`` (None
+        when unknown) — the dispatcher reads it to stamp ring unit
+        descriptors.  Front-of-deque hits dominate (the oldest unacked
+        run is the one being dispatched)."""
+        with self._stamp_lock:
+            dq = self._stamps.get(partition)
+            if not dq:
+                return None
+            for s, e, ts in dq:
+                if s <= offset < e:
+                    return ts
+        return None
+
+    def _observe_ack(self, partition: int, start: int, end: int) -> None:
+        """Pop every stamp the acked run [start, end) covers and feed the
+        observer its time-to-durable.  Handles out-of-order acks (runs
+        ack at file granularity across workers): stamps entirely below
+        the run are kept for their own later ack; a stamp the run only
+        partially covers is split, its tail re-queued.  Redelivered runs
+        re-stamp at redelivery, so duplicates measure conservatively
+        from the LAST ingest, never negative."""
+        obs = self._latency_observer
+        hits: list[tuple[float, int]] = []
+        now = time.time()
+        with self._stamp_lock:
+            dq = self._stamps.get(partition)
+            if not dq:
+                return
+            keep: list[tuple[int, int, float]] = []
+            while dq and dq[0][0] < end:
+                s, e, ts = dq.popleft()
+                if e <= start:
+                    keep.append((s, e, ts))  # earlier run, not ours
+                    continue
+                hits.append((max(0.0, now - ts),
+                             min(e, end) - max(s, start)))
+                if e > end:  # tail extends past the ack: re-stamp it
+                    keep.append((end, e, ts))
+            for item in reversed(keep):
+                dq.appendleft(item)
+            if hits:
+                self._lat_runs += len(hits)
+                self._lat_records += sum(n for _, n in hits)
+        if obs is not None:
+            for lat_s, n in hits:
+                obs(lat_s, n)
 
     def queue_depth(self) -> int:
         """Live record count in the shared bounded buffer."""
@@ -360,10 +445,24 @@ class SmartCommitConsumer:
             "autotune": (self._autotune.snapshot()
                          if self._autotune is not None
                          else {"enabled": False}),
+            "ack_latency": self.latency_snapshot(),
             "tracker": self.tracker.snapshot(),
         }
 
+    def latency_snapshot(self) -> dict:
+        with self._stamp_lock:
+            return {
+                "observed_runs": self._lat_runs,
+                "observed_records": self._lat_records,
+                "stamps_pending": sum(len(d) for d in
+                                      self._stamps.values()),
+            }
+
     def ack(self, po: PartitionOffset) -> None:
+        # observe BEFORE the commit round: durability happened at
+        # publish, and a commit retry backing off for seconds must not
+        # inflate the measured time-to-durable
+        self._observe_ack(po.partition, po.offset, po.offset + 1)
         new_commit = self.tracker.ack(po)
         if new_commit is not None:
             self._commit_with_retry(po.partition, new_commit)
@@ -374,6 +473,7 @@ class SmartCommitConsumer:
         whole files' worth of offsets at publish time)."""
         if count <= 0:
             return
+        self._observe_ack(partition, start, start + count)
         new_commit = self.tracker.ack_run(partition, start, count)
         if new_commit is not None:
             self._commit_with_retry(partition, new_commit)

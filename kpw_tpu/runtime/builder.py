@@ -120,6 +120,12 @@ class Builder:
         self._tracing = False
         self._trace_span_capacity = 65536
         self._trace_path: str | None = None
+        # crash flight recorder (runtime/telemetry.py): bounded black box
+        # of fault-path events, dumped as one JSON post-mortem on watchdog
+        # kills, fatal-sink pauses, and poison quarantines.  ON by default
+        # — it costs nothing until a fault path actually fires
+        self._flightrec = True
+        self._flightrec_dir: str | None = None  # None = <target_dir>
         # partitioned output (opt-in; the reference emits one flat stream):
         # record -> relative partition dir ahead of file assignment, with a
         # bound on concurrently open partition files per worker (LRU
@@ -651,6 +657,22 @@ class Builder:
         self._trace_path = path
         if path:
             self._tracing = True
+        return self
+
+    def flight_recorder(self, flag: bool = True, *,
+                        path: str | None = None) -> "Builder":
+        """The crash black box (``runtime/telemetry.py``): a bounded ring
+        of fault-path events (stalls, pauses, quarantines, child deaths)
+        dumped as one JSON post-mortem — naming the trigger and the
+        stalled stage — when the watchdog kills a hung worker, a worker
+        pauses on a fatal sink condition, or a file is quarantined.  ON
+        by default (zero cost until a fault fires); ``path`` overrides
+        the dump directory (default ``<target_dir>/flightrec/`` on the
+        LOCAL filesystem — a black box that publishes through the
+        possibly-failing sink would lose exactly the crashes it exists
+        to explain)."""
+        self._flightrec = flag
+        self._flightrec_dir = path
         return self
 
     def partition_by(self, spec, *, time_pattern: str | None = None,

@@ -408,6 +408,23 @@ TENANT_FILES_EVICTED_METER = "parquet.writer.tenant.files.evicted"
 DEADLETTER_METER = "parquet.writer.deadletter.records"
 TENANT_ROUTES_GAUGE = "parquet.writer.tenant.routes"
 TENANT_ROUTES_DEGRADED_GAUGE = "parquet.writer.tenant.routes.degraded"
+# telemetry-plane layer (runtime/telemetry.py): end-to-end ack latency —
+# seconds from a batch's ingest into the shared queue to its offsets
+# being durably acked (the time-to-durable histogram the cluster bench
+# needs: percentiles in SECONDS, not record-count lag proxies) — plus the
+# cross-process aggregation gauges: child-origin written/flushed record
+# counts summed over the live shm telemetry cells PLUS the banked totals
+# of dead children (a respawn banks the dead child's final counts first,
+# so the merged scrape is monotonic and a dead cell never poisons it),
+# cumulative child stage-time seconds, child span counts (recorded /
+# dropped), and the crash flight recorder's dump count
+ACK_LATENCY_HISTOGRAM = "parquet.writer.ack.latency"
+CHILD_WRITTEN_RECORDS_GAUGE = "worker.proc.child.written.records"
+CHILD_FLUSHED_RECORDS_GAUGE = "worker.proc.child.flushed.records"
+CHILD_STAGE_SECONDS_GAUGE = "worker.proc.child.stage.seconds"
+CHILD_SPANS_GAUGE = "worker.proc.child.spans"
+CHILD_SPANS_DROPPED_GAUGE = "worker.proc.child.spans.dropped"
+FLIGHTREC_DUMPS_METER = "parquet.writer.flightrec.dumps"
 
 # the canonical registry docs cite from (tools/check_docs.py verifies
 # every doc-cited metric name is listed here)
@@ -461,4 +478,11 @@ METRIC_NAMES = (
     DEADLETTER_METER,
     TENANT_ROUTES_GAUGE,
     TENANT_ROUTES_DEGRADED_GAUGE,
+    ACK_LATENCY_HISTOGRAM,
+    CHILD_WRITTEN_RECORDS_GAUGE,
+    CHILD_FLUSHED_RECORDS_GAUGE,
+    CHILD_STAGE_SECONDS_GAUGE,
+    CHILD_SPANS_GAUGE,
+    CHILD_SPANS_DROPPED_GAUGE,
+    FLIGHTREC_DUMPS_METER,
 )

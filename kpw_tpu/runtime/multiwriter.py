@@ -405,8 +405,11 @@ class _SharedCompactionService:
                     continue
                 next_due[name] = time.monotonic() + self._intervals[name]
                 try:
-                    c.recover()
-                    c.compact_once()
+                    # one traced round per route: the compaction legs were
+                    # the longest untraced gap in the e2e timeline
+                    with stage("compactor.round", tenant=name):
+                        c.recover()
+                        c.compact_once()
                     self._errors.pop(name, None)
                 except Exception as e:  # bulkhead: contain per route
                     self._errors[name] = repr(e)
@@ -600,7 +603,8 @@ class MultiWriter:
 
         w = route.writer
         try:
-            existing = _tree_physical_types(w.fs, w.target_dir)
+            with stage("tenant.schema.audit", tenant=route.name):
+                existing = _tree_physical_types(w.fs, w.target_dir)
         except OSError as e:
             logger.warning("route %s: schema guard could not list the "
                            "tree (%r); guard skipped", route.name, e)
@@ -645,7 +649,8 @@ class MultiWriter:
         started: list[_Route] = []
         try:
             for route in self._routes.values():
-                route.writer.start()
+                with stage("tenant.route.start", tenant=route.name):
+                    route.writer.start()
                 started.append(route)
         except Exception:
             # a route that cannot even START is a config error, not a
@@ -689,7 +694,8 @@ class MultiWriter:
             rem = (None if t_end is None
                    else max(0.0, t_end - time.monotonic()))
             try:
-                reports[name] = route.writer.close(deadline=rem)
+                with stage("tenant.route.close", tenant=name):
+                    reports[name] = route.writer.close(deadline=rem)
             except Exception as e:  # WriterFailedError and kin: contained
                 terminals[name] = repr(e)
         report = {
@@ -771,6 +777,10 @@ class MultiWriter:
                 "workers_dead": sum(1 for wk in w._workers if wk.failed),
                 "restarts_total": sum(w._restart_counts),
                 "deadletter_records": w._deadletter_route.count,
+                # this route's OWN time-to-durable distribution (seconds,
+                # p50/p99): the route-local histogram, not the canonical
+                # one a shared registry merges across tenants
+                "ack_latency": w._ack_latency_route.snapshot(),
                 "quota": ledger["tenants"].get(name, {}),
             }
         out = {

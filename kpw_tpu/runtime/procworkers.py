@@ -51,9 +51,10 @@ import time
 import numpy as np
 
 from ..ingest.broker import RecordBatch
-from ..utils import schedcheck
+from ..utils import schedcheck, tracing
 from ..utils.tracing import stage
 from .retry import RetryInterrupted
+from .telemetry import TM_FIELDS
 
 logger = logging.getLogger(__name__)
 
@@ -63,12 +64,18 @@ logger = logging.getLogger(__name__)
 _MP_CTX = multiprocessing.get_context("spawn")
 
 # -- shared-memory ring geometry --------------------------------------------
-# [ heartbeat cells: _HB_MAX * _HB_CELL bytes ][ slot 0 ][ slot 1 ] ...
+# [ heartbeat cells: _HB_MAX * _HB_CELL bytes ]
+# [ telemetry cells: _HB_MAX * _TM_CELL bytes ][ slot 0 ][ slot 1 ] ...
 # slot = [ header _SLOT_HEADER bytes ][ offsets (count+1) int64 ][ payload ]
 _HB_MAX = 64          # max worker processes one ring serves
 _HB_CELL = 32         # label_code i64, pending i64, started_at f64, beat f64
+_TM_SLOTS = 16        # int64 counter slots per worker telemetry cell
+#                       (telemetry.TM_FIELDS names the first 14; the rest
+#                       is spare headroom — shared-memory layout is
+#                       append-only)
+_TM_CELL = _TM_SLOTS * 8
 _SLOT_HEADER = 48     # count, offs_bytes, payload_bytes, partition,
-#                       start_offset, reserved — all little-endian int64
+#                       start_offset, ingest_us — all little-endian int64
 _HDR = struct.Struct("<qqqqqq")
 
 # heartbeat seam labels travel as small codes through the cells (fixed
@@ -80,7 +87,7 @@ _HB_CODE = {lbl: i + 1 for i, lbl in enumerate(_HB_LABELS)}
 
 class ShmBatchRing:
     """A ring of fixed-size batch slots in one shared-memory segment,
-    plus per-worker heartbeat cells at the front.
+    plus per-worker heartbeat AND telemetry cells at the front.
 
     The parent creates it (``create=True``), writes batches into free
     slots and recycles them when the consuming child reports the slot
@@ -97,7 +104,8 @@ class ShmBatchRing:
         self.slots = slots
         self.slot_bytes = slot_bytes
         self._hb_bytes = _HB_MAX * _HB_CELL
-        total = self._hb_bytes + slots * slot_bytes
+        self._tm_bytes = _HB_MAX * _TM_CELL
+        total = self._hb_bytes + self._tm_bytes + slots * slot_bytes
         self._shm = shared_memory.SharedMemory(create=create, name=name,
                                                size=total if create else 0)
         # NOTE on resource tracking: spawn children inherit the parent's
@@ -114,6 +122,12 @@ class ShmBatchRing:
                                    count=_HB_MAX * 4).reshape(_HB_MAX, 4)
         self._hb_f = np.frombuffer(self._buf, np.float64,
                                    count=_HB_MAX * 4).reshape(_HB_MAX, 4)
+        # telemetry cells: one int64 counter vector per worker (see
+        # runtime/telemetry.py for the field meanings); single-writer
+        # per cell, torn reads benign — every field is monotonic
+        self._tm = np.frombuffer(
+            self._buf, np.int64, count=_HB_MAX * _TM_SLOTS,
+            offset=self._hb_bytes).reshape(_HB_MAX, _TM_SLOTS)
 
     # -- slot payload capacity ------------------------------------------------
     def fits(self, count: int, payload_bytes: int) -> bool:
@@ -129,7 +143,7 @@ class ShmBatchRing:
     def _slot_off(self, idx: int) -> int:
         if not 0 <= idx < self.slots:
             raise IndexError(f"slot {idx} out of range")
-        return self._hb_bytes + idx * self.slot_bytes
+        return self._hb_bytes + self._tm_bytes + idx * self.slot_bytes
 
     # -- parent side -----------------------------------------------------------
     def write_slot(self, idx: int, partition: int, start_offset: int,
@@ -141,7 +155,7 @@ class ShmBatchRing:
                                      [(offsets, payload)])
 
     def write_slot_parts(self, idx: int, partition: int, start_offset: int,
-                         parts) -> int:
+                         parts, ingest_us: int = 0) -> int:
         """Stage SEVERAL offset-contiguous windows into one slot as a
         single merged offsets table + payload blob — the dispatcher packs
         a poll round's per-partition fetch slices together so unit size
@@ -149,7 +163,10 @@ class ShmBatchRing:
         otherwise make per-unit fixed costs the throughput ceiling).
         ``parts`` = [(offsets int64 n_i+1, payload buffer), ...]; the
         staging memcpy concatenates the windows (the same single copy the
-        one-part path pays).  Returns the merged record count."""
+        one-part path pays).  ``ingest_us`` stamps the unit's oldest
+        batch's ingest wall-time (microseconds since the epoch, 0 =
+        unknown) through the descriptor — the end-to-end ack-latency
+        plane's anchor.  Returns the merged record count."""
         norm = [(np.ascontiguousarray(o, np.int64), p) for o, p in parts]
         count = sum(len(o) - 1 for o, _ in norm)
         nbytes = sum(int(o[-1] - o[0]) for o, _ in norm)
@@ -159,7 +176,8 @@ class ShmBatchRing:
                 f"({self.slot_bytes} B incl. header+offsets)")
         off = self._slot_off(idx)
         self._buf[off: off + _SLOT_HEADER] = _HDR.pack(
-            count, (count + 1) * 8, nbytes, partition, start_offset, 0)
+            count, (count + 1) * 8, nbytes, partition, start_offset,
+            int(ingest_us))
         dst_offs = np.frombuffer(self._buf, np.int64, count=count + 1,
                                  offset=off + _SLOT_HEADER)
         data_start = off + _SLOT_HEADER + (count + 1) * 8
@@ -180,17 +198,17 @@ class ShmBatchRing:
 
     # -- child side ------------------------------------------------------------
     def read_slot(self, idx: int):
-        """(partition, start_offset, count, offsets_view, payload_view) —
-        both views alias the shared segment (zero-copy); the caller must
-        finish with them before the slot is reported free."""
+        """(partition, start_offset, count, offsets_view, payload_view,
+        ingest_us) — both views alias the shared segment (zero-copy); the
+        caller must finish with them before the slot is reported free."""
         off = self._slot_off(idx)
-        count, offs_bytes, nbytes, partition, start_offset, _ = _HDR.unpack(
-            bytes(self._buf[off: off + _SLOT_HEADER]))
+        (count, offs_bytes, nbytes, partition, start_offset,
+         ingest_us) = _HDR.unpack(bytes(self._buf[off: off + _SLOT_HEADER]))
         offs = np.frombuffer(self._buf, np.int64, count=count + 1,
                              offset=off + _SLOT_HEADER)
         o_end = off + _SLOT_HEADER + offs_bytes
         payload = self._buf[o_end: o_end + nbytes]
-        return partition, start_offset, count, offs, payload
+        return partition, start_offset, count, offs, payload, ingest_us
 
     # -- heartbeat cells -------------------------------------------------------
     def hb_publish(self, widx: int, label_code: int, pending: bool,
@@ -232,11 +250,43 @@ class ShmBatchRing:
         self._hb_i[widx, 1] = 0
         self._hb_i[widx, 0] = 0
 
+    def hb_label(self, widx: int) -> str | None:
+        """Decode the op label the worker last published (``None`` when
+        the cell is unlabeled or already cleared).  This is the flight
+        recorder's stalled-stage attribution for a child that died
+        without a goodbye (kill -9, OOM): the cell survives the death
+        and is only cleared later by ``respawn_slot``."""
+        code, _pending, _started, _beat = self.hb_read(widx)
+        if 1 <= code <= len(_HB_LABELS):
+            return _HB_LABELS[code - 1]
+        return None
+
+    # -- telemetry cells -------------------------------------------------------
+    def tm_publish(self, widx: int, values) -> None:
+        """Child side: overwrite this worker's telemetry counter cell
+        (field order = ``telemetry.TM_FIELDS``).  Single writer per
+        cell; a torn parent read sees a counter one tick stale, never
+        garbage — every field is monotonic."""
+        if self._tm is None:  # ring already closed (exit race)
+            return
+        n = min(len(values), _TM_SLOTS)
+        self._tm[widx, :n] = values[:n]
+
+    def tm_read(self, widx: int) -> list[int]:
+        if self._tm is None:
+            return [0] * _TM_SLOTS
+        return [int(v) for v in self._tm[widx]]
+
+    def tm_clear(self, widx: int) -> None:
+        if self._tm is None:
+            return
+        self._tm[widx, :] = 0
+
     def close(self) -> None:
         # drop our numpy views before closing the mmap; a caller-held
         # slot view keeps the mapping alive until IT is released
         # (BufferError from mmap — the unmap happens at that release)
-        self._hb_i = self._hb_f = None
+        self._hb_i = self._hb_f = self._tm = None
         self._buf = None
         try:
             self._shm.close()
@@ -347,6 +397,8 @@ class ChildConfig:
         self.on_parse_error = b._on_parse_error
         self.durable_publish = b._durable_publish
         self.verify_on_publish = b._verify_on_publish
+        self.tracing = b._tracing
+        self.trace_span_capacity = b._trace_span_capacity
 
 
 # ---------------------------------------------------------------------------
@@ -411,6 +463,29 @@ class _ChildWorker:
         self._last_error: str | None = None
         self._files_published = 0
         self._use_wire = self.columnarizer.wire_capable
+        # telemetry-plane counters, published to this worker's shm cell
+        # (~20 Hz from the heartbeat thread) and snapshotted over the
+        # low-rate ack-queue side channel at rotation/seal boundaries
+        self._written_records = 0
+        self._written_bytes = 0
+        self._flushed_records = 0
+        self._flushed_bytes = 0
+        self._deadletter_records = 0
+        self._units_processed = 0
+        self._rot_size = 0
+        self._rot_time = 0
+        self._last_side_send = 0.0
+        self._spans_shipped = 0
+        self.stage_timer: tracing.StageTimer | None = None
+        self.span_recorder: tracing.SpanRecorder | None = None
+        if cfg.tracing:
+            # this interpreter's module globals are the child's own —
+            # installing here mirrors writer.start() in the parent
+            self.stage_timer = tracing.StageTimer()
+            self.span_recorder = tracing.SpanRecorder(
+                capacity=cfg.trace_span_capacity)
+            tracing.set_tracer(self.stage_timer)
+            tracing.set_span_recorder(self.span_recorder)
 
     # -- heartbeat publisher --------------------------------------------------
     def _publish_hb(self) -> None:
@@ -422,8 +497,56 @@ class _ChildWorker:
             else:
                 ring.hb_publish(widx, _HB_CODE.get(label, 0), True,
                                 time.monotonic() - age)
+            ring.tm_publish(widx, self._tm_values())
             self._stop.wait(0.05)
         ring.hb_clear(widx)
+
+    # -- telemetry plane ------------------------------------------------------
+    def _tm_values(self) -> tuple:
+        """This worker's counter vector, field order = ``TM_FIELDS``."""
+        rec = self.span_recorder
+        st = self.stage_timer
+        stage_us = 0
+        if st is not None:
+            stage_us = int(sum(s["seconds"]
+                               for s in st.summary().values()) * 1e6)
+        return (self._written_records, self._written_bytes,
+                self._flushed_records, self._flushed_bytes,
+                self._files_published, self._units_processed,
+                self._retries, int(self._backoff_s * 1000),
+                self._deadletter_records, self._rot_size, self._rot_time,
+                # cumulative spans: shipped batches + whatever the side
+                # channel has not drained yet (len(rec) alone would reset
+                # to ~0 on every drain — a sawtooth, not a counter)
+                (self._spans_shipped + len(rec)) if rec is not None else 0,
+                rec.dropped if rec is not None else 0,
+                stage_us)
+
+    def _maybe_send_telemetry(self, force: bool = False) -> None:
+        """The low-rate side channel: a full snapshot (counter dict +
+        stage summary + drained span buffer) over the ack queue.  Sent
+        at rotation/seal boundaries and at exit; throttled so a
+        fast-rotating child cannot flood the collector."""
+        now = time.monotonic()
+        if not force and now - self._last_side_send < 0.5:
+            return
+        self._last_side_send = now
+        spans = None
+        if self.span_recorder is not None:
+            spans = self.span_recorder.export_payload(
+                process_name=f"kpw-proc-worker-{self.cfg.index}")
+            self._spans_shipped += len(spans["spans"])
+        payload = {
+            "pid": os.getpid(),
+            "tm": dict(zip(TM_FIELDS, self._tm_values())),
+            "stages": (self.stage_timer.summary()
+                       if self.stage_timer is not None else None),
+            "spans": spans,
+        }
+        try:
+            self.ack_q.put(("telemetry", self.cfg.index, payload))
+        except (OSError, ValueError):
+            pass  # parent queue torn down mid-exit; nothing to report to
 
     def _retry(self, fn, label: str = "io"):
         token = self.heartbeat.io_started(label)
@@ -467,6 +590,11 @@ class _ChildWorker:
             self.ack_q.put(("died", self.cfg.index, os.getpid(), repr(e)))
             raise
         finally:
+            # final telemetry flush: the cell freezes at these values
+            # (the parent banks them on respawn) and the side channel
+            # carries the tail spans the parent has not seen yet
+            self.ring.tm_publish(self.cfg.index, self._tm_values())
+            self._maybe_send_telemetry(force=True)
             self._stop.set()
             # the heartbeat publisher must stop touching the mapping
             # before the ring closes (BufferError/segfault race otherwise)
@@ -474,15 +602,24 @@ class _ChildWorker:
             self.ring.close()
 
     def _process_unit(self, seq: int, slot_idx: int) -> None:
-        partition, start_offset, count, offs, payload = \
+        partition, start_offset, count, offs, payload, ingest_us = \
             self.ring.read_slot(slot_idx)
+        self._units_processed += 1
+        nbytes = int(offs[-1] - offs[0])
+        # lint: clock-discipline ok — operator-facing ingest age (the
+        # wall stamp travels from the consumer through the descriptor);
+        # a span attribute for the trace timeline, never a liveness
+        # verdict
+        age_s = (round(max(0.0, time.time() - ingest_us / 1e6), 6)
+                 if ingest_us else 0.0)
         batch = None
         records = None
         if self._use_wire:
             from ..models.proto_bridge import WireShredError
 
             try:
-                with stage("worker.shred"):
+                with stage("worker.shred", records=count,
+                           ingest_age_s=age_s):
                     batch = self.columnarizer.columnarize_buffer(payload,
                                                                  offs)
             except WireShredError:
@@ -499,6 +636,8 @@ class _ChildWorker:
             # must not precede the append (a death in between would
             # count written rows that never entered any file).
             self.ack_q.put(("free", self.cfg.index, slot_idx, seq))
+            self._written_records += count
+            self._written_bytes += nbytes
             self._retry(self.current_file.maybe_flush_row_group, "flush")
         else:
             # fallback: materialize + parse per record (poison-pill
@@ -518,6 +657,8 @@ class _ChildWorker:
                 self._open_file()
             self.current_file.append_records(parsed)
             self.ack_q.put(("free", self.cfg.index, slot_idx, seq))
+            self._written_records += len(parsed)
+            self._written_bytes += nbytes
             self._retry(self.current_file.flush_if_full, "flush")
         self._pending_seqs.append(seq)
         if (self.current_file is not None
@@ -547,6 +688,7 @@ class _ChildWorker:
         frame = struct.pack("<iqI", partition, offset, len(raw)) + raw
         with self.fs.open_append(path) as f:
             f.write(frame)
+        self._deadletter_records += 1
 
     # -- files -----------------------------------------------------------------
     def _open_file(self) -> None:
@@ -640,6 +782,12 @@ class _ChildWorker:
             "assembly": f.assembly_info(),
         }
         self._files_published += 1
+        self._flushed_records += info["records"]
+        self._flushed_bytes += size
+        if reason == "time":
+            self._rot_time += 1
+        else:
+            self._rot_size += 1
         self.current_file = None
         self._ack_pending(info, reason)
 
@@ -650,10 +798,13 @@ class _ChildWorker:
             if file_info is not None:
                 self.ack_q.put(("published", self.cfg.index, [], file_info,
                                 self._retry_stats()))
+                self._maybe_send_telemetry()
             return
         seqs, self._pending_seqs = self._pending_seqs, []
         self.ack_q.put(("published", self.cfg.index, seqs, file_info,
                         self._retry_stats()))
+        # seal boundary: the natural low-rate beat for the side channel
+        self._maybe_send_telemetry()
 
     def _retry_stats(self) -> tuple:
         """(retries, backoff_s, last_error) riding every published-file
@@ -945,6 +1096,11 @@ class ProcessWorkerPool:
         for ring_idx in old.drain_unfreed_slots():
             self._recycle_slot(ring_idx)
         old.work_q.close()
+        # bank the dead child's final telemetry counters (and clear the
+        # cell for the successor) BEFORE the heartbeat clear: merged
+        # scrape totals stay monotonic across restarts, and the dead
+        # cell can never poison a later scrape
+        self.w._bank_child_telemetry(index)
         # a child killed MID-IO leaves pending=1 in its heartbeat cell;
         # left stale, the watchdog would age it through the replacement's
         # spawn import and condemn the healthy newborn
@@ -967,6 +1123,10 @@ class ProcessWorkerPool:
     def finalize(self, timeout: float = 5.0) -> None:
         self._closed = True
         self._collector.join(timeout=timeout)
+        # bank every child's final counters before the views go away so
+        # post-close stats()/scrapes keep the tree's lifetime totals
+        for s in self.slots:
+            self.w._bank_child_telemetry(s.index)
         self.ring.close()
         self.ring.unlink()
 
@@ -1215,8 +1375,13 @@ class ProcessWorkerPool:
         if slot_idx is None:
             return False
         schedcheck.point("proc.ring.stage")
+        # ack-latency anchor: the oldest covered batch's ingest
+        # wall-time rides the descriptor (0 when the consumer has no
+        # stamp for this run — e.g. records enqueued pre-upgrade)
+        ing = self.w.consumer.ingest_stamp(partition, start_offset)
         self.ring.write_slot_parts(slot_idx, partition, start_offset,
-                                   parts)
+                                   parts,
+                                   ingest_us=int(ing * 1e6) if ing else 0)
         target = self._pick_child()
         if target is None:
             self._recycle_slot(slot_idx)
@@ -1342,12 +1507,18 @@ class ProcessWorkerPool:
                 slot.exit_reason = reason
                 slot.failed = True
                 self.w._failed.mark()
-                self.w._notify_worker_death()
+                self.w._notify_worker_death(widx, reason)
         elif kind == "verify_failed":
             # the child quarantined its tmp and is about to die un-acked
             # (redelivery); the parent owns the meters
             self.w._verify_failed.mark()
             self.w._quarantined.mark()
+        elif kind == "telemetry":
+            # the low-rate side channel: a full child snapshot (counter
+            # dict + stage summary + drained span buffer) — absorbed
+            # into the merged trace and stats()['telemetry']
+            _, widx, payload = msg
+            self.w._absorb_child_telemetry(widx, payload)
         elif kind == "ready":
             _, widx, pid = msg
             self.slots[widx].pid = pid
@@ -1368,4 +1539,4 @@ class ProcessWorkerPool:
                                  f"{s._proc.exitcode}")
                 s.failed = True
                 self.w._failed.mark()
-                self.w._notify_worker_death()
+                self.w._notify_worker_death(s.index, s.exit_reason)

@@ -40,10 +40,12 @@ from ..models.proto_bridge import ProtoColumnarizer, WireShredError
 from ..utils import tracing
 from ..utils.tracing import stage
 from . import metrics as M
+from .export import registry_to_json
 from .parquet_file import ParquetFile
 from .partition import normalize_partition_path
 from .procworkers import ProcessWorkerPool
 from .retry import RetryInterrupted, RetryPolicy
+from .telemetry import ChildTelemetry, FlightRecorder
 from .watchdog import Heartbeat, Watchdog
 
 logger = logging.getLogger(__name__)
@@ -254,6 +256,16 @@ class KafkaProtoParquetWriter:
         self._deadlettered = (reg.meter(M.DEADLETTER_METER)
                               if reg else M.Meter())
         self._deadletter_route = M.Meter()
+        # end-to-end ack latency: batch-ingest wall time -> durable ack,
+        # observed on the consumer's ack path (the ingest stamp rides the
+        # queue and, in process mode, the ring unit descriptor).  Dual
+        # histograms like the dead-letter meters: the canonical one
+        # merges every route on a shared registry, the local one keeps
+        # this route's own distribution for the per-tenant block.
+        self._ack_latency = (reg.histogram(M.ACK_LATENCY_HISTOGRAM)
+                             if reg else M.Histogram())
+        self._ack_latency_route = M.Histogram()
+        self.consumer.set_latency_observer(self._observe_ack_latency)
         self._compactor: Compactor | None = None
         self._paused: dict[int, dict] = {}
         self._pause_lock = threading.Lock()
@@ -261,6 +273,21 @@ class KafkaProtoParquetWriter:
         self._resume_count = 0
         self._paused_total_s = 0.0
         self._last_close_report: dict | None = None
+        # cross-process telemetry plane (runtime/telemetry.py): the
+        # merged child-counter view + multi-pid trace merger are built
+        # at start() in process mode; the crash flight recorder is built
+        # HERE so pre-start faults (startup-verify quarantines) land in
+        # the black box too
+        self._child_telemetry: ChildTelemetry | None = None
+        self.trace_merger: tracing.MultiProcessTrace | None = None
+        self._flightrec: FlightRecorder | None = None
+        if b._flightrec:
+            self._flightrec = FlightRecorder(
+                b._flightrec_dir or self.target_dir,
+                b._instance_name,
+                meter=(reg.meter(M.FLIGHTREC_DUMPS_METER)
+                       if reg else M.Meter()))
+            self._flightrec.set_gather(self._flightrec_gather)
         # object-store sink: bind the canonical request/byte/part meters
         # + the bandwidth gauge to the registry so both generic exporters
         # render them (io/objectstore.py holds and marks them)
@@ -347,9 +374,20 @@ class KafkaProtoParquetWriter:
             self._procpool = ProcessWorkerPool(self)
             self._workers = self._procpool.slots
             self._procpool.start()
+            pool = self._procpool
+            # merged child-counter view over the pool's shm TM cells:
+            # every slot index stays a readable cell (dead-but-unbanked
+            # cells keep counting until respawn/finalize banks them, so
+            # the merged totals are monotonic across child restarts)
+            self._child_telemetry = ChildTelemetry(
+                pool.ring, lambda: range(len(pool.slots)))
+            if self.span_recorder is not None:
+                # multi-pid timeline: children drain their span rings over
+                # the ack channel; the merger aligns them on epoch_wall
+                self.trace_merger = tracing.MultiProcessTrace(
+                    self.span_recorder)
             reg = self._b._metric_registry
             if reg:
-                pool = self._procpool
                 reg.gauge(M.PROC_RING_SLOTS_GAUGE, lambda: pool.ring.slots)
                 reg.gauge(M.PROC_RING_FREE_GAUGE, pool.ring_free)
                 reg.gauge(M.PROC_INFLIGHT_GAUGE,
@@ -359,6 +397,20 @@ class KafkaProtoParquetWriter:
                           lambda: sum(s.rss_bytes() for s in pool.slots))
                 reg.gauge(M.PROC_ALIVE_GAUGE,
                           lambda: sum(1 for s in pool.slots if s.alive()))
+                # child-origin counters, merged banked+live at scrape
+                # time: one parent-side registry_to_prometheus() /
+                # registry_to_json() call covers the whole process tree
+                ct = self._child_telemetry
+                reg.gauge(M.CHILD_WRITTEN_RECORDS_GAUGE,
+                          lambda: ct.field("written_records"))
+                reg.gauge(M.CHILD_FLUSHED_RECORDS_GAUGE,
+                          lambda: ct.field("flushed_records"))
+                reg.gauge(M.CHILD_STAGE_SECONDS_GAUGE,
+                          lambda: ct.field("stage_time_us") / 1e6)
+                reg.gauge(M.CHILD_SPANS_GAUGE,
+                          lambda: ct.field("spans_recorded"))
+                reg.gauge(M.CHILD_SPANS_DROPPED_GAUGE,
+                          lambda: ct.field("spans_dropped"))
         else:
             for i in range(self._b._thread_count):
                 w = _Worker(self, i)
@@ -458,6 +510,11 @@ class KafkaProtoParquetWriter:
         self._quarantined.mark()
         logger.warning("Quarantined structurally-invalid file %s -> %s",
                        path, dest)
+        if self._flightrec is not None:
+            self._flightrec.note("quarantine", path=path,
+                                 quarantined_to=dest)
+            self._flightrec.dump("quarantine", path=path,
+                                 quarantined_to=dest)
         return dest
 
     # -- degraded operation: watchdog + pause/resume -------------------------
@@ -469,6 +526,10 @@ class KafkaProtoParquetWriter:
         filesystem its primary hangs (a hang never raises an errno, so
         the composite cannot see it on its own)."""
         self._stalled.mark()
+        if self._flightrec is not None:
+            self._flightrec.note("watchdog_stall", worker=index,
+                                 stalled_stage=label or "io",
+                                 stall_age_s=round(age, 3))
         logger.error(
             "watchdog: worker %d stalled %.1fs in %s (deadline %.1fs)",
             index, age, label or "io", self._b._io_stall_deadline)
@@ -492,6 +553,12 @@ class KafkaProtoParquetWriter:
                   f"(> io_stall_deadline "
                   f"{self._b._io_stall_deadline}s); abandoned by watchdog")
         self._failed.mark()
+        if self._flightrec is not None:
+            # the black box: what was the tree doing when the watchdog
+            # abandoned this slot, and which stage was it stuck in
+            self._flightrec.dump("watchdog_stall_kill",
+                                 stalled_stage=label or "io",
+                                 worker=index, stall_age_s=round(age, 3))
         self._notify_worker_death()
 
     def _enter_pause(self, index: int, exc: BaseException) -> None:
@@ -502,6 +569,22 @@ class KafkaProtoParquetWriter:
         logger.error(
             "worker %d PAUSED on fatal sink condition (%r); intake stops, "
             "probing for recovery", index, exc)
+        if self._flightrec is not None:
+            # best-effort stage attribution: a sink OSError's message
+            # often names the failing op ("injected fault: write call
+            # #6", "flush of ..."); a bare errno degrades to "sink"
+            stage_name = "sink"
+            text = str(exc)
+            for op in ("open", "write", "flush", "close", "publish",
+                       "rename"):
+                if op in text:
+                    stage_name = op
+                    break
+            self._flightrec.note("fatal_sink_pause", worker=index,
+                                 stalled_stage=stage_name, cause=repr(exc))
+            self._flightrec.dump("fatal_sink_pause",
+                                 stalled_stage=stage_name, worker=index,
+                                 cause=repr(exc))
 
     def _exit_pause(self, index: int) -> None:
         with self._pause_lock:
@@ -526,9 +609,97 @@ class KafkaProtoParquetWriter:
         except OSError:
             return False
 
+    # -- cross-process telemetry plane (runtime/telemetry.py) ----------------
+    def _observe_ack_latency(self, seconds: float, count: int) -> None:
+        """Consumer ack-path callback: one contiguous run of ``count``
+        records became durable ``seconds`` after its batch was ingested.
+        One histogram update per run, not per record — runs are the
+        consumer's ack granularity, and per-record updates would just
+        replicate one latency value ``count`` times into the reservoir.
+        Never raises into the ack path."""
+        try:
+            self._ack_latency.update(seconds)
+            self._ack_latency_route.update(seconds)
+        except Exception:
+            logger.exception("ack-latency observation failed (ignored)")
+
+    def _bank_child_telemetry(self, index: int) -> None:
+        """Fold a dead child's final shm counter cell into the banked
+        totals (procworkers calls this before clearing the cell for the
+        slot's successor, and at pool finalize).  No-op outside process
+        mode."""
+        if self._child_telemetry is not None:
+            self._child_telemetry.bank(index)
+
+    def _absorb_child_telemetry(self, widx: int, payload: dict) -> None:
+        """One low-rate side-channel snapshot from child ``widx`` (the
+        ``("telemetry", widx, payload)`` ack-queue descriptor): keep the
+        registry view for stats() and merge the drained span batch into
+        the multi-pid trace.  Never raises into the collector thread."""
+        try:
+            if self._child_telemetry is not None:
+                self._child_telemetry.absorb_snapshot(widx, payload)
+            spans = (payload.get("spans")
+                     if isinstance(payload, dict) else None)
+            if spans and self.trace_merger is not None:
+                self.trace_merger.absorb(spans)
+        except Exception:
+            logger.exception("child telemetry absorb failed (ignored)")
+
+    def _flightrec_gather(self) -> dict:
+        """The flight recorder's live-state hook: recent spans (a
+        non-draining snapshot — the final trace still gets them), merged
+        child counters, ack lag, per-worker observability, the
+        watchdog's stall set, and the full registry snapshot —
+        everything a post-mortem needs to say what the tree was doing
+        when the fault fired.  Exceptions here are the recorder's
+        problem: dump() degrades to the event ring."""
+        out: dict = {"ack": self.ack_lag(),
+                     "workers": [w.observability() for w in self._workers]}
+        rec = self.span_recorder
+        if rec is not None:
+            out["recent_spans"] = [
+                {"name": n, "thread": tname, "tid": tid,
+                 "start_s": round(st, 6), "duration_s": round(du, 6),
+                 "attrs": at}
+                for n, tname, tid, st, du, at in rec.snapshot()[-128:]]
+        if self._child_telemetry is not None:
+            out["children_merged"] = self._child_telemetry.totals()
+        if self._watchdog_obj is not None:
+            out["watchdog"] = self._watchdog_obj.snapshot()
+        reg = self._b._metric_registry
+        if reg is not None:
+            out["metrics"] = registry_to_json(reg)
+        return out
+
     # -- supervision (beyond the reference: a dead reference worker is a
     # silent log line until process restart) ---------------------------------
-    def _notify_worker_death(self) -> None:
+    def _notify_worker_death(self, index: int | None = None,
+                             reason: str | None = None) -> None:
+        """Wake the supervisor.  When the caller knows WHICH worker died
+        unexpectedly (process mode: kill -9 / OOM leaves no goodbye
+        message), the black box is dumped too, with the stalled stage
+        read from the dead child's heartbeat cell — the cell survives
+        the death and is only cleared later by the respawn, so the dump
+        can name the op the child was inside when it was killed."""
+        if self._flightrec is not None:
+            if index is None:
+                self._flightrec.note("worker_death")
+            else:
+                stage_name = "idle"
+                try:
+                    if self._procpool is not None:
+                        stage_name = (self._procpool.ring.hb_label(index)
+                                      or "idle")
+                except Exception:
+                    logger.exception(
+                        "heartbeat attribution failed (stage=idle)")
+                self._flightrec.note("worker_death", worker=index,
+                                     reason=reason,
+                                     stalled_stage=stage_name)
+                self._flightrec.dump("worker_death",
+                                     stalled_stage=stage_name,
+                                     worker=index, reason=reason)
         self._dead_notice.set()
 
     def _make_worker(self, i: int):
@@ -727,7 +898,12 @@ class KafkaProtoParquetWriter:
         if self.span_recorder is not None:
             if self._b._trace_path:
                 try:
-                    self.span_recorder.write_chrome_trace(self._b._trace_path)
+                    # the multi-pid merger (process mode) writes ONE
+                    # timeline covering parent + children, aligned on
+                    # epoch_wall; child span batches were absorbed by the
+                    # collector up through finalize() above
+                    sink = self.trace_merger or self.span_recorder
+                    sink.write_chrome_trace(self._b._trace_path)
                     logger.info("Wrote span timeline to %s",
                                 self._b._trace_path)
                 except OSError:
@@ -817,6 +993,12 @@ class KafkaProtoParquetWriter:
                 M.DEADLETTER_METER: self._deadlettered.snapshot(),
             },
             "file_size": self._file_size_histogram.snapshot(),
+            # end-to-end time-to-durable (seconds): batch ingest ->
+            # published+acked, one reservoir update per acked run.  The
+            # route-local histogram (this writer's own distribution,
+            # independent of registry sharing) — the canonical
+            # ACK_LATENCY_HISTOGRAM merges routes on a shared registry
+            "ack_latency": self._ack_latency_route.snapshot(),
             "rotations": {
                 "size": self._rotated_size.count,
                 "time": self._rotated_time.count,
@@ -936,6 +1118,14 @@ class KafkaProtoParquetWriter:
         # in-flight units + restart counts, dispatcher/collector counters
         if self._procpool is not None:
             out["procs"] = self._procpool.snapshot()
+        # cross-process telemetry block (process mode): the merged
+        # banked+live child counters plus each child's last side-channel
+        # snapshot; and the flight recorder's state whenever one exists
+        # ("no dumps yet" is itself evidence)
+        if self._child_telemetry is not None:
+            out["telemetry"] = self._child_telemetry.snapshot()
+        if self._flightrec is not None:
+            out["flightrec"] = self._flightrec.snapshot()
         # writer-OWNED tracing only: the process-global seam may hold a
         # different writer's (or the user's) instruments, and attributing
         # their timings to this writer would be misdirection — users who
@@ -946,6 +1136,10 @@ class KafkaProtoParquetWriter:
             out["spans"] = {"buffered": len(self.span_recorder),
                             "dropped": self.span_recorder.dropped,
                             "capacity": self.span_recorder.capacity}
+            if self.trace_merger is not None:
+                # every pid the merged timeline covers (parent + every
+                # child that shipped at least one span batch)
+                out["spans"]["merged_pids"] = self.trace_merger.pids()
         return out
 
     def write_trace(self, path: str) -> None:
@@ -955,7 +1149,7 @@ class KafkaProtoParquetWriter:
         if self.span_recorder is None:
             raise ValueError("tracing not enabled on this writer "
                              "(Builder.tracing)")
-        self.span_recorder.write_chrome_trace(path)
+        (self.trace_merger or self.span_recorder).write_chrome_trace(path)
 
     # -- programmatic metrics (KPW.java:201-210) ---------------------------
     @property

@@ -23,6 +23,8 @@ Three layers, all zero-cost when disabled:
 from __future__ import annotations
 
 import json
+import logging
+import os
 import threading
 import time
 from collections import deque
@@ -38,6 +40,7 @@ from contextlib import contextmanager
 #   encode.*   — the encoder's internal phases (ops/backend.py)
 #   compactor.* — the small-file compaction service (io/compact.py)
 #   upload.*   — the object-store part uploader (io/objectstore.py)
+#   tenant.*   — the multi-tenant routing legs (runtime/multiwriter.py)
 STAGE_NAMES = (
     "consumer.fetch",
     "consumer.track",
@@ -57,8 +60,12 @@ STAGE_NAMES = (
     "encode.bloom",
     "encode.page_index",
     "compactor.merge",
+    "compactor.round",
     "upload.part",
     "tenant.quota.wait",
+    "tenant.route.start",
+    "tenant.route.close",
+    "tenant.schema.audit",
 )
 
 
@@ -111,10 +118,15 @@ class SpanRecorder:
     fetch batches), not per record, so the hot path never sees more than
     a few thousand appends per second."""
 
-    def __init__(self, capacity: int = 65536) -> None:
+    def __init__(self, capacity: int = 65536, pid: int | None = None) -> None:
         if capacity <= 0:
             raise ValueError("capacity must be positive")
         self.capacity = capacity
+        # real process identity: every exported event carries the pid that
+        # recorded it, so a merged multi-process trace keeps its rows
+        # separable (and a single-process trace is honest about which
+        # process it came from)
+        self.pid = os.getpid() if pid is None else pid
         self._lock = threading.Lock()
         self._spans: deque = deque(maxlen=capacity)
         self._dropped = 0
@@ -152,6 +164,17 @@ class SpanRecorder:
         with self._lock:
             return list(self._spans)
 
+    def drain(self) -> list[tuple]:
+        """Pop every buffered span (oldest first), leaving the buffer
+        empty.  The cross-process shipping primitive: a child drains its
+        ring at rotation/seal boundaries and at exit, sends the batch to
+        the parent over the ack channel, and keeps recording — the
+        bounded buffer never has to hold a whole run's spans."""
+        with self._lock:
+            out = list(self._spans)
+            self._spans.clear()
+            return out
+
     def to_chrome_trace(self) -> dict:
         """Chrome/Perfetto ``trace_event`` JSON (the ``chrome://tracing``
         / https://ui.perfetto.dev object format): one complete event
@@ -159,28 +182,11 @@ class SpanRecorder:
         recording thread.  Thread names ride ``thread_name`` metadata
         events so the timeline rows are labeled kpw-rg-encode /
         kpw-rg-assemble / kpw-rg-io / worker threads."""
-        spans = self.snapshot()
-        events = []
-        thread_names: dict[int, str] = {}
-        for name, tname, tid, start_s, dur_s, attrs in spans:
-            thread_names.setdefault(tid, tname)
-            ev = {
-                "name": name,
-                "ph": "X",
-                "ts": round(start_s * 1e6, 3),
-                "dur": round(dur_s * 1e6, 3),
-                "pid": 1,
-                "tid": tid,
-                "cat": name.split(".", 1)[0],
-            }
-            if attrs:
-                ev["args"] = attrs
-            events.append(ev)
-        for tid, tname in thread_names.items():
-            events.append({
-                "name": "thread_name", "ph": "M", "pid": 1, "tid": tid,
-                "args": {"name": tname},
-            })
+        events = _span_events(self.snapshot(), self.pid, 0.0)
+        events.append({
+            "name": "process_name", "ph": "M", "pid": self.pid, "tid": 0,
+            "args": {"name": f"kpw pid {self.pid}"},
+        })
         return {
             "traceEvents": events,
             "displayTimeUnit": "ms",
@@ -194,6 +200,123 @@ class SpanRecorder:
     def write_chrome_trace(self, path: str) -> None:
         """Serialize :meth:`to_chrome_trace` to ``path`` (open the file in
         chrome://tracing or ui.perfetto.dev)."""
+        with open(path, "w") as f:
+            json.dump(self.to_chrome_trace(), f)
+
+    def export_payload(self, process_name: str | None = None) -> dict:
+        """Drain the buffer into the picklable cross-process shipping
+        shape :meth:`MultiProcessTrace.absorb` takes: spans + this
+        recorder's pid and wall-clock epoch (the alignment anchor)."""
+        return {
+            "pid": self.pid,
+            "epoch_wall": self.epoch_wall,
+            "process_name": process_name or f"kpw pid {self.pid}",
+            "spans": self.drain(),
+            "dropped": self.dropped,
+        }
+
+
+def _span_events(spans, pid: int, shift_s: float) -> list[dict]:
+    """Span tuples -> Chrome ``trace_event`` complete events (+ one
+    ``thread_name`` metadata event per thread), all stamped ``pid`` with
+    start times shifted by ``shift_s`` (the epoch-alignment delta)."""
+    events: list[dict] = []
+    thread_names: dict[int, str] = {}
+    for name, tname, tid, start_s, dur_s, attrs in spans:
+        thread_names.setdefault(tid, tname)
+        ev = {
+            "name": name,
+            "ph": "X",
+            "ts": round((start_s + shift_s) * 1e6, 3),
+            "dur": round(dur_s * 1e6, 3),
+            "pid": pid,
+            "tid": tid,
+            "cat": name.split(".", 1)[0],
+        }
+        if attrs:
+            ev["args"] = attrs
+        events.append(ev)
+    for tid, tname in thread_names.items():
+        events.append({
+            "name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
+            "args": {"name": tname},
+        })
+    return events
+
+
+class MultiProcessTrace:
+    """Parent-side merger: one Chrome/Perfetto timeline spanning every
+    process the writer tree owns.
+
+    The parent's own :class:`SpanRecorder` is the alignment anchor; each
+    child ships ``{pid, epoch_wall, spans, ...}`` payloads
+    (:meth:`SpanRecorder.export_payload`, drained over the ack side
+    channel at rotation/seal boundaries and at exit).  Child span clocks
+    are relative to the CHILD's epoch, so the merge shifts them by
+    ``child.epoch_wall - parent.epoch_wall`` — both processes anchored
+    their monotonic span clock to wall time at recorder creation, which
+    is exactly the cross-process hook ``epoch_wall`` was left for.
+    Per-child span storage is bounded by the parent recorder's capacity
+    (oldest evicted), so a chatty child cannot grow the parent without
+    bound."""
+
+    def __init__(self, recorder: SpanRecorder) -> None:
+        self._recorder = recorder
+        self._lock = threading.Lock()
+        # pid -> {"epoch_wall", "process_name", "spans": deque, "dropped"}
+        self._children: dict[int, dict] = {}
+
+    def absorb(self, payload: dict) -> None:
+        """Merge one child payload; safe from any thread, never raises
+        on a malformed payload (observability must not take down the ack
+        collector)."""
+        try:
+            pid = int(payload["pid"])
+            epoch_wall = float(payload["epoch_wall"])
+            spans = payload.get("spans") or []
+            with self._lock:
+                entry = self._children.get(pid)
+                if entry is None:
+                    entry = {
+                        "epoch_wall": epoch_wall,
+                        "process_name": str(
+                            payload.get("process_name") or f"pid {pid}"),
+                        "spans": deque(maxlen=self._recorder.capacity),
+                        "dropped": 0,
+                    }
+                    self._children[pid] = entry
+                entry["dropped"] = max(entry["dropped"],
+                                       int(payload.get("dropped") or 0))
+                entry["spans"].extend(tuple(s) for s in spans)
+        except (KeyError, TypeError, ValueError):
+            logging.getLogger(__name__).warning(
+                "dropping malformed child span payload", exc_info=True)
+
+    def pids(self) -> list[int]:
+        with self._lock:
+            return sorted([self._recorder.pid, *self._children])
+
+    def to_chrome_trace(self) -> dict:
+        trace = self._recorder.to_chrome_trace()
+        events = trace["traceEvents"]
+        with self._lock:
+            children = {pid: (e["epoch_wall"], e["process_name"],
+                              list(e["spans"]), e["dropped"])
+                        for pid, e in self._children.items()}
+        child_dropped = 0
+        for pid, (epoch_wall, pname, spans, dropped) in children.items():
+            shift = epoch_wall - self._recorder.epoch_wall
+            events.extend(_span_events(spans, pid, shift))
+            events.append({
+                "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+                "args": {"name": pname},
+            })
+            child_dropped += dropped
+        trace["otherData"]["processes"] = self.pids()
+        trace["otherData"]["child_spans_dropped"] = child_dropped
+        return trace
+
+    def write_chrome_trace(self, path: str) -> None:
         with open(path, "w") as f:
             json.dump(self.to_chrome_trace(), f)
 

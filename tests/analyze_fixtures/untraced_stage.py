@@ -1,0 +1,24 @@
+"""Fixture: both stage-coverage findings — a dynamic (non-literal) stage
+name, and a stage() whose context manager is never entered."""
+
+
+def stage(name, **attrs):  # stand-in for kpw_tpu.utils.tracing.stage
+    class _S:
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *exc):
+            return False
+
+    return _S()
+
+
+def dynamic_name(tenant):
+    # FINDING: f-string stage name bypasses the STAGE_NAMES registry
+    with stage(f"tenant.{tenant}.round"):
+        pass
+
+
+def never_entered():
+    # FINDING: context manager built but never entered — records nothing
+    stage("worker.shred")

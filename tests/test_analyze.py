@@ -32,6 +32,7 @@ FIXTURE_EXPECT = {
     "unforwarded_capability.py": "protocol-exhaustiveness",
     "wallclock_watchdog.py": "clock-discipline",
     "encoding_literal.py": "encoding-choice",
+    "untraced_stage.py": "stage-coverage",
 }
 
 
@@ -124,7 +125,7 @@ def test_pass_registry_matches_modules():
         "lock-discipline", "hot-imports", "canonical-names",
         "fault-isolation", "swallowed-exceptions", "spawn-safety",
         "resource-pairing", "protocol-exhaustiveness",
-        "clock-discipline", "encoding-choice"}
+        "clock-discipline", "encoding-choice", "stage-coverage"}
 
 
 def test_hotimport_allowlist_entries_all_justified():

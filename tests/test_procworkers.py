@@ -109,8 +109,9 @@ def test_shm_ring_roundtrip_byte_identical():
         n = ring.write_slot(2, win.partition, win.start_offset,
                             win.offsets, win.payload)
         assert n == 40
-        part, start, count, r_offs, r_payload = ring.read_slot(2)
+        part, start, count, r_offs, r_payload, ingest_us = ring.read_slot(2)
         assert (part, start, count) == (3, 1010, 40)
+        assert ingest_us == 0  # write_slot (no parts) carries no stamp
         assert r_offs[0] == 0
         base = int(win.offsets[0])
         assert bytes(r_payload) == blob[base: int(win.offsets[-1])]
@@ -148,7 +149,7 @@ def test_proc_handoff_shreds_byte_identical_to_thread_mode():
     ring = ShmBatchRing(2, 1 << 20)
     try:
         ring.write_slot(0, 0, 0, offs, blob)
-        _, _, _, r_offs, r_payload = ring.read_slot(0)
+        _, _, _, r_offs, r_payload, _ = ring.read_slot(0)
         via_ring = col.columnarize_buffer(r_payload, r_offs)
         assert via_ring.num_rows == direct.num_rows
         from kpw_tpu.core.bytecol import ByteColumn

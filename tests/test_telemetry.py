@@ -1,0 +1,555 @@
+"""Cross-process telemetry plane (ISSUE 17): the shm TM-cell layout and
+roundtrip, dead-child banking (merged totals monotonic across restarts,
+a dead or torn cell can never poison a scrape), the crash flight
+recorder (bounded event ring, parseable post-mortems, degraded-not-
+raising on gather/write failure), the multi-pid trace merger, exporter
+edge cases (tenant-suffixed names, NaN gauges), end-to-end ack latency,
+and the chaos-drill regressions: the PR-11 kill -9 drill and the PR-15
+noisy-neighbor fatal-sink drill must each now yield a parseable
+flight-recorder dump whose stalled-stage attribution matches the
+injected fault."""
+
+import errno
+import glob
+import json
+import os
+import signal
+import time
+
+import pytest
+
+from kpw_tpu import (
+    Builder,
+    FakeBroker,
+    LocalFileSystem,
+    MemoryFileSystem,
+    MetricRegistry,
+    registry_to_json,
+    registry_to_prometheus,
+)
+from kpw_tpu.io import FaultInjectingFileSystem, FaultSchedule
+from kpw_tpu.runtime import metrics as M
+from kpw_tpu.runtime.export import prometheus_name
+from kpw_tpu.runtime.procworkers import _HB_LABELS, ShmBatchRing
+from kpw_tpu.runtime.telemetry import (
+    TM_FIELDS,
+    TM_INDEX,
+    ChildTelemetry,
+    FlightRecorder,
+)
+from kpw_tpu.utils.tracing import MultiProcessTrace, SpanRecorder
+from proto_helpers import sample_message_class
+
+TOPIC = "tmplane"
+PARTS = 2
+
+
+@pytest.fixture(autouse=True)
+def _schedcheck(schedcheck_checker):
+    """Module autouse (the procworkers-suite pattern): the process-mode
+    drills below run with the schedule explorer's invariant probes live,
+    and any probe violation fails the test here."""
+    yield schedcheck_checker
+    assert not schedcheck_checker.violations, [
+        repr(v) for v in schedcheck_checker.violations]
+
+
+def produce_indexed(broker, cls, rows, parts, pad=0, topic=TOPIC):
+    filler = "x" * pad
+    for i in range(rows):
+        m = cls(query=f"q-{i}-{filler}", timestamp=i)
+        broker.produce(topic, m.SerializeToString(), partition=i % parts)
+
+
+def build_proc_writer(broker, cls, target, procs=2):
+    return (Builder().broker(broker).topic(TOPIC).proto_class(cls)
+            .target_dir(target).filesystem(LocalFileSystem())
+            .instance_name("tmplane").group_id("g")
+            .process_workers(procs)
+            .max_file_size(256 * 1024)
+            .max_file_open_duration_seconds(0.3))
+
+
+def drain(w, broker, rows, parts, deadline_s=90):
+    deadline = time.time() + deadline_s
+    while time.time() < deadline:
+        if (sum(broker.committed("g", TOPIC, p) for p in range(parts))
+                >= rows and w.ack_lag()["unacked_records"] == 0):
+            return True
+        time.sleep(0.05)
+    return False
+
+
+# -- the TM cell layout and roundtrip -----------------------------------------
+
+def test_tm_field_layout_is_pinned_and_fits_the_cell():
+    """The TM cell is shared memory: the field order is an append-only
+    wire contract between parent and child interpreters, and it must fit
+    the ring's fixed 16-slot cell."""
+    assert len(TM_FIELDS) <= 16
+    assert TM_INDEX == {n: i for i, n in enumerate(TM_FIELDS)}
+    # the first slots are load-bearing for the merged scrape gauges —
+    # pinned so a reorder (which would silently mix counters across
+    # field meanings mid-upgrade) fails here
+    assert TM_FIELDS[:4] == ("written_records", "written_bytes",
+                             "flushed_records", "flushed_bytes")
+    assert "spans_recorded" in TM_FIELDS and "stage_time_us" in TM_FIELDS
+
+
+def test_tm_cell_roundtrip_and_clear():
+    ring = ShmBatchRing(2, 1 << 15)
+    try:
+        vals = [10 * (i + 1) for i in range(len(TM_FIELDS))]
+        ring.tm_publish(0, vals)
+        got = ring.tm_read(0)
+        assert list(got[:len(TM_FIELDS)]) == vals
+        # the sibling's cell is untouched
+        assert all(v == 0 for v in ring.tm_read(1))
+        ring.tm_clear(0)
+        assert all(v == 0 for v in ring.tm_read(0))
+    finally:
+        ring.close()
+        ring.unlink()
+    # a closed ring degrades to zeros — the scrape path must never see
+    # an exception from a torn-down view
+    assert all(v == 0 for v in ring.tm_read(0))
+
+
+# -- dead-child banking -------------------------------------------------------
+
+class _FakeRing:
+    def __init__(self):
+        self.cells = {}
+
+    def tm_read(self, widx):
+        return list(self.cells.get(widx, [0] * 16))
+
+    def tm_clear(self, widx):
+        self.cells[widx] = [0] * 16
+
+
+def _cell(**fields):
+    out = [0] * 16
+    for name, v in fields.items():
+        out[TM_INDEX[name]] = v
+    return out
+
+
+def test_banking_keeps_merged_totals_monotonic_across_restart():
+    ring = _FakeRing()
+    ct = ChildTelemetry(ring, lambda: (0, 1))
+    ring.cells[0] = _cell(written_records=10, files_published=2)
+    ring.cells[1] = _cell(written_records=5)
+    assert ct.totals()["written_records"] == 15
+    # worker 0 dies: bank folds its final cell and clears it for the
+    # successor — the merged total must NOT dip
+    ct.bank(0)
+    assert all(v == 0 for v in ring.cells[0])
+    t = ct.totals()
+    assert t["written_records"] == 15
+    assert t["files_published"] == 2
+    # the successor starts from zero and counts on top
+    ring.cells[0] = _cell(written_records=3)
+    assert ct.totals()["written_records"] == 18
+    assert ct.field("files_published") == 2
+
+
+class _DeadRing:
+    def tm_read(self, widx):
+        raise RuntimeError("ring unmapped")
+
+    def tm_clear(self, widx):
+        raise RuntimeError("ring unmapped")
+
+
+def test_dead_child_cell_never_poisons_the_scrape():
+    """A scrape racing ring teardown / child respawn degrades to the
+    banked totals — totals() and bank() never raise, and a registry
+    gauge backed by the merged view keeps rendering in both exporters."""
+    ring = _FakeRing()
+    ct = ChildTelemetry(ring, lambda: (0,))
+    ring.cells[0] = _cell(written_records=7)
+    ct.bank(0)
+    ct._ring = _DeadRing()  # the teardown race, pinned deterministically
+    assert ct.totals()["written_records"] == 7  # banked half still valid
+    ct.bank(0)  # banking a dead ring is a logged no-op, not a crash
+    assert ct.totals()["written_records"] == 7
+    reg = MetricRegistry()
+    reg.gauge(M.CHILD_WRITTEN_RECORDS_GAUGE,
+              lambda: ct.field("written_records"))
+    reg.meter("parquet.writer.alive").mark()
+    prom = registry_to_prometheus(reg)
+    js = registry_to_json(reg)
+    assert f"{prometheus_name(M.CHILD_WRITTEN_RECORDS_GAUGE)} 7" in prom
+    assert js[M.CHILD_WRITTEN_RECORDS_GAUGE]["value"] == 7
+    assert "parquet_writer_alive_total 1" in prom
+
+
+def test_absorb_snapshot_keeps_last_payload_per_child():
+    ct = ChildTelemetry(_FakeRing(), lambda: ())
+    ct.absorb_snapshot(0, {"written_records": 4})
+    ct.absorb_snapshot(0, {"written_records": 9})
+    ct.absorb_snapshot(1, "not a dict")  # malformed: ignored, not raised
+    snap = ct.snapshot()
+    assert snap["child_snapshots"] == {0: {"written_records": 9}}
+    assert set(snap["children_merged"]) == set(TM_FIELDS)
+
+
+# -- the flight recorder ------------------------------------------------------
+
+def test_flight_recorder_ring_is_bounded_and_dump_parses(tmp_path):
+    meter = M.Meter()
+    fr = FlightRecorder(str(tmp_path), "box", capacity=8, meter=meter)
+    for i in range(20):
+        fr.note("tick", seq=i)
+    evts = fr.events()
+    assert len(evts) == 8  # oldest evicted, black-box style
+    assert [e["seq"] for e in evts] == list(range(12, 20))
+    fr.set_gather(lambda: {"extra": {"x": 1}})
+    path = fr.dump("watchdog_stall_kill", stalled_stage="flush", worker=0)
+    assert path is not None and os.path.exists(path)
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    assert doc["flight_recorder"] == 1
+    assert doc["trigger"] == "watchdog_stall_kill"
+    assert doc["stalled_stage"] == "flush"
+    assert doc["detail"]["worker"] == 0
+    assert doc["extra"] == {"x": 1}
+    assert [e["seq"] for e in doc["events"]] == list(range(12, 20))
+    assert meter.count == 1
+    snap = fr.snapshot()
+    assert snap["dumps_written"] == 1
+    assert snap["recent_dumps"] == [path]
+    # a second dump gets its own sequence-numbered file
+    path2 = fr.dump("quarantine")
+    assert path2 != path and os.path.exists(path)
+
+
+def test_flight_recorder_degrades_never_raises(tmp_path):
+    def bad_gather():
+        raise RuntimeError("mid-fault state walk exploded")
+
+    fr = FlightRecorder(str(tmp_path), "box")
+    fr.set_gather(bad_gather)
+    path = fr.dump("fatal_sink_pause", worker=1)
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    # a partial black box with the trigger + event ring beats none
+    assert "RuntimeError" in doc["gather_error"]
+    assert doc["trigger"] == "fatal_sink_pause"
+    # an unwritable dump dir (base is a regular FILE) is logged, not
+    # raised — the fault paths calling dump() are handling worse already
+    blocker = tmp_path / "occupied"
+    blocker.write_text("not a directory")
+    meter = M.Meter()
+    fr2 = FlightRecorder(str(blocker), "box", meter=meter)
+    assert fr2.dump("watchdog_stall_kill", stalled_stage="io") is None
+    assert meter.count == 0
+    assert fr2.snapshot()["recent_dumps"] == []
+
+
+# -- the multi-pid trace merger -----------------------------------------------
+
+def test_multiprocess_trace_merges_child_payload_with_epoch_shift():
+    rec = SpanRecorder(capacity=32)
+    mpt = MultiProcessTrace(rec)
+    mpt.absorb({"garbage": True})  # malformed payload: ignored
+    assert mpt.pids() == [os.getpid()]
+    mpt.absorb({
+        "pid": 4242,
+        "epoch_wall": rec.epoch_wall + 1.5,
+        "process_name": "kpw child 4242",
+        "spans": [("worker.publish", "KPW-worker-0", 7, 0.25, 0.5,
+                   {"file": "x"})],
+        "dropped": 3,
+    })
+    assert mpt.pids() == sorted([os.getpid(), 4242])
+    trace = mpt.to_chrome_trace()
+    child = [e for e in trace["traceEvents"]
+             if e["pid"] == 4242 and e.get("ph") == "X"]
+    assert len(child) == 1 and child[0]["name"] == "worker.publish"
+    # the child's span clock is shifted onto the parent's epoch
+    assert child[0]["ts"] == pytest.approx((0.25 + 1.5) * 1e6, rel=1e-6)
+    names = [e for e in trace["traceEvents"]
+             if e.get("ph") == "M" and e["name"] == "process_name"]
+    assert {"kpw child 4242"} <= {e["args"]["name"] for e in names}
+    assert trace["otherData"]["processes"] == mpt.pids()
+    assert trace["otherData"]["child_spans_dropped"] == 3
+
+
+# -- exporter edge cases ------------------------------------------------------
+
+def test_prometheus_escaping_of_tenant_suffixed_names():
+    """User-registered per-tenant names carry hyphens/dots that are
+    illegal in the Prometheus exposition grammar — every emitted sample
+    name must be escaped, and a leading digit gets the underscore
+    prefix."""
+    assert (prometheus_name("parquet.writer.ack.latency.team-a")
+            == "parquet_writer_ack_latency_team_a")
+    assert prometheus_name("0weird") == "_0weird"
+    reg = MetricRegistry()
+    reg.histogram("parquet.writer.ack.latency.team-a").update(0.25)
+    reg.meter("tenant.team-b.deadletter").mark()
+    prom = registry_to_prometheus(reg)
+    for line in prom.splitlines():
+        if line and not line.startswith("#"):
+            assert "-" not in line.split("{")[0].split(" ")[0], line
+    assert 'parquet_writer_ack_latency_team_a{quantile="0.5"} 0.25' in prom
+    assert "tenant_team_b_deadletter_total 1" in prom
+
+
+def test_nan_and_raising_gauges_render_without_poisoning_the_scrape():
+    reg = MetricRegistry()
+    reg.gauge("plane.nan", lambda: float("nan"))
+
+    def dead_provider():
+        raise RuntimeError("closed writer structure")
+
+    reg.gauge("plane.dead", dead_provider)
+    reg.gauge("plane.fine", lambda: 3.5)
+    prom = registry_to_prometheus(reg)
+    assert "plane_nan NaN" in prom
+    assert "plane_dead NaN" in prom  # a raising provider IS the NaN case
+    assert "plane_fine 3.5" in prom
+    js = registry_to_json(reg)
+    assert js["plane.nan"]["value"] is None  # NaN is not valid RFC JSON
+    assert js["plane.dead"]["value"] is None
+    assert js["plane.fine"]["value"] == 3.5
+    json.dumps(js)  # the whole document stays serializable
+
+
+# -- end-to-end ack latency (thread mode) -------------------------------------
+
+def test_ack_latency_histogram_observes_ingest_to_durable(tmp_path):
+    """The ingest wall-stamp travels poll -> shred -> publish -> ack and
+    lands as seconds in the canonical ack-latency histogram: positive,
+    bounded by the run's wall time, visible in stats() and the
+    registry."""
+    rows = 3000
+    cls = sample_message_class()
+    broker = FakeBroker()
+    broker.create_topic(TOPIC, PARTS)
+    produce_indexed(broker, cls, rows, PARTS, pad=40)
+    reg = MetricRegistry()
+    t0 = time.time()
+    w = (Builder().broker(broker).topic(TOPIC).proto_class(cls)
+         .target_dir(str(tmp_path / "out")).filesystem(LocalFileSystem())
+         .instance_name("acklat").group_id("g").thread_count(2)
+         .metric_registry(reg).max_file_size(128 * 1024)
+         .max_file_open_duration_seconds(0.3).build())
+    w.start()
+    try:
+        assert drain(w, broker, rows, PARTS), w.ack_lag()
+        wall = time.time() - t0
+        snap = w.stats()["ack_latency"]
+        assert snap["count"] > 0
+        assert 0.0 < snap["p50"] <= snap["p99"] <= wall + 1.0
+        rsnap = reg.get(M.ACK_LATENCY_HISTOGRAM).snapshot()
+        assert rsnap["count"] >= snap["count"]
+        js = registry_to_json(reg)
+        assert js[M.ACK_LATENCY_HISTOGRAM]["count"] == rsnap["count"]
+    finally:
+        w.close()
+
+
+# -- the merged scrape + multi-pid trace under real processes -----------------
+
+def test_one_parent_scrape_covers_the_whole_tree(tmp_path):
+    """Under process_workers(2): ONE parent registry scrape includes the
+    children's shm-merged counters, and the merged Chrome trace spans
+    >= 2 real pids — no per-child scraping, no pid collisions."""
+    rows = 3000
+    cls = sample_message_class()
+    broker = FakeBroker()
+    broker.create_topic(TOPIC, PARTS)
+    produce_indexed(broker, cls, rows, PARTS, pad=60)
+    reg = MetricRegistry()
+    w = (build_proc_writer(broker, cls, str(tmp_path / "out"))
+         .metric_registry(reg).tracing(True, span_capacity=4096).build())
+    w.start()
+    try:
+        assert drain(w, broker, rows, PARTS), w.ack_lag()
+        # the TM cells tick at ~20 Hz in the children: wait for the
+        # merged view to catch up to the drained stream (incl. the
+        # final publish) before scraping
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            merged = w.stats()["telemetry"]["children_merged"]
+            if (merged["written_records"] >= rows
+                    and merged["files_published"] >= 1
+                    and len(w.trace_merger.pids()) >= 2):
+                break
+            time.sleep(0.05)
+        st = w.stats()
+        merged = st["telemetry"]["children_merged"]
+        assert merged["written_records"] >= rows
+        assert merged["files_published"] >= 1
+        # the scrape itself: child-origin counters in both exporters
+        js = registry_to_json(reg)
+        assert js[M.CHILD_WRITTEN_RECORDS_GAUGE]["value"] >= rows
+        pn = prometheus_name(M.CHILD_WRITTEN_RECORDS_GAUGE)
+        assert pn in registry_to_prometheus(reg)
+        # the merged trace: real child pids, parent is the anchor
+        pids = w.trace_merger.pids()
+        assert os.getpid() in pids and len(pids) >= 3  # parent + 2 children
+        assert st["spans"]["merged_pids"] == pids
+        trace = w.trace_merger.to_chrome_trace()
+        event_pids = {e["pid"] for e in trace["traceEvents"]
+                      if e.get("ph") == "X"}
+        assert len(event_pids) >= 2
+        # healthy run: the black box stayed dump-free
+        assert w._flightrec.snapshot()["dumps_written"] == 0
+    finally:
+        w.close()
+
+
+# -- the crash flight recorder on the three fatal paths -----------------------
+
+def test_watchdog_sigkill_dumps_black_box_naming_stalled_stage(tmp_path):
+    """The acceptance drill: the watchdog condemning a hung child
+    produces a flight-recorder JSON naming the stalled stage — the
+    post-mortem exists on local disk, parses, and attributes the exact
+    stage the watchdog saw."""
+    rows = 3000
+    cls = sample_message_class()
+    broker = FakeBroker()
+    broker.create_topic(TOPIC, PARTS)
+    produce_indexed(broker, cls, rows, PARTS, pad=60)
+    target = str(tmp_path / "out")
+    w = (build_proc_writer(broker, cls, target)
+         .supervise(True, max_restarts=3, restart_backoff_seconds=0.05)
+         .watchdog(True, io_stall_deadline_seconds=30.0,
+                   abandon_stalled=True)
+         .build())
+    w.start()
+    try:
+        # wait for the stream to get going AND for the children's
+        # ~20 Hz TM ticks to land (the dump below asserts on the
+        # merged cell view, not just the parent-side meters)
+        deadline = time.time() + 45
+        while (time.time() < deadline
+               and (w.total_written_records < rows / 8
+                    or (w.stats()["telemetry"]["children_merged"]
+                        ["written_records"]) == 0)):
+            time.sleep(0.01)
+        slot = w._workers[0]
+        # simulate the watchdog crossing the deadline on this slot
+        w._on_watchdog_stall(0, slot, 99.0, "flush")
+        assert slot.condemned and slot.failed
+        dumps = glob.glob(
+            f"{target}/flightrec/*_watchdog_stall_kill.json")
+        assert len(dumps) == 1, dumps
+        with open(dumps[0], encoding="utf-8") as f:
+            doc = json.load(f)
+        assert doc["trigger"] == "watchdog_stall_kill"
+        assert doc["stalled_stage"] == "flush"
+        assert doc["detail"] == {"worker": 0, "stall_age_s": 99.0}
+        assert any(e["kind"] == "watchdog_stall" for e in doc["events"])
+        # gather sections made it in: the post-mortem can say what the
+        # tree was doing, not just that it died
+        assert "ack" in doc and "workers" in doc
+        assert doc["children_merged"]["written_records"] > 0
+        assert w._flightrec.snapshot()["dumps_written"] >= 1
+        # the stream still drains after the kill (at-least-once intact)
+        assert drain(w, broker, rows, PARTS), w.ack_lag()
+    finally:
+        w.close()
+
+
+def test_kill9_drill_yields_parseable_worker_death_dump(tmp_path):
+    """The PR-11 kill -9 drill, re-run: a SIGKILLed child (no goodbye
+    message) must now yield a parseable flight-recorder dump whose
+    stalled-stage attribution comes from the dead child's heartbeat
+    cell — read before the respawn clears it."""
+    rows = 6000
+    cls = sample_message_class()
+    broker = FakeBroker()
+    broker.create_topic(TOPIC, PARTS)
+    produce_indexed(broker, cls, rows, PARTS, pad=80)
+    target = str(tmp_path / "out")
+    w = (build_proc_writer(broker, cls, target)
+         .supervise(True, max_restarts=3, restart_backoff_seconds=0.05)
+         .build())
+    w.start()
+    try:
+        deadline = time.time() + 45
+        while (time.time() < deadline
+               and w.total_written_records < rows / 4):
+            time.sleep(0.01)
+        victim = w._workers[0].pid
+        assert victim is not None
+        os.kill(victim, signal.SIGKILL)
+        assert drain(w, broker, rows, PARTS), w.ack_lag()
+        dumps = glob.glob(f"{target}/flightrec/*_worker_death.json")
+        assert dumps, ("kill -9 left no flight-recorder dump — the "
+                       "black box missed an unexpected child death")
+        with open(dumps[0], encoding="utf-8") as f:
+            doc = json.load(f)
+        assert doc["trigger"] == "worker_death"
+        assert doc["detail"]["worker"] == 0
+        # attribution: the op the child was inside when it was killed
+        # (its heartbeat cell survives the death), or idle between ops
+        assert doc["stalled_stage"] in (*_HB_LABELS, "idle")
+        assert "-9" in doc["detail"]["reason"]
+        assert any(e["kind"] == "worker_death" for e in doc["events"])
+        assert w.stats()["supervision"]["restarts_total"] >= 1
+    finally:
+        w.close()
+
+
+def test_noisy_neighbor_drill_dumps_fatal_sink_pause_contained(tmp_path):
+    """The PR-15 noisy-neighbor drill, re-run: a fatal ENOSPC on ONE
+    tenant's sink must now yield a parseable flight-recorder dump on
+    THAT route attributing the injected fault (a sink write), while the
+    healthy sibling's recorder stays dump-free (fault containment holds
+    for the black box too)."""
+    from test_tenants import base_builder
+    from test_tenants import drain as mw_drain
+    from test_tenants import produce as mw_produce
+
+    cls = sample_message_class()
+    broker = FakeBroker()
+    broker.create_topic("sick", PARTS)
+    broker.create_topic("well", PARTS)
+    mw_produce(broker, "sick", cls, 2000)
+    mw_produce(broker, "well", cls, 2000)
+    sched = FaultSchedule(seed=3).recover_after("write", nth=6,
+                                                err=errno.ENOSPC)
+    sick_fs = FaultInjectingFileSystem(MemoryFileSystem(), sched)
+    fr_sick = str(tmp_path / "fr_sick")
+    fr_well = str(tmp_path / "fr_well")
+    mw = (base_builder(broker, MemoryFileSystem())
+          .route("sick", cls, "/fd/sick", filesystem=sick_fs,
+                 degraded_mode={"flag": True,
+                                "probe_interval_seconds": 0.05,
+                                "probe_backoff_max_seconds": 0.2},
+                 flight_recorder={"flag": True, "path": fr_sick})
+          .route("well", cls, "/fd/well", filesystem=MemoryFileSystem(),
+                 ack_sla_seconds=30,
+                 flight_recorder={"flag": True, "path": fr_well})
+          .build())
+    try:
+        mw.start()
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if mw.stats()["tenants"]["sick"]["state"] == "paused":
+                break
+            time.sleep(0.02)
+        assert mw.stats()["tenants"]["sick"]["state"] == "paused"
+        dumps = glob.glob(f"{fr_sick}/flightrec/*_fatal_sink_pause.json")
+        assert dumps, ("the fatal-sink pause left no flight-recorder "
+                       "dump on the faulted route")
+        with open(dumps[0], encoding="utf-8") as f:
+            doc = json.load(f)
+        assert doc["trigger"] == "fatal_sink_pause"
+        assert doc["stalled_stage"] == "write"  # the injected fault's op
+        assert "write" in doc["detail"]["cause"]
+        assert any(e["kind"] == "fatal_sink_pause" for e in doc["events"])
+        # containment: the healthy sibling's black box recorded nothing
+        assert not glob.glob(f"{fr_well}/flightrec/*.json")
+        # heal: both routes drain; the drill ends healthy
+        sched.heal()
+        mw_drain(mw, broker, {"sick": 2000, "well": 2000})
+        assert mw.stats()["tenants"]["well"]["workers_dead"] == 0
+    finally:
+        mw.close()

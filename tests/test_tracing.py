@@ -2,6 +2,7 @@
 
 import io
 import json
+import os
 import threading
 import time
 
@@ -147,10 +148,12 @@ def test_chrome_trace_roundtrip():
     for e in xs:
         assert isinstance(e["ts"], (int, float)) and e["ts"] >= 0
         assert isinstance(e["dur"], (int, float)) and e["dur"] >= 0
-        assert e["pid"] == 1 and isinstance(e["tid"], int)
+        assert e["pid"] == os.getpid() and isinstance(e["tid"], int)
     outer = next(e for e in xs if e["name"] == "trace.outer")
     assert outer["args"] == {"rowgroup": 3, "rows": 100}
     assert outer["dur"] >= 2000  # slept 2 ms; dur is microseconds
     meta = [e for e in events if e["ph"] == "M"]
-    assert meta and all(e["name"] == "thread_name" for e in meta)
+    assert meta and all(e["name"] in ("thread_name", "process_name")
+                        for e in meta)
+    assert any(e["name"] == "process_name" for e in meta)
     assert doc["otherData"]["spans_dropped"] == 0

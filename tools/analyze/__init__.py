@@ -36,6 +36,9 @@ Passes (see each module's docstring for the rule and its history):
 * ``encoding-choice`` — value encodings are chosen only in
   core/select_encoding.py; ``Encoding.`` literals elsewhere must be
   comparisons or annotated mechanism sites (tools/analyze/encchoice.py)
+* ``stage-coverage`` — stage() names must be string literals (dynamic
+  names bypass the STAGE_NAMES registry) and the returned context
+  manager must actually be entered (tools/analyze/stagecover.py)
 
 Suppression is per-site and justified: ``# lint: <pass> ok — <reason>``
 on the flagged line or the line above.  A reason-less annotation is
@@ -49,7 +52,7 @@ static passes lint).
 from __future__ import annotations
 
 from . import (clocks, encchoice, faultiso, hotimports, locks, names,
-               protocol, respair, spawnsafety, swallow)
+               protocol, respair, spawnsafety, stagecover, swallow)
 
 # registration order = report order
 PASSES = {
@@ -63,6 +66,7 @@ PASSES = {
     protocol.PASS_NAME: protocol,
     clocks.PASS_NAME: clocks,
     encchoice.PASS_NAME: encchoice,
+    stagecover.PASS_NAME: stagecover,
 }
 
 PASS_NAMES = tuple(PASSES)

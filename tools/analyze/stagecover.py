@@ -1,0 +1,87 @@
+"""Stage-coverage pass: ``stage(...)`` call sites must be statically
+traceable — a literal, registered name, actually entered.
+
+The canonical-names pass closes the literal↔registry loop, but it can
+only see string literals.  This pass covers the two ways a ``stage()``
+site escapes that loop entirely:
+
+* **dynamic names** — ``stage(f"worker.{x}")``, ``stage(name_var)``:
+  the span records under a name no registry entry, dashboard anchor, or
+  doc claim can reference, and the canonical-names pass silently skips
+  the site.  Stage identity must be a literal; variability belongs in
+  the ``**attrs`` kwargs (``stage("compactor.round", tenant=name)``),
+  which ride the trace as span args.
+* **never-entered sites** — a bare ``stage("x")`` expression statement:
+  ``stage()`` returns a context manager, and one that is never entered
+  records nothing.  The site *looks* instrumented (it has a registered
+  name, the reverse-direction registry check is satisfied) while the
+  leg runs untraced — exactly the gap this pass exists to close.
+
+Scope: every scanned file (the seam is one global function, so there is
+no module whitelist to maintain).  Only call sites named ``stage`` with
+at least one positional argument are considered; ``**attrs`` keywords
+are free-form by design.
+
+Suppression: ``# lint: stage-coverage ok — <reason>`` per site.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .common import Config, Finding, ParsedFile, suppressed
+
+PASS_NAME = "stage-coverage"
+DESCRIPTION = ("stage() names must be string literals and the returned "
+               "context manager must actually be entered")
+
+
+def _is_stage_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    name = (f.id if isinstance(f, ast.Name)
+            else f.attr if isinstance(f, ast.Attribute) else None)
+    return name == "stage"
+
+
+def run(files: dict[str, ParsedFile], cfg: Config) -> list[Finding]:
+    findings: list[Finding] = []
+    for pf in files.values():
+        # stage() calls that ARE entered: `with stage(...)` items (plain
+        # and async), so the walk below can flag the rest
+        entered: set[int] = set()
+        for node in ast.walk(pf.tree):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    if _is_stage_call(item.context_expr):
+                        entered.add(id(item.context_expr))
+        for node in ast.walk(pf.tree):
+            # a bare `stage("x")` statement: context manager built,
+            # never entered, nothing recorded — the leg runs untraced
+            if (isinstance(node, ast.Expr) and _is_stage_call(node.value)
+                    and id(node.value) not in entered):
+                if not suppressed(pf, PASS_NAME, node.lineno, findings):
+                    findings.append(Finding(
+                        PASS_NAME, pf.path, node.lineno,
+                        "stage(...) result is discarded — the context "
+                        "manager is never entered, so the site records "
+                        "nothing; wrap the leg in `with stage(...)`"))
+            if not _is_stage_call(node):
+                continue
+            if not node.args:
+                continue  # zero-arg call is some other stage()
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                continue  # literal: canonical-names owns it from here
+            if suppressed(pf, PASS_NAME, node.lineno, findings):
+                continue
+            spelled = ("an f-string" if isinstance(arg, ast.JoinedStr)
+                       else "a non-literal expression")
+            findings.append(Finding(
+                PASS_NAME, pf.path, node.lineno,
+                f"stage() name is {spelled} — dynamic stage names bypass "
+                f"the STAGE_NAMES registry (and every doc/dashboard "
+                f"anchor on it); use a registered literal name and put "
+                f"the variability in **attrs"))
+    return findings

@@ -269,6 +269,26 @@ CHECKS = [
                   r"\*\*([\d.]+)\*\*",
      ["encodings:file_bytes_ratio_adaptive_vs_plain",
       "encodings:write_throughput_ratio_adaptive_vs_default"]),
+    # cross-process telemetry plane PR: tracing-overhead A/B, per-tenant
+    # ack-latency, and the merged-scrape counters reconcile against the
+    # r21 observability artifact (`obs21:` prefix, BENCH_OBS_r21.json)
+    ("README.md", r"tracing-overhead A/B records \*\*\+([\d.]+)%\*\* with\s+"
+                  r"spans enabled",
+     ["obs21:tracing_overhead.overhead_pct"]),
+    ("README.md", r"analytics \*\*([\d.]+) ms\*\* p50 / \*\*([\d.]+) "
+                  r"ms\*\* p99,\s+audit \*\*([\d.]+) ms\*\* p50 / "
+                  r"\*\*([\d.]+) ms\*\* p99",
+     [("obs21:ack_latency_s_by_tenant.analytics.p50_s", 1e-3),
+      ("obs21:ack_latency_s_by_tenant.analytics.p99_s", 1e-3),
+      ("obs21:ack_latency_s_by_tenant.audit.p50_s", 1e-3),
+      ("obs21:ack_latency_s_by_tenant.audit.p99_s", 1e-3)]),
+    ("PARITY.md", r"`overhead_pct` \*\*\+([\d.]+)%\*\* against the 3% "
+                  r"gate",
+     ["obs21:tracing_overhead.overhead_pct"]),
+    ("PARITY.md", r"merged scrape carried \*\*(\d+)\*\* child snapshots\s+"
+                  r"covering \*\*(\d+)\*\* child-written records",
+     ["obs21:proc_leg.child_snapshots_merged",
+      "obs21:proc_leg.children_merged_written_records"]),
 ]
 
 
@@ -669,6 +689,12 @@ def main() -> int:
         "KPW_ENCODINGS_PATH", os.path.join(ROOT, "BENCH_ENCODINGS_r20.json"))
     if os.path.exists(encodings_path):
         key_record["encodings"] = json.load(open(encodings_path))
+    # the cross-process telemetry-plane artifact (bench.py --obs) is the
+    # fourteenth
+    obs21_path = os.environ.get(
+        "KPW_OBS21_PATH", os.path.join(ROOT, "BENCH_OBS_r21.json"))
+    if os.path.exists(obs21_path):
+        key_record["obs21"] = json.load(open(obs21_path))
     docs = {f: open(os.path.join(ROOT, f)).read()
             for f in ({c[0] for c in CHECKS} | set(KEY_DOCS)
                       | set(NAME_DOCS))}
@@ -709,6 +735,8 @@ def main() -> int:
                 root, spec = key_record.get("tenants", {}), spec[8:]
             elif spec.startswith("encodings:"):
                 root, spec = key_record.get("encodings", {}), spec[10:]
+            elif spec.startswith("obs21:"):
+                root, spec = key_record.get("obs21", {}), spec[6:]
             try:
                 expect = float(art(root, spec)) / scale
             except (KeyError, TypeError):

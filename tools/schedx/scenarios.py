@@ -67,8 +67,17 @@ class _ScenarioWriter:
         self._file_size_histogram = Histogram()
         self.deaths_notified = 0
 
-    def _notify_worker_death(self) -> None:
+    def _notify_worker_death(self, index=None, reason=None) -> None:
         self.deaths_notified += 1
+
+    # PR-17 telemetry-plane seams: the pool banks/absorbs child counters
+    # on the respawn and snapshot paths — no-ops here, the scenarios
+    # probe the ring/death races, not the merged scrape
+    def _bank_child_telemetry(self, index) -> None:
+        pass
+
+    def _absorb_child_telemetry(self, payload) -> None:
+        pass
 
 
 def _make_pool(tmpdir: str, workers: int = 1, ring_slots: int = 4):
