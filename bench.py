@@ -123,6 +123,7 @@ import json
 import os
 import subprocess
 import sys
+import threading
 import time
 
 import numpy as np
@@ -5051,6 +5052,409 @@ def encodings_probe(rows: int = 400_000, seed: int = 16,
 
 
 # ---------------------------------------------------------------------------
+# --rebalance: consumer-group rebalance drills — instance kill with
+# survivor reclaim, zombie fencing mid-publish, cooperative handoff
+# (ISSUE 18)
+# ---------------------------------------------------------------------------
+
+class _PublishGateFS:
+    """LocalFileSystem wrapper that can park a publish mid-flight: when
+    armed, any ``exists`` probe of a non-tmp path (the publish collision
+    check) blocks until released.  The zombie leg uses it to freeze one
+    instance INSIDE its publish while the group expires it."""
+
+    def __init__(self, target: str) -> None:
+        from kpw_tpu import LocalFileSystem
+
+        self.inner = LocalFileSystem()
+        self._tmp_prefix = target.rstrip("/") + "/tmp"
+        self._gate = threading.Event()
+        self._gate.set()
+        self.parked = threading.Event()
+
+    def arm(self) -> None:
+        self._gate.clear()
+
+    def release(self) -> None:
+        self._gate.set()
+
+    def exists(self, path: str) -> bool:
+        if not self._gate.is_set() and not path.startswith(self._tmp_prefix):
+            self.parked.set()
+            self._gate.wait()
+        return self.inner.exists(path)
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+
+def _rebalance_writer(broker, tgt: str, name: str, cls, fs=None,
+                      drain: float = 2.0):
+    from kpw_tpu import Builder, LocalFileSystem, RetryPolicy
+
+    return (Builder().broker(broker).topic("t").proto_class(cls)
+            .target_dir(tgt).filesystem(fs or LocalFileSystem())
+            .instance_name(name).group_id("g")
+            .batch_size(64).thread_count(1)
+            .retry_policy(RetryPolicy(base_sleep=0.005, max_sleep=0.05))
+            .max_file_size(128 * 1024).block_size(16 * 1024)
+            .max_file_open_duration_seconds(0.3)
+            .rebalance_drain_deadline_seconds(drain)
+            .build())
+
+
+def _rebalance_produce(broker, cls, lo: int, hi: int, parts: int) -> None:
+    pad = "x" * 60
+    for i in range(lo, hi):
+        broker.produce("t", cls(query=f"r-{i % parts}-{i}-{pad}",
+                                timestamp=i).SerializeToString(),
+                       partition=i % parts)
+
+
+def _rebalance_rowcheck(tgt: str, parts: int, n: int) -> dict:
+    """Exactly-once read-back: every produced row appears in the published
+    tree exactly once (lost == dup == 0)."""
+    import pyarrow.parquet as pq
+
+    from crash_child import published_files
+
+    rows: dict[str, int] = {}
+    for f in published_files(tgt):
+        for r in pq.read_table(f, columns=["query"]).to_pylist():
+            rows[r["query"]] = rows.get(r["query"], 0) + 1
+    pad = "x" * 60
+    expect = {f"r-{i % parts}-{i}-{pad}" for i in range(n)}
+    return {"rows": n,
+            "lost": len(expect - set(rows)),
+            "dups": sum(1 for v in rows.values() if v > 1)}
+
+
+def _rebalance_spin(pred, timeout: float, interval: float = 0.02) -> bool:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+def _rebalance_kill_leg(cls, n: int, deadline_s: float) -> dict:
+    """Three instances share one group + target tree; one is hard-killed
+    (the in-process kill -9 analog: no leave, no flush, no final acks)
+    mid-file.  Survivors reclaim its partitions after session expiry;
+    blackout = how long the dead member's partitions' committed frontier
+    stood still past the kill."""
+    import tempfile
+
+    from kpw_tpu import FakeBroker
+
+    parts = 6
+    broker = FakeBroker(session_timeout_s=0.5, revocation_drain_s=2.0)
+    broker.create_topic("t", parts)
+    with tempfile.TemporaryDirectory(prefix="kpw_rebal_kill_") as tgt:
+        writers = [_rebalance_writer(broker, tgt, f"w{i}", cls)
+                   for i in range(3)]
+        lats: list = []
+        for w in writers:
+            w.consumer.set_latency_observer(
+                lambda lat_s, cnt: lats.append(lat_s))
+            w.start()
+        victim = writers[2]
+        assert _rebalance_spin(
+            lambda: all(len(w.stats()["consumer"]["rebalance"]["assigned"])
+                        == 2 for w in writers), 20), "group never settled"
+        _rebalance_produce(broker, cls, 0, n // 2, parts)
+        # kill only once the victim HOLDS unacked rows in an open file —
+        # that is what makes the redelivery leg of the drill non-vacuous
+        assert _rebalance_spin(
+            lambda: victim.ack_lag()["unacked_records"] > 0, 20), (
+            "victim never held unacked rows")
+        victim_parts = list(
+            victim.stats()["consumer"]["rebalance"]["assigned"])
+        frontier = [(time.perf_counter(),
+                     sum(broker.committed("g", "t", p)
+                         for p in victim_parts))]
+        stop_sampling = threading.Event()
+
+        def _sample():
+            while not stop_sampling.is_set():
+                frontier.append((time.perf_counter(),
+                                 sum(broker.committed("g", "t", p)
+                                     for p in victim_parts)))
+                time.sleep(0.01)
+
+        sampler = threading.Thread(target=_sample, daemon=True)
+        sampler.start()
+        t_kill = time.perf_counter()
+        victim.hard_kill()
+        _rebalance_produce(broker, cls, n // 2, n, parts)
+        drained = _rebalance_spin(
+            lambda: (sum(broker.committed("g", "t", p)
+                         for p in range(parts)) >= n
+                     and all(w.ack_lag()["unacked_records"] == 0
+                             for w in writers[:2])), deadline_s)
+        stop_sampling.set()
+        sampler.join(timeout=2)
+        f_kill = max(v for t, v in frontier if t <= t_kill)
+        adv = [t for t, v in frontier if t > t_kill and v > f_kill]
+        blackout = round((adv[0] - t_kill), 3) if adv else None
+        gstats = broker.group_stats("g", "t")
+        survivor_resets = sum(
+            w.stats()["consumer"]["rebalance"]["full_resets"]
+            for w in writers[:2])
+        reassigned = sorted(
+            p for w in writers[:2]
+            for p in w.stats()["consumer"]["rebalance"]["assigned"])
+        for w in writers[:2]:
+            w.close()
+        check = _rebalance_rowcheck(tgt, parts, n)
+    vs = sorted(lats)
+
+    def pct(q: float) -> float:
+        return round(vs[int(q * (len(vs) - 1))], 4) if vs else 0.0
+
+    return check | {
+        "instances": 3,
+        "partitions": parts,
+        "drained": drained,
+        "rebalance_blackout_seconds": blackout,
+        "expired_members": gstats["expired_members"],
+        "rebalances": gstats["rebalances"],
+        "survivor_full_resets": survivor_resets,
+        "survivors_own_all": reassigned == list(range(parts)),
+        "ack_latency_p50_s": pct(0.50),
+        "ack_latency_p99_s": pct(0.99),
+        "ack_samples": len(vs),
+    }
+
+
+def _rebalance_zombie_leg(cls, n: int, deadline_s: float) -> dict:
+    """Zombie fencing: park one instance INSIDE its publish, let the
+    session expire and the survivor take over (and republish), then
+    resume the zombie — its stale ack must come back as the typed fence
+    error, and the fenced-unpublish backstop must remove its file so the
+    tree stays exactly-once."""
+    import tempfile
+
+    from kpw_tpu import FakeBroker
+
+    parts = 4
+    broker = FakeBroker(session_timeout_s=0.5, revocation_drain_s=1.0)
+    broker.create_topic("t", parts)
+    with tempfile.TemporaryDirectory(prefix="kpw_rebal_zomb_") as tgt:
+        gfs = _PublishGateFS(tgt)
+        victim = _rebalance_writer(broker, tgt, "vic", cls, fs=gfs,
+                                   drain=1.0)
+        surv = _rebalance_writer(broker, tgt, "sur", cls)
+        victim.start()
+        surv.start()
+        _rebalance_produce(broker, cls, 0, n // 2, parts)
+        assert _rebalance_spin(
+            lambda: len(surv.stats()["consumer"]["rebalance"]["assigned"])
+            == 2, 20), "group never settled"
+        gfs.arm()
+        _rebalance_produce(broker, cls, n // 2, n, parts)
+        parked = gfs.parked.wait(timeout=30)
+        assert parked, "victim never reached a publish"
+        victim.consumer.suspend(True)  # freeze its heartbeat too
+        drained = _rebalance_spin(
+            lambda: (sum(broker.committed("g", "t", p)
+                         for p in range(parts)) >= n
+                     and surv.ack_lag()["unacked_records"] == 0),
+            deadline_s)
+        victim.consumer.suspend(False)
+        gfs.release()
+        fenced_seen = _rebalance_spin(
+            lambda: victim._fenced_acks.count >= 1, 20)
+        gstats = broker.group_stats("g", "t")
+        vstats = victim.stats()["consumer"]["rebalance"]
+        victim.close()
+        surv.close()
+        check = _rebalance_rowcheck(tgt, parts, n)
+    return check | {
+        "drained": drained,
+        "victim_parked_in_publish": parked,
+        "stale_commits_fenced": gstats["fenced_commits"],
+        "victim_fenced_acks_seen": fenced_seen,
+        "victim_rejoins": vstats["rejoins"],
+        "expired_members": gstats["expired_members"],
+    }
+
+
+def _rebalance_coop_leg(cls, n: int, deadline_s: float) -> dict:
+    """Cooperative handoff: a second instance joins mid-stream.  Only the
+    moving partitions pause; the first instance's RETAINED partitions
+    must keep committing through the handoff window (measured as frontier
+    advance during [join, join + 1s]) with zero full resets."""
+    import tempfile
+
+    from kpw_tpu import FakeBroker
+
+    parts = 6
+    broker = FakeBroker(session_timeout_s=2.0, revocation_drain_s=2.0)
+    broker.create_topic("t", parts)
+    with tempfile.TemporaryDirectory(prefix="kpw_rebal_coop_") as tgt:
+        wa = _rebalance_writer(broker, tgt, "wa", cls)
+        wa.start()
+        assert _rebalance_spin(
+            lambda: len(wa.stats()["consumer"]["rebalance"]["assigned"])
+            == parts, 20), "first member never owned the topic"
+        feeder_done = threading.Event()
+
+        def _feed():
+            # steady trickle so the handoff window has live traffic
+            step = max(1, n // 60)
+            for lo in range(0, n, step):
+                _rebalance_produce(broker, cls, lo,
+                                   min(n, lo + step), parts)
+                time.sleep(0.05)
+            feeder_done.set()
+
+        feeder = threading.Thread(target=_feed, daemon=True)
+        feeder.start()
+        assert _rebalance_spin(
+            lambda: sum(broker.committed("g", "t", p)
+                        for p in range(parts)) > 0, 20), (
+            "no commits before the join")
+        samples: list = []
+        stop_sampling = threading.Event()
+
+        def _sample():
+            while not stop_sampling.is_set():
+                samples.append(
+                    (time.perf_counter(),
+                     tuple(broker.committed("g", "t", p)
+                           for p in range(parts))))
+                time.sleep(0.01)
+
+        sampler = threading.Thread(target=_sample, daemon=True)
+        sampler.start()
+        t_join = time.perf_counter()
+        wb = _rebalance_writer(broker, tgt, "wb", cls)
+        wb.start()
+        settled = _rebalance_spin(
+            lambda: (len(wb.stats()["consumer"]["rebalance"]["assigned"])
+                     == parts // 2
+                     and len(wa.stats()["consumer"]["rebalance"]
+                             ["assigned"]) == parts // 2), 20)
+        retained = sorted(wa.stats()["consumer"]["rebalance"]["assigned"])
+        time.sleep(max(0.0, t_join + 1.2 - time.perf_counter()))
+        stop_sampling.set()
+        sampler.join(timeout=2)
+        drained = _rebalance_spin(
+            lambda: (feeder_done.is_set()
+                     and sum(broker.committed("g", "t", p)
+                             for p in range(parts)) >= n
+                     and wa.ack_lag()["unacked_records"] == 0
+                     and wb.ack_lag()["unacked_records"] == 0),
+            deadline_s)
+        sa = wa.stats()["consumer"]["rebalance"]
+        sb = wb.stats()["consumer"]["rebalance"]
+        wa.close()
+        wb.close()
+        check = _rebalance_rowcheck(tgt, parts, n)
+    # did the retained partitions commit DURING the handoff window?
+    window = [(t, sum(v[p] for p in retained)) for t, v in samples
+              if t_join <= t <= t_join + 1.0]
+    advanced = bool(window) and window[-1][1] > window[0][1]
+    return check | {
+        "drained": drained,
+        "settled": settled,
+        "retained_partitions": retained,
+        "unrevoked_committed_during_handoff": advanced,
+        "full_resets": sa["full_resets"] + sb["full_resets"],
+        "cooperative_rebalances": sa["cooperative_rebalances"],
+    }
+
+
+def rebalance_probe(smoke: bool = False) -> dict:
+    """``--rebalance`` mode: the consumer-group rebalance drill's
+    committed evidence (ISSUE 18).
+
+    Three legs against the coordinated ``FakeBroker`` protocol
+    (session heartbeats, generation fencing, cooperative drain windows):
+
+    * KILL — three instances share one group and one target tree; one is
+      hard-killed (no leave, no flush, no final acks) while it holds
+      unacked rows in an open file.  Survivors reclaim after session
+      expiry; the artifact records the blackout (how long the dead
+      member's partitions' committed frontier stood still), p50/p99 ack
+      latency measured from the BROKER APPEND stamp (so redelivered rows
+      carry their true age across the handoff), and the exactly-once
+      read-back (0 lost / 0 dup).
+    * ZOMBIE — an instance parked INSIDE its publish through its own
+      expiry; on resume its stale ack is fenced with the typed error and
+      the fenced-unpublish backstop removes its file (>= 1 fenced commit
+      proves the fence non-vacuous).
+    * COOPERATIVE — a second instance joins mid-stream; only the moving
+      partitions pause, the first instance's retained partitions keep
+      committing through the handoff window, zero full resets.
+
+    ``--smoke`` is the CI gate: reduced rows, never writes the artifact,
+    exits nonzero unless every leg reads back exactly-once AND the fence
+    fired AND the cooperative leg kept its unrevoked partitions moving."""
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                    "tests"))
+    from proto_helpers import sample_message_class
+
+    cls = sample_message_class()
+    if smoke:
+        n_kill, n_zombie, n_coop, deadline_s = 2_400, 800, 1_200, 60.0
+    else:
+        n_kill, n_zombie, n_coop, deadline_s = 12_000, 2_000, 3_000, 180.0
+    t0 = time.perf_counter()
+    kill = _rebalance_kill_leg(cls, n_kill, deadline_s)
+    zombie = _rebalance_zombie_leg(cls, n_zombie, deadline_s)
+    coop = _rebalance_coop_leg(cls, n_coop, deadline_s)
+    lost = kill["lost"] + zombie["lost"] + coop["lost"]
+    dups = kill["dups"] + zombie["dups"] + coop["dups"]
+    invariant = (lost == 0 and dups == 0
+                 and kill["drained"] and zombie["drained"]
+                 and coop["drained"]
+                 and kill["expired_members"] == 1
+                 and kill["survivor_full_resets"] == 0
+                 and kill["survivors_own_all"]
+                 and zombie["stale_commits_fenced"] >= 1
+                 and zombie["victim_fenced_acks_seen"]
+                 and coop["full_resets"] == 0
+                 and coop["cooperative_rebalances"] >= 1
+                 and coop["unrevoked_committed_during_handoff"])
+    out = {
+        "metric": "rebalance_blackout_seconds",
+        "value": kill["rebalance_blackout_seconds"],
+        "unit": "s",
+        "rows_total": kill["rows"] + zombie["rows"] + coop["rows"],
+        "lost": lost,
+        "dups": dups,
+        "kill": kill,
+        "zombie": zombie,
+        "cooperative": coop,
+        "invariant_holds": invariant,
+        "bench_wall_s": round(time.perf_counter() - t0, 1),
+        "policy": ("coordinated FakeBroker protocol (0.5 s session "
+                   "timeout on the kill/zombie legs): hard_kill is the "
+                   "in-process kill -9 analog — no leave_group, no "
+                   "flush, no final acks; blackout sampled off the dead "
+                   "member's partitions' committed frontier every 10 ms; "
+                   "ack p50/p99 from the broker-append ingest stamp so "
+                   "redelivered rows age across the handoff; zombie "
+                   "parked inside publish via a gated exists() probe, "
+                   "expelled, resumed into the generation fence; "
+                   "cooperative leg samples the retained partitions' "
+                   "frontier through [join, join+1s]"),
+    }
+    if smoke:
+        out["smoke"] = True
+    print(f"[bench:rebalance] blackout={out['value']}s "
+          f"ack_p99={kill['ack_latency_p99_s']}s "
+          f"fenced={zombie['stale_commits_fenced']} "
+          f"coop_resets={coop['full_resets']} "
+          f"rows={out['rows_total']} lost={lost} dups={dups}; "
+          f"invariant_holds={invariant}", file=sys.stderr)
+    return out
+
+
+# ---------------------------------------------------------------------------
 # config 7: nested streaming replay (cfg5 shape through the FULL writer)
 # ---------------------------------------------------------------------------
 
@@ -5339,7 +5743,7 @@ def main() -> None:
                          "--obs", "--chaos", "--crash", "--degrade",
                          "--e2e", "--compact", "--scan", "--procs",
                          "--objstore", "--nested", "--tenants",
-                         "--encodings")):
+                         "--encodings", "--rebalance")):
         # default graded path: jax-free orchestrator (see _graded_main)
         _graded_main()
         return
@@ -5361,7 +5765,8 @@ def main() -> None:
             or "--e2e" in sys.argv or "--compact" in sys.argv
             or "--scan" in sys.argv or "--procs" in sys.argv
             or "--objstore" in sys.argv or "--nested" in sys.argv
-            or "--tenants" in sys.argv or "--encodings" in sys.argv):
+            or "--tenants" in sys.argv or "--encodings" in sys.argv
+            or "--rebalance" in sys.argv):
         # --hostasm/--obs/--chaos/--crash/--degrade/--e2e/--compact/--scan
         # /--objstore measure HOST work only and must never grab the real
         # chip; the switch must precede the first device use below
@@ -5831,6 +6236,41 @@ def main() -> None:
               file=sys.stderr)
         summary = {k: v for k, v in out.items()
                    if k not in ("grid", "arms")}
+        summary["artifact"] = os.path.basename(path)
+        print(json.dumps(summary))
+        return
+    if "--rebalance" in sys.argv:
+        if "--smoke" in sys.argv:
+            # the CI gate: reduced rows, never writes the artifact, exits
+            # nonzero unless every leg read back exactly-once AND the
+            # generation fence fired AND the cooperative leg kept its
+            # unrevoked partitions committing through the handoff
+            out = rebalance_probe(smoke=True)
+            print(json.dumps(
+                {k: out[k] for k in
+                 ("metric", "value", "rows_total", "smoke", "lost",
+                  "dups", "invariant_holds")}
+                | {"stale_commits_fenced":
+                       out["zombie"]["stale_commits_fenced"],
+                   "expired_members": out["kill"]["expired_members"],
+                   "ack_latency_p99_s": out["kill"]["ack_latency_p99_s"],
+                   "coop_full_resets": out["cooperative"]["full_resets"]}))
+            sys.exit(0 if out["invariant_holds"] else 10)
+        out = rebalance_probe()
+        path = os.environ.get(
+            "KPW_REBALANCE_PATH",
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "BENCH_REBALANCE_r22.json"))
+        with open(path, "w") as f:
+            json.dump(out, f, indent=1)
+        print(f"[bench:rebalance] artifact written to {path}",
+              file=sys.stderr)
+        summary = {k: v for k, v in out.items()
+                   if k not in ("kill", "zombie", "cooperative", "policy")}
+        summary["rebalance_blackout_seconds"] = out["value"]
+        summary["ack_latency_p99_s"] = out["kill"]["ack_latency_p99_s"]
+        summary["stale_commits_fenced"] = out["zombie"][
+            "stale_commits_fenced"]
         summary["artifact"] = os.path.basename(path)
         print(json.dumps(summary))
         return

@@ -28,6 +28,18 @@ from dataclasses import dataclass
 
 import numpy as np
 
+from ..utils import schedcheck
+
+
+class StaleGenerationError(RuntimeError):
+    """A commit was rejected by the group-coordination fence: it came from
+    a member that no longer owns the partition (expired, superseded, or
+    carrying a generation the coordinator never issued).  The committer is
+    a zombie — paused or partitioned through a rebalance while another
+    instance took over.  Typed, and deliberately NOT an OSError: the IO
+    retry loop must not spin on it — the only correct reaction is to drop
+    the in-flight state and rejoin the group."""
+
 
 @dataclass(frozen=True)
 class Record:
@@ -154,9 +166,23 @@ class _PartitionLog:
 
 
 class FakeBroker:
-    """Thread-safe in-memory broker (sharded per-partition log locks)."""
+    """Thread-safe in-memory broker (sharded per-partition log locks).
 
-    def __init__(self) -> None:
+    With ``session_timeout_s`` set the broker runs the full group
+    coordination protocol (ISSUE 18): members heartbeat to stay live, a
+    missed session window expels them, every membership change bumps the
+    group **generation**, partitions moving between two live members pass
+    through a cooperative **drain window** (withheld from the new owner
+    until the old owner confirms revocation or ``revocation_drain_s``
+    lapses), and commits carrying a member identity are **fenced** — a
+    zombie's stale commit raises :class:`StaleGenerationError` instead of
+    clobbering the new owner's offset state.  ``session_timeout_s=None``
+    (the default) keeps the legacy instant-reassignment broker: no expiry,
+    no drain windows, unfenced commits.
+    """
+
+    def __init__(self, session_timeout_s: float | None = None,
+                 revocation_drain_s: float = 5.0) -> None:
         # metadata lock: topic map shape, consumer groups, committed
         # offsets, the round-robin cursor.  Payload appends/reads take only
         # the owning partition's log lock.
@@ -166,6 +192,18 @@ class FakeBroker:
         self._groups: dict[tuple[str, str], list[str]] = {}  # (group, topic) -> member ids
         self._generation: dict[tuple[str, str], int] = {}
         self._rr = 0
+        # group coordination: heartbeat stamps (monotonic — liveness
+        # bookkeeping must not expire members on a wall-clock step),
+        # in-drain partitions awaiting cooperative handoff
+        # (partition -> {owner, deadline, old_gen}), and the per-group
+        # protocol counters group_stats() reports.
+        self.session_timeout_s = session_timeout_s
+        self.revocation_drain_s = revocation_drain_s
+        self._hb: dict[tuple[str, str], dict[str, float]] = {}
+        self._revoking: dict[tuple[str, str], dict[int, dict]] = {}
+        self._fenced: dict[tuple[str, str], int] = {}
+        self._rebalances: dict[tuple[str, str], int] = {}
+        self._expired: dict[tuple[str, str], int] = {}
 
     # -- topics / produce --------------------------------------------------
     def create_topic(self, topic: str, partitions: int = 1) -> None:
@@ -280,30 +318,162 @@ class FakeBroker:
             return log.n
 
     # -- consumer groups ---------------------------------------------------
+    @staticmethod
+    def _range_map(members: list[str], n_parts: int) -> dict[int, str]:
+        """partition -> owner under range assignment (``members`` already
+        sorted) — the single source of truth :meth:`assignment` and the
+        commit fence share."""
+        out: dict[int, str] = {}
+        if not members:
+            return out
+        per = n_parts // len(members)
+        extra = n_parts % len(members)
+        for idx, m in enumerate(members):
+            start = idx * per + min(idx, extra)
+            count = per + (1 if idx < extra else 0)
+            for p in range(start, start + count):
+                out[p] = m
+        return out
+
+    def _owner_map(self, key: tuple[str, str]) -> dict[int, str]:
+        group, topic = key
+        if topic not in self._logs:
+            return {}
+        return self._range_map(sorted(self._groups.get(key, [])),
+                               len(self._logs[topic]))
+
+    def _membership_changed(self, key: tuple[str, str],
+                            old_members: list[str]) -> None:
+        """Caller holds the lock; membership already mutated.  Bump the
+        generation and diff the old/new range maps: a partition moving
+        between two LIVE members enters a cooperative drain window
+        (coordination-enabled brokers only); every other movement hands
+        off instantly."""
+        group, topic = key
+        self._generation[key] = self._generation.get(key, 0) + 1
+        self._rebalances[key] = self._rebalances.get(key, 0) + 1
+        live = self._groups.get(key, [])
+        if topic not in self._logs:
+            return  # no partitions yet: nothing can move
+        n_parts = len(self._logs[topic])
+        old_map = self._range_map(sorted(old_members), n_parts)
+        new_map = self._range_map(sorted(live), n_parts)
+        rev = self._revoking.setdefault(key, {})
+        coop = self.session_timeout_s is not None
+        now = time.monotonic()
+        for p, owner in new_map.items():
+            prev = old_map.get(p)
+            if prev == owner:
+                continue
+            if coop and prev is not None and prev in live and p not in rev:
+                # cooperative handoff: withhold the partition from the new
+                # owner until the old owner confirms its drain (or the
+                # window lapses)
+                rev[p] = {"owner": prev,
+                          "deadline": now + self.revocation_drain_s,
+                          "old_gen": self._generation[key] - 1}
+            else:
+                schedcheck.note_partition_owner(id(self), key + (p,), owner)
+        # drain entries whose recorded owner died, or whose current target
+        # IS the recorded owner again (membership flapped back), resolve
+        # instantly — nobody is left to confirm them
+        stale = [p for p, e in rev.items()
+                 if e["owner"] not in live or new_map.get(p) == e["owner"]]
+        for p in stale:
+            del rev[p]
+            owner = new_map.get(p)
+            if owner is not None:
+                schedcheck.note_partition_owner(id(self), key + (p,), owner)
+
+    def _complete_handoffs(self, key: tuple[str, str],
+                           parts: list[int]) -> None:
+        """Caller holds the lock.  Pop drain entries and make the handoff
+        visible: ONE generation bump (when anything completed) so the new
+        owners' next refresh picks the partitions up."""
+        rev = self._revoking.get(key)
+        if not rev:
+            return
+        done = [p for p in parts if p in rev]
+        if not done:
+            return
+        for p in done:
+            del rev[p]
+        self._generation[key] = self._generation.get(key, 0) + 1
+        new_map = self._owner_map(key)
+        for p in done:
+            owner = new_map.get(p)
+            if owner is not None:
+                schedcheck.note_partition_owner(id(self), key + (p,), owner)
+
+    def _sweep_locked(self, key: tuple[str, str]) -> None:
+        """Caller holds the lock: expel members that missed their session
+        window, then complete drain windows whose deadline lapsed."""
+        st = self.session_timeout_s
+        now = time.monotonic()
+        if st is not None:
+            hb = self._hb.get(key, {})
+            members = self._groups.get(key, [])
+            dead = [m for m in members if now - hb.get(m, now) > st]
+            if dead:
+                old = list(members)
+                for m in dead:
+                    members.remove(m)
+                    hb.pop(m, None)
+                self._expired[key] = self._expired.get(key, 0) + len(dead)
+                self._membership_changed(key, old)
+        rev = self._revoking.get(key)
+        if rev:
+            lapsed = [p for p, e in rev.items() if now >= e["deadline"]]
+            if lapsed:
+                self._complete_handoffs(key, lapsed)
+
     def join_group(self, group: str, topic: str, member_id: str) -> None:
         with self._lock:
             key = (group, topic)
             members = self._groups.setdefault(key, [])
+            self._hb.setdefault(key, {})[member_id] = time.monotonic()
             if member_id not in members:
+                old = list(members)
                 members.append(member_id)
-                self._generation[key] = self._generation.get(key, 0) + 1
+                self._membership_changed(key, old)
 
     def leave_group(self, group: str, topic: str, member_id: str) -> None:
         with self._lock:
             key = (group, topic)
             members = self._groups.get(key, [])
             if member_id in members:
+                old = list(members)
                 members.remove(member_id)
-                self._generation[key] = self._generation.get(key, 0) + 1
+                self._hb.get(key, {}).pop(member_id, None)
+                self._membership_changed(key, old)
+
+    def heartbeat(self, group: str, topic: str, member_id: str) -> dict:
+        """Stamp the member's liveness and run the expiry/drain sweep.
+        Returns the current generation plus ``rejoin=True`` when the
+        member missed its session window and was expelled — its only way
+        back in is :meth:`join_group` (a fresh assignment epoch)."""
+        with self._lock:
+            key = (group, topic)
+            if member_id in self._groups.get(key, []):
+                self._hb.setdefault(key, {})[member_id] = time.monotonic()
+            self._sweep_locked(key)
+            return {"generation": self._generation.get(key, 0),
+                    "rejoin": member_id not in self._groups.get(key, [])}
 
     def generation(self, group: str, topic: str) -> int:
         with self._lock:
+            self._sweep_locked((group, topic))
             return self._generation.get((group, topic), 0)
 
     def assignment(self, group: str, topic: str, member_id: str) -> list[int]:
-        """Range assignment over the current membership (sorted member ids)."""
+        """Range assignment over the current membership (sorted member
+        ids).  Partitions inside a cooperative drain window are withheld
+        — the new owner sees them only after the old owner confirms (or
+        the window lapses)."""
         with self._lock:
-            members = sorted(self._groups.get((group, topic), []))
+            key = (group, topic)
+            self._sweep_locked(key)
+            members = sorted(self._groups.get(key, []))
             if member_id not in members or topic not in self._logs:
                 return []  # unknown topic: no partitions until first produce
             n_parts = len(self._logs[topic])
@@ -312,15 +482,105 @@ class FakeBroker:
             extra = n_parts % len(members)
             start = idx * per + min(idx, extra)
             count = per + (1 if idx < extra else 0)
-            return list(range(start, start + count))
+            rev = self._revoking.get(key, {})
+            return [p for p in range(start, start + count) if p not in rev]
+
+    def confirm_revocation(self, group: str, topic: str, member_id: str,
+                           partitions) -> None:
+        """The old owner finished draining ``partitions``: complete their
+        handoff now instead of waiting out the drain window."""
+        with self._lock:
+            key = (group, topic)
+            rev = self._revoking.get(key, {})
+            mine = [p for p in partitions
+                    if p in rev and rev[p]["owner"] == member_id]
+            if mine:
+                self._complete_handoffs(key, mine)
+
+    def group_stats(self, group: str, topic: str) -> dict:
+        """Protocol observability for tests/bench: membership, generation,
+        and the rebalance/fence/expiry counters."""
+        with self._lock:
+            key = (group, topic)
+            self._sweep_locked(key)
+            return {
+                "members": sorted(self._groups.get(key, [])),
+                "generation": self._generation.get(key, 0),
+                "rebalances": self._rebalances.get(key, 0),
+                "fenced_commits": self._fenced.get(key, 0),
+                "expired_members": self._expired.get(key, 0),
+                "revoking": sorted(self._revoking.get(key, {})),
+            }
 
     # -- offsets -----------------------------------------------------------
-    def commit(self, group: str, topic: str, partition: int, offset: int) -> None:
-        """offset = next offset to consume (Kafka convention)."""
+    def _commit_allowed_locked(self, key: tuple[str, str], partition: int,
+                               generation: int, member_id: str) -> bool:
+        """Caller holds the lock: the fence predicate.  Accept the old
+        owner through its drain window; otherwise ownership under the
+        CURRENT range map is authoritative (strict generation equality
+        would spuriously fence live owners of retained partitions across
+        handoff-completion bumps)."""
+        rev = self._revoking.get(key, {})
+        e = rev.get(partition)
+        if e is not None and e["owner"] == member_id:
+            return True  # drain window: the old owner flushing in-flight
+        if generation > self._generation.get(key, 0):
+            return False  # a generation the coordinator never issued
+        owners = self._owner_map(key)
+        if owners:
+            return owners.get(partition) == member_id
+        # topic unknown (commit before first produce): membership is the
+        # best fence available
+        return member_id in self._groups.get(key, [])
+
+    def commit(self, group: str, topic: str, partition: int, offset: int,
+               generation: int | None = None,
+               member_id: str | None = None) -> None:
+        """offset = next offset to consume (Kafka convention).
+
+        When the committer identifies itself (``generation`` +
+        ``member_id``, the coordinated path), the commit is FENCED: it
+        must come from the partition's current owner — or, during a
+        cooperative drain window, from the old owner finishing its
+        in-flight files.  A zombie (expired or superseded member) gets
+        the typed :class:`StaleGenerationError` instead of silently
+        clobbering the new owner's offset state."""
+        # deliberately outside the metadata lock: a schedule-explorer
+        # delay here must let the rebalance/handoff parties run, not
+        # block them behind a held lock
+        schedcheck.point("broker.commit.fence")
         with self._lock:
-            key = (group, topic, partition)
-            if offset > self._committed.get(key, 0):
-                self._committed[key] = offset
+            key = (group, topic)
+            self._sweep_locked(key)
+            if generation is not None and member_id is not None:
+                if not self._commit_allowed_locked(key, partition,
+                                                  generation, member_id):
+                    self._fenced[key] = self._fenced.get(key, 0) + 1
+                    raise StaleGenerationError(
+                        f"fenced commit: member {member_id!r} gen "
+                        f"{generation} is not the owner of "
+                        f"{topic}[{partition}] (current gen "
+                        f"{self._generation.get(key, 0)})")
+                schedcheck.note_commit_accepted(id(self), key + (partition,),
+                                                member_id)
+            ckey = (group, topic, partition)
+            if offset > self._committed.get(ckey, 0):
+                self._committed[ckey] = offset
+
+    def commit_allowed(self, group: str, topic: str, partition: int,
+                       generation: int | None = None,
+                       member_id: str | None = None) -> bool:
+        """The commit fence as a side-effect-free predicate: would a
+        commit from this member at this generation be accepted right
+        now?  The writer consults it before PUBLISHING a file whose
+        runs it may no longer be allowed to ack."""
+        with self._lock:
+            key = (group, topic)
+            self._sweep_locked(key)
+            if generation is None or member_id is None:
+                return True
+            return self._commit_allowed_locked(key, partition, generation,
+                                               member_id)
 
     def committed(self, group: str, topic: str, partition: int) -> int:
         with self._lock:

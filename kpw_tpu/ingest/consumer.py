@@ -21,7 +21,7 @@ from collections import deque
 from ..runtime.retry import RetryInterrupted, RetryPolicy
 from ..utils.tracing import stage
 from .autotune import IngestAutotuner
-from .broker import FakeBroker, Record, RecordBatch
+from .broker import FakeBroker, Record, RecordBatch, StaleGenerationError
 from .offsets import PagedOffsetTracker, PartitionOffset
 
 logger = logging.getLogger(__name__)
@@ -41,6 +41,8 @@ class SmartCommitConsumer:
         batch_ingest: bool = False,
         autotuner: IngestAutotuner | None = None,
         queue_listener=None,
+        drain_deadline_s: float = 5.0,
+        rebalance_listener=None,
     ) -> None:
         self.broker = broker
         self.group_id = group_id
@@ -121,6 +123,31 @@ class SmartCommitConsumer:
         self._latency_observer = None
         self._lat_runs = 0
         self._lat_records = 0
+        # group coordination (ISSUE 18): the consumer runs the full
+        # protocol — heartbeats, cooperative incremental rebalance, fenced
+        # commits — only against a coordination-enabled broker (one with a
+        # heartbeat surface AND a session timeout configured); every other
+        # broker keeps the legacy full-reset-on-generation-change path.
+        _st = getattr(broker, "session_timeout_s", None)
+        self._coordinated = (callable(getattr(broker, "heartbeat", None))
+                             and _st is not None)
+        self._hb_interval_s = (max(0.02, _st / 4.0)
+                               if self._coordinated else None)
+        self._last_hb = 0.0  # monotonic
+        self._drain_deadline_s = drain_deadline_s
+        self._rebalance_listener = rebalance_listener
+        # in-progress cooperative revocation: {"parts": set[int],
+        # "deadline": monotonic} — only the fetcher thread touches it
+        self._revoke_pending: dict | None = None
+        # SIGSTOP analog for the zombie drill: a suspended fetcher stops
+        # heartbeating/fetching but the thread stays parked (resumable)
+        self._suspended = False
+        self._killed = False  # hard_kill(): no leave_group on close
+        self._cooperative_rebalances = 0
+        self._full_resets = 0
+        self._rejoins = 0
+        self._fenced_commits = 0
+        self._revoked_purged = 0
 
     # -- lifecycle ---------------------------------------------------------
     def subscribe(self, topic: str) -> None:
@@ -153,8 +180,33 @@ class SmartCommitConsumer:
         if self._thread is not None:
             self._thread.join(timeout=timeout)
             self._thread = None
-        if self._topic is not None:
+        if self._topic is not None and not self._killed:
             self.broker.leave_group(self.group_id, self._topic, self.member_id)
+
+    def hard_kill(self) -> None:
+        """kill -9 analog at the protocol level: stop without
+        ``leave_group`` — the broker learns of the death only through the
+        missed session window, exactly like a SIGKILLed process's silent
+        socket drop.  The chaos drill's victim path."""
+        self._killed = True
+        self.close()
+
+    def suspend(self, flag: bool) -> None:
+        """SIGSTOP/SIGCONT analog: a suspended fetcher stops heartbeating
+        and fetching but stays parked and resumable — the zombie drill
+        pauses an instance through a full rebalance this way."""
+        self._suspended = bool(flag)
+
+    def set_rebalance_listener(self, listener) -> None:
+        """Bind the cooperative-revocation listener (the writer).  Surface:
+        ``on_generation(gen, revoked, added)``,
+        ``on_partitions_revoked(parts)`` (begin fencing in-flight files),
+        ``revocation_drained(parts) -> bool`` (polled until True or the
+        drain deadline), ``on_revocation_timeout(parts)`` (deadline lapsed:
+        abandon what is still in flight), ``on_partitions_lost(parts)``
+        (non-cooperative loss — expelled from the group).  Every callback
+        fires on the fetcher thread and must not block."""
+        self._rebalance_listener = listener
 
     # -- worker API --------------------------------------------------------
     def poll(self, timeout: float | None = None) -> Record | None:
@@ -299,10 +351,12 @@ class SmartCommitConsumer:
                     self._listener.on_enqueued(take)
                 if is_batch:
                     self._stamp_ingest(part.partition, part.start_offset,
-                                       part.start_offset + take)
+                                       part.start_offset + take,
+                                       part.timestamp)
                 elif part:
                     self._stamp_ingest(part[0].partition, part[0].offset,
-                                       part[-1].offset + 1)
+                                       part[-1].offset + 1,
+                                       part[0].timestamp)
                 pos += take
                 self._buf_cond.notify_all()
         return True
@@ -314,10 +368,19 @@ class SmartCommitConsumer:
         here.  The observer must be cheap and must not raise."""
         self._latency_observer = fn
 
-    def _stamp_ingest(self, partition: int, start: int, end: int) -> None:
-        # wall clock deliberately: the stamp crosses process boundaries
-        # (ring descriptor) and renders as operator-facing seconds
-        ts = time.time()
+    def _stamp_ingest(self, partition: int, start: int, end: int,
+                      ts: float = 0.0) -> None:
+        # the broker's record-append timestamp when the source carries one
+        # (FakeBroker batches do): the latency origin then SURVIVES a
+        # partition handoff — the new owner's re-fetch of an unacked run
+        # carries the same append stamp the dead owner saw, so the
+        # measured time-to-durable spans the rebalance blackout instead
+        # of restarting at redelivery.  Wall clock deliberately (not
+        # monotonic): the stamp crosses process boundaries (ring
+        # descriptor) and renders as operator-facing seconds; sources
+        # without record timestamps fall back to ingest wall time.
+        if not ts:
+            ts = time.time()
         with self._stamp_lock:
             dq = self._stamps.get(partition)
             if dq is None:
@@ -344,8 +407,10 @@ class SmartCommitConsumer:
         ack at file granularity across workers): stamps entirely below
         the run are kept for their own later ack; a stamp the run only
         partially covers is split, its tail re-queued.  Redelivered runs
-        re-stamp at redelivery, so duplicates measure conservatively
-        from the LAST ingest, never negative."""
+        re-stamp at redelivery but carry the broker's ORIGINAL append
+        timestamp, so duplicates measure the true end-to-end latency
+        (clamped at zero for sources whose stamps fall back to ingest
+        wall time)."""
         obs = self._latency_observer
         hits: list[tuple[float, int]] = []
         now = time.time()
@@ -397,6 +462,10 @@ class SmartCommitConsumer:
         at-least-once contract.  ``stop_event`` (e.g. the supervisor's
         close signal) aborts promptly — the consumer's own stop is honored
         too.  Returns the number of records re-injected."""
+        if self._coordinated and partition not in self._assigned:
+            return 0  # revoked/handed off: the NEW owner redelivers from
+            #           the committed frontier — re-injecting here would
+            #           write rows this member can no longer ack (fenced)
         stop = stop_event or self._stop_event
         end = start + count
         off = start
@@ -446,6 +515,18 @@ class SmartCommitConsumer:
                          if self._autotune is not None
                          else {"enabled": False}),
             "ack_latency": self.latency_snapshot(),
+            "rebalance": {
+                "coordinated": self._coordinated,
+                "generation": self._generation,
+                "assigned": sorted(self._assigned),
+                "cooperative_rebalances": self._cooperative_rebalances,
+                "full_resets": self._full_resets,
+                "rejoins": self._rejoins,
+                "fenced_commits": self._fenced_commits,
+                "revoked_purged_records": self._revoked_purged,
+                "revoke_pending": (sorted(self._revoke_pending["parts"])
+                                   if self._revoke_pending else []),
+            },
             "tracker": self.tracker.snapshot(),
         }
 
@@ -490,16 +571,49 @@ class SmartCommitConsumer:
         def do() -> None:
             with self._commit_lock:
                 cur = self.tracker.committed(partition)
-                # lint: lock-discipline ok — the lock exists precisely to
-                # make frontier-read + broker commit one atomic step: a
-                # real Kafka broker does NOT guard commit monotonicity, so
-                # committing outside it lets a backed-off retry push a
-                # stale lower offset over a newer one.  Retry sleeps
-                # happen in _retry.call, outside this closure/lock.
-                self.broker.commit(self.group_id, self._topic, partition,
-                                   max(offset, cur))
-        self._retry.call(do, stop_event=self._stop_event,
-                         on_retry=self._count_retry, label="broker.commit")
+                if self._coordinated:
+                    # fenced commit: carry our identity so a stale member
+                    # (zombie through a rebalance) is rejected broker-side.
+                    # lint: lock-discipline ok — the lock exists precisely
+                    # to make frontier-read + broker commit one atomic
+                    # step: a real Kafka broker does NOT guard commit
+                    # monotonicity, so committing outside it lets a
+                    # backed-off retry push a stale lower offset over a
+                    # newer one.  Retry sleeps happen in _retry.call,
+                    # outside this closure/lock.
+                    self.broker.commit(self.group_id, self._topic, partition,
+                                       max(offset, cur),
+                                       generation=self._generation,
+                                       member_id=self.member_id)
+                else:
+                    # lint: lock-discipline ok — same atomic
+                    # frontier-read + commit step as the fenced branch
+                    self.broker.commit(self.group_id, self._topic, partition,
+                                       max(offset, cur))
+        try:
+            self._retry.call(do, stop_event=self._stop_event,
+                             on_retry=self._count_retry,
+                             label="broker.commit")
+        except StaleGenerationError:
+            # typed, NOT retried (not an OSError): the caller — a worker
+            # acking a just-published file — must unpublish and drop the
+            # fenced runs, never spin
+            self._fenced_commits += 1
+            raise
+
+    def commit_allowed(self, partition: int) -> bool:
+        """Would an ack-commit for ``partition`` from this member be
+        accepted right now?  The writer's PRE-publish fence check: a file
+        about to be renamed into the tree whose runs can no longer be
+        acked is abandoned instead (the new owner redelivers)."""
+        if not self._coordinated:
+            return True
+        fn = getattr(self.broker, "commit_allowed", None)
+        if not callable(fn):
+            return True
+        return bool(fn(self.group_id, self._topic, partition,
+                       generation=self._generation,
+                       member_id=self.member_id))
 
     def _count_retry(self, attempt, exc, sleep_s) -> None:
         self._broker_retries += 1
@@ -582,14 +696,200 @@ class SmartCommitConsumer:
         gen = self.broker.generation(self.group_id, self._topic)
         if gen == self._generation:
             return
+        if not self._coordinated or self._generation < 0:
+            # legacy brokers (and the first assignment after a join/
+            # rejoin): FULL reset — every partition rewinds to the
+            # committed frontier and delivered-but-unacked records
+            # redeliver (at-least-once allows the duplicates)
+            if self._generation >= 0:
+                self._full_resets += 1
+            self._generation = gen
+            self._assigned = self.broker.assignment(self.group_id,
+                                                    self._topic,
+                                                    self.member_id)
+            self._positions = {}
+            for p in self._assigned:
+                base = self.broker.committed(self.group_id, self._topic, p)
+                self._positions[p] = base
+                self.tracker.reset_partition(p, base)
+            return
+        # cooperative (incremental) rebalance: only the delta moves.
+        # Retained partitions keep their queue contents, tracker pages and
+        # fetch positions — unaffected flow never stalls.
+        self._cooperative_rebalances += 1
+        new_assigned = self.broker.assignment(self.group_id, self._topic,
+                                              self.member_id)
+        old, new = set(self._assigned), set(new_assigned)
+        revoked = sorted(old - new)
+        added = sorted(new - old)
         self._generation = gen
-        self._assigned = self.broker.assignment(self.group_id, self._topic,
-                                                self.member_id)
-        self._positions = {}
-        for p in self._assigned:
+        self._assigned = new_assigned
+        lis = self._rebalance_listener
+        if lis is not None:
+            try:
+                lis.on_generation(gen, revoked, added)
+            # lint: swallowed-exceptions ok — listener callbacks are
+            # observability hooks on the fetcher thread; a raising hook
+            # must not kill the fetch loop mid-rebalance
+            except Exception:
+                logger.exception("rebalance listener on_generation raised")
+        if revoked:
+            self._begin_revocation(revoked)
+        for p in added:
             base = self.broker.committed(self.group_id, self._topic, p)
             self._positions[p] = base
             self.tracker.reset_partition(p, base)
+
+    def _begin_revocation(self, revoked: list[int]) -> None:
+        """Fetcher thread: stop serving ``revoked`` — purge their queued-
+        but-unpolled records (a worker must not write rows this member can
+        no longer ack), drop their fetch positions, tell the writer to
+        fence its in-flight files, and open the drain window
+        :meth:`_poll_revocation` completes."""
+        rev = set(revoked)
+        dropped = 0
+        with self._buf_cond:
+            kept: deque = deque()
+            for i, chunk in enumerate(self._buf):
+                part = (chunk.partition if isinstance(chunk, RecordBatch)
+                        else (chunk[0].partition if chunk else None))
+                if part in rev:
+                    n = len(chunk) - (self._head_pos if i == 0 else 0)
+                    dropped += n
+                    self._buf_count -= n
+                    if i == 0:
+                        self._head_pos = 0
+                else:
+                    kept.append(chunk)
+            self._buf = kept
+            if dropped:
+                self._revoked_purged += dropped
+                if self._listener is not None:
+                    # credit the queue-occupancy ledger: purged records
+                    # left the queue exactly like a drain round
+                    self._listener.on_drained(dropped)
+                self._buf_cond.notify_all()
+        for p in revoked:
+            self._positions.pop(p, None)
+        lis = self._rebalance_listener
+        if lis is not None:
+            try:
+                lis.on_partitions_revoked(list(revoked))
+            # lint: swallowed-exceptions ok — same contract as
+            # on_generation: a raising hook must not kill the fetcher
+            except Exception:
+                logger.exception("rebalance listener on_revoked raised")
+        deadline = time.monotonic() + self._drain_deadline_s
+        pend = self._revoke_pending
+        if pend is None:
+            self._revoke_pending = {"parts": rev, "deadline": deadline}
+        else:  # back-to-back rebalances: merge, keep the later deadline
+            pend["parts"] |= rev
+            pend["deadline"] = max(pend["deadline"], deadline)
+
+    def _poll_revocation(self) -> None:
+        """Fetcher thread: complete an open drain window once the writer
+        reports its in-flight files for the revoked partitions are
+        published-and-acked (or the deadline lapses — then whatever is
+        still in flight is abandoned and the new owner redelivers it)."""
+        pend = self._revoke_pending
+        if pend is None:
+            return
+        parts = sorted(pend["parts"])
+        lis = self._rebalance_listener
+        drained = True
+        if lis is not None:
+            try:
+                drained = bool(lis.revocation_drained(parts))
+            # lint: swallowed-exceptions ok — a raising drain probe must
+            # not wedge the window open forever; treat as drained and let
+            # at-least-once redelivery cover whatever was in flight
+            except Exception:
+                logger.exception("rebalance listener drain probe raised")
+        timed_out = time.monotonic() >= pend["deadline"]
+        if not drained and not timed_out:
+            return
+        if not drained and lis is not None:
+            try:
+                lis.on_revocation_timeout(parts)
+            # lint: swallowed-exceptions ok — observability hook, same
+            # fetcher-thread contract as the callbacks above
+            except Exception:
+                logger.exception("rebalance listener timeout hook raised")
+        for p in parts:
+            # this member is done with p: clear its tracker state down to
+            # the committed frontier (whatever did not get acked in the
+            # window is the new owner's redelivery)
+            self.tracker.reset_partition(
+                p, self.broker.committed(self.group_id, self._topic, p))
+        self._retry.call(
+            lambda: self.broker.confirm_revocation(
+                self.group_id, self._topic, self.member_id, parts),
+            stop_event=self._stop_event,
+            on_retry=self._count_retry, label="broker.confirm_revocation")
+        self._revoke_pending = None
+
+    def _heartbeat_tick(self) -> None:
+        """Fetcher thread, throttled to a quarter of the session window:
+        stamp liveness; a ``rejoin`` response means this member missed its
+        window and was expelled — everything it held is LOST."""
+        now = time.monotonic()
+        if now - self._last_hb < self._hb_interval_s:
+            return
+        self._last_hb = now
+        resp = self._retry.call(
+            lambda: self.broker.heartbeat(self.group_id, self._topic,
+                                          self.member_id),
+            stop_event=self._stop_event,
+            on_retry=self._count_retry, label="broker.heartbeat")
+        if resp.get("rejoin"):
+            self._rejoin()
+
+    def _rejoin(self) -> None:
+        """Expelled (missed session window — the zombie path): drop every
+        held partition as LOST, then WAIT until the writer has resolved
+        its in-flight files for them BEFORE rejoining.  The wait is the
+        exactly-once keystone: a worker blocked mid-publish must finish,
+        take its fenced-commit rejection, and unpublish while this member
+        is still an outsider — rejoining first would make it an owner
+        again and its stale ack would be accepted."""
+        self._rejoins += 1
+        lost = sorted(self._assigned)
+        lis = self._rebalance_listener
+        if lost:
+            self._begin_revocation(lost)  # purge queue + writer fencing
+            self._revoke_pending = None   # not a drain window: LOST, no
+            #                               confirm_revocation to send
+            self._assigned = []
+            self._positions = {}
+            if lis is not None:
+                try:
+                    lis.on_partitions_lost(lost)
+                # lint: swallowed-exceptions ok — observability hook on
+                # the fetcher thread; the rejoin must proceed regardless
+                except Exception:
+                    logger.exception("rebalance listener on_lost raised")
+        if lis is not None and lost:
+            warned = False
+            deadline = time.monotonic() + self._drain_deadline_s
+            while not self._stop_event.is_set():
+                try:
+                    if lis.revocation_drained(lost):
+                        break
+                # lint: swallowed-exceptions ok — a raising drain probe
+                # treated as drained: at-least-once redelivery covers it
+                except Exception:
+                    logger.exception("drain probe raised during rejoin")
+                    break
+                if not warned and time.monotonic() > deadline:
+                    warned = True
+                    logger.warning(
+                        "rejoin of %s waiting on in-flight files for lost "
+                        "partitions %s past the drain deadline",
+                        self.member_id, lost)
+                time.sleep(0.005)
+        self.broker.join_group(self.group_id, self._topic, self.member_id)
+        self._generation = -1  # force a FULL reset on the next refresh
 
     def _fetch_loop(self) -> None:
         try:
@@ -609,6 +909,12 @@ class SmartCommitConsumer:
         use_batch = (self._batch_ingest
                      and callable(getattr(self.broker, "fetch_batch", None)))
         while self._running:
+            if self._suspended:
+                time.sleep(0.005)  # SIGSTOP analog: no heartbeat, no fetch
+                continue
+            if self._coordinated:
+                self._heartbeat_tick()
+                self._poll_revocation()
             self._refresh_assignment()
             if self._autotune is not None:
                 self._apply_autotune()

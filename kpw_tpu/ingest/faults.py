@@ -72,10 +72,15 @@ class FaultInjectingBroker:
         self._fetch_gate()
         return self.inner.fetch_batch(topic, partition, offset, max_records)
 
-    def commit(self, group: str, topic: str, partition: int,
-               offset: int) -> None:
+    def commit(self, group: str, topic: str, partition: int, offset: int,
+               generation: int | None = None,
+               member_id: str | None = None) -> None:
         self.schedule.check("commit")
-        self.inner.commit(group, topic, partition, offset)
+        if generation is not None or member_id is not None:
+            self.inner.commit(group, topic, partition, offset,
+                              generation=generation, member_id=member_id)
+        else:
+            self.inner.commit(group, topic, partition, offset)
 
     def generation(self, group: str, topic: str) -> int:
         return self.inner.generation(group, topic) + self._gen_extra

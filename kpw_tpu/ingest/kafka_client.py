@@ -211,13 +211,20 @@ class KafkaBrokerClient:
             got = got.offset
         return int(got or 0)
 
-    def commit(self, group: str, topic: str, partition: int, offset: int) -> None:
+    def commit(self, group: str, topic: str, partition: int, offset: int,
+               generation: int | None = None,
+               member_id: str | None = None) -> None:
         """Commit via the partition's owning member.  During a rebalance the
         ownership snapshot can go stale between resolve and commit — the
         broker then rejects the commit (CommitFailedError).  That window is
         retriable, not fatal: re-resolve the owner and try again for a
         bounded number of rounds before surfacing (a raise here would kill
-        the worker mid-rebalance for a transient condition)."""
+        the worker mid-rebalance for a transient condition).
+
+        ``generation``/``member_id`` are accepted for FakeBroker signature
+        parity but unused: a real cluster runs Kafka's own generation
+        fencing — a zombie's commit is rejected broker-side as
+        CommitFailedError by the group coordinator itself."""
         import time as _time
 
         from kafka import TopicPartition

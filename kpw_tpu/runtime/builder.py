@@ -148,6 +148,12 @@ class Builder:
         # per route (the shared quota ledger's charge/credit source).
         self._routes: list[dict] = []
         self._queue_listener = None
+        # consumer-group cooperative rebalance (ingest/broker.py group
+        # coordination): how long a revocation may wait for in-flight
+        # files holding revoked partitions' rows to flush+publish+ack
+        # before the consumer confirms the handoff anyway (the abandoned
+        # rows redeliver through the new owner — at-least-once either way)
+        self._rebalance_drain_deadline = 5.0
 
     # -- required ----------------------------------------------------------
     def broker(self, broker) -> "Builder":
@@ -185,6 +191,21 @@ class Builder:
 
     def group_id(self, gid: str) -> "Builder":
         self._group_id = gid
+        return self
+
+    def rebalance_drain_deadline_seconds(self, seconds: float) -> "Builder":
+        """Cooperative-rebalance drain bound: how long a revocation may
+        wait for this instance's in-flight files holding revoked
+        partitions' rows to flush, publish and ack before the consumer
+        confirms the handoff anyway (the still-open rows are then
+        abandoned un-acked and redeliver through the new owner —
+        at-least-once either way).  Only meaningful against a broker
+        running group coordination (``FakeBroker(session_timeout_s=...)``
+        or a real cluster)."""
+        if seconds <= 0:
+            raise ValueError("rebalance drain deadline must be > 0 "
+                             f"(got {seconds})")
+        self._rebalance_drain_deadline = seconds
         return self
 
     # -- rotation ----------------------------------------------------------
@@ -1077,6 +1098,15 @@ class Builder:
             from .procworkers import _proto_spec
 
             _proto_spec(self._proto_class)  # raises if not descriptor-backed
+            if getattr(self._broker, "session_timeout_s", None) is not None:
+                raise ValueError(
+                    "process_workers does not support a broker running "
+                    "group coordination (session_timeout_s set): the "
+                    "cooperative-revocation drain fences the THREAD "
+                    "workers' open files, and child processes hold theirs "
+                    "across the spawn boundary where the fence cannot "
+                    "reach.  Use thread workers for coordinated groups, "
+                    "or a broker without session_timeout_s.")
 
         from .writer import KafkaProtoParquetWriter
 

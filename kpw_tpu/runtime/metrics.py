@@ -425,6 +425,18 @@ CHILD_STAGE_SECONDS_GAUGE = "worker.proc.child.stage.seconds"
 CHILD_SPANS_GAUGE = "worker.proc.child.spans"
 CHILD_SPANS_DROPPED_GAUGE = "worker.proc.child.spans.dropped"
 FLIGHTREC_DUMPS_METER = "parquet.writer.flightrec.dumps"
+# consumer-group rebalance layer (ingest/broker.py group coordination +
+# ingest/consumer.py cooperative revocation): generation bumps observed by
+# this instance's consumer, files rotated early because their open file held
+# a revoked partition's rows (the drain-window flush), ack commits the
+# broker rejected with a stale-generation fence (the zombie backstop), and
+# open files abandoned unpublished because their partitions were LOST
+# (session expiry / drain timeout — publishing would only earn a fenced
+# commit)
+REBALANCES_METER = "parquet.writer.rebalances"
+ROTATED_REVOKE_METER = "parquet.writer.rotated.revoke"
+FENCED_ACKS_METER = "parquet.writer.rebalance.fenced.acks"
+FENCE_ABANDONS_METER = "parquet.writer.rebalance.abandons"
 
 # the canonical registry docs cite from (tools/check_docs.py verifies
 # every doc-cited metric name is listed here)
@@ -485,4 +497,8 @@ METRIC_NAMES = (
     CHILD_SPANS_GAUGE,
     CHILD_SPANS_DROPPED_GAUGE,
     FLIGHTREC_DUMPS_METER,
+    REBALANCES_METER,
+    ROTATED_REVOKE_METER,
+    FENCED_ACKS_METER,
+    FENCE_ABANDONS_METER,
 )

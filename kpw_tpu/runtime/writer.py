@@ -33,7 +33,7 @@ from ..io.compact import Compactor
 from ..io.fs import publish_file
 from ..io.verify import verify_dir, verify_file
 from ..ingest.autotune import IngestAutotuner
-from ..ingest.broker import RecordBatch
+from ..ingest.broker import RecordBatch, StaleGenerationError
 from ..ingest.consumer import SmartCommitConsumer
 from ..ingest.offsets import PartitionOffset
 from ..models.proto_bridge import ProtoColumnarizer, WireShredError
@@ -130,6 +130,70 @@ def _rotation_batch_cap(max_file_size: int,
     return max(64, int(max_file_size / 16 / est_record_bytes))
 
 
+class _RebalanceListener:
+    """Writer-side cooperative-revocation hooks, fired on the consumer's
+    fetcher thread (``SmartCommitConsumer.set_rebalance_listener``
+    documents the surface + threading contract: nothing here may block).
+
+    The revocation drain is a fetcher→worker seam: ``on_partitions_revoked``
+    posts a fence request to every worker; each worker services it at its
+    next loop iteration by flushing-and-publishing its open file early when
+    the file holds a revoked partition's rows (the drain window keeps this
+    member's commits for those partitions acceptable).  The consumer polls
+    ``revocation_drained`` and only confirms the handoff once no worker
+    holds revoked runs.  LOST partitions (session expiry) and drain
+    timeouts switch to abandon: publishing would only earn a fenced
+    commit, so the open file is dropped and the new owner redelivers."""
+
+    def __init__(self, writer: "KafkaProtoParquetWriter") -> None:
+        self._w = writer
+
+    def _note(self, kind: str, **fields) -> None:
+        rec = self._w._flightrec
+        if rec is not None:
+            rec.note(kind, **fields)
+
+    def on_generation(self, gen: int, revoked, added) -> None:
+        self._w._rebalances.mark()
+        self._note("rebalance_generation", generation=gen,
+                   revoked=sorted(revoked), added=sorted(added))
+
+    def on_partitions_revoked(self, parts) -> None:
+        self._note("rebalance_revoke_begin", partitions=sorted(parts))
+        ps = frozenset(parts)
+        for wk in self._w._workers:
+            wk.request_fence(ps)
+
+    def revocation_drained(self, parts) -> bool:
+        ps = set(parts)
+        for wk in self._w._workers:
+            try:
+                held = wk.held_runs()
+            # lint: swallowed-exceptions ok — held_runs scrapes worker-
+            # mutated lists lock-free (the ack-lag precedent); a torn read
+            # just means "not drained yet", re-polled a tick later
+            except RuntimeError:
+                return False
+            if any(p in ps for p, _, _ in held):
+                return False
+        for wk in self._w._workers:
+            wk.fence_clear(ps)
+        self._note("rebalance_drain_complete", partitions=sorted(parts))
+        return True
+
+    def on_revocation_timeout(self, parts) -> None:
+        self._note("rebalance_drain_timeout", partitions=sorted(parts))
+        ps = frozenset(parts)
+        for wk in self._w._workers:
+            wk.request_abandon(ps)
+
+    def on_partitions_lost(self, parts) -> None:
+        self._note("rebalance_partitions_lost", partitions=sorted(parts))
+        ps = frozenset(parts)
+        for wk in self._w._workers:
+            wk.request_abandon(ps)
+
+
 class KafkaProtoParquetWriter:
     """Streaming writer: Kafka topic -> rotated parquet files.  Construct via
     ``kpw_tpu.Builder``; lifecycle = ``start()`` / ``close()`` (Closeable
@@ -163,8 +227,16 @@ class KafkaProtoParquetWriter:
             batch_ingest=b._batch_ingest,
             autotuner=self.autotuner,
             queue_listener=getattr(b, "_queue_listener", None),
+            drain_deadline_s=getattr(b, "_rebalance_drain_deadline", 5.0),
         )
         self.consumer.subscribe(b._topic)
+        # cooperative-rebalance seam (thread mode; Builder.build rejects
+        # process workers on a coordination-enabled broker): revocations
+        # fence the workers' open files through the drain window before the
+        # consumer confirms the handoff.  Registered unconditionally — the
+        # consumer only fires it when the broker runs group coordination.
+        if not b._proc_workers:
+            self.consumer.set_rebalance_listener(_RebalanceListener(self))
         self._workers: list = []
         self._started = False
         self._closed = False
@@ -197,6 +269,16 @@ class KafkaProtoParquetWriter:
         # structures are read only when the registry is scraped.
         self._rotated_size = reg.meter(M.ROTATED_SIZE_METER) if reg else M.Meter()
         self._rotated_time = reg.meter(M.ROTATED_TIME_METER) if reg else M.Meter()
+        # consumer-group rebalance meters: generation bumps seen, files
+        # rotated early to drain a revoked partition, acks the broker
+        # fenced (stale generation), open files abandoned for LOST
+        # partitions
+        self._rebalances = reg.meter(M.REBALANCES_METER) if reg else M.Meter()
+        self._rotated_revoke = (reg.meter(M.ROTATED_REVOKE_METER)
+                                if reg else M.Meter())
+        self._fenced_acks = reg.meter(M.FENCED_ACKS_METER) if reg else M.Meter()
+        self._fence_abandons = (reg.meter(M.FENCE_ABANDONS_METER)
+                                if reg else M.Meter())
         # robustness meters — always counted (satellite: worker death must
         # be visible even without supervision enabled)
         self._retries = reg.meter(M.RETRIES_METER) if reg else M.Meter()
@@ -930,6 +1012,40 @@ class KafkaProtoParquetWriter:
     def __exit__(self, *exc):
         self.close()
 
+    def hard_kill(self) -> None:
+        """In-process kill -9 analog AT THE PROTOCOL LEVEL (the real
+        SIGKILL drill is tests/crash_child.py): stop every thread without
+        flushing, publishing, or leaving the group — the broker learns of
+        the death only through the missed heartbeat window (session
+        expiry), exactly like a machine that dropped off the network.
+        Open tmp files stay on disk un-published, held runs are never
+        acked (the surviving group members redeliver them after the
+        expiry rebalance).  Python threads cannot be preempted
+        mid-bytecode, so an ack already in flight completes atomically
+        with its publish — a real SIGKILL could tear between rename and
+        commit (an at-least-once duplicate); this analog cannot, and a
+        straggler ack landing AFTER the session expired is fenced by the
+        broker's generation check and un-published by the backstop."""
+        if self._closed:
+            return
+        self._closed = True
+        self._close_event.set()
+        if self._watchdog_obj is not None:
+            self._watchdog_obj.close(timeout=1)
+        for w in self._workers:
+            w._stop.set()
+        # no leave_group, no final commit: the group coordinator must
+        # discover the death by session timeout
+        self.consumer.hard_kill()
+        for w in self._workers:
+            w.join(timeout=5)
+        for w in self._workers:
+            # free pipeline threads + sinks; tmps stay un-published
+            w._abandon_open_files("error")
+        if self._flightrec is not None:
+            self._flightrec.note("hard_kill",
+                                 instance=self._b._instance_name)
+
     # -- observability (beyond the reference: SURVEY.md §5 had only
     # lifecycle logging) ----------------------------------------------------
     def ack_lag(self) -> dict:
@@ -1236,6 +1352,17 @@ class _Worker:
         # rotation (a per-file snapshot alone would reset every ~1 GiB)
         self._pipe_totals: dict = {"files": 0, "split_assembly": False,
                                    "stage_busy_s": {}, "queues": {}}
+        # cooperative-rebalance fence requests (ingest/consumer.py drain
+        # protocol): frozensets of partition ids posted by the fetcher
+        # thread's _RebalanceListener, serviced by THIS thread at the next
+        # loop iteration (GIL-atomic reference swaps — same lock-free
+        # single-writer discipline as the ack-lag fields).  ``_fence_req``
+        # = flush-and-publish early (the drain window still accepts our
+        # commits); ``_fence_abandon_req`` = the partitions are LOST, drop
+        # the open file un-published (a publish would only earn a fenced
+        # commit)
+        self._fence_req: frozenset | None = None
+        self._fence_abandon_req: frozenset | None = None
 
     def start(self) -> None:
         self._thread.start()
@@ -1257,6 +1384,80 @@ class _Worker:
         runs = [(p, s, e) for p, s, e in self._written_runs]
         runs.extend((p, s, s + c) for p, s, c in self._inflight_runs)
         return runs
+
+    # -- cooperative-revocation fence (fetcher-thread setters) ---------------
+    def request_fence(self, parts: frozenset) -> None:
+        """Revoked partitions entered their drain window: flush-and-publish
+        this worker's open file at the next loop iteration if it holds any
+        of their rows."""
+        cur = self._fence_req
+        self._fence_req = parts if cur is None else frozenset(cur | parts)
+
+    def request_abandon(self, parts: frozenset) -> None:
+        """The partitions are LOST (session expiry / drain timeout):
+        abandon their rows un-published — and supersede any pending flush
+        request for them, which could no longer commit anyway."""
+        cur = self._fence_abandon_req
+        self._fence_abandon_req = (parts if cur is None
+                                   else frozenset(cur | parts))
+        req = self._fence_req
+        if req is not None:
+            self._fence_req = frozenset(req - parts) or None
+
+    def fence_clear(self, parts) -> None:
+        """Drain complete for ``parts``: retire their fence requests."""
+        ps = frozenset(parts)
+        req = self._fence_req
+        if req is not None:
+            self._fence_req = frozenset(req - ps) or None
+        aband = self._fence_abandon_req
+        if aband is not None:
+            self._fence_abandon_req = frozenset(aband - ps) or None
+
+    def _service_fence(self) -> None:
+        """Service pending cooperative-revocation fence requests (posted
+        by the fetcher thread's _RebalanceListener, drained here so only
+        this thread ever touches the file/run state).
+
+        Abandon first: LOST partitions' rows must not publish — drop the
+        open file(s) un-published, clear every held run, and redeliver the
+        runs this member still owns from a side thread (this worker is the
+        queue consumer; the _pause_until_recovered precedent).  Then the
+        flush flavor: revoked partitions with rows already in the open
+        file force an early "revoke" rotation — publish + ack NOW, inside
+        the drain window where the broker still accepts this member's
+        commits for them — which is what lets the consumer confirm the
+        handoff with zero lost and zero duplicated rows."""
+        aband = self._fence_abandon_req
+        if aband:
+            held = self.held_runs()
+            if any(p in aband for p, _, _ in held):
+                retained = [(p, s, e) for p, s, e in held if p not in aband]
+                dropped = sum(e - s for p, s, e in held if p in aband)
+                self.p._fence_abandons.mark()
+                rec = self.p._flightrec
+                if rec is not None:
+                    rec.note("rebalance_abandon", worker=self.index,
+                             partitions=sorted(aband),
+                             dropped_records=dropped,
+                             retained_runs=len(retained))
+                self._abandon_open_files("revoke")
+                self._written_runs.clear()
+                self._inflight_runs = []
+                self._unacked_count = 0
+                self._oldest_unacked_ts = None
+                if retained:
+                    threading.Thread(
+                        target=self._redeliver_runs, args=(retained,),
+                        name=f"KPW-fence-redeliver-{self.index}",
+                        daemon=True).start()
+            self._fence_abandon_req = None
+        req = self._fence_req
+        if req and any(r[0] in req for r in self._written_runs):
+            if self.p.partitioner is not None:
+                self._finalize_partitions("revoke")
+            else:
+                self._finalize_current_file("revoke")
 
     def _retry(self, fn, label: str = ""):
         """Policy-driven retry for this worker's IO: stop-aware, metered
@@ -1386,6 +1587,9 @@ class _Worker:
         """One poll→parse→write→rotate iteration (the body of the
         reference's worker loop, KPW.java:253-292), extracted so the
         degraded-mode pause seam can wrap exactly one iteration."""
+        if (self._fence_req is not None
+                or self._fence_abandon_req is not None):
+            self._service_fence()
         if self.p.partitioner is not None:
             return self._loop_once_partitioned(b, poll_batch_base)
         if (self.current_file is not None
@@ -1612,6 +1816,7 @@ class _Worker:
             self.p._partitions_evicted.mark()
         else:
             (self.p._rotated_time if reason == "time"
+             else self.p._rotated_revoke if reason == "revoke"
              else self.p._rotated_size).mark()
         self._rename_and_move(f.path, subdir=pkey)
         self._fold_pipe_stats(f)
@@ -1647,11 +1852,24 @@ class _Worker:
         if any(f.get_num_written_records() > 0
                for f in self._part_files.values()):
             return
-        for partition, start, end in self._written_runs:
-            self.p.consumer.ack_run(partition, start, end - start)
+        pending = list(self._written_runs)
         self._written_runs.clear()
         self._unacked_count = 0
         self._oldest_unacked_ts = None
+        for partition, start, end in pending:
+            try:
+                self.p.consumer.ack_run(partition, start, end - start)
+            except StaleGenerationError as e:
+                # partitioned files scatter many runs per file, so a
+                # fenced run cannot un-publish anything here — drop it
+                # (the new owner's redelivery makes its rows
+                # at-least-once duplicates) and keep acking the rest
+                self.p._fenced_acks.mark()
+                rec = self.p._flightrec
+                if rec is not None:
+                    rec.note("rebalance_fenced_ack_dropped",
+                             worker=self.index, partition=partition,
+                             run=[start, end], error=repr(e))
 
     def open_partitions(self) -> list[str]:
         """Scrape-safe snapshot of this worker's open partition keys."""
@@ -2039,33 +2257,126 @@ class _Worker:
             self.current_file = None
             return
         self._retry(f.close, "close")
+        # pre-publish fence check (side-effect-free broker predicate): a
+        # run whose partition this member can no longer commit — the drain
+        # window lapsed, or the session expired under us — must not
+        # publish, or the new owner's redelivery of those rows becomes a
+        # duplicate.  Abandon the closed tmp instead: fenced runs drop
+        # (the new owner republishes them), still-owned runs redeliver.
+        fenced_parts = {r[0] for r in self._written_runs
+                        if not self.p.consumer.commit_allowed(r[0])}
+        if fenced_parts:
+            self._fence_abandon_closed(f, fenced_parts)
+            return
         size = self.p.fs.size(f.path)
         self.p._flushed_records.mark(self._file_records)
         self.p._flushed_bytes.mark(size)
         self.p._file_size_histogram.update(size)
         self._mark_index_meters(f)
         (self.p._rotated_time if reason == "time"
+         else self.p._rotated_revoke if reason == "revoke"
          else self.p._rotated_size).mark()
-        self._rename_and_move(f.path)
+        dest = self._rename_and_move(f.path)
         self._fold_pipe_stats(f)
         self.current_file = None
-        # ack strictly after durable publish (KPW.java:347-350)
-        for partition, start, end in self._written_runs:
-            self.p.consumer.ack_run(partition, start, end - start)
+        # ack strictly after durable publish (KPW.java:347-350).  A fenced
+        # commit HERE means ownership moved between the pre-publish check
+        # and the ack (the zombie window): with nothing acked yet the file
+        # is un-published again and exactly-once is restored.
+        pending = list(self._written_runs)
         self._written_runs.clear()
         self._unacked_count = 0
         self._oldest_unacked_ts = None
+        i = 0
+        try:
+            while i < len(pending):
+                partition, start, end = pending[i]
+                self.p.consumer.ack_run(partition, start, end - start)
+                i += 1
+        except StaleGenerationError as e:
+            self._fenced_ack_cleanup(dest, pending, i, e)
+
+    def _fence_abandon_closed(self, f: ParquetFile,
+                              fenced_parts: set) -> None:
+        """The pre-publish fence tripped: ``f`` is closed but must not be
+        published.  Delete the tmp, drop the fenced partitions' runs (the
+        new owner redelivers them), and redeliver the still-owned runs
+        whose rows just vanished with the file."""
+        retained = [(p, s, e) for p, s, e in self._written_runs
+                    if p not in fenced_parts]
+        dropped = sum(e - s for p, s, e in self._written_runs
+                      if p in fenced_parts)
+        self.p._fence_abandons.mark()
+        rec = self.p._flightrec
+        if rec is not None:
+            rec.note("rebalance_fence_abandon", worker=self.index,
+                     partitions=sorted(fenced_parts),
+                     dropped_records=dropped, retained_runs=len(retained))
+        self._retry(lambda: self.p.fs.delete(f.path), "delete")
+        self._fold_pipe_stats(f)
+        self.current_file = None
+        self._written_runs.clear()
+        self._unacked_count = 0
+        self._oldest_unacked_ts = None
+        if retained:
+            threading.Thread(
+                target=self._redeliver_runs, args=(retained,),
+                name=f"KPW-fence-redeliver-{self.index}",
+                daemon=True).start()
+
+    def _fenced_ack_cleanup(self, dest: str | None, pending: list,
+                            acked: int, exc: Exception) -> None:
+        """An ack commit came back fenced (StaleGenerationError) AFTER the
+        file published — the zombie backstop.  With zero runs acked the
+        published file vouches for nothing: delete it (un-publish) and
+        exactly-once is restored — fenced runs redeliver through the new
+        owner, still-owned runs through our own side-thread re-injection.
+        With some runs already acked the file must stay (those offsets
+        point into it); ack what this member still owns and drop the
+        fenced rest — their rows become at-least-once duplicates, noted in
+        the flight recorder."""
+        con = self.p.consumer
+        rest = pending[acked:]
+        fenced = [r for r in rest if not con.commit_allowed(r[0])]
+        retained = [r for r in rest if con.commit_allowed(r[0])]
+        self.p._fenced_acks.mark()
+        rec = self.p._flightrec
+        if acked == 0 and dest is not None:
+            self._retry(lambda: self.p.fs.delete(dest), "unpublish")
+            if rec is not None:
+                rec.note("rebalance_fenced_unpublish", worker=self.index,
+                         file=dest,
+                         fenced_partitions=sorted({r[0] for r in fenced}),
+                         error=repr(exc))
+            retained.extend(fenced)  # un-published: every run redelivers
+            if retained:
+                threading.Thread(
+                    target=self._redeliver_runs,
+                    args=([(p, s, e) for p, s, e in retained],),
+                    name=f"KPW-fence-redeliver-{self.index}",
+                    daemon=True).start()
+            return
+        for p, s, e in retained:
+            try:
+                con.ack_run(p, s, e - s)
+            except StaleGenerationError:
+                fenced.append([p, s, e])
+        if rec is not None:
+            rec.note("rebalance_fenced_ack_dropped", worker=self.index,
+                     file=dest, dropped_runs=len(fenced), error=repr(exc))
 
     def _rename_and_move(self, tmp_path: str,
-                         subdir: str | None = None) -> None:
+                         subdir: str | None = None) -> str:
         # (KPW.java:359-378); spanned as one publish stage so the e2e
         # stall breakdown can attribute verify+rename time per file.
-        # ``subdir`` = the partition path in partitioned mode
+        # ``subdir`` = the partition path in partitioned mode.  Returns
+        # the published destination path (the fenced-ack un-publish
+        # backstop needs the exact dest the rename landed on).
         with stage("worker.publish"):
-            self._rename_and_move_inner(tmp_path, subdir)
+            return self._rename_and_move_inner(tmp_path, subdir)
 
     def _rename_and_move_inner(self, tmp_path: str,
-                               subdir: str | None = None) -> None:
+                               subdir: str | None = None) -> str:
         if self.p._b._verify_on_publish:
             # independent read-back BEFORE the rename: a structurally
             # invalid tmp (bad encode, torn write a retry never healed)
@@ -2094,5 +2405,6 @@ class _Worker:
         if pattern:
             dest_dir = f"{dest_dir}/{_format_now(pattern)}"
             self._retry(lambda d=dest_dir: self.p.fs.mkdirs(d), "publish")
-        publish_rename(self.p.fs, self._retry, tmp_path, dest_dir,
-                       self._new_file_name(), self.p._b._durable_publish)
+        return publish_rename(self.p.fs, self._retry, tmp_path, dest_dir,
+                              self._new_file_name(),
+                              self.p._b._durable_publish)

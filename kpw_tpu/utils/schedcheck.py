@@ -104,6 +104,16 @@ class QuotaLedgerTornError(ScheduleViolation):
     sibling's quota headroom."""
 
 
+class RevokedCommitError(ScheduleViolation):
+    """The commit fence accepted a commit for a partition AFTER its
+    ownership handed off to a different member — a revoked run was acked
+    past the generation bump, i.e. a zombie clobbered the new owner's
+    offset state.  The fenced broker makes this impossible (ownership is
+    checked under the metadata lock at commit time); the un-fenced shape
+    (a monotonic-only ``commit``) lets a delayed stale commit land after
+    the handoff completes."""
+
+
 def _stack(skip: int = 2, limit: int = 14) -> str:
     while skip > 0:
         try:
@@ -142,6 +152,11 @@ class SchedCheck:
         self._hb_writers: dict[int, str] = {}
         # quota ledgers: ledger key -> last consistent-update stack
         self._ledger_writers: dict[int, str] = {}
+        # partition ownership: (broker key, group, topic, partition) ->
+        # (owner member id, handoff stack) — written when a handoff
+        # COMPLETES (never during a drain window, so the old owner's
+        # drain commits pass)
+        self._part_owners: dict[tuple, tuple[str, str]] = {}
 
     # -- perturbation ---------------------------------------------------------
     def _coin(self, label: str) -> tuple[bool, float]:
@@ -275,6 +290,34 @@ class SchedCheck:
         with self._mu:
             self._ledger_writers[ledger_key] = _stack(2)
 
+    # -- probe: revocation-vs-in-flight-publish fence ------------------------
+    def note_partition_owner(self, broker_key: int, part_key: tuple,
+                             member: str) -> None:
+        """A partition handoff COMPLETED: ``member`` is now the
+        authoritative owner of ``part_key`` (= (group, topic,
+        partition)).  The broker notes this only when the transfer is
+        final — instant reassignments and drain-window completions —
+        never at drain BEGIN, so the old owner's in-window flush commits
+        don't trip the probe."""
+        with self._mu:
+            self._part_owners[(broker_key,) + part_key] = (member, _stack(2))
+
+    def note_commit_accepted(self, broker_key: int, part_key: tuple,
+                             member: str) -> None:
+        """Guards the fence itself: a commit the broker ACCEPTED from a
+        member that is not the recorded owner means a revoked run was
+        acked after the generation bump — the exactly-once handoff is
+        broken.  The fenced commit path cannot reach here in that state
+        (ownership is re-checked under the same lock); the ``--revert``
+        monotonic-only shape lands here with the zombie's identity."""
+        with self._mu:
+            rec = self._part_owners.get((broker_key,) + part_key)
+        if rec is not None and rec[0] != member:
+            raise self._record(RevokedCommitError(self._report(
+                f"commit for {part_key} accepted from member {member!r} "
+                f"after ownership handed off to {rec[0]!r} — a revoked "
+                f"run was acked past the generation bump", rec[1])))
+
     # -- probe: death-notice pid check ---------------------------------------
     def note_death_notice(self, slot_pid: int | None, msg_pid: int,
                           acted: bool) -> None:
@@ -341,6 +384,20 @@ def note_uploader_spawn(fs_key: int) -> None:
     c = _active
     if c is not None:
         c.note_uploader_spawn(fs_key)
+
+
+def note_partition_owner(broker_key: int, part_key: tuple,
+                         member: str) -> None:
+    c = _active
+    if c is not None:
+        c.note_partition_owner(broker_key, part_key, member)
+
+
+def note_commit_accepted(broker_key: int, part_key: tuple,
+                         member: str) -> None:
+    c = _active
+    if c is not None:
+        c.note_commit_accepted(broker_key, part_key, member)
 
 
 def note_death_notice(slot_pid: int | None, msg_pid: int,

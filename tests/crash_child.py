@@ -60,10 +60,12 @@ def make_broker_class():
                                    os.O_WRONLY | os.O_APPEND | os.O_CREAT,
                                    0o644)
 
-        def commit(self, group, topic, partition, offset) -> None:
+        def commit(self, group, topic, partition, offset,
+                   generation=None, member_id=None) -> None:
             os.write(self._log_fd, f"{partition} {offset}\n".encode())
             os.fsync(self._log_fd)
-            super().commit(group, topic, partition, offset)
+            super().commit(group, topic, partition, offset,
+                           generation=generation, member_id=member_id)
 
     return DurableCommitBroker
 

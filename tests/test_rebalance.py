@@ -1,0 +1,376 @@
+"""Consumer-group rebalance protocol tests: broker-side generation
+fencing + session expiry, the smart consumer's cooperative (incremental)
+revocation, and the writer-level drills — instance kill with survivor
+reclaim, and the zombie paused mid-publish whose stale ack must be fenced
+and un-published (exactly-once restored).
+
+The coordinated protocol is OPT-IN per broker: ``FakeBroker()`` without
+``session_timeout_s`` keeps the legacy instant-reassignment semantics
+(pinned here too), so every pre-existing chaos/ingest test is untouched.
+"""
+
+import os
+import sys
+import threading
+import time
+
+import pytest
+
+from kpw_tpu import Builder, FakeBroker, LocalFileSystem, RetryPolicy
+from kpw_tpu.ingest import SmartCommitConsumer
+from kpw_tpu.ingest.broker import StaleGenerationError
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from proto_helpers import sample_message_class  # noqa: E402
+
+
+def _drain(pred, timeout=20.0, interval=0.02):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+# -- broker protocol ---------------------------------------------------------
+
+def test_generation_bumps_on_membership_change():
+    b = FakeBroker(session_timeout_s=5.0)
+    b.create_topic("t", 4)
+    b.join_group("g", "t", "a")
+    g1 = b.generation("g", "t")
+    b.join_group("g", "t", "b")
+    b.confirm_revocation("g", "t", "a", b2_parts := [
+        p for p in range(4) if p not in b.assignment("g", "t", "a")])
+    assert b.generation("g", "t") > g1
+    assert sorted(b.assignment("g", "t", "a")
+                  + b.assignment("g", "t", "b")) == [0, 1, 2, 3]
+    assert sorted(b.assignment("g", "t", "b")) == sorted(b2_parts)
+    stats = b.group_stats("g", "t")
+    assert stats["rebalances"] >= 2
+    assert stats["members"] == sorted(["a", "b"])
+
+
+def test_stale_generation_commit_fenced():
+    b = FakeBroker(session_timeout_s=5.0)
+    b.create_topic("t", 2)
+    b.join_group("g", "t", "a")
+    gen_a = b.generation("g", "t")
+    b.leave_group("g", "t", "a")
+    b.join_group("g", "t", "b")
+    # zombie "a" commits with its old generation: typed rejection, and the
+    # new owner's offsets are not clobbered
+    with pytest.raises(StaleGenerationError):
+        b.commit("g", "t", 0, 7, generation=gen_a, member_id="a")
+    assert b.committed("g", "t", 0) == 0
+    assert b.group_stats("g", "t")["fenced_commits"] == 1
+    # the live owner commits fine at the current generation
+    b.commit("g", "t", 0, 5, generation=b.generation("g", "t"),
+             member_id="b")
+    assert b.committed("g", "t", 0) == 5
+
+
+def test_drain_window_allows_old_owner_commit():
+    b = FakeBroker(session_timeout_s=5.0, revocation_drain_s=5.0)
+    b.create_topic("t", 2)
+    b.join_group("g", "t", "a")
+    gen_a = b.generation("g", "t")
+    b.join_group("g", "t", "b")  # partitions move a->b, drain window opens
+    moving = [p for p in range(2) if p in b.assignment("g", "t", "b")
+              or p not in b.assignment("g", "t", "a")]
+    rev = b.group_stats("g", "t")["revoking"]
+    assert rev, "a live-member handoff must open a drain window"
+    p = rev[0]
+    # the OLD owner may still commit the moving partition (that is what
+    # lets in-flight files publish+ack during the drain)...
+    b.commit("g", "t", p, 3, generation=gen_a, member_id="a")
+    assert b.committed("g", "t", p) == 3
+    assert b.commit_allowed("g", "t", p, generation=gen_a, member_id="a")
+    # ...and once the old owner confirms, the window closes: the same
+    # commit is now fenced
+    b.confirm_revocation("g", "t", "a", [p])
+    assert not b.commit_allowed("g", "t", p, generation=gen_a,
+                                member_id="a")
+    with pytest.raises(StaleGenerationError):
+        b.commit("g", "t", p, 4, generation=gen_a, member_id="a")
+    assert b.committed("g", "t", p) == 3
+    assert moving  # silence linters; membership math covered above
+
+
+def test_session_expiry_expels_silent_member():
+    b = FakeBroker(session_timeout_s=0.1)
+    b.create_topic("t", 4)
+    b.join_group("g", "t", "a")
+    b.join_group("g", "t", "b")
+    for p in b.group_stats("g", "t")["revoking"]:
+        b.confirm_revocation("g", "t", "a", [p])
+    # "a" heartbeats, "b" goes silent
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        b.heartbeat("g", "t", "a")
+        if b.group_stats("g", "t")["members"] == ["a"]:
+            break
+        time.sleep(0.02)
+    stats = b.group_stats("g", "t")
+    assert stats["members"] == ["a"]
+    assert stats["expired_members"] == 1
+    assert sorted(b.assignment("g", "t", "a")) == [0, 1, 2, 3]
+    # the expelled member is told to rejoin
+    assert b.heartbeat("g", "t", "b")["rejoin"] is True
+
+
+def test_legacy_broker_keeps_instant_reassignment():
+    # no session_timeout_s: join/assign with no drain windows, no fencing
+    b = FakeBroker()
+    b.create_topic("t", 8)
+    for m in ("a", "b", "c"):
+        b.join_group("g", "t", m)
+    parts = [b.assignment("g", "t", m) for m in ("a", "b", "c")]
+    assert sorted(p for ps in parts for p in ps) == list(range(8))
+    assert b.group_stats("g", "t")["revoking"] == []
+    b.commit("g", "t", 0, 9)  # legacy positional commit still accepted
+    assert b.committed("g", "t", 0) == 9
+
+
+# -- consumer cooperative revocation -----------------------------------------
+
+def _mk_consumer(b, drain=2.0):
+    c = SmartCommitConsumer(b, "g", page_size=64,
+                            max_open_pages_per_partition=64,
+                            retry_policy=RetryPolicy(base_sleep=0.005,
+                                                     max_sleep=0.05),
+                            drain_deadline_s=drain)
+    c.subscribe("t")
+    c.start()
+    return c
+
+
+def test_cooperative_rebalance_keeps_unrevoked_positions():
+    b = FakeBroker(session_timeout_s=2.0)
+    b.create_topic("t", 4)
+    for i in range(400):
+        b.produce("t", f"m{i}".encode(), partition=i % 4)
+    c1 = _mk_consumer(b)
+    try:
+        assert _drain(lambda: len(c1.stats()["rebalance"]["assigned"]) == 4)
+        got = []
+        while len(got) < 100:
+            r = c1.poll(timeout=0.2)
+            assert r is not None
+            got.append(r)
+        # second member joins: only HALF of c1's partitions leave; the
+        # retained ones must not rewind (no full reset)
+        c2 = _mk_consumer(b)
+        try:
+            assert _drain(
+                lambda: len(c2.stats()["rebalance"]["assigned"]) == 2
+                and len(c1.stats()["rebalance"]["assigned"]) == 2)
+            s1 = c1.stats()["rebalance"]
+            assert s1["coordinated"] is True
+            assert s1["full_resets"] == 0
+            assert s1["cooperative_rebalances"] >= 1
+            # both consumers together still deliver every record exactly
+            # as at-least-once requires: drain the rest from both
+            seen = {(r.partition, r.offset) for r in got}
+            deadline = time.time() + 10
+            while len(seen) < 400 and time.time() < deadline:
+                for c in (c1, c2):
+                    r = c.poll(timeout=0.05)
+                    if r is not None:
+                        seen.add((r.partition, r.offset))
+            assert len(seen) == 400
+        finally:
+            c2.close()
+    finally:
+        c1.close()
+
+
+def test_uncoordinated_consumer_keeps_legacy_full_reset():
+    b = FakeBroker()  # legacy: heartbeat exists but no session timeout
+    b.create_topic("t", 2)
+    c = _mk_consumer(b)
+    try:
+        assert c.stats()["rebalance"]["coordinated"] is False
+    finally:
+        c.close()
+
+
+# -- writer-level drills -----------------------------------------------------
+
+def _mk_writer(broker, tgt, name, fs=None, drain=2.0):
+    return (Builder().broker(broker).topic("t")
+            .proto_class(sample_message_class())
+            .target_dir(tgt).filesystem(fs or LocalFileSystem())
+            .instance_name(name).group_id("g")
+            .batch_size(64).thread_count(1)
+            .retry_policy(RetryPolicy(base_sleep=0.005, max_sleep=0.05))
+            .max_file_size(128 * 1024).block_size(16 * 1024)
+            .max_file_open_duration_seconds(0.3)
+            .rebalance_drain_deadline_seconds(drain)
+            .build())
+
+
+def _produce(broker, cls, lo, hi, parts, pad=60):
+    filler = "x" * pad
+    for i in range(lo, hi):
+        broker.produce("t", cls(query=f"r-{i % parts}-{i}-{filler}",
+                                timestamp=i).SerializeToString(),
+                       partition=i % parts)
+
+
+def _read_rows(tgt):
+    import pyarrow.parquet as pq
+
+    from crash_child import published_files
+
+    rows: dict[str, int] = {}
+    for f in published_files(tgt):
+        for r in pq.read_table(f).to_pylist():
+            rows[r["query"]] = rows.get(r["query"], 0) + 1
+    return rows
+
+
+def test_instance_kill_survivors_reclaim(tmp_path):
+    """kill -9 analog mid-stream: the dead instance's partitions move to
+    the survivor after session expiry, every record lands exactly once."""
+    parts, n = 4, 1200
+    cls = sample_message_class()
+    broker = FakeBroker(session_timeout_s=0.5, revocation_drain_s=2.0)
+    broker.create_topic("t", parts)
+    tgt = str(tmp_path)
+    w0 = _mk_writer(broker, tgt, "w0")
+    w1 = _mk_writer(broker, tgt, "w1")
+    w0.start()
+    w1.start()
+    _produce(broker, cls, 0, n // 2, parts)
+    assert _drain(lambda: len(
+        w0.stats()["consumer"]["rebalance"]["assigned"]) == 2)
+    w1.hard_kill()
+    _produce(broker, cls, n // 2, n, parts)
+    assert _drain(lambda: (
+        sum(broker.committed("g", "t", p) for p in range(parts)) >= n
+        and w0.ack_lag()["unacked_records"] == 0), timeout=30)
+    stats = broker.group_stats("g", "t")
+    assert stats["expired_members"] == 1
+    assert sorted(w0.stats()["consumer"]["rebalance"]["assigned"]) == [
+        0, 1, 2, 3]
+    assert w0.stats()["consumer"]["rebalance"]["full_resets"] == 0
+    w0.close()
+    rows = _read_rows(tgt)
+    filler = "x" * 60
+    expect = {f"r-{i % parts}-{i}-{filler}" for i in range(n)}
+    assert not (expect - set(rows)), "rows lost across the kill"
+    assert not {k for k, v in rows.items() if v > 1}, "duplicate rows"
+
+
+class _GateFS:
+    """LocalFileSystem wrapper that can park a publish mid-flight: when
+    armed, any ``exists`` probe of a non-tmp path (the publish collision
+    check, the first touch of the destination) blocks until released."""
+
+    def __init__(self, target: str) -> None:
+        self.inner = LocalFileSystem()
+        self._tmp_prefix = target.rstrip("/") + "/tmp"
+        self._gate = threading.Event()
+        self._gate.set()
+        self.parked = threading.Event()
+
+    def arm(self) -> None:
+        self._gate.clear()
+
+    def release(self) -> None:
+        self._gate.set()
+
+    def exists(self, path: str) -> bool:
+        if not self._gate.is_set() and not path.startswith(self._tmp_prefix):
+            self.parked.set()
+            self._gate.wait()
+        return self.inner.exists(path)
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+
+def test_zombie_fenced_mid_publish(tmp_path):
+    """The zombie drill (satellite d): pause an instance INSIDE its
+    publish, let the session expire and the survivor republish, then
+    resume — the zombie's ack must come back as the typed fence error,
+    its file must be un-published, and the tree stays exactly-once."""
+    parts, n = 4, 800
+    cls = sample_message_class()
+    broker = FakeBroker(session_timeout_s=0.5, revocation_drain_s=1.0)
+    broker.create_topic("t", parts)
+    tgt = str(tmp_path)
+    gfs = _GateFS(tgt)
+    victim = _mk_writer(broker, tgt, "vic", fs=gfs, drain=1.0)
+    surv = _mk_writer(broker, tgt, "sur")
+    victim.start()
+    surv.start()
+    _produce(broker, cls, 0, n // 2, parts)
+    assert _drain(lambda: len(
+        surv.stats()["consumer"]["rebalance"]["assigned"]) == 2)
+    # park the victim inside a publish, then freeze its heartbeat
+    gfs.arm()
+    _produce(broker, cls, n // 2, n, parts)
+    assert gfs.parked.wait(timeout=15), "victim never reached a publish"
+    victim.consumer.suspend(True)
+    # survivor inherits everything after expiry and drains the topic
+    assert _drain(lambda: (
+        sum(broker.committed("g", "t", p) for p in range(parts)) >= n
+        and surv.ack_lag()["unacked_records"] == 0), timeout=30)
+    assert len(surv.stats()["consumer"]["rebalance"]["assigned"]) == parts
+    # resume the zombie: its publish completes, the ack is fenced, and
+    # the fenced-unpublish backstop removes the file again
+    victim.consumer.suspend(False)
+    gfs.release()
+    assert _drain(lambda: victim._fenced_acks.count >= 1, timeout=15)
+    assert _drain(
+        lambda: broker.group_stats("g", "t")["fenced_commits"] >= 1)
+    assert victim.stats()["consumer"]["rebalance"]["fenced_commits"] >= 1
+    victim.close()
+    surv.close()
+    rows = _read_rows(tgt)
+    filler = "x" * 60
+    expect = {f"r-{i % parts}-{i}-{filler}" for i in range(n)}
+    assert not (expect - set(rows)), "rows lost across the zombie fence"
+    assert not {k for k, v in rows.items() if v > 1}, (
+        "the zombie's fenced file leaked duplicate rows")
+
+
+def test_process_workers_reject_coordinated_broker(tmp_path):
+    b = FakeBroker(session_timeout_s=1.0)
+    b.create_topic("t", 2)
+    with pytest.raises(ValueError, match="group coordination"):
+        (Builder().broker(b).topic("t")
+         .proto_class(sample_message_class())
+         .target_dir(str(tmp_path)).filesystem(LocalFileSystem())
+         .process_workers(2).build())
+
+
+def test_broker_timestamp_survives_to_ack_latency():
+    """Satellite: the ack-latency ingest stamp is the broker record's
+    append timestamp, not the consumer's fetch wall clock — so the
+    measure survives a partition handoff mid-flight."""
+    b = FakeBroker(session_timeout_s=5.0)
+    b.create_topic("t", 1)
+    t_produce = time.time()
+    b.produce("t", b"v")
+    time.sleep(0.3)  # delay between append and fetch must be measured
+    c = _mk_consumer(b)
+    try:
+        lats = []
+        c.set_latency_observer(lambda lat_s, n: lats.append(lat_s))
+        r = None
+        deadline = time.time() + 5
+        while r is None and time.time() < deadline:
+            r = c.poll(timeout=0.1)
+        assert r is not None
+        c.ack_run(r.partition, r.offset, 1)
+        assert _drain(lambda: len(lats) == 1)
+        # latency includes the produce->fetch gap; wall-clock fudge only
+        assert lats[0] >= 0.25
+        assert lats[0] < (time.time() - t_produce) + 1.0
+    finally:
+        c.close()

@@ -289,6 +289,29 @@ CHECKS = [
                   r"covering \*\*(\d+)\*\* child-written records",
      ["obs21:proc_leg.child_snapshots_merged",
       "obs21:proc_leg.children_merged_written_records"]),
+    # consumer-group rebalance drills (`rebalance:` prefix,
+    # BENCH_REBALANCE_r22.json)
+    ("README.md", r"survivors reclaim after a \*\*([\d.]+) s\*\* blackout",
+     ["rebalance:kill.rebalance_blackout_seconds"]),
+    ("README.md", r"ack latency\s+\*\*([\d.]+) s\*\* p50 / "
+                  r"\*\*([\d.]+) s\*\* p99 measured from the broker "
+                  r"append\s+stamp",
+     ["rebalance:kill.ack_latency_p50_s",
+      "rebalance:kill.ack_latency_p99_s"]),
+    ("README.md", r"\*\*(\d+)\*\* rows across the\s+three legs with "
+                  r"\*\*(\d+)\*\* lost and \*\*(\d+)\*\* duplicated",
+     ["rebalance:rows_total", "rebalance:lost", "rebalance:dups"]),
+    ("README.md", r"\*\*(\d+)\*\* stale-generation commit fenced with the "
+                  r"typed\s+error",
+     ["rebalance:zombie.stale_commits_fenced"]),
+    ("PARITY.md", r"`rebalance_blackout_seconds`\s+\*\*([\d.]+) s\*\* with "
+                  r"`ack_latency_p99_s` \*\*([\d.]+) s\*\*",
+     ["rebalance:kill.rebalance_blackout_seconds",
+      "rebalance:kill.ack_latency_p99_s"]),
+    ("PARITY.md", r"`stale_commits_fenced` \*\*(\d+)\*\* and cooperative\s+"
+                  r"`full_resets` \*\*(\d+)\*\*",
+     ["rebalance:zombie.stale_commits_fenced",
+      "rebalance:cooperative.full_resets"]),
 ]
 
 
@@ -695,6 +718,13 @@ def main() -> int:
         "KPW_OBS21_PATH", os.path.join(ROOT, "BENCH_OBS_r21.json"))
     if os.path.exists(obs21_path):
         key_record["obs21"] = json.load(open(obs21_path))
+    # the consumer-group rebalance-drill artifact (bench.py --rebalance)
+    # is the fifteenth
+    rebalance_path = os.environ.get(
+        "KPW_REBALANCE_PATH",
+        os.path.join(ROOT, "BENCH_REBALANCE_r22.json"))
+    if os.path.exists(rebalance_path):
+        key_record["rebalance"] = json.load(open(rebalance_path))
     docs = {f: open(os.path.join(ROOT, f)).read()
             for f in ({c[0] for c in CHECKS} | set(KEY_DOCS)
                       | set(NAME_DOCS))}
@@ -737,6 +767,8 @@ def main() -> int:
                 root, spec = key_record.get("encodings", {}), spec[10:]
             elif spec.startswith("obs21:"):
                 root, spec = key_record.get("obs21", {}), spec[6:]
+            elif spec.startswith("rebalance:"):
+                root, spec = key_record.get("rebalance", {}), spec[10:]
             try:
                 expect = float(art(root, spec)) / scale
             except (KeyError, TypeError):

@@ -415,12 +415,89 @@ def stale_death_notice(seed: int, revert: bool = False,
     return checker
 
 
+# ---------------------------------------------------------------------------
+# scenario: zombie commit vs. cooperative handoff (PR-18 generation fence)
+# ---------------------------------------------------------------------------
+
+def _legacy_commit(self, group, topic, partition, offset,
+                   generation=None, member_id=None):
+    # PR-18 PRE-FENCE shape, reintroduced test-locally: the committer's
+    # identity rides along but is never CHECKED — only the monotonic
+    # guard protects the offset state, so an old owner's in-flight ack
+    # delayed past the handoff silently clobbers the new owner's
+    # partition (the exact zombie window the generation fence closes)
+    from kpw_tpu.utils import schedcheck as _sc
+
+    _sc.point("broker.commit.fence")
+    with self._lock:
+        key = (group, topic)
+        self._sweep_locked(key)
+        if generation is not None and member_id is not None:
+            _sc.note_commit_accepted(id(self), key + (partition,),
+                                     member_id)
+        ckey = (group, topic, partition)
+        if offset > self._committed.get(ckey, 0):
+            self._committed[ckey] = offset
+
+
+def stale_commit_fence(seed: int, revert: bool = False,
+                       virtual: bool = False):
+    """The revocation-vs-in-flight-publish race: an old owner's ack
+    commit parks at the fence point (``broker.commit.fence`` sits
+    deliberately OUTSIDE the broker lock so a delayed commit cannot block
+    the handoff parties) while the cooperative handoff completes
+    (``confirm_revocation`` records the new owner).  The fixed tree
+    fences the late commit with ``StaleGenerationError``; the reverted
+    pre-fence shape accepts it, and the commit-ownership probe
+    (``schedcheck.note_commit_accepted``) rejects the schedule."""
+    from kpw_tpu.ingest import broker as brk
+
+    # one-sided perturbation (see ring_free_respawn): only the zombie's
+    # commit passes the installed label — the handoff party never parks,
+    # so a seed's verdict depends on its own coin alone
+    checker = schedcheck.install(
+        seed=seed, virtual=virtual, max_delay_s=0.25,
+        labels=("broker.commit.fence",))
+    patches = []
+    if revert:
+        patches.append(_Patch(brk.FakeBroker, "commit", _legacy_commit))
+    try:
+        b = brk.FakeBroker(session_timeout_s=30.0, revocation_drain_s=30.0)
+        b.create_topic("t", 2)
+        b.join_group("g", "t", "a")  # owns both partitions
+        gen_a = b.generation("g", "t")
+        b.join_group("g", "t", "b")  # one partition moves a->b: drain opens
+        rev = b.group_stats("g", "t")["revoking"]
+        assert rev, "a live-member handoff must open a drain window"
+        p = rev[0]
+
+        def zombie_commit():
+            # the old owner's in-flight ack: legitimate inside the drain
+            # window, fenced (fixed tree) or silently accepted (reverted)
+            # once the handoff completed underneath it
+            try:
+                b.commit("g", "t", p, 5, generation=gen_a, member_id="a")
+            except brk.StaleGenerationError:
+                pass  # the fence doing its job — a clean schedule
+
+        _run_threads([
+            zombie_commit,
+            lambda: b.confirm_revocation("g", "t", "a", [p]),
+        ])
+    finally:
+        for pch in patches:
+            pch.undo()
+        schedcheck.uninstall()
+    return checker
+
+
 # registration order = report order; names are the CLI / seeds.json keys
 SCENARIOS = {
     "ring-free-respawn": ring_free_respawn,
     "heartbeat-torn-read": heartbeat_torn_read,
     "uploader-spawn-race": uploader_spawn_race,
     "stale-death-notice": stale_death_notice,
+    "stale-commit-fence": stale_commit_fence,
 }
 
 # which historical PR the reverted fix belongs to (reporting only)
@@ -429,4 +506,5 @@ HISTORY = {
     "heartbeat-torn-read": "PR-11 heartbeat torn read",
     "uploader-spawn-race": "PR-12 uploader-thread spawn race",
     "stale-death-notice": "PR-11 stale death notice",
+    "stale-commit-fence": "PR-18 zombie commit vs cooperative handoff",
 }
