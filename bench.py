@@ -5455,6 +5455,376 @@ def rebalance_probe(smoke: bool = False) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# --rebalance --procs: the same drills with SPAWNED WORKER PROCESSES —
+# revocation crossing the process boundary as ring fence descriptors,
+# whole-instance SIGKILL (children die by real SIGKILL), the zombie
+# CHILD parked inside its publish (ISSUE 19)
+# ---------------------------------------------------------------------------
+
+def _rebalance_procs_writer(broker, tgt: str, name: str, cls,
+                            drain: float = 2.0, open_s: float = 0.3,
+                            clean: bool = False):
+    from kpw_tpu import Builder, LocalFileSystem, RetryPolicy
+
+    b = (Builder().broker(broker).topic("t").proto_class(cls)
+         .target_dir(tgt).filesystem(LocalFileSystem())
+         .instance_name(name).group_id("g")
+         .batch_size(64)
+         .process_workers(1, ring_slots=4)
+         .retry_policy(RetryPolicy(base_sleep=0.005, max_sleep=0.05))
+         .max_file_size(512 * 1024).block_size(16 * 1024)
+         .max_file_open_duration_seconds(open_s)
+         .rebalance_drain_deadline_seconds(drain))
+    if clean:
+        b = b.clean_abandoned_tmp(True)
+    return b.build()
+
+
+def _rebalance_procs_handoff_leg(cls, n: int, deadline_s: float) -> dict:
+    """Cooperative revocation ACROSS the process boundary: a second
+    proc-mode member joins mid-stream, the parent's listener translates
+    the revoked set into ``revoke``/flush descriptors on the work
+    queues, and the child publishes its long-open file early (rotation
+    cause ``revoke``) so the drain completes inside the window.  The
+    victim's files are held open 10 s — the only way those rows ack
+    before the window closes is the cross-process fence flush itself."""
+    import tempfile
+
+    from kpw_tpu import FakeBroker
+
+    parts = 4
+    broker = FakeBroker(session_timeout_s=5.0, revocation_drain_s=3.0)
+    broker.create_topic("t", parts)
+    with tempfile.TemporaryDirectory(prefix="kpw_rebal_pfence_") as tgt:
+        w0 = _rebalance_procs_writer(broker, tgt, "p0", cls,
+                                     drain=3.0, open_s=10.0)
+        w0.start()
+        _rebalance_produce(broker, cls, 0, n // 2, parts)
+        assert _rebalance_spin(
+            lambda: w0.total_written_records >= n // 2, 30), (
+            "rows never reached the child's open file")
+        t_join = time.perf_counter()
+        w1 = _rebalance_procs_writer(broker, tgt, "p1", cls, drain=3.0)
+        w1.start()
+        fenced = _rebalance_spin(lambda: w0._rotated_revoke.count >= 1, 30)
+        fence_flush_s = (round(time.perf_counter() - t_join, 3)
+                         if fenced else None)
+        assert _rebalance_spin(
+            lambda: len(w1.stats()["consumer"]["rebalance"]["assigned"])
+            == parts // 2, 30), "the joiner never settled"
+        _rebalance_produce(broker, cls, n // 2, n, parts)
+        drained = _rebalance_spin(
+            lambda: (sum(broker.committed("g", "t", p)
+                         for p in range(parts)) >= n
+                     and w0.ack_lag()["unacked_records"] == 0
+                     and w1.ack_lag()["unacked_records"] == 0),
+            deadline_s)
+        child_fenced = int(w0._child_telemetry.field("rebalance_fenced"))
+        revoke_rotations = w0._rotated_revoke.count
+        kinds = {e["kind"] for e in w0._flightrec.events()}
+        full_resets = sum(
+            w.stats()["consumer"]["rebalance"]["full_resets"]
+            for w in (w0, w1))
+        gstats = broker.group_stats("g", "t")
+        w1.close()
+        w0.close()
+        check = _rebalance_rowcheck(tgt, parts, n)
+    return check | {
+        "drained": drained,
+        "revoke_flush_rotations": revoke_rotations,
+        "child_rebalance_fenced": child_fenced,
+        "join_to_first_fence_flush_s": fence_flush_s,
+        "fence_notes_recorded": ("rebalance_fence_sent" in kinds
+                                 and "rebalance_child_drained" in kinds
+                                 and "rebalance_drain_complete" in kinds),
+        "full_resets": full_resets,
+        "rebalances": gstats["rebalances"],
+    }
+
+
+def _rebalance_procs_kill_leg(cls, n: int, deadline_s: float) -> dict:
+    """Whole-instance SIGKILL: two proc-mode instances share the group
+    and target tree; the victim's worker children die by REAL SIGKILL
+    (``hard_kill`` — no leave, no flush, no final acks, open tmp debris
+    left behind).  The survivor reclaims after session expiry (blackout
+    = how long the dead member's partitions' committed frontier stood
+    still), and a restarted instance's opt-in startup sweep removes the
+    dead children's tmp debris."""
+    import glob
+    import tempfile
+
+    from kpw_tpu import FakeBroker
+
+    parts = 4
+    broker = FakeBroker(session_timeout_s=0.5, revocation_drain_s=2.0)
+    broker.create_topic("t", parts)
+    with tempfile.TemporaryDirectory(prefix="kpw_rebal_pkill_") as tgt:
+        surv = _rebalance_procs_writer(broker, tgt, "sur", cls)
+        victim = _rebalance_procs_writer(broker, tgt, "vic", cls,
+                                         open_s=30.0)
+        lats: list = []
+        for w in (surv, victim):
+            w.consumer.set_latency_observer(
+                lambda lat_s, cnt: lats.append(lat_s))
+            w.start()
+        assert _rebalance_spin(
+            lambda: all(len(w.stats()["consumer"]["rebalance"]["assigned"])
+                        == parts // 2 for w in (surv, victim)), 20), (
+            "group never settled")
+        _rebalance_produce(broker, cls, 0, n // 2, parts)
+        assert _rebalance_spin(
+            lambda: victim.ack_lag()["unacked_records"] > 0, 20), (
+            "victim never held unacked rows")
+        # a transient rejoin can briefly empty the assigned snapshot;
+        # the blackout frontier must sum over the victim's REAL share
+        assert _rebalance_spin(
+            lambda: len(victim.stats()["consumer"]["rebalance"]
+                        ["assigned"]) == parts // 2, 20), (
+            "victim's assignment never resettled")
+        victim_parts = list(
+            victim.stats()["consumer"]["rebalance"]["assigned"])
+        pids = [s.pid for s in victim._procpool.slots]
+        frontier = [(time.perf_counter(),
+                     sum(broker.committed("g", "t", p)
+                         for p in victim_parts))]
+        stop_sampling = threading.Event()
+
+        def _sample():
+            while not stop_sampling.is_set():
+                frontier.append((time.perf_counter(),
+                                 sum(broker.committed("g", "t", p)
+                                     for p in victim_parts)))
+                time.sleep(0.01)
+
+        sampler = threading.Thread(target=_sample, daemon=True)
+        sampler.start()
+        t_kill = time.perf_counter()
+        victim.hard_kill()
+
+        def _dead(pid):
+            try:
+                os.kill(pid, 0)
+            except OSError:
+                return True
+            return False
+
+        children_sigkilled = _rebalance_spin(
+            lambda: all(_dead(p) for p in pids), 10)
+        debris = glob.glob(f"{tgt}/tmp/vic_*.tmp")
+        _rebalance_produce(broker, cls, n // 2, n, parts)
+        drained = _rebalance_spin(
+            lambda: (sum(broker.committed("g", "t", p)
+                         for p in range(parts)) >= n
+                     and surv.ack_lag()["unacked_records"] == 0),
+            deadline_s)
+        stop_sampling.set()
+        sampler.join(timeout=2)
+        f_kill = max(v for t, v in frontier if t <= t_kill)
+        adv = [t for t, v in frontier if t > t_kill and v > f_kill]
+        blackout = round((adv[0] - t_kill), 3) if adv else None
+        gstats = broker.group_stats("g", "t")
+        sstats = surv.stats()["consumer"]["rebalance"]
+        survivor_owns_all = sorted(sstats["assigned"]) == list(range(parts))
+        # the restart: same instance name, opt-in startup sweep — the
+        # dead children's open tmps are debris of a dead pid generation
+        w2 = _rebalance_procs_writer(broker, tgt, "vic", cls, clean=True)
+        w2.start()
+        swept = _rebalance_spin(
+            lambda: not glob.glob(f"{tgt}/tmp/vic_*.tmp"), 10)
+        sweep_noted = "rebalance_orphan_swept" in {
+            e["kind"] for e in w2._flightrec.events()}
+        w2.close()
+        surv.close()
+        check = _rebalance_rowcheck(tgt, parts, n)
+    vs = sorted(lats)
+
+    def pct(q: float) -> float:
+        return round(vs[int(q * (len(vs) - 1))], 4) if vs else 0.0
+
+    return check | {
+        "partitions": parts,
+        "drained": drained,
+        "rebalance_blackout_seconds": blackout,
+        "children_sigkilled": children_sigkilled,
+        "tmp_debris_after_kill": len(debris),
+        "startup_sweep_clean": swept,
+        "startup_sweep_noted": sweep_noted,
+        "expired_members": gstats["expired_members"],
+        "rebalances": gstats["rebalances"],
+        "survivor_full_resets": sstats["full_resets"],
+        "survivor_owns_all": survivor_owns_all,
+        "ack_latency_p50_s": pct(0.50),
+        "ack_latency_p99_s": pct(0.99),
+        "ack_samples": len(vs),
+    }
+
+
+def _rebalance_procs_zombie_leg(cls, n: int, deadline_s: float) -> dict:
+    """The zombie CHILD: a spawned worker parked INSIDE its publish (the
+    ``KPW_CHILD_PUBLISH_GATE`` file gate) while the parent's generation
+    expires.  The survivor republishes; when the child finally
+    publishes, the parent's collector fences the stale ack off the
+    force-released ledger and un-publishes the file — the tree stays
+    exactly-once.  The survivor runs thread-mode so it never reads the
+    gate."""
+    import tempfile
+
+    from kpw_tpu import FakeBroker
+
+    parts = 4
+    broker = FakeBroker(session_timeout_s=0.5, revocation_drain_s=1.0)
+    broker.create_topic("t", parts)
+    with tempfile.TemporaryDirectory(prefix="kpw_rebal_pzomb_") as root:
+        gate = os.path.join(root, "publish.gate")
+        tgt = os.path.join(root, "out")
+        os.makedirs(tgt)
+        os.environ["KPW_CHILD_PUBLISH_GATE"] = gate
+        try:
+            # children spawn with the gate env; file absent = gate open
+            victim = _rebalance_procs_writer(broker, tgt, "vic", cls,
+                                             drain=1.0)
+            victim.start()
+            surv = _rebalance_writer(broker, tgt, "sur", cls, drain=1.0)
+            surv.start()
+            _rebalance_produce(broker, cls, 0, n // 2, parts)
+            assert _rebalance_spin(
+                lambda: victim.total_written_records > 0, 20), (
+                "victim never wrote")
+            open(gate, "w").close()  # arm: next child publish parks
+            _rebalance_produce(broker, cls, n // 2, n, parts)
+            parked = _rebalance_spin(
+                lambda: victim._procpool.ring.hb_label(0) == "publish", 30)
+            assert parked, "child never parked inside a publish"
+            victim.consumer.suspend(True)  # freeze the parent heartbeat
+            drained = _rebalance_spin(
+                lambda: (sum(broker.committed("g", "t", p)
+                             for p in range(parts)) >= n
+                         and surv.ack_lag()["unacked_records"] == 0),
+                deadline_s)
+            # release the zombie INTO the fence: the stale publish lands,
+            # the collector fences it proactively off the force-released
+            # ledger (the ack never even reaches the broker) and the
+            # backstop removes the file
+            victim.consumer.suspend(False)
+            os.unlink(gate)
+            fenced_seen = _rebalance_spin(
+                lambda: victim._fenced_acks.count >= 1, 20)
+            unpublish_noted = _rebalance_spin(
+                lambda: "rebalance_fenced_unpublish" in {
+                    e["kind"] for e in victim._flightrec.events()}, 20)
+            gstats = broker.group_stats("g", "t")
+            vstats = victim.stats()["consumer"]["rebalance"]
+            victim.close()
+            surv.close()
+        finally:
+            os.environ.pop("KPW_CHILD_PUBLISH_GATE", None)
+        check = _rebalance_rowcheck(tgt, parts, n)
+    return check | {
+        "drained": drained,
+        "child_parked_in_publish": parked,
+        "victim_fenced_acks": victim._fenced_acks.count,
+        "fenced_unpublish_noted": unpublish_noted,
+        "victim_fenced_acks_seen": fenced_seen,
+        "victim_rejoins": vstats["rejoins"],
+        "expired_members": gstats["expired_members"],
+    }
+
+
+def rebalance_procs_probe(smoke: bool = False) -> dict:
+    """``--rebalance --procs`` mode: the rebalance drills re-proven with
+    SPAWNED WORKER PROCESSES (ISSUE 19) — revocation crossing the
+    process boundary as ring fence descriptors.
+
+    Three legs, all against real subprocesses and a real on-disk tree:
+
+    * HANDOFF — a second proc-mode member joins; the parent's listener
+      fans ``revoke``/flush descriptors down the work queues and the
+      child publishes its 10 s-open file early (rotation cause
+      ``revoke``) inside the drain window; the child-side fence counter
+      rides the shm telemetry cells up to the parent.
+    * KILL — whole-instance SIGKILL: the victim's children die by real
+      SIGKILL mid-file (tmp debris left), the survivor reclaims after
+      session expiry (committed-frontier blackout sampled every 10 ms),
+      and a restarted instance's opt-in startup sweep removes the dead
+      children's debris.
+    * ZOMBIE CHILD — a worker child parked INSIDE its publish through
+      the parent's expiry; on release its stale ack is fenced off the
+      force-released ledger and the file is un-published.
+
+    ``--smoke`` is the CI gate: reduced rows, never writes the artifact,
+    exits nonzero unless every leg reads back exactly-once AND the
+    cross-process fence flush fired AND the zombie child's stale publish
+    was fenced and un-published."""
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                    "tests"))
+    from proto_helpers import sample_message_class
+
+    cls = sample_message_class()
+    if smoke:
+        n_handoff, n_kill, n_zombie, deadline_s = 600, 800, 600, 90.0
+    else:
+        n_handoff, n_kill, n_zombie, deadline_s = 2_400, 3_200, 1_600, 240.0
+    t0 = time.perf_counter()
+    handoff = _rebalance_procs_handoff_leg(cls, n_handoff, deadline_s)
+    kill = _rebalance_procs_kill_leg(cls, n_kill, deadline_s)
+    zombie = _rebalance_procs_zombie_leg(cls, n_zombie, deadline_s)
+    lost = handoff["lost"] + kill["lost"] + zombie["lost"]
+    dups = handoff["dups"] + kill["dups"] + zombie["dups"]
+    invariant = (lost == 0 and dups == 0
+                 and handoff["drained"] and kill["drained"]
+                 and zombie["drained"]
+                 and handoff["revoke_flush_rotations"] >= 1
+                 and handoff["child_rebalance_fenced"] >= 1
+                 and handoff["fence_notes_recorded"]
+                 and handoff["full_resets"] == 0
+                 and kill["children_sigkilled"]
+                 and kill["rebalance_blackout_seconds"] is not None
+                 and kill["expired_members"] == 1
+                 and kill["tmp_debris_after_kill"] >= 1
+                 and kill["survivor_owns_all"]
+                 and kill["startup_sweep_clean"]
+                 and kill["startup_sweep_noted"]
+                 and zombie["child_parked_in_publish"]
+                 and zombie["victim_fenced_acks"] >= 1
+                 and zombie["fenced_unpublish_noted"])
+    out = {
+        "metric": "rebalance_blackout_seconds_procs",
+        "value": kill["rebalance_blackout_seconds"],
+        "unit": "s",
+        "rows_total": handoff["rows"] + kill["rows"] + zombie["rows"],
+        "lost": lost,
+        "dups": dups,
+        "handoff": handoff,
+        "kill": kill,
+        "zombie_child": zombie,
+        "invariant_holds": invariant,
+        "bench_wall_s": round(time.perf_counter() - t0, 1),
+        "policy": ("same coordinated FakeBroker protocol as --rebalance, "
+                   "but every instance runs SPAWNED worker processes: "
+                   "revocation crosses the process boundary as revoke "
+                   "fence descriptors on the work queues (flush vs "
+                   "abandon), un-dispatched revoked ring units are "
+                   "backed out parent-side, and the drain confirms only "
+                   "after the children's commits land (peek/settle "
+                   "split); hard_kill SIGKILLs the children — real "
+                   "kill -9, tmp debris left for the restart sweep; the "
+                   "zombie child is parked inside publish_rename via "
+                   "the KPW_CHILD_PUBLISH_GATE file gate and fenced "
+                   "proactively off the force-released ledger"),
+    }
+    if smoke:
+        out["smoke"] = True
+    print(f"[bench:rebalance:procs] blackout={out['value']}s "
+          f"fence_flush_rot={handoff['revoke_flush_rotations']} "
+          f"child_fenced={handoff['child_rebalance_fenced']} "
+          f"zombie_fenced_acks={zombie['victim_fenced_acks']} "
+          f"swept={kill['startup_sweep_clean']} "
+          f"rows={out['rows_total']} lost={lost} dups={dups}; "
+          f"invariant_holds={invariant}", file=sys.stderr)
+    return out
+
+
+# ---------------------------------------------------------------------------
 # config 7: nested streaming replay (cfg5 shape through the FULL writer)
 # ---------------------------------------------------------------------------
 
@@ -6117,10 +6487,11 @@ def main() -> None:
         summary["artifact"] = os.path.basename(path)
         print(json.dumps(summary))
         return
-    if "--procs" in sys.argv:
+    if "--procs" in sys.argv and "--rebalance" not in sys.argv:
         # the --e2e bench's process-workers sweep (usable as `--e2e
         # --procs` or bare `--procs`): own artifact (BENCH_E2E_r15.json),
         # never touches the r14 thread-mode artifact
+        # (`--rebalance --procs` is the proc-mode rebalance drill below)
         if "--smoke" in sys.argv:
             # the CI gate: reduced replay through >=2 worker processes,
             # never writes the artifact, exits nonzero unless ack-lag
@@ -6240,6 +6611,50 @@ def main() -> None:
         print(json.dumps(summary))
         return
     if "--rebalance" in sys.argv:
+        if "--procs" in sys.argv:
+            # process-workers variant of the drill (ISSUE 19): own
+            # artifact, never touches the r22 thread-mode artifact
+            if "--smoke" in sys.argv:
+                # the CI gate: reduced rows, never writes the artifact,
+                # exits nonzero unless every leg read back exactly-once
+                # AND the cross-process fence flush fired AND the zombie
+                # child's stale publish was fenced and un-published
+                out = rebalance_procs_probe(smoke=True)
+                print(json.dumps(
+                    {k: out[k] for k in
+                     ("metric", "value", "rows_total", "smoke", "lost",
+                      "dups", "invariant_holds")}
+                    | {"revoke_flush_rotations":
+                           out["handoff"]["revoke_flush_rotations"],
+                       "child_rebalance_fenced":
+                           out["handoff"]["child_rebalance_fenced"],
+                       "children_sigkilled":
+                           out["kill"]["children_sigkilled"],
+                       "startup_sweep_clean":
+                           out["kill"]["startup_sweep_clean"],
+                       "zombie_fenced_acks":
+                           out["zombie_child"]["victim_fenced_acks"]}))
+                sys.exit(0 if out["invariant_holds"] else 11)
+            out = rebalance_procs_probe()
+            path = os.environ.get(
+                "KPW_REBALANCE_PROCS_PATH",
+                os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "BENCH_REBALANCE_PROCS_r23.json"))
+            with open(path, "w") as f:
+                json.dump(out, f, indent=1)
+            print(f"[bench:rebalance:procs] artifact written to {path}",
+                  file=sys.stderr)
+            summary = {k: v for k, v in out.items()
+                       if k not in ("handoff", "kill", "zombie_child",
+                                    "policy")}
+            summary["rebalance_blackout_seconds"] = out["value"]
+            summary["revoke_flush_rotations"] = out["handoff"][
+                "revoke_flush_rotations"]
+            summary["zombie_fenced_acks"] = out["zombie_child"][
+                "victim_fenced_acks"]
+            summary["artifact"] = os.path.basename(path)
+            print(json.dumps(summary))
+            return
         if "--smoke" in sys.argv:
             # the CI gate: reduced rows, never writes the artifact, exits
             # nonzero unless every leg read back exactly-once AND the

@@ -135,6 +135,7 @@ class SmartCommitConsumer:
                                if self._coordinated else None)
         self._last_hb = 0.0  # monotonic
         self._drain_deadline_s = drain_deadline_s
+        self._rejoin_drain_timeouts = 0  # hard-bounded rejoin waits
         self._rebalance_listener = rebalance_listener
         # in-progress cooperative revocation: {"parts": set[int],
         # "deadline": monotonic} — only the fetcher thread touches it
@@ -872,6 +873,13 @@ class SmartCommitConsumer:
         if lis is not None and lost:
             warned = False
             deadline = time.monotonic() + self._drain_deadline_s
+            # hard bound at 4x the drain deadline: a worker that can no
+            # longer respond (a SIGKILL-orphaned or parked child process
+            # whose abandon descriptor it will never service) must not
+            # wedge the rejoin forever — its runs were never acked, so
+            # proceeding costs only at-least-once redelivery, while a
+            # member that never rejoins starves its share of the topic
+            hard_stop = deadline + 3 * self._drain_deadline_s
             while not self._stop_event.is_set():
                 try:
                     if lis.revocation_drained(lost):
@@ -881,12 +889,21 @@ class SmartCommitConsumer:
                 except Exception:
                     logger.exception("drain probe raised during rejoin")
                     break
-                if not warned and time.monotonic() > deadline:
+                now = time.monotonic()
+                if not warned and now > deadline:
                     warned = True
                     logger.warning(
                         "rejoin of %s waiting on in-flight files for lost "
                         "partitions %s past the drain deadline",
                         self.member_id, lost)
+                if now > hard_stop:
+                    self._rejoin_drain_timeouts += 1
+                    logger.error(
+                        "rejoin of %s abandoning the drain wait for lost "
+                        "partitions %s (4x drain deadline): in-flight "
+                        "files stay un-acked and redeliver",
+                        self.member_id, lost)
+                    break
                 time.sleep(0.005)
         self.broker.join_group(self.group_id, self._topic, self.member_id)
         self._generation = -1  # force a FULL reset on the next refresh

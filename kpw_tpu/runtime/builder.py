@@ -1098,15 +1098,14 @@ class Builder:
             from .procworkers import _proto_spec
 
             _proto_spec(self._proto_class)  # raises if not descriptor-backed
-            if getattr(self._broker, "session_timeout_s", None) is not None:
-                raise ValueError(
-                    "process_workers does not support a broker running "
-                    "group coordination (session_timeout_s set): the "
-                    "cooperative-revocation drain fences the THREAD "
-                    "workers' open files, and child processes hold theirs "
-                    "across the spawn boundary where the fence cannot "
-                    "reach.  Use thread workers for coordinated groups, "
-                    "or a broker without session_timeout_s.")
+            # a coordinated broker (session_timeout_s set) is SUPPORTED in
+            # process mode: the parent owns the group membership and
+            # heartbeat (children never talk to the broker) and forwards
+            # revocations across the ring as `revoke` fence descriptors —
+            # see runtime/procworkers.py.  The rejections above still
+            # apply under coordination (a custom parser, object-store or
+            # composite sinks, partition_by all stay unsupported in proc
+            # mode, coordinated or not).
 
         from .writer import KafkaProtoParquetWriter
 

@@ -437,6 +437,13 @@ REBALANCES_METER = "parquet.writer.rebalances"
 ROTATED_REVOKE_METER = "parquet.writer.rotated.revoke"
 FENCED_ACKS_METER = "parquet.writer.rebalance.fenced.acks"
 FENCE_ABANDONS_METER = "parquet.writer.rebalance.abandons"
+# process-mode rebalance (runtime/procworkers.py): child-side fence
+# activity folded into the merged scrape through the PR-17 telemetry
+# cells — files a child flushed under a revoke fence and open files it
+# abandoned on revoke/lost, summed live + banked like the other
+# worker.proc.child.* gauges
+CHILD_REBALANCE_FENCED_GAUGE = "worker.proc.child.rebalance.fenced"
+CHILD_REBALANCE_ABANDONED_GAUGE = "worker.proc.child.rebalance.abandoned"
 
 # the canonical registry docs cite from (tools/check_docs.py verifies
 # every doc-cited metric name is listed here)
@@ -501,4 +508,6 @@ METRIC_NAMES = (
     ROTATED_REVOKE_METER,
     FENCED_ACKS_METER,
     FENCE_ABANDONS_METER,
+    CHILD_REBALANCE_FENCED_GAUGE,
+    CHILD_REBALANCE_ABANDONED_GAUGE,
 )

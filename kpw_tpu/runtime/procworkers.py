@@ -50,7 +50,7 @@ import time
 
 import numpy as np
 
-from ..ingest.broker import RecordBatch
+from ..ingest.broker import RecordBatch, StaleGenerationError
 from ..utils import schedcheck, tracing
 from ..utils.tracing import stage
 from .retry import RetryInterrupted
@@ -70,9 +70,9 @@ _MP_CTX = multiprocessing.get_context("spawn")
 _HB_MAX = 64          # max worker processes one ring serves
 _HB_CELL = 32         # label_code i64, pending i64, started_at f64, beat f64
 _TM_SLOTS = 16        # int64 counter slots per worker telemetry cell
-#                       (telemetry.TM_FIELDS names the first 14; the rest
-#                       is spare headroom — shared-memory layout is
-#                       append-only)
+#                       (telemetry.TM_FIELDS names all 16 as of the
+#                       rebalance fields — shared-memory layout is
+#                       append-only; grow _TM_SLOTS before TM_FIELDS)
 _TM_CELL = _TM_SLOTS * 8
 _SLOT_HEADER = 48     # count, offs_bytes, payload_bytes, partition,
 #                       start_offset, ingest_us — all little-endian int64
@@ -455,7 +455,18 @@ class _ChildWorker:
             self._encoder_factory = lambda: make_encoder(opts, cfg.backend)
         self.current_file = None
         self._pending_seqs: list[int] = []  # units in the open file
+        self._pending_parts: set[int] = set()  # partitions of those units
         self._carry_est = 64.0
+        # cooperative-rebalance counters (TM cell fields): files flushed
+        # under a revoke fence / open files abandoned on revoke-lost
+        self._rebalance_fenced = 0
+        self._rebalance_abandoned = 0
+        # test seam for the zombie-child drill: while this path exists on
+        # disk the child parks INSIDE its publish (heartbeat label
+        # "publish" stays pending) — the cross-process analog of the
+        # thread-mode gated exists() probe, which cannot reach a child
+        # because proc mode pins the child filesystem to LocalFileSystem
+        self._publish_gate = os.environ.get("KPW_CHILD_PUBLISH_GATE")
         # retry accounting, reported to the parent with every published
         # file so process-mode stats() shows real retry activity
         self._retries = 0
@@ -520,7 +531,8 @@ class _ChildWorker:
                 # to ~0 on every drain — a sawtooth, not a counter)
                 (self._spans_shipped + len(rec)) if rec is not None else 0,
                 rec.dropped if rec is not None else 0,
-                stage_us)
+                stage_us,
+                self._rebalance_fenced, self._rebalance_abandoned)
 
     def _maybe_send_telemetry(self, force: bool = False) -> None:
         """The low-rate side channel: a full snapshot (counter dict +
@@ -568,18 +580,44 @@ class _ChildWorker:
         self._hb_publisher.start()
         self.ack_q.put(("ready", self.cfg.index, os.getpid()))
         try:
+            fence: dict[int, str] = {}  # partition -> pending fence mode
             while True:
                 try:
-                    msg = self.work_q.get(timeout=0.05)
+                    msg = self.work_q.get_nowait()
                 except pyqueue.Empty:
-                    self._maybe_time_rotate()
-                    continue
+                    # queue drained: NOW service accumulated fence
+                    # descriptors.  The deferral is the thread worker's
+                    # _service_fence parity — an abandon posted a few µs
+                    # behind its flush (the rejoin-after-expiry shape)
+                    # must supersede it, not watch it publish rows whose
+                    # commits can only come back fenced
+                    if fence:
+                        self._service_fences(fence)
+                        fence = {}
+                    try:
+                        msg = self.work_q.get(timeout=0.05)
+                    except pyqueue.Empty:
+                        self._maybe_time_rotate()
+                        continue
                 if msg is None:  # poison: abandon the open tmp un-acked
                     self._abandon("close")
                     self.ack_q.put(("closed", self.cfg.index))
                     return
-                _kind, seq, slot_idx = msg
-                self._process_unit(seq, slot_idx)
+                kind = msg[0]
+                if kind == "revoke":
+                    # cross-process fence descriptor: the parent's
+                    # rebalance listener revoked partitions; flush (drain
+                    # window open) or abandon (LOST / deadline lapsed)
+                    # whatever of the open file touches them.  Abandon
+                    # supersedes a pending flush; a flush never
+                    # downgrades an abandon.
+                    _, parts, mode = msg
+                    for p in parts:
+                        if mode == "abandon" or fence.get(p) != "abandon":
+                            fence[p] = mode
+                elif kind == "unit":
+                    _, seq, slot_idx = msg
+                    self._process_unit(seq, slot_idx)
                 self._maybe_time_rotate()
         except RetryInterrupted:
             self._abandon("close")
@@ -661,6 +699,7 @@ class _ChildWorker:
             self._written_bytes += nbytes
             self._retry(self.current_file.flush_if_full, "flush")
         self._pending_seqs.append(seq)
+        self._pending_parts.add(partition)
         if (self.current_file is not None
                 and self.current_file.get_data_size()
                 >= self.cfg.max_file_size):
@@ -711,6 +750,42 @@ class _ChildWorker:
                                heartbeat=self.heartbeat)
 
         self.current_file = self._retry(make, "open")
+
+    def _service_fences(self, fence: dict) -> None:
+        """Service the accumulated fence descriptors, abandon flavor
+        first (its partitions' rows must not publish at all)."""
+        ab = frozenset(p for p, m in fence.items() if m == "abandon")
+        fl = frozenset(p for p, m in fence.items() if m == "flush")
+        if ab:
+            self._service_revoke(ab, "abandon")
+        if fl:
+            self._service_revoke(fl, "flush")
+
+    def _service_revoke(self, parts: frozenset, mode: str) -> None:
+        """One fence descriptor from the parent.  ``flush``: the drain
+        window is open — publish+ack the open file now if it holds any
+        revoked partition's rows (rotation cause ``revoke``, exactly the
+        thread-mode `_service_fence` flavor).  ``abandon``: the window
+        lapsed or the assignment is LOST — publishing would only earn a
+        fenced commit, so the open file is dropped whole and its units
+        reported ``abandoned`` (the parent redelivers retained-partition
+        runs; revoked ones ride the committed frontier to the new owner).
+
+        Work-queue FIFO makes the protocol race-free child-side: every
+        unit dispatched before the fence lands in the open file before
+        this runs, so the flush/abandon decision covers them all."""
+        if not (self._pending_parts & parts):
+            return  # open file (if any) holds only retained partitions
+        if mode == "abandon":
+            seqs, self._pending_seqs = self._pending_seqs, []
+            self._pending_parts.clear()
+            self._abandon("revoke")
+            self._rebalance_abandoned += 1
+            self.ack_q.put(("abandoned", self.cfg.index, seqs))
+            self._maybe_send_telemetry()
+            return
+        self._rebalance_fenced += 1
+        self._finalize("revoke")
 
     def _maybe_time_rotate(self) -> None:
         f = self.current_file
@@ -771,12 +846,26 @@ class _ChildWorker:
             ts = _format_now(self.cfg.file_date_time_pattern)
             name = (f"{ts}_{self.cfg.instance_name}_{self.cfg.index}"
                     f"{self.cfg.file_extension}")
-            publish_rename(self.fs, self._retry, f.path, dest_dir, name,
-                           self.cfg.durable_publish)
+            if self._publish_gate:
+                # zombie-child drill seam: park mid-publish (heartbeat
+                # pending under "publish") until the gate file is removed
+                tok = self.heartbeat.io_started("publish")
+                try:
+                    while (os.path.exists(self._publish_gate)
+                           and not self._stop.is_set()):
+                        time.sleep(0.01)
+                finally:
+                    self.heartbeat.io_finished(tok)
+            dest = publish_rename(self.fs, self._retry, f.path, dest_dir,
+                                  name, self.cfg.durable_publish)
         info = {
             "size": size,
             "records": f.get_num_written_records(),
             "reason": reason,
+            # the published path rides the ack so the parent's fenced-ack
+            # backstop can un-publish a zombie child's file (the parent
+            # and child share the local tree — proc mode pins the fs)
+            "dest": dest,
             "verified": bool(self.cfg.verify_on_publish),
             "index": f.index_info(),
             "assembly": f.assembly_info(),
@@ -786,7 +875,7 @@ class _ChildWorker:
         self._flushed_bytes += size
         if reason == "time":
             self._rot_time += 1
-        else:
+        elif reason != "revoke":  # revoke counts via _rebalance_fenced
             self._rot_size += 1
         self.current_file = None
         self._ack_pending(info, reason)
@@ -794,6 +883,7 @@ class _ChildWorker:
     def _ack_pending(self, file_info, reason: str) -> None:
         """Every unit whose rows are now durably published (or that wrote
         nothing) is safe to ack — the parent commits their offset runs."""
+        self._pending_parts.clear()
         if not self._pending_seqs:
             if file_info is not None:
                 self.ack_q.put(("published", self.cfg.index, [], file_info,
@@ -822,6 +912,7 @@ class _ChildWorker:
             logger.exception("proc worker %d: abandon failed (ignored)",
                              self.cfg.index)
         self.current_file = None
+        self._pending_parts.clear()
 
 
 # ---------------------------------------------------------------------------
@@ -853,11 +944,18 @@ class _ProcWorkerSlot:
         self.backoff_s = 0.0
         self.last_error: str | None = None
         self.pid: int | None = None
-        # seq -> {"runs": [(p, s, e)], "count", "bytes", "slot", "freed"}
-        # guarded by _mu: dispatcher inserts, collector settles, the
-        # supervisor reads held_runs() after join
+        # seq -> {"runs": [(p, s, e)], "count", "bytes", "slot", "freed",
+        #          "sent", "fenced"} — guarded by _mu: dispatcher inserts
+        # (sent=False) and marks sent under the lock, collector settles,
+        # the supervisor reads held_runs() after join, the rebalance
+        # listener backs out un-sent revoked units / force-releases runs
         self._mu = threading.Lock()
         self._ledger: dict[int, dict] = {}
+        # sticky cooperative-revocation fence: partitions whose drain
+        # window is open (GIL-atomic frozenset swaps, the thread
+        # worker's _fence_req discipline — fetcher thread writes,
+        # dispatcher reads)
+        self._fence_flush: frozenset = frozenset()
         self._unacked_count = 0
         self._oldest_unacked_ts: float | None = None
         self._written = 0
@@ -937,13 +1035,65 @@ class _ProcWorkerSlot:
                 e["freed"] = True
             return out
 
+    # -- cooperative-rebalance surface (the _Worker fence duck type) -----------
+    def request_fence(self, parts) -> None:
+        """Revocation drain window opened: back out revoked units the
+        child was never handed (their ledger runs release — the new
+        owner reads them off the committed frontier), then forward the
+        fence descriptor so the child flushes its open file early.  The
+        fence is STICKY until ``fence_clear`` (mirroring the thread
+        worker's ``_fence_req``): a batch buffered before the revoke can
+        still dispatch after this descriptor, and the dispatcher re-sends
+        the fence behind any such late unit so FIFO flushes it too."""
+        ps = frozenset(parts)
+        self._fence_flush = frozenset(self._fence_flush | ps)
+        self.pool.backout_undispatched(self, ps)
+        self._send_revoke(ps, "flush")
+
+    def request_abandon(self, parts) -> None:
+        """Drain deadline lapsed or assignment LOST: back out un-sent
+        revoked units, force-release the revoked runs still in flight
+        (held_runs() must stop reporting them even when the child is
+        parked/unresponsive — the rejoin waits on that), and tell the
+        child to drop its open file.  A file the parked child publishes
+        later settles to zero acked runs and the collector's fenced
+        backstop un-publishes it, so the release cannot double-count."""
+        ps = frozenset(parts)
+        # supersede any pending flush fence for them (thread-worker
+        # request_abandon parity: their commits could no longer land)
+        self._fence_flush = frozenset(self._fence_flush - ps)
+        self.pool.backout_undispatched(self, ps)
+        with self._mu:
+            for e in self._ledger.values():
+                if e["runs"] and any(r[0] in ps for r in e["runs"]):
+                    e["runs"] = []
+                    e["fenced"] = True
+        self._send_revoke(ps, "abandon")
+
+    def fence_clear(self, parts) -> None:
+        """Drain confirmed for ``parts``: retire their sticky flush
+        fence (the child-side state was consumed when the descriptor
+        was serviced)."""
+        self._fence_flush = frozenset(self._fence_flush - frozenset(parts))
+
+    def _send_revoke(self, ps: frozenset, mode: str) -> None:
+        rec = getattr(self.pool.w, "_flightrec", None)
+        if rec is not None:
+            rec.note("rebalance_fence_sent", worker=self.index,
+                     partitions=sorted(ps), mode=mode)
+        try:
+            self.work_q.put(("revoke", tuple(sorted(ps)), mode))
+        except (OSError, ValueError):
+            pass  # child torn down; its ledger redelivers via the supervisor
+
     # -- ledger (dispatcher/collector) -----------------------------------------
     def note_dispatch(self, seq: int, runs, count: int, nbytes: int,
                       slot_idx: int) -> None:
         with self._mu:
             self._ledger[seq] = {"runs": runs, "count": count,
                                  "bytes": nbytes, "slot": slot_idx,
-                                 "freed": False}
+                                 "freed": False, "sent": False,
+                                 "fenced": False}
             if self._oldest_unacked_ts is None:
                 # lint: clock-discipline ok — operator-facing ack-age
                 # observability matches thread-mode stats() (wall
@@ -966,17 +1116,70 @@ class _ProcWorkerSlot:
             self._written += e["count"]
             return e["count"], e["bytes"]
 
+    def mark_sent(self, seq: int) -> bool:
+        """Dispatcher, immediately before the work-queue put: commit to
+        sending.  Returns False when a concurrent revocation already
+        backed the unit out — the put must not happen (the ring slot is
+        recycled and the runs belong to the new owner)."""
+        with self._mu:
+            e = self._ledger.get(seq)
+            if e is None:
+                return False
+            e["sent"] = True
+            return True
+
+    def backout_units(self, parts: frozenset) -> list[int]:
+        """Pop every revoked unit the child was never handed (sent=False)
+        and whose ring slot is still staged (freed=False): its runs were
+        never processed anywhere, so dropping the entry hands them to the
+        new owner via the committed frontier.  Returns the ring slots to
+        recycle — the caller routes them through ``_recycle_slot`` so the
+        double-recycle probe guards this path against the collector's
+        concurrent ``free`` handling for the same slot."""
+        with self._mu:
+            out = []
+            for seq, e in list(self._ledger.items()):
+                if (not e["sent"] and not e["freed"] and e["runs"]
+                        and all(r[0] in parts for r in e["runs"])):
+                    self._ledger.pop(seq)
+                    self._unacked_count = max(
+                        0, self._unacked_count - e["count"])
+                    out.append(e["slot"])
+            if not self._ledger:
+                self._oldest_unacked_ts = None
+            return out
+
     def settle(self, seq: int):
         """The unit's rows are durably published (or needed no publish):
         pop its runs for acking."""
+        return self.settle_unit(seq)[0]
+
+    def peek_unit(self, seq: int) -> tuple[list, bool]:
+        """(runs, fenced) WITHOUT popping the entry: the collector acks
+        off the peek and settles only after the commits land, so
+        ``held_runs()`` keeps reporting the runs until they are durable
+        — ``revocation_drained`` must not confirm a handoff whose
+        offsets have not committed yet (the new owner would refetch
+        rows this member's file already published)."""
+        with self._mu:
+            e = self._ledger.get(seq)
+            if e is None:
+                return [], False
+            return list(e["runs"]), bool(e.get("fenced"))
+
+    def settle_unit(self, seq: int) -> tuple[list, bool]:
+        """(runs, fenced): pop the unit; ``fenced`` is True when a
+        revocation already force-released its runs — the ack arriving
+        now is a zombie child's stale publish, and a file settling to
+        zero acked runs with any fenced unit must be un-published."""
         with self._mu:
             e = self._ledger.pop(seq, None)
             if e is None:
-                return []
+                return [], False
             self._unacked_count = max(0, self._unacked_count - e["count"])
             if not self._ledger:
                 self._oldest_unacked_ts = None
-            return e["runs"]
+            return e["runs"], bool(e.get("fenced"))
 
     def inflight_units(self) -> int:
         with self._mu:
@@ -1138,6 +1341,52 @@ class ProcessWorkerPool:
         is the PR-11 double-free, whichever interleaving produced it."""
         schedcheck.note_slot_recycled(self._pool_key, ring_idx)
         self._free.put(ring_idx)
+
+    def backout_undispatched(self, slot: _ProcWorkerSlot,
+                             parts: frozenset) -> int:
+        """Revocation met a unit still sitting un-dispatched in the ring
+        (staged, ledger'd, never handed to the child): back it out whole.
+        The runs release with the ledger entry (the new owner reads them
+        from the committed frontier — sending now would double-write),
+        and the ring slot recycles through the probed single re-entry
+        point: the collector's ``free`` handling for the same slot is the
+        racing party, the cross-process analog of the PR-11 stale-free/
+        respawn double recycle."""
+        # schedule-explorer edge, BEFORE the ledger pop: the collector's
+        # ``free`` handling for a unit of the same child races this
+        # back-out — a shape that takes entries the dispatcher already
+        # committed to sending (or the child already freed) recycles the
+        # same ring slot twice, and the probe in _recycle_slot catches it
+        schedcheck.point("proc.revoke.backout")
+        backed = slot.backout_units(parts)
+        for ring_idx in backed:
+            self._recycle_slot(ring_idx)
+        if backed:
+            rec = getattr(self.w, "_flightrec", None)
+            if rec is not None:
+                rec.note("rebalance_backout", worker=slot.index,
+                         units=len(backed))
+        return len(backed)
+
+    def redeliver_async(self, runs, label: str) -> None:
+        """Redeliver abandoned runs off the collector thread (the
+        consumer's redeliver path can block on a full queue and drops
+        revoked/unassigned partitions itself — the retained-vs-revoked
+        filter lives there, same as thread mode)."""
+        if not runs:
+            return
+        t = threading.Thread(
+            target=self._redeliver_runs, args=(list(runs),),
+            name=f"KPW-proc-redeliver-{label}", daemon=True)
+        t.start()
+
+    def _redeliver_runs(self, runs) -> None:
+        for p, s, e in runs:
+            try:
+                self.w.consumer.redeliver_run(p, s, e - s,
+                                              stop_event=self._stop)
+            except Exception:
+                logger.exception("proc redelivery of %s failed", (p, s, e))
 
     # -- stats ------------------------------------------------------------------
     def ring_free(self) -> int:
@@ -1390,17 +1639,24 @@ class ProcessWorkerPool:
         seq = self._seq
         target.note_dispatch(seq, [tuple(r) for r in runs], count, nbytes,
                              slot_idx)
+        # commit-to-send under the ledger lock: a rebalance listener
+        # backing out revoked un-sent units races this exact window, and
+        # sending a unit whose ledger entry (and ring slot) were just
+        # reclaimed would publish rows the new owner also redelivers
+        if not target.mark_sent(seq):
+            return not self._stop.is_set()
         try:
-            # lint: protocol-exhaustiveness ok — the work queue is
-            # single-tag by design: the child unpacks ("unit", seq,
-            # slot) positionally and poison is the bare None, so there
-            # is no receiving dispatch table to drift against
             target.work_q.put(("unit", seq, slot_idx))
         except (OSError, ValueError):
             # the child died between pick and put: the ledger entry makes
             # the runs redeliverable through the supervisor path
             return not self._stop.is_set()
         self.dispatched_units += 1
+        if partition in target._fence_flush:
+            # a batch buffered before the revoke dispatched AFTER the
+            # fence descriptor: re-send it so work-queue FIFO flushes
+            # this late unit inside the drain window too
+            target._send_revoke(frozenset({partition}), "flush")
         return True
 
     def _get_free_slot(self):
@@ -1471,20 +1727,60 @@ class ProcessWorkerPool:
             _, widx, seqs, file_info, retry_stats = msg
             slot = self.slots[widx]
             slot.retries, slot.backoff_s, slot.last_error = retry_stats
+            acked_runs = 0
+            fenced = False
+            fenced_runs: list = []
             with stage("worker.proc.ack"):
                 for seq in seqs:
-                    for p, s, e in slot.settle(seq):
-                        self.w.consumer.ack_run(p, s, e - s)
+                    runs, was_fenced = slot.peek_unit(seq)
+                    fenced |= was_fenced
+                    for p, s, e in runs:
+                        try:
+                            self.w.consumer.ack_run(p, s, e - s)
+                            acked_runs += 1
+                        except StaleGenerationError:
+                            # the broker fenced this commit: the child
+                            # published across a generation bump (zombie
+                            # shape) — resolved below, never fatal to
+                            # the collector
+                            fenced = True
+                            fenced_runs.append((p, s, e))
+                    # settle strictly AFTER the commits (peek/settle
+                    # split): see peek_unit
+                    slot.settle_unit(seq)
                     self.acked_units += 1
+            if fenced:
+                self.w._fenced_acks.mark()
             if file_info is not None:
+                if fenced and acked_runs == 0:
+                    # nothing under the file committed: un-publish it
+                    # (exactly-once restored — the rows ride the
+                    # committed frontier to the new owner / redelivery),
+                    # the proc-mode mirror of _fenced_ack_cleanup
+                    self._fenced_unpublish(widx, file_info, fenced_runs)
+                    return
+                if fenced:
+                    rec = getattr(self.w, "_flightrec", None)
+                    if rec is not None:
+                        rec.note("rebalance_fenced_ack_dropped",
+                                 worker=widx, mode="proc",
+                                 runs=fenced_runs)
                 slot._published_files += 1
                 self.w._flushed_records.mark(file_info["records"])
                 self.w._flushed_bytes.mark(file_info["size"])
                 self.w._file_size_histogram.update(file_info["size"])
                 if file_info.get("verified"):
                     self.w._verified.mark()
-                (self.w._rotated_time if file_info["reason"] == "time"
-                 else self.w._rotated_size).mark()
+                reason = file_info["reason"]
+                if reason == "revoke":
+                    self.w._rotated_revoke.mark()
+                    rec = getattr(self.w, "_flightrec", None)
+                    if rec is not None:
+                        rec.note("rebalance_child_drained", worker=widx,
+                                 records=file_info["records"])
+                else:
+                    (self.w._rotated_time if reason == "time"
+                     else self.w._rotated_size).mark()
                 info = file_info.get("index") or {}
                 if info.get("pages_indexed"):
                     self.w._indexed.mark()
@@ -1494,6 +1790,24 @@ class ProcessWorkerPool:
                 if asm.get("native_chunks"):
                     self.w._native_asm_chunks.mark(asm["native_chunks"])
                     self.w._native_asm_pages.mark(asm["native_pages"])
+        elif kind == "abandoned":
+            # the child dropped its open file on a revoke-abandon: settle
+            # every covered unit and redeliver what this member RETAINS
+            # (redeliver_run drops revoked/unassigned partitions itself);
+            # revoked runs were force-released at request_abandon and ride
+            # the committed frontier to the new owner
+            _, widx, seqs = msg
+            slot = self.slots[widx]
+            runs: list = []
+            for seq in seqs:
+                rs, _was_fenced = slot.settle_unit(seq)
+                runs.extend(rs)
+            self.w._fence_abandons.mark()
+            rec = getattr(self.w, "_flightrec", None)
+            if rec is not None:
+                rec.note("rebalance_child_abandoned", worker=widx,
+                         units=len(seqs), retained_runs=len(runs))
+            self.redeliver_async(runs, f"abandon-{widx}")
         elif kind == "died":
             _, widx, pid, reason = msg
             schedcheck.point("proc.collector.died")
@@ -1525,6 +1839,27 @@ class ProcessWorkerPool:
             self.slots[widx].ready = True
         elif kind == "closed":
             pass  # clean poison exit; close() already joins
+
+    def _fenced_unpublish(self, widx: int, file_info: dict,
+                          fenced_runs) -> None:
+        """A child's publish crossed a generation fence and NOTHING under
+        the file committed: delete the just-renamed dest so the tree
+        stays exactly-once (the new owner republishes the same rows from
+        the committed frontier), and redeliver any retained runs whose
+        ack the fence rejected.  Parent and child share the local tree —
+        proc mode pins the filesystem — so the parent can un-publish."""
+        dest = file_info.get("dest")
+        if dest:
+            try:
+                self.w.fs.delete(dest)
+            except OSError:
+                logger.exception("fenced un-publish of %s failed "
+                                 "(duplicate rows possible)", dest)
+        rec = getattr(self.w, "_flightrec", None)
+        if rec is not None:
+            rec.note("rebalance_fenced_unpublish", worker=widx,
+                     dest=dest, records=file_info.get("records"))
+        self.redeliver_async(fenced_runs, f"fence-{widx}")
 
     def _monitor_liveness(self) -> None:
         """A SIGKILLed child sends no death notice — poll exit codes so

@@ -69,6 +69,8 @@ TM_FIELDS = (
     "spans_recorded",      # spans the child's SpanRecorder accepted
     "spans_dropped",       # spans its ring buffer overwrote
     "stage_time_us",       # cumulative stage() wall-time, microseconds
+    "rebalance_fenced",    # files flushed under a revoke fence
+    "rebalance_abandoned",  # open files abandoned on revoke/lost
 )
 
 TM_INDEX = {name: i for i, name in enumerate(TM_FIELDS)}

@@ -230,13 +230,16 @@ class KafkaProtoParquetWriter:
             drain_deadline_s=getattr(b, "_rebalance_drain_deadline", 5.0),
         )
         self.consumer.subscribe(b._topic)
-        # cooperative-rebalance seam (thread mode; Builder.build rejects
-        # process workers on a coordination-enabled broker): revocations
-        # fence the workers' open files through the drain window before the
-        # consumer confirms the handoff.  Registered unconditionally — the
-        # consumer only fires it when the broker runs group coordination.
-        if not b._proc_workers:
-            self.consumer.set_rebalance_listener(_RebalanceListener(self))
+        # cooperative-rebalance seam: revocations fence the workers' open
+        # files through the drain window before the consumer confirms the
+        # handoff.  Registered unconditionally — the consumer only fires
+        # it when the broker runs group coordination.  Process mode uses
+        # the same listener: _ProcWorkerSlot duck-types the fence surface
+        # (request_fence / request_abandon / fence_clear / held_runs) and
+        # forwards the fence as a `revoke` ring-protocol descriptor; the
+        # coordinated heartbeat stays parent-owned — children never talk
+        # to the broker.
+        self.consumer.set_rebalance_listener(_RebalanceListener(self))
         self._workers: list = []
         self._started = False
         self._closed = False
@@ -493,6 +496,11 @@ class KafkaProtoParquetWriter:
                           lambda: ct.field("spans_recorded"))
                 reg.gauge(M.CHILD_SPANS_DROPPED_GAUGE,
                           lambda: ct.field("spans_dropped"))
+                # child-side rebalance activity in the same merged scrape
+                reg.gauge(M.CHILD_REBALANCE_FENCED_GAUGE,
+                          lambda: ct.field("rebalance_fenced"))
+                reg.gauge(M.CHILD_REBALANCE_ABANDONED_GAUGE,
+                          lambda: ct.field("rebalance_abandoned"))
         else:
             for i in range(self._b._thread_count):
                 w = _Worker(self, i)
@@ -540,13 +548,20 @@ class KafkaProtoParquetWriter:
                      if pat.fullmatch(p.rsplit("/", 1)[-1])]
         except FileNotFoundError:
             return
+        swept = 0
         for p in stale:
             try:
                 self.fs.delete(p)
                 self._tmp_swept.mark()
+                swept += 1
                 logger.info("Removed abandoned tmp file %s", p)
             except OSError:
                 logger.warning("Could not remove abandoned tmp file %s", p)
+        if swept and self._flightrec is not None:
+            # rebalance-drill evidence: a restarted instance aborting the
+            # dead instance's debris (incl. SIGKILLed proc-mode children's
+            # tmps — their '{instance}_{worker}_{rand}.tmp' names match)
+            self._flightrec.note("rebalance_orphan_swept", files=swept)
 
     def _verify_published(self) -> None:
         """Startup recovery, the read-back half of the durability story:
@@ -1032,16 +1047,40 @@ class KafkaProtoParquetWriter:
         self._close_event.set()
         if self._watchdog_obj is not None:
             self._watchdog_obj.close(timeout=1)
-        for w in self._workers:
-            w._stop.set()
-        # no leave_group, no final commit: the group coordinator must
-        # discover the death by session timeout
-        self.consumer.hard_kill()
-        for w in self._workers:
-            w.join(timeout=5)
-        for w in self._workers:
-            # free pipeline threads + sinks; tmps stay un-published
-            w._abandon_open_files("error")
+        if self._procpool is not None:
+            # whole-instance kill, process edition: the children get a
+            # REAL SIGKILL (orphaned mid-file, tmps left on disk for the
+            # restarted instance's startup sweep), the dispatcher and
+            # collector stop abruptly (units in the ring abandoned
+            # un-acked), and the ring is torn down for shm hygiene — the
+            # segment is parent-owned and a dead instance must not leak
+            # it.  No leave_group, no final commit: the group
+            # coordinator must discover the death by session timeout.
+            self._procpool._stop.set()
+            for s in self._workers:
+                try:
+                    s._proc.kill()
+                except (OSError, ValueError):
+                    pass
+            self.consumer.hard_kill()
+            self._procpool._closed = True
+            self._procpool._dispatcher.join(timeout=5)
+            self._procpool._collector.join(timeout=5)
+            for s in self._workers:
+                s.join(timeout=5)
+            self._procpool.ring.close()
+            self._procpool.ring.unlink()
+        else:
+            for w in self._workers:
+                w._stop.set()
+            # no leave_group, no final commit: the group coordinator must
+            # discover the death by session timeout
+            self.consumer.hard_kill()
+            for w in self._workers:
+                w.join(timeout=5)
+            for w in self._workers:
+                # free pipeline threads + sinks; tmps stay un-published
+                w._abandon_open_files("error")
         if self._flightrec is not None:
             self._flightrec.note("hard_kill",
                                  instance=self._b._instance_name)

@@ -339,14 +339,41 @@ def test_zombie_fenced_mid_publish(tmp_path):
         "the zombie's fenced file leaked duplicate rows")
 
 
-def test_process_workers_reject_coordinated_broker(tmp_path):
-    b = FakeBroker(session_timeout_s=1.0)
+def test_process_workers_coordinated_broker_builds(tmp_path):
+    """The PR-18 build() rejection of process_workers + coordinated
+    broker is gone: the parent owns membership/heartbeat and fans
+    revocation out to children as fence descriptors."""
+    b = FakeBroker(session_timeout_s=5.0)
     b.create_topic("t", 2)
-    with pytest.raises(ValueError, match="group coordination"):
-        (Builder().broker(b).topic("t")
+    w = (Builder().broker(b).topic("t")
          .proto_class(sample_message_class())
          .target_dir(str(tmp_path)).filesystem(LocalFileSystem())
-         .process_workers(2).build())
+         .process_workers(1, ring_slots=2).build())
+    # never started — just prove build() wires the coordinated consumer
+    # over proc-worker config with the listener installed (proc slots
+    # duck-type the fence surface once start() spawns them)
+    assert w.consumer._coordinated
+    assert w._b._proc_workers == 1
+    assert w.consumer._rebalance_listener is not None
+
+
+@pytest.mark.parametrize("mutate,match", [
+    (lambda bld: bld.partition_by(lambda m: "x"), "partition_by"),
+    (lambda bld: bld.parser(lambda rec: rec), "custom parser"),
+    (lambda bld: bld.encoder_backend("tpu"), "cpu/native/auto"),
+])
+def test_process_workers_remaining_rejections_coordinated(
+        tmp_path, mutate, match):
+    """Combos still unsupported in process mode stay loud typed errors,
+    coordinated broker or not — each pinned here."""
+    b = FakeBroker(session_timeout_s=1.0)
+    b.create_topic("t", 2)
+    bld = (Builder().broker(b).topic("t")
+           .proto_class(sample_message_class())
+           .target_dir(str(tmp_path)).filesystem(LocalFileSystem())
+           .process_workers(2))
+    with pytest.raises(ValueError, match=match):
+        mutate(bld).build()
 
 
 def test_broker_timestamp_survives_to_ack_latency():

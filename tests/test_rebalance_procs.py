@@ -1,0 +1,352 @@
+"""Cross-process rebalance protocol: the parent's rebalance listener
+fans revocation out to spawned worker children as fence descriptors
+(``revoke`` beside ``unit``/``free``/``published`` on the ring queues),
+children flush-or-abandon the open file across the process boundary,
+and the drills from tests/test_rebalance.py re-prove exactly-once in
+process mode — whole-instance SIGKILL with survivor reclaim + startup
+sweep of the dead instance's tmp debris, and the zombie child parked
+inside a publish whose stale ack must be fenced and un-published.
+
+Real spawned subprocesses against a real on-disk LocalFileSystem
+throughout (the only sink that crosses a process boundary), so row
+counts stay small."""
+
+import glob
+import os
+import time
+
+import pytest
+
+from kpw_tpu import Builder, FakeBroker, LocalFileSystem, RetryPolicy
+from proto_helpers import sample_message_class
+
+TOPIC = "t"
+
+
+@pytest.fixture(autouse=True)
+def _schedcheck(schedcheck_checker):
+    """Every proc-mode test runs with the schedule explorer's invariant
+    probes live in the parent — including the new ``proc.revoke.backout``
+    point on the revocation back-out path."""
+    yield schedcheck_checker
+    assert not schedcheck_checker.violations, [
+        repr(v) for v in schedcheck_checker.violations]
+
+
+def _drain(pred, timeout=30.0, interval=0.02):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+def _builder(broker, tgt, name, drain=2.0, open_s=0.3, procs=1):
+    return (Builder().broker(broker).topic(TOPIC)
+            .proto_class(sample_message_class())
+            .target_dir(tgt).filesystem(LocalFileSystem())
+            .instance_name(name).group_id("g")
+            .batch_size(64)
+            .process_workers(procs, ring_slots=4)
+            .retry_policy(RetryPolicy(base_sleep=0.005, max_sleep=0.05))
+            .max_file_size(512 * 1024).block_size(16 * 1024)
+            .max_file_open_duration_seconds(open_s)
+            .rebalance_drain_deadline_seconds(drain))
+
+
+def _mk_proc_writer(broker, tgt, name, **kw):
+    return _builder(broker, tgt, name, **kw).build()
+
+
+def _mk_thread_writer(broker, tgt, name, drain=1.0):
+    return (Builder().broker(broker).topic(TOPIC)
+            .proto_class(sample_message_class())
+            .target_dir(tgt).filesystem(LocalFileSystem())
+            .instance_name(name).group_id("g")
+            .batch_size(64).thread_count(1)
+            .retry_policy(RetryPolicy(base_sleep=0.005, max_sleep=0.05))
+            .max_file_size(128 * 1024).block_size(16 * 1024)
+            .max_file_open_duration_seconds(0.3)
+            .rebalance_drain_deadline_seconds(drain)
+            .build())
+
+
+def _produce(broker, lo, hi, parts, pad=60):
+    cls = sample_message_class()
+    filler = "x" * pad
+    for i in range(lo, hi):
+        broker.produce(TOPIC, cls(query=f"r-{i % parts}-{i}-{filler}",
+                                  timestamp=i).SerializeToString(),
+                       partition=i % parts)
+
+
+def _read_rows(tgt):
+    import pyarrow.parquet as pq
+
+    from crash_child import published_files
+
+    rows: dict[str, int] = {}
+    for f in published_files(tgt):
+        for r in pq.read_table(f).to_pylist():
+            rows[r["query"]] = rows.get(r["query"], 0) + 1
+    return rows
+
+
+def _assert_exactly_once(tgt, n, parts, pad=60):
+    rows = _read_rows(tgt)
+    filler = "x" * pad
+    expect = {f"r-{i % parts}-{i}-{filler}" for i in range(n)}
+    assert not (expect - set(rows)), "rows lost across the rebalance"
+    assert not {k for k, v in rows.items() if v > 1}, "duplicate rows"
+
+
+def _committed(broker, parts):
+    return sum(broker.committed("g", TOPIC, p) for p in range(parts))
+
+
+def _kinds(w):
+    return {e["kind"] for e in w._flightrec.events()}
+
+
+# -- fence descriptor roundtrip ----------------------------------------------
+
+def test_fence_descriptor_roundtrip_flush(tmp_path):
+    """Cooperative revocation crosses the process boundary: a second
+    member joins, the parent's listener sends ``revoke``/flush
+    descriptors down the work queues, the child publishes its open file
+    early (rotation cause ``revoke``), the drain completes inside the
+    window, and the handoff stays exactly-once."""
+    parts, n = 4, 600
+    broker = FakeBroker(session_timeout_s=5.0, revocation_drain_s=3.0)
+    broker.create_topic(TOPIC, parts)
+    tgt = str(tmp_path)
+    # long-open files: the only way those rows ack before the window
+    # closes is the fence flush itself
+    w0 = _mk_proc_writer(broker, tgt, "w0", open_s=10.0, drain=3.0)
+    w0.start()
+    try:
+        _produce(broker, 0, n // 2, parts)
+        assert _drain(lambda: w0.total_written_records >= n // 2), \
+            "rows never reached the child's open file"
+        w1 = _mk_proc_writer(broker, tgt, "w1", open_s=0.3)
+        w1.start()
+        try:
+            assert _drain(lambda: len(
+                w1.stats()["consumer"]["rebalance"]["assigned"]) == 2)
+            assert _drain(lambda: w0._rotated_revoke.count >= 1), \
+                "no revoke-cause rotation crossed the process boundary"
+            kinds = _kinds(w0)
+            assert "rebalance_fence_sent" in kinds
+            assert "rebalance_child_drained" in kinds
+            assert "rebalance_drain_complete" in kinds
+            # the child-side counter rode the shm telemetry cells up
+            assert _drain(lambda: w0._child_telemetry.field(
+                "rebalance_fenced") >= 1)
+            _produce(broker, n // 2, n, parts)
+            assert _drain(lambda: (
+                _committed(broker, parts) >= n
+                and w0.ack_lag()["unacked_records"] == 0
+                and w1.ack_lag()["unacked_records"] == 0), timeout=45)
+            for w in (w0, w1):
+                assert w.stats()["consumer"]["rebalance"]["full_resets"] \
+                    == 0
+            assert broker.group_stats("g", TOPIC)["rebalances"] >= 2
+        finally:
+            w1.close()
+    finally:
+        w0.close()
+    _assert_exactly_once(tgt, n, parts)
+
+
+def test_revoked_undispatched_unit_backed_out(tmp_path):
+    """A revoked unit still sitting in the ring (dispatched to the
+    ledger, never handed to the child) is backed out at the fence: its
+    ring slot recycles through the probed single re-entry point and its
+    runs release so the drain completes without the child ever seeing
+    the unit.  Driven at the pool surface against a live writer."""
+    parts = 2
+    broker = FakeBroker(session_timeout_s=5.0, revocation_drain_s=2.0)
+    broker.create_topic(TOPIC, parts)
+    w = _mk_proc_writer(broker, str(tmp_path), "w0", open_s=10.0)
+    w.start()
+    try:
+        pool = w._procpool
+        slot = pool.slots[0]
+        # stage a synthetic unit: ledger entry exists, work-queue put
+        # never happened (the exact shape of a unit the revocation races
+        # ahead of)
+        ri = pool._get_free_slot()
+        slot.note_dispatch(10_001, [(0, 500, 564)], 64, 4096, ri)
+        assert slot.inflight_units() == 1
+        assert (0, 500, 564) in slot.held_runs()
+        backed = pool.backout_undispatched(slot, frozenset({0}))
+        assert backed == 1
+        assert slot.inflight_units() == 0
+        assert slot.held_runs() == []
+        # the slot really recycled: the free pool hands it back out
+        assert "rebalance_backout" in _kinds(w)
+        # a unit the dispatcher already committed to sending is NOT
+        # backed out (the child will flush it under the fence instead)
+        ri2 = pool._get_free_slot()
+        slot.note_dispatch(10_002, [(1, 600, 664)], 64, 4096, ri2)
+        assert slot.mark_sent(10_002)
+        assert pool.backout_undispatched(slot, frozenset({1})) == 0
+        assert slot.inflight_units() == 1
+        slot.settle(10_002)  # clean up for close()
+        pool._recycle_slot(ri2)
+    finally:
+        w.close()
+
+
+# -- abandon: lost partitions across the process boundary ---------------------
+
+def test_partitions_lost_abandons_across_process_boundary(tmp_path):
+    """Session expiry while rows sit in a child's open file: on rejoin
+    the listener's abandon descriptor crosses the process boundary, the
+    child drops the open tmp un-acked (no fenced publish attempt), the
+    survivor republishes from the committed frontier, and the tree
+    stays exactly-once."""
+    parts, n = 4, 600
+    broker = FakeBroker(session_timeout_s=0.5, revocation_drain_s=1.0)
+    broker.create_topic(TOPIC, parts)
+    tgt = str(tmp_path)
+    victim = _mk_proc_writer(broker, tgt, "vic", open_s=30.0, drain=1.0)
+    surv = _mk_thread_writer(broker, tgt, "sur")
+    victim.start()
+    surv.start()
+    try:
+        _produce(broker, 0, n // 2, parts)
+        assert _drain(lambda: len(
+            surv.stats()["consumer"]["rebalance"]["assigned"]) == 2)
+        assert _drain(lambda: victim.total_written_records > 0)
+        victim.consumer.suspend(True)  # SIGSTOP analog: heartbeat stops
+        _produce(broker, n // 2, n, parts)
+        assert _drain(lambda: (
+            _committed(broker, parts) >= n
+            and surv.ack_lag()["unacked_records"] == 0), timeout=45)
+        assert broker.group_stats("g", TOPIC)["expired_members"] == 1
+        # resume: the heartbeat comes back fenced, the rejoin reports
+        # the assignment LOST, and the abandon rides the work queue
+        victim.consumer.suspend(False)
+        assert _drain(lambda: victim._fence_abandons.count >= 1,
+                      timeout=20)
+        kinds = _kinds(victim)
+        assert "rebalance_partitions_lost" in kinds
+        assert "rebalance_child_abandoned" in kinds
+        assert _drain(lambda: victim._child_telemetry.field(
+            "rebalance_abandoned") >= 1)
+        assert _drain(lambda: victim.ack_lag()["unacked_records"] == 0,
+                      timeout=20)
+    finally:
+        victim.close()
+        surv.close()
+    _assert_exactly_once(tgt, n, parts)
+
+
+# -- the zombie child ---------------------------------------------------------
+
+def test_zombie_child_stale_publish_fenced_and_unpublished(
+        tmp_path, monkeypatch):
+    """The zombie-child drill: a child parked INSIDE its publish while
+    the parent's generation is fenced away.  When the child finally
+    publishes, the parent's collector must fence the stale ack
+    (``StaleGenerationError`` from the broker) and un-publish the file
+    — never double-count it against the survivor's republication."""
+    gate = str(tmp_path / "publish.gate")
+    monkeypatch.setenv("KPW_CHILD_PUBLISH_GATE", gate)
+    parts, n = 4, 600
+    broker = FakeBroker(session_timeout_s=0.5, revocation_drain_s=1.0)
+    broker.create_topic(TOPIC, parts)
+    tgt = str(tmp_path / "out")
+    victim = _mk_proc_writer(broker, tgt, "vic", open_s=0.3, drain=1.0)
+    victim.start()  # children spawn with the gate env; file absent = open
+    # thread-mode survivor: same group, does not read the gate
+    surv = _mk_thread_writer(broker, tgt, "sur")
+    surv.start()
+    try:
+        _produce(broker, 0, n // 2, parts)
+        assert _drain(lambda: victim.total_written_records > 0)
+        open(gate, "w").close()  # arm: next child publish parks
+        _produce(broker, n // 2, n, parts)
+        assert _drain(lambda: victim._procpool.ring.hb_label(0)
+                      == "publish", timeout=20), \
+            "child never parked inside a publish"
+        victim.consumer.suspend(True)
+        assert _drain(lambda: (
+            _committed(broker, parts) >= n
+            and surv.ack_lag()["unacked_records"] == 0), timeout=45)
+        # release the zombie: the stale publish lands, its ack comes
+        # back fenced, and the collector's backstop removes the file
+        victim.consumer.suspend(False)
+        os.unlink(gate)
+        assert _drain(lambda: victim._fenced_acks.count >= 1,
+                      timeout=20)
+        assert _drain(
+            lambda: "rebalance_fenced_unpublish" in _kinds(victim),
+            timeout=20)
+        # note: the broker's fenced_commits counter may stay 0 here —
+        # the parent fences PROACTIVELY off the force-released ledger
+        # (the stale ack never even reaches the broker), which is the
+        # stronger property
+    finally:
+        victim.close()
+        surv.close()
+    _assert_exactly_once(tgt, n, parts)
+
+
+# -- whole-instance SIGKILL ---------------------------------------------------
+
+def test_instance_sigkill_reclaim_and_startup_sweep(tmp_path):
+    """kill -9 of a whole proc-mode instance mid-stream: the children
+    die by real SIGKILL (orphaned ring abandoned), the survivor inherits
+    the dead member's partitions after session expiry with acked ⊆
+    published exactly-once, and a restarted instance's startup sweep
+    aborts the dead instance's tmp debris."""
+    parts, n = 4, 800
+    broker = FakeBroker(session_timeout_s=0.5, revocation_drain_s=1.0)
+    broker.create_topic(TOPIC, parts)
+    tgt = str(tmp_path)
+    surv = _mk_proc_writer(broker, tgt, "sur")
+    victim = _mk_proc_writer(broker, tgt, "vic", open_s=30.0)
+    surv.start()
+    victim.start()
+    try:
+        _produce(broker, 0, n // 2, parts)
+        assert _drain(lambda: len(
+            surv.stats()["consumer"]["rebalance"]["assigned"]) == 2)
+        assert _drain(lambda: victim.total_written_records > 0)
+        pids = [s.pid for s in victim._procpool.slots]
+        assert all(pids)
+        victim.hard_kill()
+        # the children are really gone (SIGKILL, not a clean drain)
+        def _dead(pid):
+            try:
+                os.kill(pid, 0)
+            except OSError:
+                return True
+            return False
+        assert _drain(lambda: all(_dead(p) for p in pids), timeout=10)
+        # open-file debris survives the kill for the restart sweep
+        debris = glob.glob(f"{tgt}/tmp/vic_*.tmp")
+        assert debris, "expected the dead instance's tmp debris"
+        _produce(broker, n // 2, n, parts)
+        assert _drain(lambda: (
+            _committed(broker, parts) >= n
+            and surv.ack_lag()["unacked_records"] == 0), timeout=60)
+        stats = broker.group_stats("g", TOPIC)
+        assert stats["expired_members"] == 1
+        assert sorted(surv.stats()["consumer"]["rebalance"]["assigned"]) \
+            == list(range(parts))
+        # restarted instance (same name) sweeps the dead one's debris
+        w2 = (_builder(broker, tgt, "vic")
+              .clean_abandoned_tmp(True).build())
+        w2.start()
+        try:
+            assert not glob.glob(f"{tgt}/tmp/vic_*.tmp")
+            assert "rebalance_orphan_swept" in _kinds(w2)
+        finally:
+            w2.close()
+    finally:
+        surv.close()
+    _assert_exactly_once(tgt, n, parts)

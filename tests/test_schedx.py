@@ -140,6 +140,15 @@ def test_refinds_pr11_stale_death_notice_with_fix_reverted():
     assert len(hits) >= 2, "reverted death-notice pid check was not re-found"
 
 
+def test_refinds_pr19_revoke_backout_vs_free_with_fix_reverted():
+    """Negative control for the cross-process rebalance protocol: with
+    backout_units reverted to a shape that ignores the commit-to-send /
+    freed handshake, a revoked unit the child already freed is backed
+    out anyway and the same ring slot recycles twice."""
+    hits = _refound("proc-revoke-vs-free", schedcheck.DoubleRecycleError)
+    assert len(hits) >= 2, "reverted revoke back-out fix was not re-found"
+
+
 # -- CLI ----------------------------------------------------------------------
 
 @pytest.mark.slow

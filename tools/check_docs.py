@@ -312,6 +312,29 @@ CHECKS = [
                   r"`full_resets` \*\*(\d+)\*\*",
      ["rebalance:zombie.stale_commits_fenced",
       "rebalance:cooperative.full_resets"]),
+    # process-mode rebalance drills (`rebalproc:` prefix,
+    # BENCH_REBALANCE_PROCS_r23.json)
+    ("README.md", r"cross-process fence flush lands\s+\*\*([\d.]+) s\*\* "
+                  r"after the joiner",
+     ["rebalproc:handoff.join_to_first_fence_flush_s"]),
+    ("README.md", r"survivor drains after a \*\*([\d.]+) s\*\*\s+blackout",
+     ["rebalproc:kill.rebalance_blackout_seconds"]),
+    ("README.md", r"\*\*(\d+)\*\* stale child ack\s+fenced and its file "
+                  r"un-published",
+     ["rebalproc:zombie_child.victim_fenced_acks"]),
+    ("README.md", r"\*\*(\d+)\*\* rows\s+across the three process-mode "
+                  r"legs with \*\*(\d+)\*\* lost and \*\*(\d+)\*\*\s+"
+                  r"duplicated",
+     ["rebalproc:rows_total", "rebalproc:lost", "rebalproc:dups"]),
+    ("PARITY.md", r"`join_to_first_fence_flush_s` \*\*([\d.]+) s\*\*",
+     ["rebalproc:handoff.join_to_first_fence_flush_s"]),
+    ("PARITY.md", r"`rebalance_blackout_seconds` \*\*([\d.]+) s\*\* with\s+"
+                  r"`tmp_debris_after_kill` \*\*(\d+)\*\*",
+     ["rebalproc:kill.rebalance_blackout_seconds",
+      "rebalproc:kill.tmp_debris_after_kill"]),
+    ("PARITY.md", r"`victim_fenced_acks` \*\*(\d+)\*\* with the stale "
+                  r"publish\s+un-published",
+     ["rebalproc:zombie_child.victim_fenced_acks"]),
 ]
 
 
@@ -725,6 +748,13 @@ def main() -> int:
         os.path.join(ROOT, "BENCH_REBALANCE_r22.json"))
     if os.path.exists(rebalance_path):
         key_record["rebalance"] = json.load(open(rebalance_path))
+    # the process-mode rebalance-drill artifact (bench.py --rebalance
+    # --procs) is the sixteenth
+    rebalproc_path = os.environ.get(
+        "KPW_REBALANCE_PROCS_PATH",
+        os.path.join(ROOT, "BENCH_REBALANCE_PROCS_r23.json"))
+    if os.path.exists(rebalproc_path):
+        key_record["rebalproc"] = json.load(open(rebalproc_path))
     docs = {f: open(os.path.join(ROOT, f)).read()
             for f in ({c[0] for c in CHECKS} | set(KEY_DOCS)
                       | set(NAME_DOCS))}
@@ -767,6 +797,8 @@ def main() -> int:
                 root, spec = key_record.get("encodings", {}), spec[10:]
             elif spec.startswith("obs21:"):
                 root, spec = key_record.get("obs21", {}), spec[6:]
+            elif spec.startswith("rebalproc:"):
+                root, spec = key_record.get("rebalproc", {}), spec[10:]
             elif spec.startswith("rebalance:"):
                 root, spec = key_record.get("rebalance", {}), spec[10:]
             try:

@@ -95,6 +95,15 @@
 #                          unless every leg reads back exactly-once AND
 #                          the generation fence fired; committed
 #                          artifact never overwritten)
+#  17. proc-rebalance smoke — python bench.py --rebalance --procs
+#                          --smoke (the drills with SPAWNED worker
+#                          processes: revocation crossing the process
+#                          boundary as ring fence descriptors, whole-
+#                          instance SIGKILL with startup sweep, the
+#                          zombie CHILD parked inside its publish; exits
+#                          nonzero unless every leg reads back exactly-
+#                          once AND the cross-process fence flush fired;
+#                          committed artifact never overwritten)
 #
 # Usage: bash tools/ci.sh        (exit 0 = all gates green)
 
@@ -104,10 +113,10 @@ cd "$(dirname "$0")/.."
 fail=0
 step() { echo; echo "=== ci.sh [$1] $2 ==="; }
 
-step 1/16 "lint suite (python -m tools.analyze)"
+step 1/17 "lint suite (python -m tools.analyze)"
 python -m tools.analyze || fail=1
 
-step 2/16 "tier-1 pytest (-m 'not slow')"
+step 2/17 "tier-1 pytest (-m 'not slow')"
 # tier-1's exit code is nonzero on THIS container because of the known
 # environmental failures (python zstandard + jax shard_map absent — see
 # the CHANGES.md baseline), so the gate is mechanical instead of
@@ -130,47 +139,50 @@ if [ "$t1_errors" -gt 0 ] || [ "$t1_failed" -gt "$max_failed" ] \
 fi
 rm -f "$T1_LOG"
 
-step 3/16 "compaction smoke (bench.py --compact --smoke)"
+step 3/17 "compaction smoke (bench.py --compact --smoke)"
 JAX_PLATFORMS=cpu python bench.py --compact --smoke || fail=1
 
-step 4/16 "scan smoke (bench.py --scan --smoke)"
+step 4/17 "scan smoke (bench.py --scan --smoke)"
 JAX_PLATFORMS=cpu python bench.py --scan --smoke || fail=1
 
-step 5/16 "e2e smoke (bench.py --e2e --smoke)"
+step 5/17 "e2e smoke (bench.py --e2e --smoke)"
 JAX_PLATFORMS=cpu python bench.py --e2e --smoke || fail=1
 
-step 6/16 "process-mode smoke (bench.py --procs --smoke)"
+step 6/17 "process-mode smoke (bench.py --procs --smoke)"
 JAX_PLATFORMS=cpu python bench.py --procs --smoke || fail=1
 
-step 7/16 "object-store smoke (bench.py --objstore --smoke)"
+step 7/17 "object-store smoke (bench.py --objstore --smoke)"
 JAX_PLATFORMS=cpu python bench.py --objstore --smoke || fail=1
 
-step 8/16 "nested-replay smoke (bench.py --nested --smoke)"
+step 8/17 "nested-replay smoke (bench.py --nested --smoke)"
 JAX_PLATFORMS=cpu python bench.py --nested --smoke || fail=1
 
-step 9/16 "schedule-explorer smoke (python -m tools.schedx --smoke)"
+step 9/17 "schedule-explorer smoke (python -m tools.schedx --smoke)"
 JAX_PLATFORMS=cpu python -m tools.schedx --smoke || fail=1
 
-step 10/16 "doc reconciliation (tools/check_docs.py)"
+step 10/17 "doc reconciliation (tools/check_docs.py)"
 python tools/check_docs.py || fail=1
 
-step 11/16 "sanitizer smoke (tools/sanitize.sh --smoke)"
+step 11/17 "sanitizer smoke (tools/sanitize.sh --smoke)"
 bash tools/sanitize.sh --smoke || fail=1
 
-step 12/16 "tsan smoke (tools/sanitize.sh --tsan --smoke)"
+step 12/17 "tsan smoke (tools/sanitize.sh --tsan --smoke)"
 bash tools/sanitize.sh --tsan --smoke || fail=1
 
-step 13/16 "multi-tenant smoke (bench.py --tenants --smoke)"
+step 13/17 "multi-tenant smoke (bench.py --tenants --smoke)"
 JAX_PLATFORMS=cpu python bench.py --tenants --smoke || fail=1
 
-step 14/16 "adaptive-encodings smoke (bench.py --encodings --smoke)"
+step 14/17 "adaptive-encodings smoke (bench.py --encodings --smoke)"
 JAX_PLATFORMS=cpu python bench.py --encodings --smoke || fail=1
 
-step 15/16 "telemetry-plane smoke (bench.py --obs --smoke)"
+step 15/17 "telemetry-plane smoke (bench.py --obs --smoke)"
 JAX_PLATFORMS=cpu python bench.py --obs --smoke || fail=1
 
-step 16/16 "rebalance smoke (bench.py --rebalance --smoke)"
+step 16/17 "rebalance smoke (bench.py --rebalance --smoke)"
 JAX_PLATFORMS=cpu python bench.py --rebalance --smoke || fail=1
+
+step 17/17 "proc-rebalance smoke (bench.py --rebalance --procs --smoke)"
+JAX_PLATFORMS=cpu python bench.py --rebalance --procs --smoke || fail=1
 
 echo
 if [ "$fail" -ne 0 ]; then

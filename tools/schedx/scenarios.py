@@ -491,6 +491,74 @@ def stale_commit_fence(seed: int, revert: bool = False,
     return checker
 
 
+# ---------------------------------------------------------------------------
+# scenario: revocation back-out vs. collector free (PR-19 cross-process fence)
+# ---------------------------------------------------------------------------
+
+def _legacy_backout_units(self, parts):
+    # PR-19 PRE-FIX shape, reintroduced test-locally: revoked units are
+    # popped with no regard for the commit-to-send / freed handshake —
+    # an entry whose unit the dispatcher already sent (and whose ring
+    # slot the child already freed back through the collector) is backed
+    # out anyway, recycling the same ring slot a second time
+    with self._mu:
+        out = []
+        for seq, e in list(self._ledger.items()):
+            if e["runs"] and all(r[0] in parts for r in e["runs"]):
+                self._ledger.pop(seq)
+                self._unacked_count = max(
+                    0, self._unacked_count - e["count"])
+                out.append(e["slot"])
+        return out
+
+
+def proc_revoke_vs_free(seed: int, revert: bool = False,
+                        virtual: bool = False):
+    """The rebalance listener backs out a revoked unit while the
+    collector handles the child's ``free`` ack for the same ring slot
+    (the unit was dispatched after all — the revocation raced the
+    commit-to-send window).  The fixed ``backout_units`` only takes
+    entries with ``sent=False and freed=False`` under the ledger lock,
+    so exactly one party recycles; the double-recycle probe in
+    ``ProcessWorkerPool._recycle_slot`` rejects any schedule where both
+    do."""
+    from kpw_tpu.runtime import procworkers as pw
+
+    # one-sided perturbation (see ring_free_respawn): only the back-out
+    # party parks — at ``proc.revoke.backout``, BEFORE its ledger pop —
+    # so a seed's verdict depends on its own coin alone
+    checker = schedcheck.install(
+        seed=seed, virtual=virtual, max_delay_s=0.25,
+        labels=("proc.revoke.backout",))
+    patches = []
+    if revert:
+        patches.append(_Patch(pw._ProcWorkerSlot, "backout_units",
+                              _legacy_backout_units))
+    tmpdir = tempfile.mkdtemp(prefix="schedx-revoke-")
+    try:
+        pool = _make_pool(tmpdir)
+        try:
+            ri = pool._get_free_slot()
+            slot = pool.slots[0]
+            slot.note_dispatch(seq=1, runs=[(3, 0, 64)], count=64,
+                               nbytes=128, slot_idx=ri)
+            # the dispatcher committed to sending: the child WILL free
+            # this slot, so the revocation back-out must leave it alone
+            slot.mark_sent(1)
+            _run_threads([
+                lambda: pool._handle(("free", 0, ri, 1)),
+                lambda: pool.backout_undispatched(slot, frozenset({3})),
+            ])
+        finally:
+            _close_pool(pool)
+    finally:
+        for p in patches:
+            p.undo()
+        schedcheck.uninstall()
+        shutil.rmtree(tmpdir, ignore_errors=True)
+    return checker
+
+
 # registration order = report order; names are the CLI / seeds.json keys
 SCENARIOS = {
     "ring-free-respawn": ring_free_respawn,
@@ -498,6 +566,7 @@ SCENARIOS = {
     "uploader-spawn-race": uploader_spawn_race,
     "stale-death-notice": stale_death_notice,
     "stale-commit-fence": stale_commit_fence,
+    "proc-revoke-vs-free": proc_revoke_vs_free,
 }
 
 # which historical PR the reverted fix belongs to (reporting only)
@@ -507,4 +576,5 @@ HISTORY = {
     "uploader-spawn-race": "PR-12 uploader-thread spawn race",
     "stale-death-notice": "PR-11 stale death notice",
     "stale-commit-fence": "PR-18 zombie commit vs cooperative handoff",
+    "proc-revoke-vs-free": "PR-19 revocation back-out vs collector free",
 }
